@@ -1,0 +1,52 @@
+"""TPU-native lag-balanced Kafka partition-assignment framework.
+
+A ground-up JAX/XLA re-design of the capabilities of
+``com.github.grantneale:kafka-lag-based-assignor`` (reference under
+/root/reference): a ``partition.assignment.strategy`` plugin whose
+combinatorial core — per-topic greedy LPT multiway number partitioning in
+descending-lag order with count-primary / lag-secondary / member-id-tertiary
+selection — runs as a batched TPU kernel, while lag acquisition and group
+bookkeeping stay host-side.
+
+Layers (mirroring SURVEY.md §1):
+* L1 plugin adapter  — :mod:`.assignor` (configure / name / assign)
+* L2 lag acquisition — :mod:`.lag` (broker RPC shell + pure lag formula)
+* L3 assignment core — :mod:`.models.greedy` (host oracle),
+                       :mod:`.ops` (TPU kernels),
+                       :mod:`.models.sinkhorn` (OT relaxation)
+* parallel           — :mod:`.parallel` (device-mesh sharding)
+* utils              — :mod:`.utils` (config, structured observability)
+"""
+
+from .types import (
+    Assignment,
+    Cluster,
+    GroupAssignment,
+    GroupSubscription,
+    OffsetAndMetadata,
+    PartitionInfo,
+    Subscription,
+    TopicPartition,
+    TopicPartitionLag,
+)
+from .lag import compute_partition_lag, read_topic_partition_lags
+from .models.greedy import assign_greedy, consumers_per_topic
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Assignment",
+    "Cluster",
+    "GroupAssignment",
+    "GroupSubscription",
+    "OffsetAndMetadata",
+    "PartitionInfo",
+    "Subscription",
+    "TopicPartition",
+    "TopicPartitionLag",
+    "compute_partition_lag",
+    "read_topic_partition_lags",
+    "assign_greedy",
+    "consumers_per_topic",
+    "__version__",
+]

@@ -1,0 +1,124 @@
+"""Lag acquisition (the I/O layer, L2) and the pure lag formula.
+
+Reference semantics reproduced exactly:
+
+* ``compute_partition_lag`` — LagBasedPartitionAssignor.java:376-404:
+  committed offset wins; otherwise ``auto.offset.reset=latest`` means lag 0
+  and any other mode means the full backlog (end - begin); the result is
+  clamped to >= 0 to guard failed end-offset reads.
+* ``read_topic_partition_lags`` — LagBasedPartitionAssignor.java:317-365:
+  per topic, consult cluster metadata; if a topic has no metadata, warn and
+  skip it; otherwise batch-read beginning/end/committed offsets from the
+  broker client and compute per-partition lag.
+
+The broker client is abstracted behind ``MetadataConsumer`` so the I/O shell
+is testable with a fake — the reference left this layer untested (SURVEY §4).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Iterable, List, Mapping, Optional, Protocol, Sequence, Set
+
+from .types import (
+    Cluster,
+    LagMap,
+    OffsetAndMetadata,
+    TopicPartition,
+    TopicPartitionLag,
+)
+
+LOGGER = logging.getLogger(__name__)
+
+
+def compute_partition_lag(
+    partition_metadata: Optional[OffsetAndMetadata],
+    begin_offset: int,
+    end_offset: int,
+    auto_offset_reset_mode: str,
+) -> int:
+    """Pure lag formula; exact parity with reference :376-404.
+
+    lag = max(end_offset - next_offset, 0) where next_offset is the committed
+    offset if present, else end_offset when auto.offset.reset=latest
+    (case-insensitive), else begin_offset (earliest / none / anything else).
+    """
+    if partition_metadata is not None:
+        next_offset = partition_metadata.offset
+    elif auto_offset_reset_mode.lower() == "latest":
+        next_offset = end_offset
+    else:
+        # assume earliest (reference :393-396: any non-"latest" mode,
+        # including "none", takes the earliest branch)
+        next_offset = begin_offset
+    return max(end_offset - next_offset, 0)
+
+
+class MetadataConsumer(Protocol):
+    """The slice of KafkaConsumer the lag reader uses (reference :339-342).
+
+    Three blocking batch RPCs per topic: ListOffsets (begin), ListOffsets
+    (end), OffsetFetch (committed).  Exceptions are deliberately NOT caught —
+    a broker failure must abort the rebalance, matching reference semantics
+    (SURVEY §2.4.9).
+    """
+
+    def beginning_offsets(
+        self, partitions: Sequence[TopicPartition]
+    ) -> Mapping[TopicPartition, int]: ...
+
+    def end_offsets(
+        self, partitions: Sequence[TopicPartition]
+    ) -> Mapping[TopicPartition, int]: ...
+
+    def committed(
+        self, partitions: Set[TopicPartition]
+    ) -> Mapping[TopicPartition, Optional[OffsetAndMetadata]]: ...
+
+
+def read_topic_partition_lags(
+    metadata_consumer: MetadataConsumer,
+    cluster: Cluster,
+    all_subscribed_topics: Iterable[str],
+    auto_offset_reset_mode: str = "latest",
+) -> LagMap:
+    """Fetch current consumer-group lag for every partition of every topic.
+
+    Exact behavioral parity with reference :317-365:
+    * topics with null/empty cluster metadata are warned about and excluded
+      from the result map entirely (:358-360);
+    * missing begin/end offsets for a partition default to 0 (:350-351);
+    * ``committed`` may omit partitions or map them to None — both mean "no
+      committed offset" (:349).
+    """
+    topic_partition_lags: Dict[str, List[TopicPartitionLag]] = {}
+    for topic in all_subscribed_topics:
+        partition_info = cluster.partitions_for_topic(topic)
+        if not partition_info:
+            LOGGER.warning(
+                "Skipping assignment for topic %s since no metadata is available",
+                topic,
+            )
+            continue
+
+        topic_partitions = [
+            TopicPartition(p.topic, p.partition) for p in partition_info
+        ]
+        rows: List[TopicPartitionLag] = []
+
+        # The three batch RPCs — the only network boundary in the plugin.
+        begin_offsets = metadata_consumer.beginning_offsets(topic_partitions)
+        end_offsets = metadata_consumer.end_offsets(topic_partitions)
+        committed = metadata_consumer.committed(set(topic_partitions))
+
+        for tp in topic_partitions:
+            lag = compute_partition_lag(
+                committed.get(tp),
+                begin_offsets.get(tp, 0),
+                end_offsets.get(tp, 0),
+                auto_offset_reset_mode,
+            )
+            rows.append(TopicPartitionLag(tp.topic, tp.partition, lag))
+        topic_partition_lags[topic] = rows
+
+    return topic_partition_lags

@@ -1,0 +1,5 @@
+"""Solver families: the exact greedy oracle and relaxation-based solvers."""
+
+from .greedy import assign_greedy, assign_topic_greedy, consumers_per_topic
+
+__all__ = ["assign_greedy", "assign_topic_greedy", "consumers_per_topic"]

@@ -1,0 +1,92 @@
+"""Host-side greedy LPT oracle — the reference-semantics ground truth.
+
+This is the pure assignment core (layer L3 of the reference,
+LagBasedPartitionAssignor.java:166-308) re-stated as a plain Python function.
+It exists for three reasons:
+
+1. **Oracle** for differential testing of the TPU kernels (bit-exact parity).
+2. **Fallback** path so a rebalance never fails because the accelerator is
+   unreachable (SURVEY §5, failure-detection row).
+3. Executable specification of the semantics the kernels must reproduce
+   (SURVEY §2.4): count-primary / lag-secondary / member-id-tertiary
+   selection, lag-descending / partition-id-ascending processing order,
+   per-topic independence, every member present in the output.
+
+Unlike the reference, the input lag lists are NOT mutated (SURVEY §2.4.10
+calls the in-place sort an implementation wart, not a contract).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Mapping, Sequence
+
+from ..types import AssignmentMap, TopicPartition, TopicPartitionLag
+
+
+def consumers_per_topic(
+    subscriptions: Mapping[str, Sequence[str]],
+) -> Dict[str, List[str]]:
+    """Invert member->topics into topic->members (reference :410-426).
+
+    Member order within each topic list follows the iteration order of
+    ``subscriptions`` — irrelevant to the result because selection ends in a
+    total order over member ids (SURVEY §2.4.2).
+    """
+    result: Dict[str, List[str]] = {}
+    for member_id, topics in subscriptions.items():
+        for topic in topics:
+            result.setdefault(topic, []).append(member_id)
+    return result
+
+
+def assign_topic_greedy(
+    assignment: AssignmentMap,
+    topic: str,
+    consumers: Sequence[str],
+    partition_lags: Sequence[TopicPartitionLag],
+) -> None:
+    """Greedy LPT for one topic, appended into ``assignment`` in place.
+
+    Exact reference semantics (:204-308): process partitions in descending
+    lag (ties: ascending partition id); each partition goes to the consumer
+    minimizing (assigned count, total assigned lag, member id).
+    """
+    if not consumers:
+        return
+
+    total_lag = {m: 0 for m in consumers}
+    total_count = {m: 0 for m in consumers}
+
+    ordered = sorted(partition_lags, key=lambda p: (-p.lag, p.partition))
+    for part in ordered:
+        member = min(consumers, key=lambda m: (total_count[m], total_lag[m], m))
+        assignment[member].append(TopicPartition(part.topic, part.partition))
+        total_lag[member] += part.lag
+        total_count[member] += 1
+
+
+def assign_greedy(
+    partition_lag_per_topic: Mapping[str, Sequence[TopicPartitionLag]],
+    subscriptions: Mapping[str, Sequence[str]],
+) -> AssignmentMap:
+    """The pure core: (topic lags, member subscriptions) -> member assignments.
+
+    Parity points with reference :166-188:
+    * every member appears in the output, possibly with an empty list (:171-174);
+    * topics missing from the lag map assign nothing (:182);
+    * topics are independent — lag is never balanced across topics (§2.4.3).
+
+    Topics are processed in sorted order for run-to-run determinism of the
+    *per-member partition list order* (the reference's order depends on
+    HashMap iteration; the assignment *content* is order-independent).
+    """
+    assignment: AssignmentMap = {member: [] for member in subscriptions}
+    by_topic = consumers_per_topic(subscriptions)
+    for topic in sorted(by_topic):
+        assign_topic_greedy(
+            assignment,
+            topic,
+            by_topic[topic],
+            partition_lag_per_topic.get(topic, ()),
+        )
+    return assignment

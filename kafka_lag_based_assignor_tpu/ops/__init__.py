@@ -1,0 +1,1 @@
+"""TPU (JAX/XLA) assignment kernels."""

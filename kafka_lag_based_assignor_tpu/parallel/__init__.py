@@ -1,0 +1,1 @@
+"""Device-mesh sharding for multi-chip assignment."""

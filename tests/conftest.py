@@ -1,0 +1,21 @@
+"""Test configuration: force a virtual 8-device CPU platform before JAX init.
+
+Multi-chip hardware is not available in CI; sharding tests run on a virtual
+8-device CPU mesh (jax.sharding.Mesh over forced host devices).  int64 lags
+require x64 mode (SURVEY §7 step 2).
+"""
+
+import os
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,41 @@
+"""Lag-formula tests — ports of the 4 computePartitionLag reference tests
+(LagBasedPartitionAssignorTest.java:21-80) plus edge cases the reference
+left uncovered."""
+
+from kafka_lag_based_assignor_tpu import OffsetAndMetadata, compute_partition_lag
+
+
+def test_compute_partition_lag():
+    # Test.java:21-33 — lag = end - committed
+    assert compute_partition_lag(OffsetAndMetadata(5555), 1111, 9999, "none") == 4444
+
+
+def test_compute_partition_lag_no_end_offset():
+    # Test.java:38-50 — offsets read as 0 but committed=5555 => clamp to 0
+    assert compute_partition_lag(OffsetAndMetadata(5555), 0, 0, "none") == 0
+
+
+def test_compute_partition_lag_no_committed_offset_reset_mode_latest():
+    # Test.java:52-64 — no committed + latest => 0
+    assert compute_partition_lag(None, 1111, 9999, "latest") == 0
+
+
+def test_compute_partition_lag_no_committed_offset_reset_mode_earliest():
+    # Test.java:66-80 — no committed + earliest => end - begin
+    assert compute_partition_lag(None, 1111, 9999, "earliest") == 9999 - 1111
+
+
+def test_reset_mode_latest_is_case_insensitive():
+    # reference :391 uses equalsIgnoreCase
+    assert compute_partition_lag(None, 1111, 9999, "LATEST") == 0
+    assert compute_partition_lag(None, 1111, 9999, "Latest") == 0
+
+
+def test_reset_mode_none_takes_earliest_branch():
+    # reference :393-396 — any non-"latest" mode assumes earliest
+    assert compute_partition_lag(None, 100, 250, "none") == 150
+
+
+def test_committed_ahead_of_end_clamps_to_zero():
+    # reference :400-402 — max(end - next, 0)
+    assert compute_partition_lag(OffsetAndMetadata(300), 0, 250, "latest") == 0

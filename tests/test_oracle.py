@@ -1,0 +1,149 @@
+"""Host-oracle tests — ports of the 3 assignment reference tests
+(LagBasedPartitionAssignorTest.java:82-228) plus invariants the reference
+documents but never asserted (SURVEY §2.4, §4 coverage gaps)."""
+
+from kafka_lag_based_assignor_tpu import TopicPartition, TopicPartitionLag, assign_greedy
+
+
+def tpl(topic, rows):
+    return [TopicPartitionLag(topic, p, lag) for p, lag in rows]
+
+
+def test_assign_golden():
+    """Golden multi-topic test — exact map pinned by Test.java:82-132."""
+    partition_lag_per_topic = {
+        "topic1": tpl("topic1", [(0, 100000), (1, 100000), (2, 500), (3, 1)]),
+        "topic2": tpl("topic2", [(0, 900000), (1, 100000)]),
+    }
+    subscriptions = {
+        "consumer-1": ["topic1", "topic2"],
+        "consumer-2": ["topic1"],
+    }
+    expected = {
+        "consumer-1": [
+            TopicPartition("topic1", 0),
+            TopicPartition("topic1", 2),
+            TopicPartition("topic2", 0),
+            TopicPartition("topic2", 1),
+        ],
+        "consumer-2": [
+            TopicPartition("topic1", 1),
+            TopicPartition("topic1", 3),
+        ],
+    }
+    assert assign_greedy(partition_lag_per_topic, subscriptions) == expected
+
+
+def test_assign_with_zero_lags():
+    """Test.java:134-175 — 7 all-zero-lag partitions / 2 consumers:
+    max - min assigned count <= 1."""
+    lags = {"topic1": tpl("topic1", [(p, 0) for p in range(7)])}
+    subs = {"consumer-1": ["topic1"], "consumer-2": ["topic1"]}
+    result = assign_greedy(lags, subs)
+    sizes = [len(v) for v in result.values()]
+    assert max(sizes) <= min(sizes) + 1
+    assert sum(sizes) == 7
+
+
+def test_assign_with_heavily_skewed_lags():
+    """Test.java:177-228 — two ~450k-lag hot partitions among 10, 3 consumers,
+    count not divisible by consumers: max - min count <= 1."""
+    rows = [
+        (0, 360), (1, 359), (2, 230), (3, 118), (4, 444),
+        (5, 122), (6, 65), (7, 111), (8, 455000), (9, 424000),
+    ]
+    lags = {"topic1": tpl("topic1", rows)}
+    subs = {f"consumer-{i}": ["topic1"] for i in (1, 2, 3)}
+    result = assign_greedy(lags, subs)
+    sizes = [len(v) for v in result.values()]
+    assert max(sizes) <= min(sizes) + 1
+    assert sum(sizes) == 10
+    # The reference's TODO (Test.java:226): the consumers carrying the hot
+    # partitions should get the fewest partitions.  With 10 partitions over
+    # 3 consumers, the two hot-partition holders get 3 each and the rest of
+    # the lag piles onto the third.
+    hot = {TopicPartition("topic1", 8), TopicPartition("topic1", 9)}
+    for member, parts in result.items():
+        if hot & set(parts):
+            assert len(parts) == min(sizes)
+
+
+def test_readme_worked_example():
+    """README.md:40-69 — t0 lags 100k/50k/60k, 2 consumers =>
+    C0=[t0p0], C1=[t0p1, t0p2]."""
+    lags = {"t0": tpl("t0", [(0, 100000), (1, 50000), (2, 60000)])}
+    subs = {"C0": ["t0"], "C1": ["t0"]}
+    result = assign_greedy(lags, subs)
+    assert result["C0"] == [TopicPartition("t0", 0)]
+    # README lists C1 as [t0p1, t0p2] in display order; append order is by
+    # descending lag (p2=60k before p1=50k).
+    assert set(result["C1"]) == {TopicPartition("t0", 1), TopicPartition("t0", 2)}
+    assert sum(l.lag for l in lags["t0"] if TopicPartition("t0", l.partition) in result["C1"]) == 110000
+
+
+def test_unassigned_member_present_with_empty_list():
+    """SURVEY §2.4.4 — every member appears in the output (reference :171-174)."""
+    lags = {"t0": tpl("t0", [(0, 5)])}
+    subs = {"a": ["t0"], "b": ["other-topic"]}
+    result = assign_greedy(lags, subs)
+    assert result["b"] == []
+    assert result["a"] == [TopicPartition("t0", 0)]
+
+
+def test_topic_without_lag_data_assigns_nothing():
+    """SURVEY §2.4.5 — topic missing from the lag map terminates cleanly
+    (reference :182 getOrDefault(emptyList))."""
+    subs = {"a": ["ghost"], "b": ["ghost"]}
+    assert assign_greedy({}, subs) == {"a": [], "b": []}
+
+
+def test_topic_with_no_consumers_is_skipped():
+    """reference :211-213 early-return — lag rows for an unsubscribed topic
+    are ignored."""
+    lags = {"t0": tpl("t0", [(0, 5)]), "t1": tpl("t1", [(0, 7)])}
+    subs = {"a": ["t0"]}
+    assert assign_greedy(lags, subs) == {"a": [TopicPartition("t0", 0)]}
+
+
+def test_tie_break_member_id_lexicographic():
+    """SURVEY §2.4.2 — equal count and equal lag resolve to the
+    lexicographically smallest member id (reference :259)."""
+    lags = {"t0": tpl("t0", [(0, 10)])}
+    subs = {"zz": ["t0"], "aa": ["t0"], "mm": ["t0"]}
+    result = assign_greedy(lags, subs)
+    assert result["aa"] == [TopicPartition("t0", 0)]
+
+
+def test_sort_tie_break_partition_id_ascending():
+    """reference :228-235 — equal lags process in ascending partition order."""
+    lags = {"t0": tpl("t0", [(3, 5), (1, 5), (2, 5), (0, 5)])}
+    subs = {"a": ["t0"], "b": ["t0"]}
+    result = assign_greedy(lags, subs)
+    # order: p0,p1,p2,p3 -> a,b then (counts tie, lags tie at 5) a,b
+    assert result == {
+        "a": [TopicPartition("t0", 0), TopicPartition("t0", 2)],
+        "b": [TopicPartition("t0", 1), TopicPartition("t0", 3)],
+    }
+
+
+def test_cross_topic_lag_not_balanced():
+    """SURVEY §2.4.3 — per-topic independence: a member's lag from one topic
+    never influences another topic's assignment."""
+    lags = {
+        "t0": tpl("t0", [(0, 10**12)]),
+        "t1": tpl("t1", [(0, 1), (1, 1)]),
+    }
+    subs = {"a": ["t0", "t1"], "b": ["t0", "t1"]}
+    result = assign_greedy(lags, subs)
+    # t0p0 -> a (tie-break id).  In t1, counts reset: p0 -> a, p1 -> b,
+    # despite a holding a trillion lag from t0.
+    assert TopicPartition("t1", 0) in result["a"]
+    assert TopicPartition("t1", 1) in result["b"]
+
+
+def test_input_not_mutated():
+    """Improvement over the reference's in-place sort (SURVEY §2.4.10)."""
+    rows = tpl("t0", [(1, 5), (0, 9)])
+    original = list(rows)
+    assign_greedy({"t0": rows}, {"a": ["t0"]})
+    assert rows == original
