@@ -1,0 +1,123 @@
+"""General greedy-LPT assignment kernel: ``lax.scan`` over sorted partitions.
+
+This is the direct device-side statement of the reference's hot loop
+(LagBasedPartitionAssignor.java:237-277): process partitions in descending
+lag (ties: ascending partition id, :228-235) and give each to the consumer
+minimizing the 3-level key (assigned count, total assigned lag, member rank)
+(:246-259).  The O(C) linear ``Collections.min`` becomes a C-wide vectorized
+two-stage lexicographic argmin per scan step; the scan has P sequential
+steps.
+
+Use this kernel as the always-correct reference path and for differential
+testing; :mod:`.rounds_kernel` is the fast path (P/C sequential steps) that
+exploits the count-primary round structure.
+
+Conventions (shared by all kernels in :mod:`..ops`):
+* consumers are dense indices ``0..C-1`` = rank in the lexicographically
+  sorted member-id list, so "lowest index" == "lexicographically smallest
+  member id" and integer argmin reproduces the string tie-break exactly;
+* ``lags`` are non-negative (the lag formula clamps, reference :400-402);
+* padding rows have ``valid=False`` and are ignored;
+* output ``choice[i]`` is the consumer index for input row ``i``
+  (input order, NOT sorted order), ``-1`` for padding rows.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def sort_partitions(lags: jax.Array, partition_ids: jax.Array, valid: jax.Array):
+    """Return the processing-order permutation: lag desc, partition id asc,
+    padding last (reference :228-235).
+
+    Works because valid lags are >= 0: negated they are <= 0, and padding
+    gets key +1 which sorts after every valid row in ascending order.
+    """
+    neg_lag = jnp.where(valid, -lags, 1)
+    pid_key = jnp.where(valid, partition_ids, jnp.iinfo(jnp.int32).max)
+    idx = jnp.arange(lags.shape[0], dtype=jnp.int32)
+    _, _, perm = lax.sort((neg_lag, pid_key, idx), num_keys=2)
+    return perm
+
+
+def _argmin_consumer(counts: jax.Array, totals: jax.Array, eligible: jax.Array):
+    """Two-stage lexicographic argmin over (count, total lag, index).
+
+    Exact analogue of the reference comparator (:246-259): smallest assigned
+    count, then smallest total lag, then smallest index (= lexicographically
+    smallest member id under the rank convention).  No key packing — lags
+    use the full int64 range, so a packed single key would overflow
+    (SURVEY §7 hard parts).
+    """
+    big_count = jnp.iinfo(counts.dtype).max
+    key1 = jnp.where(eligible, counts, big_count)
+    mask1 = key1 == jnp.min(key1)
+    big_total = jnp.iinfo(totals.dtype).max
+    key2 = jnp.where(mask1, totals, big_total)
+    mask2 = mask1 & (key2 == jnp.min(key2))
+    return jnp.argmax(mask2).astype(jnp.int32)  # first True = smallest index
+
+
+@functools.partial(jax.jit, static_argnames=("num_consumers",))
+def assign_topic_scan(
+    lags: jax.Array,
+    partition_ids: jax.Array,
+    valid: jax.Array,
+    num_consumers: int,
+    eligible: jax.Array | None = None,
+):
+    """Assign one topic's partitions to ``num_consumers`` consumers.
+
+    Args:
+      lags: int lag per partition row, shape [P] (padded).
+      partition_ids: int32 partition id per row, shape [P].
+      valid: bool mask, shape [P]; False rows are padding.
+      num_consumers: static consumer count C.
+      eligible: optional bool[C]; ineligible consumers never receive
+        partitions.  Default: all eligible (the host passes only subscribed
+        consumers per topic, reference :176-183).
+
+    Returns:
+      (choice int32[P] in input order with -1 padding,
+       counts int32[C], totals lag-dtype[C]).
+    """
+    P = lags.shape[0]
+    C = int(num_consumers)
+    if eligible is None:
+        eligible = jnp.ones((C,), dtype=bool)
+
+    perm = sort_partitions(lags, partition_ids, valid)
+    sorted_lags = lags[perm]
+    sorted_valid = valid[perm]
+
+    # With no eligible consumer nothing may be assigned; without this guard
+    # the masked argmin would degenerate (all keys saturate to the sentinel)
+    # and silently hand every partition to consumer 0.
+    any_eligible = jnp.any(eligible)
+
+    def step(carry, x):
+        counts, totals = carry
+        lag, is_valid = x
+        assignable = is_valid & any_eligible
+        who = _argmin_consumer(counts, totals, eligible)
+        one_hot = (jnp.arange(C, dtype=jnp.int32) == who) & assignable
+        counts = counts + one_hot.astype(counts.dtype)
+        totals = totals + jnp.where(one_hot, lag, 0).astype(totals.dtype)
+        return (counts, totals), jnp.where(assignable, who, -1)
+
+    init = (
+        jnp.zeros((C,), dtype=jnp.int32),
+        jnp.zeros((C,), dtype=lags.dtype),
+    )
+    (counts, totals), sorted_choice = lax.scan(
+        step, init, (sorted_lags, sorted_valid)
+    )
+
+    # Scatter choices back to input row order.
+    choice = jnp.full((P,), -1, dtype=jnp.int32).at[perm].set(sorted_choice)
+    return choice, counts, totals

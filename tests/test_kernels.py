@@ -1,0 +1,167 @@
+"""Kernel parity tests: both JAX kernels must reproduce the host oracle
+bit-exactly — golden tests plus differential fuzzing (SURVEY §4: the parity
+suite adds a differential oracle and fuzzes the kernel against it)."""
+
+import numpy as np
+import pytest
+
+from kafka_lag_based_assignor_tpu import TopicPartition, TopicPartitionLag, assign_greedy
+from kafka_lag_based_assignor_tpu.ops.dispatch import assign_device, assign_topic_device
+
+KERNELS = ["scan", "rounds"]
+
+
+def tpl(topic, rows):
+    return [TopicPartitionLag(topic, p, lag) for p, lag in rows]
+
+
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_golden_assign(kernel):
+    """The reference golden test (Test.java:82-132) through the device path."""
+    lags = {
+        "topic1": tpl("topic1", [(0, 100000), (1, 100000), (2, 500), (3, 1)]),
+        "topic2": tpl("topic2", [(0, 900000), (1, 100000)]),
+    }
+    subs = {"consumer-1": ["topic1", "topic2"], "consumer-2": ["topic1"]}
+    expected = {
+        "consumer-1": [
+            TopicPartition("topic1", 0),
+            TopicPartition("topic1", 2),
+            TopicPartition("topic2", 0),
+            TopicPartition("topic2", 1),
+        ],
+        "consumer-2": [
+            TopicPartition("topic1", 1),
+            TopicPartition("topic1", 3),
+        ],
+    }
+    assert assign_device(lags, subs, kernel=kernel) == expected
+
+
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_readme_example(kernel):
+    lags = {"t0": tpl("t0", [(0, 100000), (1, 50000), (2, 60000)])}
+    subs = {"C0": ["t0"], "C1": ["t0"]}
+    result = assign_device(lags, subs, kernel=kernel)
+    assert result["C0"] == [TopicPartition("t0", 0)]
+    assert result["C1"] == [TopicPartition("t0", 2), TopicPartition("t0", 1)]
+
+
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_zero_lags_balance(kernel):
+    lags = {"t": tpl("t", [(p, 0) for p in range(7)])}
+    subs = {"c1": ["t"], "c2": ["t"]}
+    sizes = [len(v) for v in assign_device(lags, subs, kernel=kernel).values()]
+    assert max(sizes) - min(sizes) <= 1 and sum(sizes) == 7
+
+
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_empty_topic_and_empty_member(kernel):
+    lags = {"t": tpl("t", [(0, 9)])}
+    subs = {"a": ["t"], "b": ["ghost"]}
+    assert assign_device(lags, subs, kernel=kernel) == {
+        "a": [TopicPartition("t", 0)],
+        "b": [],
+    }
+
+
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_single_consumer_gets_everything(kernel):
+    lags = {"t": tpl("t", [(p, p * 7) for p in range(13)])}
+    result = assign_device(lags, {"only": ["t"]}, kernel=kernel)
+    assert len(result["only"]) == 13
+
+
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_more_consumers_than_partitions(kernel):
+    lags = {"t": tpl("t", [(0, 100), (1, 50)])}
+    subs = {m: ["t"] for m in ["m1", "m2", "m3", "m4", "m5"]}
+    result = assign_device(lags, subs, kernel=kernel)
+    # 2 partitions over 5 consumers: smallest-id consumers win the ties.
+    assert result["m1"] == [TopicPartition("t", 0)]
+    assert result["m2"] == [TopicPartition("t", 1)]
+    assert all(result[m] == [] for m in ["m3", "m4", "m5"])
+
+
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_int64_scale_lags(kernel):
+    """Lags near 2^62 — kernels must not overflow or lose precision
+    (SURVEY §7: int64 throughout, no packed keys)."""
+    big = 2**62
+    lags = {"t": tpl("t", [(0, big), (1, big - 1), (2, 1), (3, 0)])}
+    subs = {"a": ["t"], "b": ["t"]}
+    assert assign_device(lags, subs, kernel=kernel) == assign_greedy(lags, subs)
+
+
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_fuzz_differential_vs_oracle(kernel):
+    """Random instances: device result must equal the host oracle exactly —
+    same members, same partitions, same per-member list order."""
+    rng = np.random.default_rng(0)
+    for trial in range(60):
+        n_topics = int(rng.integers(1, 4))
+        n_members = int(rng.integers(1, 7))
+        members = [f"m{j:02d}" for j in range(n_members)]
+        lag_map = {}
+        subs = {m: [] for m in members}
+        for t in range(n_topics):
+            topic = f"topic{t}"
+            n_parts = int(rng.integers(0, 23))
+            # Heavy tie density: draw lags from a tiny support half the time.
+            if rng.random() < 0.5:
+                vals = rng.integers(0, 3, size=n_parts)
+            else:
+                vals = rng.integers(0, 10**12, size=n_parts)
+            lag_map[topic] = tpl(topic, [(p, int(v)) for p, v in enumerate(vals)])
+            for m in members:
+                if rng.random() < 0.7:
+                    subs[m].append(topic)
+        # Ensure at least one member subscribes somewhere.
+        if all(not v for v in subs.values()):
+            subs[members[0]].append("topic0")
+        expected = assign_greedy(lag_map, subs)
+        actual = assign_device(lag_map, subs, kernel=kernel)
+        assert actual == expected, f"trial {trial} diverged for kernel {kernel}"
+
+
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_duplicate_topic_subscription_dedupes(kernel):
+    """A member listing a topic twice must not become two phantom consumers
+    (reference dedupes via map-keyed accumulators, :216-225)."""
+    lags = {"t": tpl("t", [(0, 5), (1, 5), (2, 5)])}
+    subs = {"a": ["t", "t"], "b": ["t"]}
+    assert assign_device(lags, subs, kernel=kernel) == assign_greedy(lags, subs)
+
+
+def test_scan_all_ineligible_assigns_nothing():
+    """eligible=all-False must yield -1 choices, not hand everything to
+    consumer 0."""
+    import numpy as np
+    from kafka_lag_based_assignor_tpu.ops.scan_kernel import assign_topic_scan
+
+    choice, counts, totals = assign_topic_scan(
+        np.array([5, 3], dtype=np.int64),
+        np.array([0, 1], dtype=np.int32),
+        np.array([True, True]),
+        num_consumers=2,
+        eligible=np.array([False, False]),
+    )
+    assert list(np.asarray(choice)) == [-1, -1]
+    assert int(np.asarray(counts).sum()) == 0
+
+
+def test_scan_vs_rounds_cross_check():
+    """The two kernels must agree with each other on larger instances than
+    the oracle can comfortably cover."""
+    rng = np.random.default_rng(1)
+    for _ in range(5):
+        P = int(rng.integers(50, 400))
+        C = int(rng.integers(1, 33))
+        lag_map = {
+            "t": tpl("t", [(p, int(v)) for p, v in
+                           enumerate(rng.integers(0, 10**9, size=P))])
+        }
+        subs = {f"m{j:03d}": ["t"] for j in range(C)}
+        assert assign_device(lag_map, subs, kernel="scan") == assign_device(
+            lag_map, subs, kernel="rounds"
+        )

@@ -69,8 +69,8 @@ def test_assign_with_heavily_skewed_lags():
 
 
 def test_readme_worked_example():
-    """README.md:40-69 — t0 lags 100k/50k/60k, 2 consumers =>
-    C0=[t0p0], C1=[t0p1, t0p2]."""
+    """Reference /root/reference/README.md:40-69 — t0 lags 100k/50k/60k,
+    2 consumers => C0=[t0p0], C1=[t0p1, t0p2]."""
     lags = {"t0": tpl("t0", [(0, 100000), (1, 50000), (2, 60000)])}
     subs = {"C0": ["t0"], "C1": ["t0"]}
     result = assign_greedy(lags, subs)
