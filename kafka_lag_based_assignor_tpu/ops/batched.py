@@ -1,0 +1,36 @@
+"""Batched (vmap-over-topics) assignment kernels.
+
+One kernel launch assigns every topic in a :class:`..ops.packing.TopicGroup`
+— the vmap stress shape of BASELINE config 3 (256 topics x 64 partitions x
+64 consumers) runs as a single [T, P] batch instead of 256 host-looped
+launches.  Per-topic independence (SURVEY §2.4.3) makes the batch dimension
+embarrassingly parallel, which is exactly what ``vmap`` models.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from .rounds_kernel import assign_topic_rounds
+from .scan_kernel import assign_topic_scan
+
+
+@functools.partial(jax.jit, static_argnames=("num_consumers",))
+def assign_batched_rounds(lags, partition_ids, valid, num_consumers: int):
+    """Rounds kernel over a topic batch.
+
+    Args: lags int64[T, P], partition_ids int32[T, P], valid bool[T, P].
+    Returns (choice int32[T, P], counts int32[T, C], totals[T, C]).
+    """
+    fn = functools.partial(assign_topic_rounds, num_consumers=num_consumers)
+    return jax.vmap(fn)(lags, partition_ids, valid)
+
+
+@functools.partial(jax.jit, static_argnames=("num_consumers",))
+def assign_batched_scan(lags, partition_ids, valid, num_consumers: int):
+    """Scan kernel over a topic batch (same contract as
+    :func:`assign_batched_rounds`)."""
+    fn = functools.partial(assign_topic_scan, num_consumers=num_consumers)
+    return jax.vmap(fn)(lags, partition_ids, valid)

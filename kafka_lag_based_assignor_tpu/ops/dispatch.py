@@ -1,25 +1,21 @@
-"""Host<->device dispatch: map-based API in, kernels on device, maps out.
+"""Host<->device dispatch: map-based API in, batched kernels on device, maps out.
 
 Converts the reference core's signature —
 ``(Map<topic, List<TopicPartitionLag>>, Map<member, List<topic>>) ->
 Map<member, List<TopicPartition>>`` (LagBasedPartitionAssignor.java:166-188)
-— into columnar tensors, runs an assignment kernel, and rebuilds per-member
-partition lists in the reference's append order (processing order: lag
-descending, partition id ascending).
+— into packed topic groups (:mod:`.packing`), runs one batched kernel launch
+per group (:mod:`.batched`), and rebuilds per-member partition lists in the
+reference's append order: topics in sorted order, partitions within a topic
+in processing order (lag descending, partition id ascending, :228-235).
 
-Member-rank convention: per topic, the subscribed members are sorted
-lexicographically and the kernel sees dense indices; index order == id
-order, so the kernel's integer tie-break reproduces the reference's string
-compare (:259) exactly.
-
-Shapes are padded to buckets (next power of two) so repeated rebalances at
-similar scale reuse the jit cache instead of recompiling (SURVEY §7:
-host/device round-trip budget — avoid recompiles via static padded shapes).
+Member-rank convention: per group, subscribed members sorted
+lexicographically map to dense kernel indices, so the kernel's integer
+tie-break reproduces the reference's member-id string compare (:259).
 """
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Mapping, Sequence
+from typing import Dict, List, Mapping, Sequence
 
 import numpy as np
 
@@ -27,14 +23,12 @@ import jax
 
 from ..models.greedy import consumers_per_topic
 from ..types import AssignmentMap, TopicPartition, TopicPartitionLag
-from .rounds_kernel import assign_topic_rounds
-from .scan_kernel import assign_topic_scan
+from .batched import assign_batched_rounds, assign_batched_scan
+from .packing import TopicGroup, build_groups, pad_bucket
 
-KernelFn = Callable[..., tuple]
-
-_KERNELS: Dict[str, KernelFn] = {
-    "rounds": assign_topic_rounds,
-    "scan": assign_topic_scan,
+_BATCHED_KERNELS = {
+    "rounds": assign_batched_rounds,
+    "scan": assign_batched_scan,
 }
 
 
@@ -44,18 +38,88 @@ def ensure_x64() -> None:
         jax.config.update("jax_enable_x64", True)
 
 
-def pad_bucket(n: int, minimum: int = 8) -> int:
-    """Next power-of-two bucket >= n, so shape-polymorphic workloads hit a
-    bounded number of jit cache entries."""
-    b = minimum
-    while b < n:
-        b *= 2
-    return b
+def _rebuild_topic(
+    topic: str,
+    members: Sequence[str],
+    lags: np.ndarray,
+    pids: np.ndarray,
+    valid: np.ndarray,
+    choice: np.ndarray,
+) -> Dict[str, List[TopicPartition]]:
+    """Per-member lists for one topic, in processing order, vectorized.
+
+    A stable argsort over the processing-order choice array groups rows per
+    consumer while preserving processing order within each consumer.
+    """
+    P = int(valid.sum())
+    lags, pids, choice = lags[:P], pids[:P], choice[:P]
+    order = np.lexsort((pids, -lags))
+    sorted_choice = choice[order]
+    sorted_pids = pids[order]
+    grouped = np.argsort(sorted_choice, kind="stable")
+    counts = np.bincount(
+        sorted_choice[sorted_choice >= 0], minlength=len(members)
+    )
+    out: Dict[str, List[TopicPartition]] = {}
+    pos = int((sorted_choice < 0).sum())  # unassigned rows group first (-1)
+    for c, member in enumerate(members):
+        rows = grouped[pos : pos + int(counts[c])]
+        out[member] = [TopicPartition(topic, int(sorted_pids[i])) for i in rows]
+        pos += int(counts[c])
+    return out
 
 
-def _lag_dtype():
+def assign_group_device(group: TopicGroup, kernel: str = "rounds"):
+    """Run one packed topic group through a batched kernel.
+
+    Returns (choice int32[T, P_pad], counts [T, C], totals [T, C]) as
+    **device arrays** — callers materialize only what they consume, so the
+    rebalance path doesn't pay device->host syncs for discarded stats.
+    """
     ensure_x64()
-    return np.int64
+    kernel_fn = _BATCHED_KERNELS[kernel]
+    return kernel_fn(
+        group.lags, group.partition_ids, group.valid,
+        num_consumers=group.num_consumers,
+    )
+
+
+def assign_device(
+    partition_lag_per_topic: Mapping[str, Sequence[TopicPartitionLag]],
+    subscriptions: Mapping[str, Sequence[str]],
+    kernel: str = "rounds",
+) -> AssignmentMap:
+    """Device-backed equivalent of the reference's static core (:166-188):
+    full parity including empty members and missing-lag topics, with one
+    batched kernel launch per subscriber-set group."""
+    if kernel not in _BATCHED_KERNELS:
+        raise ValueError(
+            f"unknown kernel {kernel!r}; valid: {sorted(_BATCHED_KERNELS)}"
+        )
+    assignment: AssignmentMap = {m: [] for m in subscriptions}
+    by_topic = consumers_per_topic(subscriptions)
+    groups = build_groups(partition_lag_per_topic, by_topic)
+
+    fragments: Dict[str, Dict[str, List[TopicPartition]]] = {}
+    for group in groups:
+        choice, _, _ = assign_group_device(group, kernel=kernel)
+        choice = np.asarray(choice)
+        for ti, topic in enumerate(group.topics):
+            fragments[topic] = _rebuild_topic(
+                topic,
+                group.members,
+                group.lags[ti],
+                group.partition_ids[ti],
+                group.valid[ti],
+                choice[ti],
+            )
+
+    # Merge fragments in global sorted-topic order so per-member list order
+    # matches the oracle exactly (topics sorted, then processing order).
+    for topic in sorted(fragments):
+        for member, tps in fragments[topic].items():
+            assignment[member].extend(tps)
+    return assignment
 
 
 def assign_topic_device(
@@ -64,71 +128,19 @@ def assign_topic_device(
     partition_lags: Sequence[TopicPartitionLag],
     kernel: str = "rounds",
 ) -> Dict[str, List[TopicPartition]]:
-    """Run one topic's assignment on device; returns member -> partitions
-    in reference append order.
-
-    Duplicate member ids in ``consumers`` are deduplicated, matching the
-    reference where per-consumer accumulators are maps keyed by member id
-    (:216-225) even though consumersPerTopic can append duplicates.
-    """
-    ranked = sorted(set(consumers))
-    C = len(ranked)
-    P = len(partition_lags)
-    if C == 0 or P == 0:
-        return {m: [] for m in ranked}
-
-    P_pad = pad_bucket(P)
-    lags = np.zeros((P_pad,), dtype=_lag_dtype())
-    pids = np.zeros((P_pad,), dtype=np.int32)
-    valid = np.zeros((P_pad,), dtype=bool)
-    lags[:P] = np.fromiter((r.lag for r in partition_lags), np.int64, count=P)
-    pids[:P] = np.fromiter((r.partition for r in partition_lags), np.int32, count=P)
-    valid[:P] = True
-
-    kernel_fn = _KERNELS[kernel]
-    choice, _, _ = kernel_fn(lags, pids, valid, num_consumers=C)
-    choice = np.asarray(choice)[:P]
-
-    # Rebuild lists in processing order (lag desc, pid asc) — the order the
-    # reference appends in (:237-264).  Stable argsort over the choice array
-    # (itself traversed in processing order) groups rows per consumer while
-    # preserving that order, without a Python-level loop over P.
-    order = np.lexsort((pids[:P], -lags[:P]))
-    sorted_choice = choice[order]
-    sorted_pids = pids[:P][order]
-    grouped = np.argsort(sorted_choice, kind="stable")
-    counts = np.bincount(sorted_choice[sorted_choice >= 0], minlength=C)
-    result: Dict[str, List[TopicPartition]] = {}
-    pos = int((sorted_choice < 0).sum())  # padding rows group first (-1)
-    for c, member in enumerate(ranked):
-        rows = grouped[pos : pos + int(counts[c])]
-        result[member] = [TopicPartition(topic, int(sorted_pids[i])) for i in rows]
-        pos += int(counts[c])
+    """Single-topic convenience wrapper (degenerate one-topic group)."""
+    result = assign_device(
+        {topic: partition_lags},
+        {m: [topic] for m in consumers},
+        kernel=kernel,
+    )
     return result
 
 
-def assign_device(
-    partition_lag_per_topic: Mapping[str, Sequence[TopicPartitionLag]],
-    subscriptions: Mapping[str, Sequence[str]],
-    kernel: str = "rounds",
-) -> AssignmentMap:
-    """Device-backed equivalent of the reference's static core
-    (:166-188) — full parity including empty members and missing-lag topics.
-
-    Topics are dispatched one kernel call per topic; topics whose subscriber
-    sets coincide share jit cache entries via the rank convention and shape
-    bucketing.  (Batched vmap execution across topics lives in
-    :mod:`.batched`.)
-    """
-    assignment: AssignmentMap = {m: [] for m in subscriptions}
-    by_topic = consumers_per_topic(subscriptions)
-    for topic in sorted(by_topic):
-        part = assign_topic_device(
-            topic,
-            by_topic[topic],
-            partition_lag_per_topic.get(topic, ()),
-            kernel=kernel,
-        )
-        for member, tps in part.items():
-            assignment[member].extend(tps)
-    return assignment
+__all__ = [
+    "assign_device",
+    "assign_group_device",
+    "assign_topic_device",
+    "ensure_x64",
+    "pad_bucket",
+]
