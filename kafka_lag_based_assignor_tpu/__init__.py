@@ -31,6 +31,9 @@ from .types import (
 )
 from .lag import compute_partition_lag, read_topic_partition_lags
 from .models.greedy import assign_greedy, consumers_per_topic
+from .assignor import LagBasedPartitionAssignor
+from .utils.config import AssignorConfig, parse_config
+from .utils.observability import RebalanceStats
 
 __version__ = "0.1.0"
 
@@ -48,5 +51,9 @@ __all__ = [
     "read_topic_partition_lags",
     "assign_greedy",
     "consumers_per_topic",
+    "LagBasedPartitionAssignor",
+    "AssignorConfig",
+    "parse_config",
+    "RebalanceStats",
     "__version__",
 ]

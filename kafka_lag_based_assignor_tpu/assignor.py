@@ -1,0 +1,194 @@
+"""The plugin adapter (L1): the ``ConsumerPartitionAssignor`` surface.
+
+Mirrors the reference's protocol contract
+(LagBasedPartitionAssignor.java:83-157):
+
+* ``configure(configs)`` — validates ``group.id``, derives metadata-consumer
+  properties (auto-commit off, ``client.id=<group>.assignor``);
+* ``name()`` — returns ``"lag"``, the protocol name embedded in JoinGroup
+  metadata (all group members must support it);
+* ``assign(cluster, group_subscription)`` — runs on the elected group
+  leader: unions subscribed topics, reads lags (the only network boundary),
+  solves the assignment, wraps results with no user data.
+
+Differences by design (each one a SURVEY §5 requirement):
+* the combinatorial core runs on TPU via :mod:`.ops.dispatch`, with an
+  automatic host-greedy fallback so a rebalance never fails because the
+  accelerator is unreachable — broker-RPC exceptions still propagate and
+  fail the rebalance exactly like the reference (SURVEY §2.4.9);
+* every rebalance emits a structured :class:`RebalanceStats` record
+  (imbalance ratio, timings) instead of only debug text.
+
+Statelessness matches the reference: no ``on_assignment`` state carryover;
+durable state is the group's committed offsets, which are only read.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Mapping, Optional
+
+from .lag import MetadataConsumer, read_topic_partition_lags
+from .models.greedy import assign_greedy
+from .types import (
+    Assignment,
+    Cluster,
+    GroupAssignment,
+    GroupSubscription,
+    TopicPartition,
+)
+from .utils.config import AssignorConfig, parse_config
+from .utils.observability import (
+    RebalanceStats,
+    log_rebalance,
+    profile_trace,
+    stopwatch,
+    summarize_assignment,
+)
+
+LOGGER = logging.getLogger(__name__)
+
+# A factory so tests (and the real deployment) inject their broker client;
+# the reference constructs a KafkaConsumer from the derived props lazily on
+# first use and never closes it (:322-324) — same lifecycle here.
+MetadataConsumerFactory = Callable[[Mapping[str, Any]], MetadataConsumer]
+
+
+class LagBasedPartitionAssignor:
+    """TPU-native drop-in for the reference assignor."""
+
+    def __init__(
+        self, metadata_consumer_factory: Optional[MetadataConsumerFactory] = None
+    ):
+        self._config: Optional[AssignorConfig] = None
+        self._metadata_consumer: Optional[MetadataConsumer] = None
+        self._metadata_consumer_factory = metadata_consumer_factory
+        self.last_stats: Optional[RebalanceStats] = None
+
+    # -- Configurable SPI --------------------------------------------------
+
+    def configure(self, configs: Mapping[str, Any]) -> None:
+        """Reference :97-130 — fails fast if ``group.id`` is absent."""
+        self._config = parse_config(configs)
+        LOGGER.debug(
+            "Configured LagBasedPartitionAssignor with values:\n"
+            "\tgroup.id = %s\n\tclient.id = %s\n\tsolver = %s",
+            self._config.group_id,
+            self._config.client_id,
+            self._config.solver,
+        )
+
+    # -- ConsumerPartitionAssignor SPI ------------------------------------
+
+    def name(self) -> str:
+        """The protocol name (reference :132-135)."""
+        return "lag"
+
+    def assign(
+        self, metadata: Cluster, subscriptions: GroupSubscription
+    ) -> GroupAssignment:
+        """The rebalance entry point; runs on the group leader
+        (reference :137-157)."""
+        if self._config is None:
+            raise RuntimeError("configure() must be called before assign()")
+
+        stats = RebalanceStats(solver=self._config.solver)
+        with stopwatch() as wall:
+            with profile_trace(self._config.profile):
+                group_assignment = self._assign_inner(
+                    metadata, subscriptions, stats
+                )
+        stats.wall_ms = wall[0]
+        log_rebalance(stats)
+        self.last_stats = stats
+        return group_assignment
+
+    def _assign_inner(
+        self,
+        metadata: Cluster,
+        subscriptions: GroupSubscription,
+        stats: RebalanceStats,
+    ) -> GroupAssignment:
+        # Union all members' subscribed topics (reference :140-146).
+        topic_subscriptions = {
+            member: list(sub.topics)
+            for member, sub in subscriptions.group_subscription.items()
+        }
+        all_subscribed = set()
+        for topics in topic_subscriptions.values():
+            all_subscribed.update(topics)
+
+        # Lag acquisition — exceptions propagate and fail the rebalance,
+        # matching the reference's absence of try/catch (:339-342).
+        with stopwatch() as lag_ms:
+            lags = read_topic_partition_lags(
+                self._get_metadata_consumer(),
+                metadata,
+                all_subscribed,
+                self._config.auto_offset_reset,
+            )
+        stats.lag_read_ms = lag_ms[0]
+
+        with stopwatch() as solve_ms:
+            raw = self._solve(lags, topic_subscriptions, stats)
+        stats.solve_ms = solve_ms[0]
+
+        stats.num_topics = len(lags)
+        stats.num_partitions = sum(len(v) for v in lags.values())
+        stats.num_members = len(topic_subscriptions)
+        lag_by_tp = {
+            TopicPartition(r.topic, r.partition): r.lag
+            for rows in lags.values()
+            for r in rows
+        }
+        stats.total_lag = sum(lag_by_tp.values())
+        summarize_assignment(stats, raw, lag_by_tp)
+
+        return GroupAssignment(
+            {member: Assignment(tuple(tps)) for member, tps in raw.items()}
+        )
+
+    def _solve(self, lags, topic_subscriptions, stats: RebalanceStats):
+        solver = self._config.solver
+        if solver == "host":
+            return assign_greedy(lags, topic_subscriptions)
+        try:
+            if solver == "sinkhorn":
+                from .models.sinkhorn import assign_sinkhorn
+
+                return assign_sinkhorn(lags, topic_subscriptions)
+            if solver == "native":
+                from .native import assign_native
+
+                return assign_native(lags, topic_subscriptions)
+            from .ops.dispatch import assign_device
+
+            return assign_device(lags, topic_subscriptions, kernel=solver)
+        except Exception:
+            if not self._config.host_fallback:
+                raise
+            LOGGER.warning(
+                "device solver %r failed; falling back to host greedy",
+                solver,
+                exc_info=True,
+            )
+            stats.fallback_used = True
+            return assign_greedy(lags, topic_subscriptions)
+
+    def _get_metadata_consumer(self) -> MetadataConsumer:
+        """Lazily create the shared metadata consumer (reference :322-324);
+        it lives as long as the assignor and is never closed."""
+        if self._metadata_consumer is None:
+            if self._metadata_consumer_factory is None:
+                raise RuntimeError(
+                    "no metadata consumer factory configured; inject one at "
+                    "construction or call set_metadata_consumer()"
+                )
+            self._metadata_consumer = self._metadata_consumer_factory(
+                self._config.metadata_consumer_props
+            )
+        return self._metadata_consumer
+
+    def set_metadata_consumer(self, consumer: MetadataConsumer) -> None:
+        """Directly inject a broker client (tests, embedding runtimes)."""
+        self._metadata_consumer = consumer

@@ -1,0 +1,84 @@
+"""Test doubles: an in-memory broker client and cluster builder.
+
+The reference never tested its I/O shell (SURVEY §4 coverage gaps); this
+fake implements the :class:`..lag.MetadataConsumer` protocol so the lag
+reader and the full plugin adapter are testable without a broker — and it
+doubles as the synthetic-workload source for benchmarks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Mapping, Optional, Sequence, Set
+
+from .types import Cluster, OffsetAndMetadata, PartitionInfo, TopicPartition
+
+
+@dataclass
+class FakeBroker:
+    """In-memory offsets store implementing the MetadataConsumer protocol.
+
+    ``raise_on`` simulates broker RPC failures: any listed method raises,
+    letting tests assert that exceptions propagate and fail the rebalance
+    (reference has no try/catch around the RPCs, SURVEY §2.4.9).
+    """
+
+    begin: Dict[TopicPartition, int] = field(default_factory=dict)
+    end: Dict[TopicPartition, int] = field(default_factory=dict)
+    committed_offsets: Dict[TopicPartition, Optional[OffsetAndMetadata]] = field(
+        default_factory=dict
+    )
+    raise_on: Set[str] = field(default_factory=set)
+    calls: list = field(default_factory=list)
+
+    def beginning_offsets(
+        self, partitions: Sequence[TopicPartition]
+    ) -> Mapping[TopicPartition, int]:
+        self.calls.append("beginning_offsets")
+        if "beginning_offsets" in self.raise_on:
+            raise TimeoutError("simulated broker timeout (ListOffsets)")
+        return {tp: self.begin.get(tp, 0) for tp in partitions}
+
+    def end_offsets(
+        self, partitions: Sequence[TopicPartition]
+    ) -> Mapping[TopicPartition, int]:
+        self.calls.append("end_offsets")
+        if "end_offsets" in self.raise_on:
+            raise TimeoutError("simulated broker timeout (ListOffsets)")
+        return {tp: self.end.get(tp, 0) for tp in partitions}
+
+    def committed(
+        self, partitions: Set[TopicPartition]
+    ) -> Mapping[TopicPartition, Optional[OffsetAndMetadata]]:
+        self.calls.append("committed")
+        if "committed" in self.raise_on:
+            raise TimeoutError("simulated broker timeout (OffsetFetch)")
+        return {tp: self.committed_offsets.get(tp) for tp in partitions}
+
+    # -- builder helpers ---------------------------------------------------
+
+    def with_partition(
+        self,
+        topic: str,
+        partition: int,
+        end: int,
+        committed: Optional[int] = None,
+        begin: int = 0,
+    ) -> "FakeBroker":
+        tp = TopicPartition(topic, partition)
+        self.begin[tp] = begin
+        self.end[tp] = end
+        if committed is not None:
+            self.committed_offsets[tp] = OffsetAndMetadata(committed)
+        return self
+
+    def cluster(self) -> Cluster:
+        """A Cluster whose metadata covers every partition this broker knows."""
+        topics: Dict[str, list] = {}
+        for tp in self.end:
+            topics.setdefault(tp.topic, []).append(
+                PartitionInfo(tp.topic, tp.partition)
+            )
+        for infos in topics.values():
+            infos.sort(key=lambda p: p.partition)
+        return Cluster(topics)

@@ -1,0 +1,94 @@
+"""Typed configuration layer.
+
+The reference receives an untyped ``Map<String,?>`` through Kafka's
+``Configurable`` SPI (LagBasedPartitionAssignor.java:97-130) and consumes:
+``group.id`` (required, :107-113), ``auto.offset.reset`` (default "latest",
+:346-347), and derives metadata-consumer overrides
+``enable.auto.commit=false`` + ``client.id=<group.id>.assignor`` (:116-120).
+
+This module reproduces those pass-through semantics exactly and adds the
+framework's own typed knobs (solver choice, shape buckets, fallback policy)
+under a ``tpu.assignor.`` key prefix — unknown Kafka keys pass through
+untouched, as the reference copies the whole map (:101-104).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Mapping
+
+GROUP_ID_CONFIG = "group.id"
+AUTO_OFFSET_RESET_CONFIG = "auto.offset.reset"
+ENABLE_AUTO_COMMIT_CONFIG = "enable.auto.commit"
+CLIENT_ID_CONFIG = "client.id"
+PARTITION_ASSIGNMENT_STRATEGY_CONFIG = "partition.assignment.strategy"
+
+SOLVER_CONFIG = "tpu.assignor.solver"  # rounds | scan | sinkhorn | native | host
+FALLBACK_CONFIG = "tpu.assignor.host.fallback"  # bool: greedy host fallback
+PROFILE_CONFIG = "tpu.assignor.profile"  # bool: jax.profiler traces
+
+_VALID_SOLVERS = ("rounds", "scan", "sinkhorn", "native", "host")
+
+
+@dataclass
+class AssignorConfig:
+    """Validated view over the consumer config map."""
+
+    group_id: str
+    auto_offset_reset: str = "latest"
+    solver: str = "rounds"
+    host_fallback: bool = True
+    profile: bool = False
+    consumer_group_props: Dict[str, Any] = field(default_factory=dict)
+    metadata_consumer_props: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def client_id(self) -> str:
+        return f"{self.group_id}.assignor"
+
+
+def _as_bool(value: Any) -> bool:
+    if isinstance(value, bool):
+        return value
+    return str(value).strip().lower() in ("true", "1", "yes")
+
+
+def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
+    """Validate and type the raw config map.
+
+    Raises ``ValueError`` if ``group.id`` is absent — the reference throws
+    IllegalArgumentException in the same situation (:107-113) so that a
+    misconfigured consumer fails at construction, not mid-rebalance.
+    """
+    consumer_group_props = dict(configs)
+
+    group_id = consumer_group_props.get(GROUP_ID_CONFIG)
+    if group_id is None:
+        raise ValueError(
+            f"{GROUP_ID_CONFIG} cannot be null when using "
+            f"{PARTITION_ASSIGNMENT_STRATEGY_CONFIG}=LagBasedPartitionAssignor"
+        )
+
+    solver = str(consumer_group_props.get(SOLVER_CONFIG, "rounds"))
+    if solver not in _VALID_SOLVERS:
+        raise ValueError(
+            f"{SOLVER_CONFIG}={solver!r} invalid; choose one of {_VALID_SOLVERS}"
+        )
+
+    # Derived metadata-consumer properties, exactly as the reference builds
+    # them (:116-120): same config, auto-commit off, suffixed client id.
+    metadata_consumer_props = dict(consumer_group_props)
+    metadata_consumer_props[ENABLE_AUTO_COMMIT_CONFIG] = "false"
+    metadata_consumer_props[CLIENT_ID_CONFIG] = f"{group_id}.assignor"
+
+    return AssignorConfig(
+        group_id=str(group_id),
+        auto_offset_reset=str(
+            consumer_group_props.get(AUTO_OFFSET_RESET_CONFIG, "latest")
+        ),
+        solver=solver,
+        host_fallback=_as_bool(consumer_group_props.get(FALLBACK_CONFIG, True)),
+        profile=_as_bool(consumer_group_props.get(PROFILE_CONFIG, False)),
+        consumer_group_props=consumer_group_props,
+        metadata_consumer_props=metadata_consumer_props,
+    )

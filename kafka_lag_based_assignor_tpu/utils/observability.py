@@ -1,0 +1,102 @@
+"""Structured observability for rebalances.
+
+The reference's observability is slf4j logging: debug config summary
+(LagBasedPartitionAssignor.java:122-128), trace per-assignment decisions
+(:268-275), debug per-topic totals (:280-306), warn on missing metadata
+(:359).  Here the per-rebalance record is structured — per-consumer totals,
+the max/mean lag-imbalance ratio (the north-star metric), count spread, and
+wall/kernel timings — and emitted both as a log line and as a returned
+value so callers and benches can consume it programmatically.
+
+``profile_trace`` wraps a rebalance in a ``jax.profiler`` trace for
+Perfetto/TensorBoard inspection (SURVEY §5 tracing row).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Dict, Iterator, List, Optional
+
+LOGGER = logging.getLogger("kafka_lag_based_assignor_tpu")
+
+
+@dataclass
+class RebalanceStats:
+    """One rebalance's structured record."""
+
+    num_topics: int = 0
+    num_partitions: int = 0
+    num_members: int = 0
+    solver: str = ""
+    fallback_used: bool = False
+    wall_ms: float = 0.0
+    lag_read_ms: float = 0.0
+    solve_ms: float = 0.0
+    total_lag: int = 0
+    # Per-member totals across all topics (host-aggregated).
+    member_total_lag: Dict[str, int] = field(default_factory=dict)
+    member_partition_count: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def max_mean_lag_imbalance(self) -> float:
+        """max(member lag) / mean(member lag) — 1.0 is perfect, and the
+        input-driven lower bound is max_partition_lag / mean(member lag)."""
+        lags = list(self.member_total_lag.values())
+        if not lags:
+            return 1.0
+        mean = sum(lags) / len(lags)
+        return max(lags) / mean if mean > 0 else 1.0
+
+    @property
+    def count_spread(self) -> int:
+        counts = list(self.member_partition_count.values())
+        return (max(counts) - min(counts)) if counts else 0
+
+    def to_json(self) -> str:
+        d = asdict(self)
+        d["max_mean_lag_imbalance"] = self.max_mean_lag_imbalance
+        d["count_spread"] = self.count_spread
+        return json.dumps(d, sort_keys=True)
+
+
+def summarize_assignment(
+    stats: RebalanceStats,
+    assignment: Dict[str, List],
+    lag_by_tp: Dict,
+) -> RebalanceStats:
+    """Fill member totals from an assignment map and a TopicPartition->lag map."""
+    for member, tps in assignment.items():
+        stats.member_partition_count[member] = len(tps)
+        stats.member_total_lag[member] = sum(lag_by_tp.get(tp, 0) for tp in tps)
+    return stats
+
+
+def log_rebalance(stats: RebalanceStats) -> None:
+    LOGGER.info("rebalance %s", stats.to_json())
+
+
+@contextlib.contextmanager
+def stopwatch() -> Iterator[List[float]]:
+    """``with stopwatch() as t: ...`` -> ``t[0]`` is elapsed milliseconds."""
+    out = [0.0]
+    start = time.perf_counter()
+    try:
+        yield out
+    finally:
+        out[0] = (time.perf_counter() - start) * 1000.0
+
+
+@contextlib.contextmanager
+def profile_trace(enabled: bool, log_dir: str = "/tmp/klba_tpu_trace"):
+    """Optionally wrap a block in a jax.profiler trace (Perfetto-compatible)."""
+    if not enabled:
+        yield None
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield log_dir

@@ -1,0 +1,188 @@
+"""Plugin-adapter (L1) tests: configure validation, name(), the full
+assign() path against a fake broker, failure semantics, fallback, and
+structured observability — the layers the reference left untested
+(SURVEY §4)."""
+
+import pytest
+
+from kafka_lag_based_assignor_tpu.assignor import LagBasedPartitionAssignor
+from kafka_lag_based_assignor_tpu.testing import FakeBroker
+from kafka_lag_based_assignor_tpu.types import (
+    Cluster,
+    GroupSubscription,
+    Subscription,
+    TopicPartition,
+)
+
+
+def make_assignor(broker, configs=None):
+    a = LagBasedPartitionAssignor(metadata_consumer_factory=lambda props: broker)
+    a.configure({"group.id": "g1", **(configs or {})})
+    return a
+
+
+def subs(d):
+    return GroupSubscription({m: Subscription(tuple(t)) for m, t in d.items()})
+
+
+def readme_broker():
+    """t0 with lags 100k/50k/60k via end offsets and zero committed."""
+    return (
+        FakeBroker()
+        .with_partition("t0", 0, end=100_000, committed=0)
+        .with_partition("t0", 1, end=50_000, committed=0)
+        .with_partition("t0", 2, end=60_000, committed=0)
+    )
+
+
+def test_configure_requires_group_id():
+    a = LagBasedPartitionAssignor()
+    with pytest.raises(ValueError, match="group.id"):
+        a.configure({"bootstrap.servers": "localhost:9092"})
+
+
+def test_configure_derives_metadata_consumer_props():
+    broker = FakeBroker()
+    captured = {}
+
+    def factory(props):
+        captured.update(props)
+        return broker
+
+    a = LagBasedPartitionAssignor(metadata_consumer_factory=factory)
+    a.configure({"group.id": "orders", "auto.offset.reset": "earliest"})
+    a.assign(Cluster({}), subs({"m": []}))
+    assert captured["enable.auto.commit"] == "false"
+    assert captured["client.id"] == "orders.assignor"
+    assert captured["auto.offset.reset"] == "earliest"
+
+
+def test_name_is_lag():
+    assert LagBasedPartitionAssignor().name() == "lag"
+
+
+def test_assign_before_configure_raises():
+    with pytest.raises(RuntimeError, match="configure"):
+        LagBasedPartitionAssignor().assign(Cluster({}), subs({}))
+
+
+def test_full_assign_readme_example():
+    broker = readme_broker()
+    a = make_assignor(broker)
+    result = a.assign(broker.cluster(), subs({"C0": ["t0"], "C1": ["t0"]}))
+    ga = result.group_assignment
+    assert list(ga["C0"].partitions) == [TopicPartition("t0", 0)]
+    assert set(ga["C1"].partitions) == {
+        TopicPartition("t0", 1),
+        TopicPartition("t0", 2),
+    }
+
+
+def test_invalid_solver_rejected_at_configure():
+    with pytest.raises(ValueError, match="tpu.assignor.solver"):
+        make_assignor(FakeBroker(), {"tpu.assignor.solver": "quantum"})
+
+
+def test_missing_topic_metadata_skipped():
+    """Topic not in cluster metadata: warn + skip; subscribers still appear
+    in the result with what they got elsewhere (reference :358-360)."""
+    broker = readme_broker()
+    a = make_assignor(broker)
+    result = a.assign(
+        broker.cluster(), subs({"C0": ["t0", "ghost"], "C1": ["t0"]})
+    )
+    assert set(result.group_assignment) == {"C0", "C1"}
+
+
+def test_broker_exception_fails_rebalance():
+    """RPC exceptions propagate — the rebalance must fail, Kafka retries
+    (SURVEY §2.4.9).  The host fallback covers solver failures only."""
+    broker = readme_broker()
+    broker.raise_on.add("end_offsets")
+    a = make_assignor(broker)
+    with pytest.raises(TimeoutError):
+        a.assign(broker.cluster(), subs({"C0": ["t0"]}))
+
+
+def test_host_fallback_on_device_failure(monkeypatch):
+    """If the device solver raises, the host greedy produces the same
+    assignment and the stats record the fallback."""
+    import kafka_lag_based_assignor_tpu.ops.dispatch as dispatch
+
+    def boom(*a, **k):
+        raise RuntimeError("simulated TPU unreachable")
+
+    monkeypatch.setattr(dispatch, "assign_device", boom)
+    broker = readme_broker()
+    a = make_assignor(broker)
+    result = a.assign(broker.cluster(), subs({"C0": ["t0"], "C1": ["t0"]}))
+    assert a.last_stats.fallback_used
+    assert list(result.group_assignment["C0"].partitions) == [
+        TopicPartition("t0", 0)
+    ]
+
+
+def test_fallback_disabled_propagates(monkeypatch):
+    import kafka_lag_based_assignor_tpu.ops.dispatch as dispatch
+
+    monkeypatch.setattr(
+        dispatch, "assign_device",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("tpu down")),
+    )
+    broker = readme_broker()
+    a = make_assignor(broker, {"tpu.assignor.host.fallback": "false"})
+    with pytest.raises(RuntimeError, match="tpu down"):
+        a.assign(broker.cluster(), subs({"C0": ["t0"]}))
+
+
+def test_solver_host_runs_pure_python():
+    broker = readme_broker()
+    a = make_assignor(broker, {"tpu.assignor.solver": "host"})
+    result = a.assign(broker.cluster(), subs({"C0": ["t0"], "C1": ["t0"]}))
+    assert list(result.group_assignment["C0"].partitions) == [
+        TopicPartition("t0", 0)
+    ]
+
+
+def test_stats_structured_record():
+    broker = readme_broker()
+    a = make_assignor(broker)
+    a.assign(broker.cluster(), subs({"C0": ["t0"], "C1": ["t0"]}))
+    s = a.last_stats
+    assert s.num_topics == 1 and s.num_partitions == 3 and s.num_members == 2
+    assert s.total_lag == 210_000
+    assert s.member_total_lag == {"C0": 100_000, "C1": 110_000}
+    assert s.member_partition_count == {"C0": 1, "C1": 2}
+    assert abs(s.max_mean_lag_imbalance - 110_000 / 105_000) < 1e-9
+    assert s.count_spread == 1
+    assert s.wall_ms > 0 and "max_mean_lag_imbalance" in s.to_json()
+
+
+def test_metadata_consumer_created_lazily_and_reused():
+    created = []
+    broker = readme_broker()
+
+    def factory(props):
+        created.append(1)
+        return broker
+
+    a = LagBasedPartitionAssignor(metadata_consumer_factory=factory)
+    a.configure({"group.id": "g"})
+    assert created == []  # not created at configure time (reference :322-324)
+    s = subs({"C0": ["t0"]})
+    a.assign(broker.cluster(), s)
+    a.assign(broker.cluster(), s)
+    assert created == [1]  # created once, reused across rebalances
+
+
+def test_auto_offset_reset_earliest_full_backlog():
+    """No committed offsets + earliest => lag = end - begin through the
+    full plugin path."""
+    broker = (
+        FakeBroker()
+        .with_partition("t", 0, end=500, begin=100)
+        .with_partition("t", 1, end=50, begin=0)
+    )
+    a = make_assignor(broker, {"auto.offset.reset": "earliest"})
+    a.assign(broker.cluster(), subs({"m1": ["t"], "m2": ["t"]}))
+    assert a.last_stats.total_lag == 450
