@@ -1,0 +1,79 @@
+"""Mesh-sharded execution tests on the virtual 8-device CPU platform."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from kafka_lag_based_assignor_tpu.ops.batched import assign_batched_rounds
+from kafka_lag_based_assignor_tpu.parallel.mesh import (
+    assign_sharded,
+    make_mesh,
+    shard_topic_batch,
+)
+
+
+def make_batch(T, P, C, seed=0):
+    rng = np.random.default_rng(seed)
+    lags = rng.integers(0, 10**9, size=(T, P)).astype(np.int64)
+    pids = np.tile(np.arange(P, dtype=np.int32), (T, 1))
+    valid = np.ones((T, P), dtype=bool)
+    return lags, pids, valid
+
+
+def test_eight_devices_available():
+    assert len(jax.devices()) == 8
+
+
+@pytest.mark.parametrize("topics_axis,members_axis", [(8, 1), (4, 2), (2, 4)])
+def test_sharded_matches_single_device(topics_axis, members_axis):
+    """Sharded result must be bit-identical to the unsharded batched kernel
+    (determinism requirement, SURVEY §5 race-detection row)."""
+    T, P, C = 16, 64, 8
+    lags, pids, valid = make_batch(T, P, C)
+    mesh = make_mesh(
+        jax.devices()[: topics_axis * members_axis],
+        topics_axis=topics_axis,
+        members_axis=members_axis,
+    )
+    s_lags, s_pids, s_valid = shard_topic_batch(mesh, lags, pids, valid)
+    choice, counts, totals, member_load, member_count = assign_sharded(
+        mesh, s_lags, s_pids, s_valid, num_consumers=C
+    )
+    ref_choice, ref_counts, ref_totals = assign_batched_rounds(
+        lags, pids, valid, num_consumers=C
+    )
+    np.testing.assert_array_equal(np.asarray(choice), np.asarray(ref_choice))
+    np.testing.assert_array_equal(np.asarray(counts), np.asarray(ref_counts))
+    np.testing.assert_array_equal(np.asarray(totals), np.asarray(ref_totals))
+    # Global stats: psum over topics == host reduction.
+    np.testing.assert_array_equal(
+        np.asarray(member_load), np.asarray(ref_totals).sum(axis=0)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(member_count), np.asarray(ref_counts).sum(axis=0)
+    )
+
+
+def test_indivisible_members_axis_rejected():
+    mesh = make_mesh(jax.devices(), topics_axis=4, members_axis=2)
+    lags, pids, valid = make_batch(4, 8, 7)
+    with pytest.raises(ValueError, match="not divisible"):
+        assign_sharded(mesh, lags, pids, valid, num_consumers=7)
+
+
+def test_mesh_shape_validation():
+    with pytest.raises(ValueError, match="mesh"):
+        make_mesh(jax.devices(), topics_axis=3, members_axis=2)
+
+
+def test_determinism_across_runs():
+    """Same input => bit-identical assignment across repeated sharded runs."""
+    T, P, C = 8, 32, 4
+    lags, pids, valid = make_batch(T, P, C, seed=42)
+    mesh = make_mesh(jax.devices(), topics_axis=8, members_axis=1)
+    outs = []
+    for _ in range(3):
+        choice, *_ = assign_sharded(mesh, lags, pids, valid, num_consumers=C)
+        outs.append(np.asarray(choice))
+    assert all((o == outs[0]).all() for o in outs)
