@@ -1,0 +1,274 @@
+"""Benchmark harness — the 5 BASELINE.json configs.
+
+Prints exactly ONE JSON line to stdout (the driver contract):
+``{"metric": ..., "value": N, "unit": "ms", "vs_baseline": N}`` where the
+headline metric is the assign wall-time at the north-star scale (100k
+partitions / 1k consumers, BASELINE.json:5) on the attached accelerator,
+and ``vs_baseline`` is the speedup factor versus the reference algorithm —
+the O(P*C) linear-min greedy loop (LagBasedPartitionAssignor.java:240-263)
+— implemented as an efficient vectorized host baseline on this same
+machine (the reference publishes no numbers of its own, BASELINE.md).
+
+Everything else (per-config results, imbalance ratios, streaming p50/p95)
+goes to stderr and BENCH_DETAILS.json.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def host_baseline_greedy(lags: np.ndarray, C: int) -> tuple[np.ndarray, float]:
+    """The reference's algorithm at reference fidelity, on host: sort by lag
+    desc, then per partition a linear min over consumers keyed by
+    (count, total, rank) — numpy-vectorized inner scan (generous to the
+    baseline vs. the JVM original).  Returns (member totals, wall ms)."""
+    order = np.argsort(-lags, kind="stable")
+    counts = np.zeros(C, dtype=np.int64)
+    totals = np.zeros(C, dtype=np.int64)
+    t0 = time.perf_counter()
+    for p in order:
+        # lexicographic argmin (count, total, index): indices are the
+        # tiebreak via argmin's first-minimum rule on the masked pass
+        min_count = counts.min()
+        cand = counts == min_count
+        masked = np.where(cand, totals, np.iinfo(np.int64).max)
+        who = int(np.argmin(masked))
+        counts[who] += 1
+        totals[who] += int(lags[p])
+    return totals, (time.perf_counter() - t0) * 1000.0
+
+
+def rtt_floor_ms(iters: int = 6) -> float:
+    """Measure the harness's device->host synchronization floor: fetching a
+    freshly computed 4-byte scalar.  Through a tunneled/remote chip this can
+    be tens of ms and bounds ANY implementation's end-to-end latency here;
+    on a locally attached TPU it is microseconds."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jax.device_put(np.arange(1024, dtype=np.int32))
+    f = jax.jit(lambda x: (x * 2 + 1).sum())
+    float(f(x))
+    times = []
+    for _ in range(iters):
+        r = f(x)
+        t0 = time.perf_counter()
+        float(r)
+        times.append((time.perf_counter() - t0) * 1000.0)
+    return float(np.median(times))
+
+
+def device_assign_ms(lags, pids, valid, C, iters=20):
+    """Steady-state end-to-end ms for one batched device solve: host numpy
+    in, choices materialized to host out (a single device->host readback;
+    per-member totals are derived host-side, cheaper than a second RTT)."""
+    from kafka_lag_based_assignor_tpu.ops.batched import assign_batched_rounds
+
+    def once():
+        t0 = time.perf_counter()
+        choice, _, _ = assign_batched_rounds(
+            lags, pids, valid, num_consumers=C
+        )
+        choice = np.asarray(choice)  # the one blocking readback
+        ms = (time.perf_counter() - t0) * 1000.0
+        return ms, choice
+
+    once()  # warm-up/compile
+    times = []
+    choice = None
+    for _ in range(iters):
+        ms, choice = once()
+        times.append(ms)
+
+    totals = np.zeros((lags.shape[0], C), dtype=np.int64)
+    for t in range(lags.shape[0]):
+        sel = valid[t] & (choice[t] >= 0)
+        np.add.at(totals[t], choice[t][sel], lags[t][sel])
+    return float(np.median(times)), choice, totals
+
+
+def imbalance(member_totals: np.ndarray) -> float:
+    mean = member_totals.mean()
+    return float(member_totals.max() / mean) if mean > 0 else 1.0
+
+
+def zipf_lags(rng, P, a=1.1, scale=1000):
+    # Bounded Zipf via inverse-power sampling (np.random.zipf can overflow).
+    ranks = rng.permutation(P) + 1
+    return (scale * (P / ranks) ** (1.0 / a)).astype(np.int64)
+
+
+def config1_readme():
+    """1 topic, 3 partitions, 2 consumers — correctness gate."""
+    from kafka_lag_based_assignor_tpu import TopicPartition, TopicPartitionLag
+    from kafka_lag_based_assignor_tpu.ops.dispatch import assign_device
+
+    lags = {
+        "t0": [
+            TopicPartitionLag("t0", 0, 100_000),
+            TopicPartitionLag("t0", 1, 50_000),
+            TopicPartitionLag("t0", 2, 60_000),
+        ]
+    }
+    result = assign_device(lags, {"C0": ["t0"], "C1": ["t0"]})
+    ok = result["C0"] == [TopicPartition("t0", 0)] and set(result["C1"]) == {
+        TopicPartition("t0", 1),
+        TopicPartition("t0", 2),
+    }
+    if not ok:
+        raise AssertionError(f"config1 parity failed: {result}")
+    return {"config": "readme_3p_2c", "parity": "exact"}
+
+
+def config2_zipf():
+    """1 topic, 1k partitions, 16 consumers, Zipf(1.1)."""
+    rng = np.random.default_rng(2)
+    P, C = 1000, 16
+    lags = zipf_lags(rng, P)[None, :]
+    pids = np.arange(P, dtype=np.int32)[None, :]
+    valid = np.ones((1, P), dtype=bool)
+    ms, _, totals = device_assign_ms(lags, pids, valid, C)
+    return {
+        "config": "zipf1.1_1k_16c",
+        "assign_ms": ms,
+        "max_mean_imbalance": imbalance(totals[0]),
+        "bound": float(lags.max() / (lags.sum() / C)),
+    }
+
+
+def config3_vmap():
+    """256 topics x 64 partitions, 64 consumers, uniform lag."""
+    rng = np.random.default_rng(3)
+    T, P, C = 256, 64, 64
+    lags = rng.integers(0, 1000, size=(T, P)).astype(np.int64)
+    pids = np.tile(np.arange(P, dtype=np.int32), (T, 1))
+    valid = np.ones((T, P), dtype=bool)
+    ms, _, totals = device_assign_ms(lags, pids, valid, C)
+    member_load = totals.sum(axis=0)
+    return {
+        "config": "vmap_256t_64p_64c",
+        "assign_ms": ms,
+        "max_mean_imbalance_global": imbalance(member_load),
+    }
+
+
+def config4_skew():
+    """10k partitions, 512 consumers, 90% zero-lag / 10% hot."""
+    rng = np.random.default_rng(4)
+    P, C = 10_000, 512
+    lags = np.zeros(P, dtype=np.int64)
+    hot = rng.choice(P, size=P // 10, replace=False)
+    lags[hot] = rng.integers(10**5, 10**7, size=hot.size)
+    ms, _, totals = device_assign_ms(
+        lags[None, :], np.arange(P, dtype=np.int32)[None, :],
+        np.ones((1, P), dtype=bool), C,
+    )
+    return {
+        "config": "skew_10k_512c",
+        "assign_ms": ms,
+        "max_mean_imbalance": imbalance(totals[0]),
+        "bound": float(lags.max() / (lags.sum() / C)),
+    }
+
+
+def config5_northstar():
+    """100k partitions, 1k consumers + streaming rebalance under drift.
+
+    Returns the headline assign wall-time and the baseline comparison."""
+    from kafka_lag_based_assignor_tpu.ops.batched import assign_stream
+
+    rng = np.random.default_rng(5)
+    P, C = 100_000, 1000
+    lags0 = zipf_lags(rng, P)
+
+    # Transfer-lean streaming path: exact-shape lags in, int16 choices out.
+    def stream_once(arr):
+        t0 = time.perf_counter()
+        choice = np.asarray(assign_stream(arr, num_consumers=C))
+        return (time.perf_counter() - t0) * 1000.0, choice
+
+    stream_once(lags0)  # warm-up/compile
+    times = []
+    for _ in range(20):
+        ms, choice = stream_once(lags0)
+        times.append(ms)
+    ms = float(np.median(times))
+    totals = np.zeros(C, dtype=np.int64)
+    np.add.at(totals, choice.astype(np.int64), lags0)
+    imb = imbalance(totals)
+    bound = float(lags0.max() / (lags0.sum() / C))
+
+    # Reference-algorithm baseline on host (same machine, same input).
+    base_totals, base_ms = host_baseline_greedy(lags0, C)
+    base_imb = imbalance(base_totals)
+
+    # Streaming: rebalance repeatedly under multiplicative drift + churn,
+    # reusing the compiled kernel (stable exact shape).
+    lags = lags0.astype(np.float64)
+    stream_times = []
+    for _ in range(10):
+        drift = rng.lognormal(0.0, 0.2, size=P)
+        lags = lags * drift + rng.integers(0, 1000, size=P)
+        t, _ = stream_once(lags.astype(np.int64))
+        stream_times.append(t)
+
+    return {
+        "config": "northstar_100k_1kc",
+        "assign_ms": ms,
+        "max_mean_imbalance": imb,
+        "imbalance_bound": bound,
+        "baseline_host_greedy_ms": base_ms,
+        "baseline_imbalance": base_imb,
+        "speedup_vs_baseline": base_ms / ms,
+        "streaming_p50_ms": float(np.percentile(stream_times, 50)),
+        "streaming_p95_ms": float(np.percentile(stream_times, 95)),
+        "target_ms": 50.0,
+    }
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    # Persist compiled executables across bench processes — first-ever run
+    # pays the XLA compiles (~40 s/shape through this image's remote-compile
+    # tunnel), subsequent runs start warm.
+    jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    log(f"bench devices: {jax.devices()}")
+
+    results = {"harness": {"rtt_floor_ms": rtt_floor_ms()}}
+    log(json.dumps(results["harness"]))
+    for fn in (config1_readme, config2_zipf, config3_vmap, config4_skew,
+               config5_northstar):
+        r = fn()
+        results[r["config"]] = r
+        log(json.dumps(r))
+
+    with open("BENCH_DETAILS.json", "w") as f:
+        json.dump(results, f, indent=2, sort_keys=True)
+
+    ns = results["northstar_100k_1kc"]
+    print(
+        json.dumps(
+            {
+                "metric": "assign_wall_ms_100k_partitions_1k_consumers",
+                "value": round(ns["assign_ms"], 3),
+                "unit": "ms",
+                "vs_baseline": round(ns["speedup_vs_baseline"], 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
