@@ -1,0 +1,191 @@
+"""Sinkhorn-style optimal-transport relaxation solver.
+
+The greedy LPT core (reference semantics) is a 4/3-approximation for makespan
+and is what the reference prescribes; this solver is the framework's
+*quality* alternative (SURVEY §7 step 5; BASELINE config 4 compares the two
+on heavy skew): it directly optimizes the north-star metric — max/mean lag
+imbalance — while preserving the count-primary invariant
+``max - min assigned partitions <= 1``.
+
+Method: entropic mirror descent on the squared-load objective over the
+transport polytope, with Sinkhorn-style alternating marginal scaling
+(pattern references: the OT papers in PAPERS.md — FlashSinkhorn's
+tile-friendly iteration, push-relabel additive approximation for rounding
+intuition; patterns only, no code).
+
+* relaxation variable  X in [0,1]^{P x C}, row-stochastic: X[p] is a
+  distribution of partition p over consumers;
+* objective  sum_j load_j^2  with  load_j = sum_p lag_p X[p,j]  — minimized
+  exactly when loads are equal;
+* update     X <- X * exp(-eta * lag_p * (load_j - mean load) / scale)
+  (mirror/multiplicative-weights step on the gradient), followed by one
+  Sinkhorn pair: column scaling toward the balanced count marginal P/C,
+  then row re-normalization;
+* rounding   partitions in descending-lag order pick their argmax-X
+  consumer among those with remaining count capacity (capacities
+  floor/ceil(P/C)), a lax.scan with a masked vectorized argmax — integral,
+  count-balanced by construction.
+
+Everything is [P, C] dense elementwise + row/col reductions — ideal XLA
+fusion shape — and the iteration count is static (lax.fori_loop), so one
+compiled program serves every rebalance at a bucketed shape.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Mapping, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..types import AssignmentMap, TopicPartitionLag
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_consumers", "iters")
+)
+def sinkhorn_plan(
+    lags: jax.Array,
+    valid: jax.Array,
+    num_consumers: int,
+    iters: int = 60,
+    eta: float = 8.0,
+):
+    """Relaxed transport plan X [P, C] (rows of padding are uniform)."""
+    C = int(num_consumers)
+    P = lags.shape[0]
+    w = jnp.where(valid, lags, 0).astype(jnp.float32)
+    total = jnp.maximum(jnp.sum(w), 1.0)
+    scale = total / C  # ideal per-consumer load
+    n_valid = jnp.maximum(jnp.sum(valid), 1)
+    # Keep everything float32 (x64 mode would otherwise promote the carry).
+    cap = n_valid.astype(jnp.float32) / C  # balanced count marginal
+
+    # Symmetry breaking: from an exactly-uniform init every consumer is
+    # identical and mirror descent preserves the symmetry forever (the
+    # relaxed fixpoint is any row-stochastic plan with equal loads) — a tiny
+    # deterministic perturbation lets the plan commit per partition.
+    key = jax.random.PRNGKey(0)
+    logX = 0.01 * jax.random.normal(key, (P, C), dtype=jnp.float32)
+
+    def body(_, logX):
+        X = jax.nn.softmax(logX, axis=1)
+        load = w @ X  # [C]
+        # Mirror step on d/dX sum_j load_j^2 = lag_p * 2 load_j, centered so
+        # the step is invariant to uniform load shifts.
+        grad = (load - jnp.mean(load)) / scale
+        logX = logX - eta * (w / scale)[:, None] * grad[None, :]
+        # Sinkhorn pair: scale columns toward the balanced count marginal,
+        # rows back to stochastic (in log space for stability).
+        X = jax.nn.softmax(logX, axis=1)
+        colsum = jnp.sum(X, axis=0, where=valid[:, None]) + 1e-9
+        logX = logX + jnp.log(cap / colsum)[None, :]
+        logX = logX - jax.nn.logsumexp(logX, axis=1, keepdims=True)
+        return logX
+
+    logX = lax.fori_loop(0, iters, body, logX)
+    return jax.nn.softmax(logX, axis=1)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_consumers", "iters", "refine_iters")
+)
+def assign_topic_sinkhorn(
+    lags: jax.Array,
+    partition_ids: jax.Array,
+    valid: jax.Array,
+    num_consumers: int,
+    iters: int = 60,
+    refine_iters: int = 128,
+):
+    """Integral, count-balanced assignment from the Sinkhorn plan.
+
+    Rounding: partitions in descending-lag order pick the *least-loaded*
+    open consumer (capacity floor/ceil(n/C)), with the transport plan as a
+    continuous tie-break bonus — i.e. LPT steered by the OT relaxation.
+    A pairwise-exchange refinement pass (:mod:`..ops.refine`) then tightens
+    max/mean imbalance below what any single greedy pass reaches.
+
+    Same output contract as the greedy kernels: (choice int32[P] in input
+    order, counts int32[C], totals[C]).
+    """
+    from ..ops.refine import refine_assignment
+
+    C = int(num_consumers)
+    P = lags.shape[0]
+    X = sinkhorn_plan(lags, valid, num_consumers=C, iters=iters)
+
+    n_valid = jnp.sum(valid.astype(jnp.int32))
+    floor_cap = n_valid // C
+    extras = n_valid - floor_cap * C  # this many consumers may hit ceil
+
+    neg_lag = jnp.where(valid, -lags, jnp.iinfo(lags.dtype).max)
+    order = jnp.argsort(neg_lag)  # lag desc, padding last
+
+    w = jnp.where(valid, lags, 0).astype(jnp.float32)
+    scale = jnp.maximum(jnp.sum(w), 1.0) / C
+
+    def step(carry, p):
+        counts, totals, extras_left = carry
+        is_valid = valid[p]
+        # A consumer is open if under floor cap, or at floor cap while
+        # ceil-slots remain.
+        under_floor = counts < floor_cap
+        at_floor = (counts == floor_cap) & (extras_left > 0)
+        open_mask = under_floor | at_floor
+        # Least load first; the plan contributes a sub-lag-unit bonus so it
+        # decides ties without overriding the load ordering.
+        score = totals.astype(jnp.float32) / scale - 0.01 * X[p]
+        score = jnp.where(open_mask, score, jnp.inf)
+        who = jnp.argmin(score).astype(jnp.int32)
+        take = is_valid
+        one_hot = (jnp.arange(C, dtype=jnp.int32) == who) & take
+        used_extra = take & at_floor[who]
+        counts = counts + one_hot.astype(jnp.int32)
+        totals = totals + jnp.where(one_hot, lags[p], 0).astype(totals.dtype)
+        extras_left = extras_left - used_extra.astype(jnp.int32)
+        return (counts, totals, extras_left), jnp.where(take, who, -1)
+
+    init = (
+        jnp.zeros((C,), jnp.int32),
+        jnp.zeros((C,), lags.dtype),
+        extras,
+    )
+    (_, _, _), sorted_choice = lax.scan(step, init, order)
+    choice = jnp.full((P,), -1, jnp.int32).at[order].set(sorted_choice)
+    return refine_assignment(
+        lags, valid, choice, num_consumers=C, iters=refine_iters
+    )
+
+
+def assign_sinkhorn(
+    partition_lag_per_topic: Mapping[str, Sequence[TopicPartitionLag]],
+    subscriptions: Mapping[str, Sequence[str]],
+    iters: int = 60,
+) -> AssignmentMap:
+    """Map-level Sinkhorn solve (same surface as
+    :func:`..ops.dispatch.assign_device`); per-topic independence preserved."""
+    from ..ops.dispatch import assign_per_topic, ensure_x64
+    from ..ops.packing import pad_bucket
+
+    ensure_x64()
+
+    def solve_topic(lags, pids, num_consumers):
+        P = lags.shape[0]
+        P_pad = pad_bucket(P)
+        lags_p = np.zeros(P_pad, dtype=np.int64)
+        pids_p = np.zeros(P_pad, dtype=np.int32)
+        valid = np.zeros(P_pad, dtype=bool)
+        lags_p[:P], pids_p[:P], valid[:P] = lags, pids, True
+        choice, _, _ = assign_topic_sinkhorn(
+            lags_p, pids_p, valid, num_consumers=num_consumers, iters=iters
+        )
+        return choice
+
+    return assign_per_topic(
+        partition_lag_per_topic, subscriptions, solve_topic
+    )

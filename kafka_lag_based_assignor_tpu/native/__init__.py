@@ -1,0 +1,102 @@
+"""ctypes loader for the native greedy core (builds on demand with g++).
+
+The shared library is compiled once per machine into this directory; if the
+toolchain is unavailable the caller falls back to the pure-Python oracle —
+an import of this module never hard-fails a rebalance.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Dict, List, Mapping, Optional, Sequence
+
+import numpy as np
+
+from ..types import AssignmentMap, TopicPartitionLag
+
+LOGGER = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "greedy.cpp")
+_LIB = os.path.join(_DIR, "libklba_native.so")
+_LOCK = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build() -> None:
+    subprocess.run(
+        ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", _LIB, _SRC],
+        check=True,
+        capture_output=True,
+    )
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _load_failed
+    with _LOCK:
+        if _lib is not None or _load_failed:
+            return _lib
+        try:
+            if not os.path.exists(_LIB) or os.path.getmtime(
+                _LIB
+            ) < os.path.getmtime(_SRC):
+                _build()
+            lib = ctypes.CDLL(_LIB)
+            lib.klba_assign_greedy.restype = ctypes.c_int
+            lib.klba_assign_greedy.argtypes = [
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int64,
+                ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int32),
+            ]
+            _lib = lib
+        except Exception:
+            LOGGER.warning("native greedy core unavailable", exc_info=True)
+            _load_failed = True
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def assign_topic_native(
+    lags: np.ndarray, partition_ids: np.ndarray, num_consumers: int
+) -> np.ndarray:
+    """Run the native core on one topic's columns; returns choice int32[P]."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native greedy core unavailable")
+    lags = np.ascontiguousarray(lags, dtype=np.int64)
+    pids = np.ascontiguousarray(partition_ids, dtype=np.int32)
+    out = np.empty(lags.shape[0], dtype=np.int32)
+    rc = lib.klba_assign_greedy(
+        lags.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        pids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.c_int64(lags.shape[0]),
+        ctypes.c_int32(num_consumers),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    if rc != 0:
+        raise ValueError(f"klba_assign_greedy failed with code {rc}")
+    return out
+
+
+def assign_native(
+    partition_lag_per_topic: Mapping[str, Sequence[TopicPartitionLag]],
+    subscriptions: Mapping[str, Sequence[str]],
+) -> AssignmentMap:
+    """Map-level native solve — same surface and exact same output as the
+    Python oracle and the device dispatch."""
+    from ..ops.dispatch import assign_per_topic
+
+    return assign_per_topic(
+        partition_lag_per_topic, subscriptions, assign_topic_native
+    )

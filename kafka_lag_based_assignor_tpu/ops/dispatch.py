@@ -137,6 +137,36 @@ def assign_topic_device(
     return result
 
 
+def assign_per_topic(
+    partition_lag_per_topic: Mapping[str, Sequence[TopicPartitionLag]],
+    subscriptions: Mapping[str, Sequence[str]],
+    solve_topic,
+) -> AssignmentMap:
+    """Shared host orchestration for per-topic solvers (Sinkhorn, native):
+    dedup + rank members, columnarize rows, call
+    ``solve_topic(lags int64[P], pids int32[P], num_consumers) -> choice``
+    (any array-like of consumer indices in input row order), and rebuild
+    per-member lists with the same reference ordering as the batched path.
+    """
+    assignment: AssignmentMap = {m: [] for m in subscriptions}
+    by_topic = consumers_per_topic(subscriptions)
+    for topic in sorted(by_topic):
+        members = sorted(set(by_topic[topic]))
+        rows = partition_lag_per_topic.get(topic, ())
+        if not members or not rows:
+            continue
+        P = len(rows)
+        lags = np.fromiter((r.lag for r in rows), np.int64, count=P)
+        pids = np.fromiter((r.partition for r in rows), np.int32, count=P)
+        choice = np.asarray(solve_topic(lags, pids, len(members)))[:P]
+        frag = _rebuild_topic(
+            topic, members, lags, pids, np.ones(P, dtype=bool), choice
+        )
+        for member, tps in frag.items():
+            assignment[member].extend(tps)
+    return assignment
+
+
 __all__ = [
     "assign_device",
     "assign_group_device",
