@@ -38,6 +38,7 @@ from .types import (
     TopicPartition,
 )
 from .utils.config import AssignorConfig, parse_config
+from .utils.watchdog import Watchdog
 from .utils.observability import (
     RebalanceStats,
     log_rebalance,
@@ -63,6 +64,7 @@ class LagBasedPartitionAssignor:
         self._config: Optional[AssignorConfig] = None
         self._metadata_consumer: Optional[MetadataConsumer] = None
         self._metadata_consumer_factory = metadata_consumer_factory
+        self._watchdog: Optional[Watchdog] = None
         self.last_stats: Optional[RebalanceStats] = None
 
     # -- Configurable SPI --------------------------------------------------
@@ -70,6 +72,7 @@ class LagBasedPartitionAssignor:
     def configure(self, configs: Mapping[str, Any]) -> None:
         """Reference :97-130 — fails fast if ``group.id`` is absent."""
         self._config = parse_config(configs)
+        self._watchdog = Watchdog(self._config.solve_timeout_s)
         LOGGER.debug(
             "Configured LagBasedPartitionAssignor with values:\n"
             "\tgroup.id = %s\n\tclient.id = %s\n\tsolver = %s",
@@ -153,17 +156,13 @@ class LagBasedPartitionAssignor:
         if solver == "host":
             return assign_greedy(lags, topic_subscriptions)
         try:
-            if solver == "sinkhorn":
-                from .models.sinkhorn import assign_sinkhorn
-
-                return assign_sinkhorn(lags, topic_subscriptions)
-            if solver == "native":
-                from .native import assign_native
-
-                return assign_native(lags, topic_subscriptions)
-            from .ops.dispatch import assign_device
-
-            return assign_device(lags, topic_subscriptions, kernel=solver)
+            # Device/native solves run under the watchdog: a wedged
+            # accelerator transport can HANG rather than raise, and a
+            # rebalance must never block past its deadline (SURVEY §5,
+            # failure-detection row).
+            return self._watchdog.call(
+                self._solve_accelerated, solver, lags, topic_subscriptions
+            )
         except Exception:
             if not self._config.host_fallback:
                 raise
@@ -174,6 +173,20 @@ class LagBasedPartitionAssignor:
             )
             stats.fallback_used = True
             return assign_greedy(lags, topic_subscriptions)
+
+    @staticmethod
+    def _solve_accelerated(solver, lags, topic_subscriptions):
+        if solver == "sinkhorn":
+            from .models.sinkhorn import assign_sinkhorn
+
+            return assign_sinkhorn(lags, topic_subscriptions)
+        if solver == "native":
+            from .native import assign_native
+
+            return assign_native(lags, topic_subscriptions)
+        from .ops.dispatch import assign_device
+
+        return assign_device(lags, topic_subscriptions, kernel=solver)
 
     def _get_metadata_consumer(self) -> MetadataConsumer:
         """Lazily create the shared metadata consumer (reference :322-324);
@@ -192,3 +205,9 @@ class LagBasedPartitionAssignor:
     def set_metadata_consumer(self, consumer: MetadataConsumer) -> None:
         """Directly inject a broker client (tests, embedding runtimes)."""
         self._metadata_consumer = consumer
+
+    def reset_accelerator(self) -> None:
+        """Clear a tripped solve watchdog so the next rebalance probes the
+        accelerator again (the trip also auto-expires after its cooldown)."""
+        if self._watchdog is not None:
+            self._watchdog.reset()

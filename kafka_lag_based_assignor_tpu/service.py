@@ -1,0 +1,262 @@
+"""Sidecar service: the plugin boundary as a wire API.
+
+Deployment model (BASELINE.json north star): the JVM-side
+``partition.assignment.strategy`` plugin keeps doing what the reference's
+``assign(Cluster, GroupSubscription)`` does — group bookkeeping and the
+offset/lag RPCs — and marshals the resulting ``(partition lags,
+subscriptions)`` to this co-located sidecar, which runs the TPU solve and
+returns the member->partitions map.  Only the combinatorial core crosses
+the process boundary, mirroring the L1/L3 split (SURVEY §1).
+
+Protocol: newline-delimited JSON over TCP (trivially implementable from
+Java; no schema compiler needed).
+
+Request::
+
+    {"id": 1, "method": "assign",
+     "params": {"topics":        {"t0": [[0, 100000], [1, 50000]]},
+                "subscriptions": {"C0": ["t0"], "C1": ["t0"]},
+                "solver":        "rounds"}}          # optional
+
+Response::
+
+    {"id": 1, "result": {"assignments": {"C0": [["t0", 0]], ...},
+                         "stats": {...}}}
+    {"id": 1, "error": {"message": "..."}}
+
+Also supported: ``{"method": "ping"}`` -> ``{"result": "pong"}`` and
+``{"method": "stats"}`` -> counters since start.  One request per line;
+responses preserve the request ``id``.  Malformed JSON gets an error
+response with ``id: null`` rather than a dropped connection.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import socketserver
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .models.greedy import assign_greedy
+from .types import TopicPartitionLag
+from .utils.observability import RebalanceStats, summarize_assignment
+
+LOGGER = logging.getLogger(__name__)
+
+_SOLVERS = ("rounds", "scan", "sinkhorn", "native", "host")
+
+
+def _solve(topics, subscriptions, solver):
+    lag_map = {
+        topic: [
+            TopicPartitionLag(topic, int(pid), int(lag)) for pid, lag in rows
+        ]
+        for topic, rows in topics.items()
+    }
+    subs = {m: list(ts) for m, ts in subscriptions.items()}
+    if solver == "host":
+        raw = assign_greedy(lag_map, subs)
+    elif solver == "sinkhorn":
+        from .models.sinkhorn import assign_sinkhorn
+
+        raw = assign_sinkhorn(lag_map, subs)
+    elif solver == "native":
+        from .native import assign_native
+
+        raw = assign_native(lag_map, subs)
+    else:
+        from .ops.dispatch import assign_device
+
+        raw = assign_device(lag_map, subs, kernel=solver)
+
+    stats = RebalanceStats(
+        solver=solver,
+        num_topics=len(lag_map),
+        num_partitions=sum(len(v) for v in lag_map.values()),
+        num_members=len(subs),
+    )
+    lag_by_tp = {
+        (r.topic, r.partition): r.lag for rows in lag_map.values() for r in rows
+    }
+    stats.total_lag = sum(lag_by_tp.values())
+    summarize_assignment(
+        stats, raw, {tp: lag_by_tp.get((tp.topic, tp.partition), 0)
+                     for tps in raw.values() for tp in tps}
+    )
+    assignments = {
+        m: [[tp.topic, tp.partition] for tp in tps] for m, tps in raw.items()
+    }
+    return assignments, stats
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        for line in self.rfile:
+            line = line.strip()
+            if not line:
+                continue
+            response = self.server.app.handle_line(line)  # type: ignore[attr-defined]
+            self.wfile.write(response + b"\n")
+            self.wfile.flush()
+
+
+class AssignorService:
+    """The request processor + TCP front end."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._tcp = socketserver.ThreadingTCPServer(
+            (host, port), _Handler, bind_and_activate=True
+        )
+        self._tcp.daemon_threads = True
+        self._tcp.app = self  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+        self.requests_served = 0
+        self.errors = 0
+        self.started_at = time.time()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._tcp.server_address  # type: ignore[return-value]
+
+    # -- request processing ------------------------------------------------
+
+    def handle_line(self, line: bytes) -> bytes:
+        req_id = None
+        try:
+            req = json.loads(line)
+            req_id = req.get("id")
+            method = req.get("method")
+            if method == "ping":
+                result: Any = "pong"
+            elif method == "stats":
+                result = {
+                    "requests_served": self.requests_served,
+                    "errors": self.errors,
+                    "uptime_s": time.time() - self.started_at,
+                }
+            elif method == "assign":
+                params = req.get("params") or {}
+                solver = params.get("solver", "rounds")
+                if solver not in _SOLVERS:
+                    raise ValueError(
+                        f"unknown solver {solver!r}; valid: {list(_SOLVERS)}"
+                    )
+                assignments, stats = _solve(
+                    params.get("topics") or {},
+                    params.get("subscriptions") or {},
+                    solver,
+                )
+                result = {
+                    "assignments": assignments,
+                    "stats": json.loads(stats.to_json()),
+                }
+            else:
+                raise ValueError(f"unknown method {method!r}")
+            self.requests_served += 1
+            return json.dumps({"id": req_id, "result": result}).encode()
+        except Exception as exc:  # noqa: BLE001 — wire boundary
+            self.errors += 1
+            LOGGER.warning("service request failed", exc_info=True)
+            return json.dumps(
+                {"id": req_id, "error": {"message": str(exc)}}
+            ).encode()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "AssignorService":
+        self._thread = threading.Thread(
+            target=self._tcp.serve_forever, name="klba-service", daemon=True
+        )
+        self._thread.start()
+        LOGGER.info("assignor service listening on %s:%d", *self.address)
+        return self
+
+    def stop(self) -> None:
+        self._tcp.shutdown()
+        self._tcp.server_close()
+
+    def __enter__(self) -> "AssignorService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+class AssignorServiceClient:
+    """Blocking line-protocol client (what the JVM plugin side implements)."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 60.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout_s)
+        self._file = self._sock.makefile("rwb")
+        self._next_id = 0
+        self._lock = threading.Lock()
+
+    def request(self, method: str, params: Optional[Dict] = None) -> Any:
+        with self._lock:
+            self._next_id += 1
+            req = {"id": self._next_id, "method": method}
+            if params is not None:
+                req["params"] = params
+            self._file.write(json.dumps(req).encode() + b"\n")
+            self._file.flush()
+            line = self._file.readline()
+        if not line:
+            raise ConnectionError("service closed the connection")
+        resp = json.loads(line)
+        if "error" in resp:
+            raise RuntimeError(resp["error"]["message"])
+        return resp["result"]
+
+    def ping(self) -> bool:
+        return self.request("ping") == "pong"
+
+    def assign(
+        self,
+        topics: Dict[str, List[Tuple[int, int]]],
+        subscriptions: Dict[str, List[str]],
+        solver: str = "rounds",
+    ) -> Dict[str, List[Tuple[str, int]]]:
+        result = self.request(
+            "assign",
+            {
+                "topics": topics,
+                "subscriptions": subscriptions,
+                "solver": solver,
+            },
+        )
+        return {
+            m: [(t, int(p)) for t, p in tps]
+            for m, tps in result["assignments"].items()
+        }
+
+    def close(self) -> None:
+        self._file.close()
+        self._sock.close()
+
+    def __enter__(self) -> "AssignorServiceClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def main() -> None:
+    """``python -m kafka_lag_based_assignor_tpu.service [host] [port]``"""
+    import sys
+
+    logging.basicConfig(level=logging.INFO)
+    host = sys.argv[1] if len(sys.argv) > 1 else "127.0.0.1"
+    port = int(sys.argv[2]) if len(sys.argv) > 2 else 7531
+    service = AssignorService(host, port).start()
+    print(f"listening on {service.address[0]}:{service.address[1]}", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        service.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -15,7 +15,7 @@ untouched, as the reference copies the whole map (:101-104).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Dict, Mapping
+from typing import Any, Dict, Mapping, Optional
 
 GROUP_ID_CONFIG = "group.id"
 AUTO_OFFSET_RESET_CONFIG = "auto.offset.reset"
@@ -26,6 +26,7 @@ PARTITION_ASSIGNMENT_STRATEGY_CONFIG = "partition.assignment.strategy"
 SOLVER_CONFIG = "tpu.assignor.solver"  # rounds | scan | sinkhorn | native | host
 FALLBACK_CONFIG = "tpu.assignor.host.fallback"  # bool: greedy host fallback
 PROFILE_CONFIG = "tpu.assignor.profile"  # bool: jax.profiler traces
+SOLVE_TIMEOUT_CONFIG = "tpu.assignor.solve.timeout.ms"  # 0/empty disables
 
 _VALID_SOLVERS = ("rounds", "scan", "sinkhorn", "native", "host")
 
@@ -39,6 +40,12 @@ class AssignorConfig:
     solver: str = "rounds"
     host_fallback: bool = True
     profile: bool = False
+    # A hung accelerator (wedged transport) must never block a rebalance
+    # past its deadline; None disables the watchdog.  The default leaves
+    # headroom for first-rebalance XLA compiles (~40 s/shape without a warm
+    # persistent cache); a trip only sidelines the accelerator for the
+    # watchdog cooldown, not forever.
+    solve_timeout_s: Optional[float] = 120.0
     consumer_group_props: Dict[str, Any] = field(default_factory=dict)
     metadata_consumer_props: Dict[str, Any] = field(default_factory=dict)
 
@@ -81,6 +88,15 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
     metadata_consumer_props[ENABLE_AUTO_COMMIT_CONFIG] = "false"
     metadata_consumer_props[CLIENT_ID_CONFIG] = f"{group_id}.assignor"
 
+    raw_timeout = consumer_group_props.get(SOLVE_TIMEOUT_CONFIG, 120_000)
+    try:
+        timeout_ms = float(raw_timeout) if raw_timeout not in ("", None) else 0.0
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"{SOLVE_TIMEOUT_CONFIG}={raw_timeout!r} is not a number"
+        )
+    solve_timeout_s = timeout_ms / 1000.0 if timeout_ms > 0 else None
+
     return AssignorConfig(
         group_id=str(group_id),
         auto_offset_reset=str(
@@ -89,6 +105,7 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
         solver=solver,
         host_fallback=_as_bool(consumer_group_props.get(FALLBACK_CONFIG, True)),
         profile=_as_bool(consumer_group_props.get(PROFILE_CONFIG, False)),
+        solve_timeout_s=solve_timeout_s,
         consumer_group_props=consumer_group_props,
         metadata_consumer_props=metadata_consumer_props,
     )

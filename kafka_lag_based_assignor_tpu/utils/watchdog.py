@@ -1,0 +1,107 @@
+"""Timeout-based failure detection for accelerator calls.
+
+The reference's failure model is exception propagation (broker RPCs abort
+the rebalance, SURVEY §2.4.9) — but an accelerator behind a
+tunnel/sidecar can also *hang* (observed in practice: a wedged transport
+makes even device enumeration block forever).  A consumer-group rebalance
+must never block on the accelerator past its rebalance timeout, so device
+solves run under a watchdog: the call executes in a daemon worker thread
+and, on timeout, the caller falls back to the host path while the stuck
+call is abandoned (threads blocked in a wedged RPC cannot be force-killed
+from Python; abandoning is the correct containment — the daemon thread dies
+with the process and later calls go straight to the fallback).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, TypeVar
+
+LOGGER = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+
+class SolveTimeout(Exception):
+    """Raised when a watched call exceeds its deadline."""
+
+
+class Watchdog:
+    """Runs callables with a deadline on abandonable daemon threads.
+
+    Deliberately NOT a ThreadPoolExecutor: the executor's atexit hook JOINS
+    its workers, so a process that abandoned a hung solve would block at
+    shutdown for the full hang.  A bare daemon thread dies with the process.
+
+    A timeout marks the watchdog *tripped* so subsequent solves skip the
+    accelerator immediately (fast host fallback) instead of queueing fresh
+    threads behind a wedged transport.  The trip is NOT permanent: after
+    ``cooldown_s`` the next solve probes the accelerator again, so one
+    transient stall (e.g. a slow first-rebalance XLA compile) cannot
+    banish a healthy device forever.  ``reset()`` clears the trip
+    immediately (operator action).
+    """
+
+    def __init__(self, timeout_s: Optional[float], cooldown_s: float = 300.0):
+        self.timeout_s = timeout_s
+        self.cooldown_s = cooldown_s
+        self._tripped_at: Optional[float] = None
+        self._lock = threading.Lock()
+
+    @property
+    def tripped(self) -> bool:
+        with self._lock:
+            return self._tripped_at is not None and (
+                time.monotonic() - self._tripped_at < self.cooldown_s
+            )
+
+    def reset(self) -> None:
+        """Allow the accelerator another chance (e.g. operator action)."""
+        with self._lock:
+            self._tripped_at = None
+
+    def call(self, fn: Callable[..., T], *args: Any, **kwargs: Any) -> T:
+        """Run ``fn`` under the deadline.
+
+        Raises SolveTimeout if the deadline passes or the watchdog tripped
+        within the cooldown window.  With ``timeout_s`` None the call runs
+        inline (watchdog disabled).
+        """
+        if self.timeout_s is None:
+            return fn(*args, **kwargs)
+        with self._lock:
+            if self._tripped_at is not None:
+                if time.monotonic() - self._tripped_at < self.cooldown_s:
+                    raise SolveTimeout(
+                        "watchdog tripped; accelerator considered down for "
+                        f"{self.cooldown_s}s (or until reset())"
+                    )
+                self._tripped_at = None  # cooldown over — probe again
+
+        outcome: Dict[str, Any] = {}
+        done = threading.Event()
+
+        def run() -> None:
+            try:
+                outcome["value"] = fn(*args, **kwargs)
+            except BaseException as exc:  # noqa: BLE001 — re-raised below
+                outcome["exc"] = exc
+            finally:
+                done.set()
+
+        worker = threading.Thread(target=run, name="klba-solve", daemon=True)
+        worker.start()
+        if not done.wait(self.timeout_s):
+            with self._lock:
+                self._tripped_at = time.monotonic()
+            LOGGER.warning(
+                "device solve exceeded %.1fs; abandoning call and marking "
+                "accelerator down",
+                self.timeout_s,
+            )
+            raise SolveTimeout(f"device solve exceeded {self.timeout_s}s")
+        if "exc" in outcome:
+            raise outcome["exc"]
+        return outcome["value"]

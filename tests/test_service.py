@@ -1,0 +1,102 @@
+"""Sidecar service tests: wire protocol, solver routing, error handling,
+concurrency."""
+
+import json
+import socket
+import threading
+
+import pytest
+
+from kafka_lag_based_assignor_tpu import TopicPartitionLag, assign_greedy
+from kafka_lag_based_assignor_tpu.service import (
+    AssignorService,
+    AssignorServiceClient,
+)
+
+
+@pytest.fixture()
+def service():
+    with AssignorService(port=0) as svc:
+        yield svc
+
+
+def client_for(svc):
+    return AssignorServiceClient(*svc.address)
+
+
+def test_ping(service):
+    with client_for(service) as c:
+        assert c.ping()
+
+
+def test_assign_matches_oracle(service):
+    topics = {"t0": [[0, 100000], [1, 50000], [2, 60000]]}
+    subs = {"C0": ["t0"], "C1": ["t0"]}
+    with client_for(service) as c:
+        result = c.assign(topics, subs, solver="host")
+    oracle = assign_greedy(
+        {"t0": [TopicPartitionLag("t0", p, l) for p, l in topics["t0"]]}, subs
+    )
+    assert result == {
+        m: [(tp.topic, tp.partition) for tp in tps] for m, tps in oracle.items()
+    }
+
+
+def test_assign_device_solver(service):
+    topics = {"t0": [[p, p * 100] for p in range(16)]}
+    subs = {f"m{i}": ["t0"] for i in range(4)}
+    with client_for(service) as c:
+        result = c.assign(topics, subs, solver="rounds")
+    sizes = sorted(len(v) for v in result.values())
+    assert sizes == [4, 4, 4, 4]
+
+
+def test_unknown_method(service):
+    with client_for(service) as c:
+        with pytest.raises(RuntimeError, match="unknown method"):
+            c.request("frobnicate")
+
+
+def test_unknown_solver(service):
+    with client_for(service) as c:
+        with pytest.raises(RuntimeError, match="unknown solver"):
+            c.assign({"t": [[0, 1]]}, {"m": ["t"]}, solver="quantum")
+
+
+def test_malformed_json_gets_error_response(service):
+    host, port = service.address
+    with socket.create_connection((host, port)) as s:
+        f = s.makefile("rwb")
+        f.write(b"this is not json\n")
+        f.flush()
+        resp = json.loads(f.readline())
+    assert resp["id"] is None and "error" in resp
+
+
+def test_stats_counts_requests(service):
+    with client_for(service) as c:
+        c.ping()
+        c.ping()
+        stats = c.request("stats")
+    assert stats["requests_served"] >= 2
+
+
+def test_concurrent_clients(service):
+    topics = {"t0": [[p, p] for p in range(10)]}
+    results = []
+
+    def run(i):
+        with client_for(service) as c:
+            results.append(
+                c.assign(topics, {f"m{i}": ["t0"], "other": ["t0"]},
+                         solver="host")
+            )
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(results) == 8
+    for r in results:
+        assert sum(len(v) for v in r.values()) == 10

@@ -1,0 +1,72 @@
+"""Streaming warm-start tests: bounded churn, preserved invariants, reset."""
+
+import numpy as np
+
+from kafka_lag_based_assignor_tpu.ops.batched import assign_stream
+from kafka_lag_based_assignor_tpu.ops.streaming import StreamingAssignor
+
+
+def drift(rng, lags, sigma=0.05):
+    return np.maximum(
+        (lags.astype(np.float64) * rng.lognormal(0, sigma, lags.shape)), 0
+    ).astype(np.int64)
+
+
+def test_cold_then_warm_invariants():
+    rng = np.random.default_rng(0)
+    P, C = 2048, 16
+    engine = StreamingAssignor(num_consumers=C, refine_iters=64)
+    lags = rng.integers(0, 10**9, size=P).astype(np.int64)
+
+    choice = engine.rebalance(lags)
+    assert engine.last_stats.cold_start
+    assert engine.last_stats.count_spread <= 1
+    assert choice.shape == (P,)
+
+    for _ in range(5):
+        lags = drift(rng, lags)
+        choice = engine.rebalance(lags)
+        s = engine.last_stats
+        assert not s.cold_start
+        assert s.count_spread <= 1
+        # Churn bounded by the exchange budget (2 partitions per swap).
+        assert s.churn <= 2 * 64
+        assert s.max_mean_imbalance < 1.2
+
+
+def test_warm_churn_much_lower_than_resolve():
+    """Under mild drift, the warm path must move far fewer partitions than a
+    from-scratch re-solve would."""
+    rng = np.random.default_rng(1)
+    P, C = 4096, 32
+    engine = StreamingAssignor(num_consumers=C, refine_iters=32)
+    lags = rng.integers(0, 10**9, size=P).astype(np.int64)
+    prev = engine.rebalance(lags)
+
+    lags2 = drift(rng, lags, sigma=0.02)
+    warm = engine.rebalance(lags2)
+    warm_churn = int((warm != prev).sum())
+
+    scratch = np.asarray(assign_stream(lags2, num_consumers=C)).astype(np.int32)
+    scratch_churn = int((scratch != prev).sum())
+
+    assert warm_churn <= 2 * 32
+    assert scratch_churn > 10 * max(warm_churn, 1)
+
+
+def test_shape_change_forces_cold_start():
+    rng = np.random.default_rng(2)
+    engine = StreamingAssignor(num_consumers=4)
+    engine.rebalance(rng.integers(0, 100, size=64).astype(np.int64))
+    engine.rebalance(rng.integers(0, 100, size=128).astype(np.int64))
+    assert engine.last_stats.cold_start
+
+
+def test_reset_forces_cold_start():
+    rng = np.random.default_rng(3)
+    engine = StreamingAssignor(num_consumers=4)
+    lags = rng.integers(0, 100, size=64).astype(np.int64)
+    engine.rebalance(lags)
+    engine.reset()
+    engine.rebalance(lags)
+    assert engine.last_stats.cold_start

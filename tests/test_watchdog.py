@@ -1,0 +1,98 @@
+"""Watchdog failure-detection tests: a HUNG accelerator (not just a raising
+one) must never block a rebalance — observed in practice when the device
+transport wedges."""
+
+import time
+
+import pytest
+
+from kafka_lag_based_assignor_tpu.assignor import LagBasedPartitionAssignor
+from kafka_lag_based_assignor_tpu.testing import FakeBroker
+from kafka_lag_based_assignor_tpu.types import GroupSubscription, Subscription
+from kafka_lag_based_assignor_tpu.utils.watchdog import SolveTimeout, Watchdog
+
+
+def test_fast_call_passes_through():
+    wd = Watchdog(timeout_s=5.0)
+    assert wd.call(lambda x: x + 1, 41) == 42
+    assert not wd.tripped
+
+
+def test_timeout_raises_and_trips():
+    wd = Watchdog(timeout_s=0.05)
+    with pytest.raises(SolveTimeout):
+        wd.call(time.sleep, 10)
+    assert wd.tripped
+    # Subsequent calls short-circuit without waiting.
+    t0 = time.perf_counter()
+    with pytest.raises(SolveTimeout):
+        wd.call(lambda: 1)
+    assert time.perf_counter() - t0 < 0.05
+
+
+def test_reset_restores_service():
+    wd = Watchdog(timeout_s=0.05)
+    with pytest.raises(SolveTimeout):
+        wd.call(time.sleep, 10)
+    wd.reset()
+    assert wd.call(lambda: "ok") == "ok"
+
+
+def test_cooldown_auto_retries():
+    """A trip is temporary: after the cooldown the next call probes again —
+    one transient stall must not banish a healthy accelerator forever."""
+    wd = Watchdog(timeout_s=0.05, cooldown_s=0.1)
+    with pytest.raises(SolveTimeout):
+        wd.call(time.sleep, 10)
+    assert wd.tripped
+    time.sleep(0.15)
+    assert not wd.tripped
+    assert wd.call(lambda: "recovered") == "recovered"
+
+
+def test_assignor_reset_accelerator():
+    broker = FakeBroker().with_partition("t", 0, end=100, committed=0)
+    a = LagBasedPartitionAssignor(metadata_consumer_factory=lambda p: broker)
+    a.configure({"group.id": "g", "tpu.assignor.solve.timeout.ms": "100"})
+    a._watchdog.call  # built at configure time
+    a._watchdog._tripped_at = time.monotonic()
+    a.reset_accelerator()
+    assert not a._watchdog.tripped
+
+
+def test_disabled_watchdog_runs_inline():
+    wd = Watchdog(timeout_s=None)
+    assert wd.call(lambda: 7) == 7
+
+
+def test_exception_propagates_not_tripped():
+    wd = Watchdog(timeout_s=5.0)
+    with pytest.raises(ZeroDivisionError):
+        wd.call(lambda: 1 / 0)
+    assert not wd.tripped
+
+
+def test_hung_solver_falls_back_to_host(monkeypatch):
+    """Full plugin path: device solver hangs -> host greedy result within the
+    deadline, fallback recorded."""
+    import kafka_lag_based_assignor_tpu.ops.dispatch as dispatch
+
+    def hang(*a, **k):
+        time.sleep(30)
+
+    monkeypatch.setattr(dispatch, "assign_device", hang)
+    broker = FakeBroker().with_partition("t", 0, end=100, committed=0)
+    a = LagBasedPartitionAssignor(metadata_consumer_factory=lambda p: broker)
+    a.configure({"group.id": "g", "tpu.assignor.solve.timeout.ms": "200"})
+    subs = GroupSubscription({"m": Subscription(("t",))})
+    t0 = time.perf_counter()
+    result = a.assign(broker.cluster(), subs)
+    assert time.perf_counter() - t0 < 5
+    assert a.last_stats.fallback_used
+    assert len(result.group_assignment["m"].partitions) == 1
+
+
+def test_timeout_config_validation():
+    a = LagBasedPartitionAssignor()
+    with pytest.raises(ValueError, match="not a number"):
+        a.configure({"group.id": "g", "tpu.assignor.solve.timeout.ms": "soon"})
