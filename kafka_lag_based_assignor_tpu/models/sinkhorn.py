@@ -100,7 +100,7 @@ def assign_topic_sinkhorn(
     valid: jax.Array,
     num_consumers: int,
     iters: int = 60,
-    refine_iters: int = 128,
+    refine_iters: int = 24,
 ):
     """Integral, count-balanced assignment from the Sinkhorn plan.
 

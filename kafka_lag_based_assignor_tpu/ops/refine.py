@@ -1,27 +1,39 @@
-"""Exchange local-search refinement kernel.
+"""Parallel pairwise-exchange refinement kernel.
 
 Post-processes any integral, count-balanced assignment to tighten the
 north-star metric (max/mean lag imbalance) beyond what one greedy pass can
 reach, while preserving the count invariant ``max - min <= 1``.
 
-Each iteration (a ``lax.fori_loop`` step, all vectorized over [P]/[C]):
+TPU-native design: instead of one exchange per step (a sequential local
+search with a P-sized sort in every iteration), each **round** performs up
+to ``max_pairs`` *disjoint* exchanges simultaneously:
 
-1. find the most- and least-loaded consumers, jmax / jmin;
-2. candidate **swap**: exchange a partition p on jmax with a partition q on
-   jmin (counts unchanged).  Ideal transfer is delta = (load_max -
-   load_min)/2; q is jmin's lightest partition, p is chosen on jmax with
-   lag closest to q.lag + delta;
-3. candidate **move**: shift p from jmax to jmin, allowed only when
-   count(jmax) > count(jmin) (keeps the count spread <= 1); p closest to
-   delta;
-4. apply whichever of the applicable candidates reduces the pairwise load
-   spread; stop changing anything once no candidate improves (the loop
-   body becomes a no-op — convergence is monotone).
+1. rank consumers by load (one C-sized argsort — C << P) and pair the
+   k-th most-loaded consumer with a partner from the light half, rotating
+   the partner permutation every round so a stuck heavy consumer meets
+   every possible partner across rounds;
+2. for every pair independently, pick the best single-partition **move**
+   (heavy → light, lag closest to half the load gap, only while the count
+   spread stays <= 1) and the best **swap** — the light side is sorted by
+   (pair, lag) once per round, and one vectorized ``searchsorted`` finds,
+   for every heavy-side partition p, the light-side q whose lag is
+   closest to ``lag_p - delta`` (the exact best counterpart), reduced to
+   the best (p, q) per pair by O(P) segment-argmin scatter ops;
+3. apply every strictly-improving exchange at once.  Pairs are disjoint
+   (each consumer belongs to at most one), so parallel application is
+   race-free, and since any transferred amount d satisfies
+   0 < d < load_heavy - load_light, no consumer's load ever exceeds the
+   running maximum — the global max is monotone non-increasing.
+
+A round costs one P-sized sort plus a handful of O(P) gathers/scatters
+and retires up to K exchanges, versus the sequential kernel's one
+exchange per round; at P=100k / C=1k this is ~3 orders of magnitude more
+exchange throughput.  Churn is bounded by ``2 * iters * max_pairs``.
 
 The refinement is solver-agnostic: it accepts the (choice, lags) pair in
 input order from the greedy kernels or the Sinkhorn rounding.  It
 intentionally does NOT reproduce reference semantics — it is the framework's
-quality mode (BASELINE config 4), parity solvers remain bit-exact.
+quality mode (BASELINE config 4); parity solvers remain bit-exact.
 """
 
 from __future__ import annotations
@@ -33,116 +45,159 @@ import jax.numpy as jnp
 from jax import lax
 
 
-@functools.partial(jax.jit, static_argnames=("num_consumers", "iters"))
+def _segment_argmin(score, seg, num_segments, P):
+    """Deterministic per-segment argmin: returns (min value, first index
+    attaining it) per segment.  ``seg`` entries equal to ``num_segments``
+    are parked in a discard slot.  Two O(P) scatter-mins."""
+    big = jnp.iinfo(score.dtype).max
+    minv = jnp.full((num_segments + 1,), big, score.dtype).at[seg].min(score)
+    hit = (score == minv[seg]) & (seg < num_segments)
+    idx_cand = jnp.where(hit, jnp.arange(P, dtype=jnp.int32), P)
+    idx = jnp.full((num_segments + 1,), P, jnp.int32).at[seg].min(idx_cand)
+    return minv[:num_segments], idx[:num_segments]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_consumers", "iters", "max_pairs")
+)
 def refine_assignment(
     lags: jax.Array,
     valid: jax.Array,
     choice: jax.Array,
     num_consumers: int,
-    iters: int = 128,
+    iters: int = 16,
+    max_pairs: int | None = None,
 ):
-    """Improve an integral assignment by pairwise exchanges.
+    """Improve an integral assignment by rounds of parallel exchanges.
 
     Args:
       lags: [P] lag per partition row.
       valid: [P] mask; invalid rows must have choice == -1.
       choice: int32[P] consumer index per row (count-balanced).
       num_consumers: static C.
-      iters: local-search steps (each strictly improving or no-op).
+      iters: refinement rounds; each applies up to ``max_pairs`` disjoint,
+        strictly-improving exchanges (or no-ops once converged).
+      max_pairs: concurrent consumer pairs per round (default C // 2).
+        Total churn is bounded by ``2 * iters * max_pairs`` partitions.
 
     Returns (choice int32[P], counts int32[C], totals[C]).
     """
     C = int(num_consumers)
     P = lags.shape[0]
+    K = max(1, min(C // 2, max_pairs if max_pairs is not None else C // 2))
     big = jnp.iinfo(lags.dtype).max
+    arangeC = jnp.arange(C, dtype=jnp.int32)
 
-    safe_choice = jnp.maximum(choice, 0)
+    choice = choice.astype(jnp.int32)
+    safe0 = jnp.clip(choice, 0, C - 1)
     assigned = valid & (choice >= 0)
-    totals0 = jnp.zeros((C,), lags.dtype).at[safe_choice].add(
+    totals0 = jnp.zeros((C,), lags.dtype).at[safe0].add(
         jnp.where(assigned, lags, 0)
     )
-    counts0 = jnp.zeros((C,), jnp.int32).at[safe_choice].add(
+    counts0 = jnp.zeros((C,), jnp.int32).at[safe0].add(
         assigned.astype(jnp.int32)
     )
+    if C < 2:
+        return choice, counts0, totals0
 
-    def body(_, state):
+    # Float key scale for the (pair, lag) composite sort.  Approximate
+    # (52-bit mantissa vs 63-bit lags) is fine: candidates are re-checked
+    # exactly before being applied.
+    scale = (jnp.max(jnp.where(assigned, lags, 0)) + 1).astype(jnp.float64)
+
+    def body(it, state):
         choice, totals, counts = state
-        jmax = jnp.argmax(totals).astype(jnp.int32)
-        jmin = jnp.argmin(totals).astype(jnp.int32)
+        safe_choice = jnp.clip(choice, 0, C - 1)
 
-        on_max = (choice == jmax) & valid
-        others = valid & (choice >= 0) & (choice != jmax)
+        # Rank consumers by load.  Pair the k-th heaviest with a partner
+        # from the light half, rotating the partner permutation each round
+        # (a bijection on the light half, so pairs stay disjoint).
+        order = jnp.argsort(totals).astype(jnp.int32)  # ascending
+        rank = jnp.zeros((C,), jnp.int32).at[order].set(arangeC)
+        n_light = C - K
+        shift = jnp.asarray(it, jnp.int32) % jnp.int32(n_light)
+        light_slot = (jnp.arange(K, dtype=jnp.int32) + shift) % n_light
+        light = order[light_slot]             # [K]
+        heavy = order[C - 1 - jnp.arange(K)]  # [K]
+        diff = totals[heavy] - totals[light]  # [K] >= 0
+        delta = diff // 2
 
-        # Per-candidate ideal transfer: q may live on ANY consumer j; moving
-        # d from jmax to j improves the pair iff 0 < d < load_max - load_j,
-        # ideally d = (load_max - load_j)/2.
-        load_of_q = totals[jnp.clip(choice, 0, C - 1)]
-        delta_q = (totals[jmax] - load_of_q) // 2
-
-        def closest_on_max(target):
-            dist = jnp.where(on_max, jnp.abs(lags - target), big)
-            p = jnp.argmin(dist)
-            return p, lags[p]
-
-        # Swap candidate: best improving pair (p on jmax, q elsewhere)
-        # minimizing |(lag_p - lag_q) - delta_q|.  For each q the best p is
-        # a neighbor of (lag_q + delta_q) in jmax's sorted lags — one
-        # vectorized searchsorted instead of a PxP cross product.
-        sorted_max = jnp.sort(jnp.where(on_max, lags, big))
-        targets = jnp.where(others, lags + delta_q, big)
-        pos = jnp.searchsorted(sorted_max, targets)
-        lo = sorted_max[jnp.clip(pos - 1, 0, P - 1)]
-        hi = sorted_max[jnp.clip(pos, 0, P - 1)]
-
-        def pair_err(cand):
-            d = cand - lags  # transfer for (cand, q) per q position
-            ok = others & (cand != big) & (d > 0) & (d < 2 * delta_q)
-            return jnp.where(ok, jnp.abs(d - delta_q), big), d
-
-        err_lo, d_lo = pair_err(lo)
-        err_hi, d_hi = pair_err(hi)
-        use_hi = err_hi < err_lo
-        err = jnp.where(use_hi, err_hi, err_lo)
-        d_q = jnp.where(use_hi, d_hi, d_lo)
-        cand = jnp.where(use_hi, hi, lo)
-
-        q = jnp.argmin(err).astype(jnp.int32)
-        swap_ok = err[q] < big
-        d_swap = d_q[q]
-        j_swap = jnp.clip(choice[q], 0, C - 1)
-        p_s, _ = closest_on_max(cand[q])
-
-        # Move candidate: shift p from jmax to jmin without a counterpart;
-        # allowed only while it keeps the count spread <= 1.
-        delta_min = (totals[jmax] - totals[jmin]) // 2
-        p_m, p_m_lag = closest_on_max(delta_min)
-        d_move = p_m_lag
-        move_ok = (counts[jmax] > counts[jmin]) & (d_move > 0) & (
-            d_move < 2 * delta_min
+        # Map consumers to pair ids (K = unpaired) and partitions to sides.
+        r = rank
+        slot_to_pair = (
+            jnp.full((n_light,), K, jnp.int32)
+            .at[light_slot]
+            .set(jnp.arange(K, dtype=jnp.int32))
         )
-
-        # Prefer the candidate with the smaller relative error to its ideal.
-        use_swap = swap_ok & (
-            ~move_ok | (jnp.abs(d_swap - delta_q[q]) <= jnp.abs(d_move - delta_min))
+        pair_of = jnp.where(
+            r < n_light, slot_to_pair[jnp.clip(r, 0, n_light - 1)], C - 1 - r
         )
-        use_move = move_ok & ~use_swap
+        heavy_side = r >= C - K
+        k_p = jnp.where(assigned, pair_of[safe_choice], K)
+        on_heavy = assigned & heavy_side[safe_choice] & (k_p < K)
+        on_light = assigned & ~heavy_side[safe_choice] & (k_p < K)
+        kc = jnp.clip(k_p, 0, K - 1)
+        diff_p = diff[kc]
+        delta_p = delta[kc]
+        seg_h = jnp.where(on_heavy, k_p, K)
 
-        p = jnp.where(use_swap, p_s, p_m)
-        dest = jnp.where(use_swap, j_swap, jmin)
-        do = use_swap | use_move
+        # Candidate 1 — MOVE: heavy-side partition with lag closest to
+        # delta; improving iff 0 < lag < diff.
+        ok_move = on_heavy & (lags > 0) & (lags < diff_p)
+        score_move = jnp.where(ok_move, jnp.abs(lags - delta_p), big)
+        err_move, p_move = _segment_argmin(score_move, seg_h, K, P)
 
-        new_choice = choice
-        new_choice = jnp.where(
-            do & (jnp.arange(P) == p), dest, new_choice
+        # Candidate 2 — exact best SWAP: sort light-side partitions by
+        # (pair, lag); for each heavy p, searchsorted its ideal
+        # counterpart lag_p - delta and examine the two neighbours.
+        keyl = jnp.where(
+            on_light,
+            k_p.astype(jnp.float64) + lags.astype(jnp.float64) / scale,
+            jnp.inf,
         )
-        new_choice = jnp.where(
-            use_swap & (jnp.arange(P) == q), jmax, new_choice
-        )
-        d = jnp.where(use_swap, d_swap, d_move)
+        perm = jnp.argsort(keyl).astype(jnp.int32)
+        skey = keyl[perm]
+        tgt = jnp.clip(lags - delta_p, 0, None).astype(jnp.float64) / scale
+        query = jnp.where(on_heavy, k_p.astype(jnp.float64) + tgt, jnp.inf)
+        pos = jnp.searchsorted(skey, query).astype(jnp.int32)
+
+        def neighbour(nb):
+            inb = jnp.clip(nb, 0, P - 1)
+            qi = perm[inb]
+            okq = (nb >= 0) & (nb < P) & on_light[qi] & (k_p[qi] == k_p)
+            d = lags - lags[qi]
+            ok = on_heavy & okq & (d > 0) & (d < diff_p)
+            return jnp.where(ok, jnp.abs(d - delta_p), big), qi
+
+        err_a, q_a = neighbour(pos - 1)
+        err_b, q_b = neighbour(pos)
+        use_b = err_b < err_a
+        err_pq = jnp.where(use_b, err_b, err_a)
+        q_of_p = jnp.where(use_b, q_b, q_a)
+        err_swap, p_swap = _segment_argmin(err_pq, seg_h, K, P)
+        q_swap = q_of_p[jnp.clip(p_swap, 0, P - 1)]
+
+        # Choose per pair; moves must keep the count spread <= 1.
+        move_allowed = (counts[heavy] > counts[light]) & (err_move < big)
+        err_move_eff = jnp.where(move_allowed, err_move, big)
+        use_move = move_allowed & (err_move_eff <= err_swap)
+        use_swap = ~use_move & (err_swap < big)
+        do = use_move | use_swap
+
+        p_sel = jnp.where(use_move, p_move, p_swap)
+        p_safe = jnp.clip(p_sel, 0, P - 1)
+        lag_q = jnp.where(use_swap, lags[jnp.clip(q_swap, 0, P - 1)], 0)
+        d = jnp.where(use_move, lags[p_safe], lags[p_safe] - lag_q)
         d = jnp.where(do, d, 0)
-        new_totals = totals.at[jmax].add(-d).at[dest].add(d)
+
+        # Apply all exchanges at once (pairs are disjoint -> race-free).
+        upd_p = jnp.where(do, p_sel, P)
+        upd_q = jnp.where(use_swap, q_swap, P)
+        new_choice = choice.at[upd_p].set(light, mode="drop")
+        new_choice = new_choice.at[upd_q].set(heavy, mode="drop")
+        new_totals = totals.at[heavy].add(-d).at[light].add(d)
         dc = use_move.astype(jnp.int32)
-        new_counts = counts.at[jmax].add(-dc).at[dest].add(dc)
+        new_counts = counts.at[heavy].add(-dc).at[light].add(dc)
         return new_choice, new_totals, new_counts
 
     choice, totals, counts = lax.fori_loop(

@@ -11,10 +11,12 @@ the optional warm start for the streaming-rebalance benchmark):
   transfer-lean :func:`..ops.batched.assign_stream` path (optionally plus
   refinement);
 * **warm rebalance** — keep the previous assignment and run only the
-  pairwise-exchange refinement (:mod:`.refine`) under the NEW lags.  The
-  count invariant is preserved by construction, imbalance is re-tightened,
-  and only the exchanges' partitions move — churn is bounded by
-  2 x refine_iters instead of O(P).
+  parallel pairwise-exchange refinement (:mod:`.refine`) under the NEW
+  lags.  The count invariant is preserved by construction, imbalance is
+  re-tightened, and only the exchanges' partitions move — ``refine_iters``
+  is a total *exchange budget*, split into rounds of up to ``C // 2``
+  concurrent disjoint exchanges, so churn is bounded by 2 x refine_iters
+  instead of O(P).
 
 The churn/quality trade-off is configurable per rebalance via
 ``refine_iters``.
@@ -28,6 +30,8 @@ from typing import Optional
 import numpy as np
 
 from .batched import assign_stream
+from .dispatch import ensure_x64
+from .packing import pad_bucket
 from .refine import refine_assignment
 
 
@@ -50,6 +54,7 @@ class StreamingAssignor:
 
     def rebalance(self, lags: np.ndarray) -> np.ndarray:
         """Produce choice int32[P] for the current lag vector."""
+        ensure_x64()  # int64 lags would silently downcast to int32 otherwise
         lags = np.ascontiguousarray(lags, dtype=np.int64)
         P = lags.shape[0]
         stats = StreamingStats()
@@ -61,16 +66,35 @@ class StreamingAssignor:
                 assign_stream(lags, num_consumers=self.num_consumers)
             ).astype(np.int32)
             prev_for_churn = None
+        elif self.refine_iters <= 0:
+            # Zero exchange budget: keep the previous assignment untouched
+            # (churn bound 2 * refine_iters = 0 holds exactly).
+            choice = prev
+            prev_for_churn = prev
         else:
-            valid = np.ones(P, dtype=bool)
+            # Pad to the power-of-two bucket (padding rows invalid/-1) so
+            # the refine kernel's P-sized sorts hit fast shapes and the jit
+            # cache stays bounded across slowly-varying P.
+            B = pad_bucket(P)
+            lags_p = np.zeros(B, dtype=np.int64)
+            lags_p[:P] = lags
+            valid = np.zeros(B, dtype=bool)
+            valid[:P] = True
+            prev_p = np.full(B, -1, dtype=np.int32)
+            prev_p[:P] = prev
+            # refine_iters is the exchange budget: rounds * pairs <= budget
+            # keeps the documented churn bound of 2 * refine_iters.
+            pairs = max(1, min(self.num_consumers // 2, self.refine_iters))
+            rounds = max(1, self.refine_iters // pairs)
             choice, _, _ = refine_assignment(
-                lags,
+                lags_p,
                 valid,
-                prev,
+                prev_p,
                 num_consumers=self.num_consumers,
-                iters=self.refine_iters,
+                iters=rounds,
+                max_pairs=pairs,
             )
-            choice = np.asarray(choice)
+            choice = np.asarray(choice)[:P]
             prev_for_churn = prev
 
         totals = np.zeros(self.num_consumers, dtype=np.int64)

@@ -40,16 +40,17 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from .assignor import LagBasedPartitionAssignor
 from .models.greedy import assign_greedy
 from .types import TopicPartitionLag
+from .utils.config import VALID_SOLVERS
 from .utils.observability import RebalanceStats, summarize_assignment
+from .utils.watchdog import Watchdog
 
 LOGGER = logging.getLogger(__name__)
 
-_SOLVERS = ("rounds", "scan", "sinkhorn", "native", "host")
 
-
-def _solve(topics, subscriptions, solver):
+def _solve(topics, subscriptions, solver, watchdog=None, host_fallback=True):
     lag_map = {
         topic: [
             TopicPartitionLag(topic, int(pid), int(lag)) for pid, lag in rows
@@ -57,20 +58,31 @@ def _solve(topics, subscriptions, solver):
         for topic, rows in topics.items()
     }
     subs = {m: list(ts) for m, ts in subscriptions.items()}
+    fallback_used = False
     if solver == "host":
         raw = assign_greedy(lag_map, subs)
-    elif solver == "sinkhorn":
-        from .models.sinkhorn import assign_sinkhorn
-
-        raw = assign_sinkhorn(lag_map, subs)
-    elif solver == "native":
-        from .native import assign_native
-
-        raw = assign_native(lag_map, subs)
     else:
-        from .ops.dispatch import assign_device
-
-        raw = assign_device(lag_map, subs, kernel=solver)
+        # Same failure model as the in-process plugin adapter
+        # (assignor._solve): device solves run under the watchdog — a
+        # wedged accelerator transport can HANG rather than raise, and a
+        # service request must never block a rebalance past its deadline —
+        # with the host greedy as the fallback.
+        solve = LagBasedPartitionAssignor._solve_accelerated
+        try:
+            if watchdog is not None:
+                raw = watchdog.call(solve, solver, lag_map, subs)
+            else:
+                raw = solve(solver, lag_map, subs)
+        except Exception:
+            if not host_fallback:
+                raise
+            LOGGER.warning(
+                "device solver %r failed; falling back to host greedy",
+                solver,
+                exc_info=True,
+            )
+            fallback_used = True
+            raw = assign_greedy(lag_map, subs)
 
     stats = RebalanceStats(
         solver=solver,
@@ -78,6 +90,7 @@ def _solve(topics, subscriptions, solver):
         num_partitions=sum(len(v) for v in lag_map.values()),
         num_members=len(subs),
     )
+    stats.fallback_used = fallback_used
     lag_by_tp = {
         (r.topic, r.partition): r.lag for rows in lag_map.values() for r in rows
     }
@@ -106,13 +119,24 @@ class _Handler(socketserver.StreamRequestHandler):
 class AssignorService:
     """The request processor + TCP front end."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        # Default matches the in-process plugin (utils/config.py): generous
+        # enough for a cold first-rebalance XLA compile (~40 s/shape).
+        solve_timeout_s: Optional[float] = 120.0,
+        host_fallback: bool = True,
+    ):
         self._tcp = socketserver.ThreadingTCPServer(
             (host, port), _Handler, bind_and_activate=True
         )
         self._tcp.daemon_threads = True
         self._tcp.app = self  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
+        self._watchdog = Watchdog(solve_timeout_s)
+        self._host_fallback = host_fallback
+        self._counter_lock = threading.Lock()
         self.requests_served = 0
         self.errors = 0
         self.started_at = time.time()
@@ -132,22 +156,25 @@ class AssignorService:
             if method == "ping":
                 result: Any = "pong"
             elif method == "stats":
-                result = {
-                    "requests_served": self.requests_served,
-                    "errors": self.errors,
-                    "uptime_s": time.time() - self.started_at,
-                }
+                with self._counter_lock:
+                    result = {
+                        "requests_served": self.requests_served,
+                        "errors": self.errors,
+                        "uptime_s": time.time() - self.started_at,
+                    }
             elif method == "assign":
                 params = req.get("params") or {}
                 solver = params.get("solver", "rounds")
-                if solver not in _SOLVERS:
+                if solver not in VALID_SOLVERS:
                     raise ValueError(
-                        f"unknown solver {solver!r}; valid: {list(_SOLVERS)}"
+                        f"unknown solver {solver!r}; valid: {list(VALID_SOLVERS)}"
                     )
                 assignments, stats = _solve(
                     params.get("topics") or {},
                     params.get("subscriptions") or {},
                     solver,
+                    watchdog=self._watchdog,
+                    host_fallback=self._host_fallback,
                 )
                 result = {
                     "assignments": assignments,
@@ -155,10 +182,12 @@ class AssignorService:
                 }
             else:
                 raise ValueError(f"unknown method {method!r}")
-            self.requests_served += 1
+            with self._counter_lock:
+                self.requests_served += 1
             return json.dumps({"id": req_id, "result": result}).encode()
         except Exception as exc:  # noqa: BLE001 — wire boundary
-            self.errors += 1
+            with self._counter_lock:
+                self.errors += 1
             LOGGER.warning("service request failed", exc_info=True)
             return json.dumps(
                 {"id": req_id, "error": {"message": str(exc)}}

@@ -28,7 +28,7 @@ FALLBACK_CONFIG = "tpu.assignor.host.fallback"  # bool: greedy host fallback
 PROFILE_CONFIG = "tpu.assignor.profile"  # bool: jax.profiler traces
 SOLVE_TIMEOUT_CONFIG = "tpu.assignor.solve.timeout.ms"  # 0/empty disables
 
-_VALID_SOLVERS = ("rounds", "scan", "sinkhorn", "native", "host")
+VALID_SOLVERS = ("rounds", "scan", "sinkhorn", "native", "host")
 
 
 @dataclass
@@ -77,9 +77,9 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
         )
 
     solver = str(consumer_group_props.get(SOLVER_CONFIG, "rounds"))
-    if solver not in _VALID_SOLVERS:
+    if solver not in VALID_SOLVERS:
         raise ValueError(
-            f"{SOLVER_CONFIG}={solver!r} invalid; choose one of {_VALID_SOLVERS}"
+            f"{SOLVER_CONFIG}={solver!r} invalid; choose one of {VALID_SOLVERS}"
         )
 
     # Derived metadata-consumer properties, exactly as the reference builds

@@ -62,6 +62,18 @@ def test_shape_change_forces_cold_start():
     assert engine.last_stats.cold_start
 
 
+def test_zero_budget_keeps_previous_assignment():
+    """refine_iters=0 must honour the churn bound 2 * 0 = 0 exactly."""
+    rng = np.random.default_rng(5)
+    engine = StreamingAssignor(num_consumers=8, refine_iters=0)
+    lags = rng.integers(0, 10**6, size=256).astype(np.int64)
+    first = engine.rebalance(lags)
+    second = engine.rebalance(drift(rng, lags))
+    assert (first == second).all()
+    assert engine.last_stats.churn == 0
+    assert not engine.last_stats.cold_start
+
+
 def test_reset_forces_cold_start():
     rng = np.random.default_rng(3)
     engine = StreamingAssignor(num_consumers=4)
