@@ -154,10 +154,33 @@ def config3_vmap():
     valid = np.ones((T, P), dtype=bool)
     ms, _, totals = device_assign_ms(lags, pids, valid, C)
     member_load = totals.sum(axis=0)
+
+    # Cross-topic global-balance quality mode (beyond-reference): same
+    # per-topic count invariant, lag totals carried across topics.
+    from kafka_lag_based_assignor_tpu.ops.rounds_kernel import (
+        assign_global_rounds,
+    )
+
+    def global_once():
+        t0 = time.perf_counter()
+        _, _, g_totals = assign_global_rounds(
+            lags, pids, valid, num_consumers=C
+        )
+        g_totals = np.asarray(g_totals)  # the one blocking readback
+        return (time.perf_counter() - t0) * 1000.0, g_totals
+
+    global_once()  # warm-up/compile
+    g_times, g_totals = [], None
+    for _ in range(10):
+        g_ms, g_totals = global_once()
+        g_times.append(g_ms)
+
     return {
         "config": "vmap_256t_64p_64c",
         "assign_ms": ms,
         "max_mean_imbalance_global": imbalance(member_load),
+        "global_mode_assign_ms": float(np.median(g_times)),
+        "global_mode_max_mean_imbalance": imbalance(g_totals),
     }
 
 

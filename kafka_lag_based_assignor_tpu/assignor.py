@@ -29,7 +29,7 @@ import logging
 from typing import Any, Callable, Mapping, Optional
 
 from .lag import MetadataConsumer, read_topic_partition_lags
-from .models.greedy import assign_greedy
+from .models.greedy import assign_greedy, host_fallback_for
 from .types import (
     Assignment,
     Cluster,
@@ -172,7 +172,7 @@ class LagBasedPartitionAssignor:
                 exc_info=True,
             )
             stats.fallback_used = True
-            return assign_greedy(lags, topic_subscriptions)
+            return host_fallback_for(solver)(lags, topic_subscriptions)
 
     @staticmethod
     def _solve_accelerated(solver, lags, topic_subscriptions):

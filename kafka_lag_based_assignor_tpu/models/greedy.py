@@ -44,17 +44,25 @@ def assign_topic_greedy(
     topic: str,
     consumers: Sequence[str],
     partition_lags: Sequence[TopicPartitionLag],
+    total_lag: Dict[str, int] | None = None,
 ) -> None:
     """Greedy LPT for one topic, appended into ``assignment`` in place.
 
     Exact reference semantics (:204-308): process partitions in descending
     lag (ties: ascending partition id); each partition goes to the consumer
     minimizing (assigned count, total assigned lag, member id).
+
+    ``total_lag`` defaults to a fresh all-zero accumulator — the reference's
+    topic-local ``consumerTotalLags`` (:216, SURVEY §2.4.3).  Passing a
+    shared dict (updated in place) carries the lag tiebreak across calls,
+    which is how :func:`assign_greedy_global` implements the cross-topic
+    quality mode; count stays topic-local (primary criterion) either way.
     """
     if not consumers:
         return
 
-    total_lag = {m: 0 for m in consumers}
+    if total_lag is None:
+        total_lag = {m: 0 for m in consumers}
     total_count = {m: 0 for m in consumers}
 
     ordered = sorted(partition_lags, key=lambda p: (-p.lag, p.partition))
@@ -63,6 +71,56 @@ def assign_topic_greedy(
         assignment[member].append(TopicPartition(part.topic, part.partition))
         total_lag[member] += part.lag
         total_count[member] += 1
+
+
+def assign_greedy_global(
+    partition_lag_per_topic: Mapping[str, Sequence[TopicPartitionLag]],
+    subscriptions: Mapping[str, Sequence[str]],
+) -> AssignmentMap:
+    """Cross-topic global-balance quality mode — host oracle/fallback.
+
+    Beyond-reference feature (the reference keeps ``consumerTotalLags``
+    local to each topic, :216, SURVEY §2.4.3).  Selection is still
+    (per-TOPIC count, total lag, member id) — so the per-topic count
+    invariant max − min ≤ 1 is preserved — but the lag totals accumulate
+    across all topics **within a subscriber-set group** (topics whose
+    subscriber sets are identical), mirroring exactly the scope the device
+    kernel's carried scan covers (:func:`..ops.rounds_kernel.assign_global_rounds`
+    via :func:`..ops.packing.build_groups`).  Topics are processed in global
+    sorted order with one shared accumulator per group, so per-member list
+    order matches the device dispatch path bit-for-bit.
+    """
+    assignment: AssignmentMap = {member: [] for member in subscriptions}
+    by_topic = consumers_per_topic(subscriptions)
+
+    # Topics in global sorted order (the same append order as assign_greedy
+    # and the device dispatch), with one shared lag accumulator per
+    # subscriber-set group — totals only ever interact within a group, so
+    # interleaving groups is equivalent to processing them separately.
+    group_totals: Dict[tuple, Dict[str, int]] = {}
+    for topic in sorted(by_topic):
+        members = tuple(sorted(set(by_topic[topic])))
+        if not members or not partition_lag_per_topic.get(topic):
+            continue
+        totals = group_totals.setdefault(members, {m: 0 for m in members})
+        assign_topic_greedy(
+            assignment,
+            topic,
+            members,
+            partition_lag_per_topic[topic],
+            total_lag=totals,
+        )
+    return assignment
+
+
+def host_fallback_for(solver: str):
+    """The host solver whose semantics match ``solver`` — used by both the
+    in-process plugin adapter and the sidecar service when a device solve
+    fails or times out, so a fallback never silently changes the assignment
+    semantics the caller configured: the ``global`` quality mode falls back
+    to :func:`assign_greedy_global`; every other solver is parity-bound to
+    the reference and falls back to :func:`assign_greedy`."""
+    return assign_greedy_global if solver == "global" else assign_greedy
 
 
 def assign_greedy(

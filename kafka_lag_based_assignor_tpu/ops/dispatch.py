@@ -25,10 +25,14 @@ from ..models.greedy import consumers_per_topic
 from ..types import AssignmentMap, TopicPartition, TopicPartitionLag
 from .batched import assign_batched_rounds, assign_batched_scan
 from .packing import TopicGroup, build_groups, pad_bucket
+from .rounds_kernel import assign_global_rounds
 
+# "global" returns a single [C] totals vector (cross-topic) instead of
+# [T, C]; choice/counts contracts are identical across all three.
 _BATCHED_KERNELS = {
     "rounds": assign_batched_rounds,
     "scan": assign_batched_scan,
+    "global": assign_global_rounds,
 }
 
 
@@ -72,9 +76,11 @@ def _rebuild_topic(
 def assign_group_device(group: TopicGroup, kernel: str = "rounds"):
     """Run one packed topic group through a batched kernel.
 
-    Returns (choice int32[T, P_pad], counts [T, C], totals [T, C]) as
-    **device arrays** — callers materialize only what they consume, so the
-    rebalance path doesn't pay device->host syncs for discarded stats.
+    Returns (choice int32[T, P_pad], counts [T, C], totals) as **device
+    arrays** — callers materialize only what they consume, so the rebalance
+    path doesn't pay device->host syncs for discarded stats.  ``totals`` is
+    per-topic [T, C] for the parity kernels ("rounds"/"scan") but a single
+    cross-topic [C] vector for "global" (its totals carry across topics).
     """
     ensure_x64()
     kernel_fn = _BATCHED_KERNELS[kernel]

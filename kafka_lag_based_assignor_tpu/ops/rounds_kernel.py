@@ -59,6 +59,41 @@ def _rounds_body(totals: jax.Array, xs, C: int):
     return totals, choice
 
 
+def _rounds_scan(sorted_lags, sorted_valid, totals0, C: int):
+    """Scan the round decomposition over one topic's sorted partitions.
+
+    Pads the sorted axis to a whole number of rounds.  Padding sorts last
+    (sort_partitions), so valid rows form a prefix and each round's valid
+    entries are a prefix of the row — exactly the partial-round shape the
+    theorem requires.  ``totals0`` is the starting per-consumer load: zeros
+    for reference semantics (lag tiebreak local to the topic, SURVEY
+    §2.4.3), or the running global totals for the cross-topic quality mode.
+
+    Returns (totals[C], sorted_choice int32[P] in sorted order).
+    """
+    P = sorted_lags.shape[0]
+    R = -(-P // C) if P else 0
+    pad = R * C - P
+    sorted_lags = jnp.pad(sorted_lags, (0, pad))
+    sorted_valid = jnp.pad(sorted_valid, (0, pad))
+    totals, round_choice = lax.scan(
+        functools.partial(_rounds_body, C=C),
+        totals0,
+        (sorted_lags.reshape(R, C), sorted_valid.reshape(R, C)),
+    )
+    return totals, round_choice.reshape(R * C)[:P]
+
+
+def _unsort_choice(perm, sorted_choice, P: int, C: int):
+    """Scatter sorted-order choices back to input row order and histogram
+    per-consumer counts (-1 padding rows excluded)."""
+    choice = jnp.full((P,), -1, dtype=jnp.int32).at[perm].set(sorted_choice)
+    counts = jnp.zeros((C,), dtype=jnp.int32).at[jnp.maximum(choice, 0)].add(
+        (choice >= 0).astype(jnp.int32)
+    )
+    return choice, counts
+
+
 @functools.partial(jax.jit, static_argnames=("num_consumers",))
 def assign_topic_rounds(
     lags: jax.Array,
@@ -77,28 +112,58 @@ def assign_topic_rounds(
     C = int(num_consumers)
 
     perm = sort_partitions(lags, partition_ids, valid)
-    sorted_lags = lags[perm]
-    sorted_valid = valid[perm]
+    totals0 = jnp.zeros((C,), dtype=lags.dtype)
+    totals, sorted_choice = _rounds_scan(lags[perm], valid[perm], totals0, C)
+    choice, counts = _unsort_choice(perm, sorted_choice, P, C)
+    return choice, counts, totals
 
-    # Pad the sorted axis to a whole number of rounds.  Padding sorts last
-    # (sort_partitions), so valid rows form a prefix and each round's valid
-    # entries are a prefix of the row — exactly the partial-round shape the
-    # theorem requires.
-    R = -(-P // C) if P else 0
-    pad = R * C - P
-    sorted_lags = jnp.pad(sorted_lags, (0, pad))
-    sorted_valid = jnp.pad(sorted_valid, (0, pad))
+
+@functools.partial(jax.jit, static_argnames=("num_consumers",))
+def assign_global_rounds(
+    lags: jax.Array,
+    partition_ids: jax.Array,
+    valid: jax.Array,
+    num_consumers: int,
+):
+    """Cross-topic global-balance quality mode (beyond-reference feature).
+
+    The reference never balances lag across topics — ``consumerTotalLags``
+    is local to ``assignTopic`` (reference :216, SURVEY §2.4.3) — so a
+    consumer can end up with every topic's hottest partitions.  This kernel
+    keeps the per-topic **count** invariant (max − min ≤ 1 per topic, the
+    primary criterion) but carries the lag-tiebreak totals **across
+    topics**: a ``lax.scan`` over the topic axis threads the running global
+    per-consumer load through each topic's round decomposition.  The round
+    theorem (module docstring) holds unchanged with a non-zero starting
+    load, because within a topic count is still primary and a round still
+    retires exactly one partition per consumer.
+
+    Sequential depth is sum over topics of ceil(P_t/C) rounds — the same
+    total round count as the vmap path, traded for cross-topic quality
+    (global max/mean lag imbalance →~1 instead of ~2 on uniform loads).
+
+    Args/returns as :func:`..ops.batched.assign_batched_rounds`, except
+    ``totals`` is the single global [C] vector (the north-star metric's
+    denominator), not per-topic.
+    """
+    T, P = lags.shape
+    C = int(num_consumers)
+
+    # Only the totals carry is sequential across topics; the per-topic sorts
+    # are independent, so hoist them out of the scan and run them as one
+    # parallel vmap batch (same parallelism as the reference-semantics path).
+    perms = jax.vmap(sort_partitions)(lags, partition_ids, valid)
+    sorted_lags = jnp.take_along_axis(lags, perms, axis=1)
+    sorted_valid = jnp.take_along_axis(valid, perms, axis=1)
+
+    def topic_step(totals, xs):
+        sl_t, sv_t, perm = xs
+        totals, sorted_choice = _rounds_scan(sl_t, sv_t, totals, C)
+        choice, counts = _unsort_choice(perm, sorted_choice, P, C)
+        return totals, (choice, counts)
 
     totals0 = jnp.zeros((C,), dtype=lags.dtype)
-    totals, round_choice = lax.scan(
-        functools.partial(_rounds_body, C=C),
-        totals0,
-        (sorted_lags.reshape(R, C), sorted_valid.reshape(R, C)),
-    )
-
-    sorted_choice = round_choice.reshape(R * C)[:P]
-    choice = jnp.full((P,), -1, dtype=jnp.int32).at[perm].set(sorted_choice)
-    counts = jnp.zeros((C,), dtype=jnp.int32).at[jnp.maximum(choice, 0)].add(
-        (choice >= 0).astype(jnp.int32)
+    totals, (choice, counts) = lax.scan(
+        topic_step, totals0, (sorted_lags, sorted_valid, perms)
     )
     return choice, counts, totals

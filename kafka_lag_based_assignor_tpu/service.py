@@ -41,7 +41,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from .assignor import LagBasedPartitionAssignor
-from .models.greedy import assign_greedy
+from .models.greedy import assign_greedy, host_fallback_for
 from .types import TopicPartitionLag
 from .utils.config import VALID_SOLVERS
 from .utils.observability import RebalanceStats, summarize_assignment
@@ -82,7 +82,7 @@ def _solve(topics, subscriptions, solver, watchdog=None, host_fallback=True):
                 exc_info=True,
             )
             fallback_used = True
-            raw = assign_greedy(lag_map, subs)
+            raw = host_fallback_for(solver)(lag_map, subs)
 
     stats = RebalanceStats(
         solver=solver,

@@ -23,12 +23,14 @@ ENABLE_AUTO_COMMIT_CONFIG = "enable.auto.commit"
 CLIENT_ID_CONFIG = "client.id"
 PARTITION_ASSIGNMENT_STRATEGY_CONFIG = "partition.assignment.strategy"
 
-SOLVER_CONFIG = "tpu.assignor.solver"  # rounds | scan | sinkhorn | native | host
+SOLVER_CONFIG = (
+    "tpu.assignor.solver"  # rounds | scan | global | sinkhorn | native | host
+)
 FALLBACK_CONFIG = "tpu.assignor.host.fallback"  # bool: greedy host fallback
 PROFILE_CONFIG = "tpu.assignor.profile"  # bool: jax.profiler traces
 SOLVE_TIMEOUT_CONFIG = "tpu.assignor.solve.timeout.ms"  # 0/empty disables
 
-VALID_SOLVERS = ("rounds", "scan", "sinkhorn", "native", "host")
+VALID_SOLVERS = ("rounds", "scan", "global", "sinkhorn", "native", "host")
 
 
 @dataclass

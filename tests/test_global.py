@@ -1,0 +1,232 @@
+"""Cross-topic global-balance quality mode (beyond-reference feature).
+
+Covers the device kernel (:func:`..ops.rounds_kernel.assign_global_rounds`),
+the host oracle (:func:`..models.greedy.assign_greedy_global`), and their
+integration through the dispatch/config layers:
+
+* device vs host-oracle parity under fuzzing (incl. multiple subscriber-set
+  groups, ragged partition counts, tie-heavy lags);
+* the per-topic count invariant max - min <= 1 is preserved (count stays
+  the PRIMARY criterion, as in the reference :246-249);
+* the global max/mean lag imbalance is no worse than per-topic-independent
+  reference semantics on uniform multi-topic loads (the point of the mode);
+* degenerate cases: single topic (must equal reference semantics exactly —
+  with one topic there is nothing to carry), empty topics, lone consumer.
+"""
+
+import numpy as np
+
+from kafka_lag_based_assignor_tpu import TopicPartitionLag, assign_greedy
+from kafka_lag_based_assignor_tpu.models.greedy import assign_greedy_global
+from kafka_lag_based_assignor_tpu.ops.dispatch import assign_device
+from kafka_lag_based_assignor_tpu.ops.rounds_kernel import (
+    assign_global_rounds,
+    assign_topic_rounds,
+)
+
+
+def tpl(topic, rows):
+    return [TopicPartitionLag(topic, p, lag) for p, lag in rows]
+
+
+def member_lag_totals(result, lag_map):
+    lag_by_tp = {
+        (r.topic, r.partition): r.lag for rows in lag_map.values() for r in rows
+    }
+    return {
+        m: sum(lag_by_tp[(tp.topic, tp.partition)] for tp in tps)
+        for m, tps in result.items()
+    }
+
+
+def test_single_topic_equals_reference_semantics():
+    lag_map = {"t": tpl("t", [(0, 100_000), (1, 50_000), (2, 60_000)])}
+    subs = {"C0": ["t"], "C1": ["t"]}
+    assert assign_greedy_global(lag_map, subs) == assign_greedy(lag_map, subs)
+    assert assign_device(lag_map, subs, kernel="global") == assign_greedy(
+        lag_map, subs
+    )
+
+
+def test_kernel_first_topic_matches_per_topic_kernel():
+    """With zero starting totals the first topic of the global scan must be
+    bit-identical to the per-topic rounds kernel."""
+    rng = np.random.default_rng(11)
+    T, P, C = 4, 32, 5
+    lags = rng.integers(0, 10**6, size=(T, P)).astype(np.int64)
+    pids = np.tile(np.arange(P, dtype=np.int32), (T, 1))
+    valid = np.ones((T, P), dtype=bool)
+    g_choice, g_counts, _ = assign_global_rounds(
+        lags, pids, valid, num_consumers=C
+    )
+    s_choice, s_counts, _ = assign_topic_rounds(
+        lags[0], pids[0], valid[0], num_consumers=C
+    )
+    np.testing.assert_array_equal(np.asarray(g_choice)[0], np.asarray(s_choice))
+    np.testing.assert_array_equal(np.asarray(g_counts)[0], np.asarray(s_counts))
+
+
+def test_global_totals_returned_by_kernel():
+    rng = np.random.default_rng(12)
+    T, P, C = 3, 16, 4
+    lags = rng.integers(0, 1000, size=(T, P)).astype(np.int64)
+    pids = np.tile(np.arange(P, dtype=np.int32), (T, 1))
+    valid = np.ones((T, P), dtype=bool)
+    choice, counts, totals = assign_global_rounds(
+        lags, pids, valid, num_consumers=C
+    )
+    choice, totals = np.asarray(choice), np.asarray(totals)
+    want = np.zeros(C, dtype=np.int64)
+    for t in range(T):
+        np.add.at(want, choice[t], lags[t])
+    np.testing.assert_array_equal(totals, want)
+    assert totals.sum() == lags.sum()
+
+
+def test_per_topic_count_invariant_preserved():
+    """Count stays primary per topic: spread <= 1 in every topic even when
+    carried totals are wildly uneven."""
+    rng = np.random.default_rng(13)
+    lag_map = {}
+    members = [f"m{j}" for j in range(7)]
+    for t in range(9):
+        topic = f"t{t}"
+        n = int(rng.integers(1, 30))
+        lag_map[topic] = tpl(
+            topic, [(p, int(v)) for p, v in enumerate(rng.integers(0, 10**9, n))]
+        )
+    subs = {m: list(lag_map) for m in members}
+    result = assign_device(lag_map, subs, kernel="global")
+    for topic in lag_map:
+        per_member = [
+            sum(1 for tp in tps if tp.topic == topic)
+            for tps in result.values()
+        ]
+        assert max(per_member) - min(per_member) <= 1, topic
+
+
+def test_global_mode_tightens_uniform_multi_topic_imbalance():
+    """The headline win: on many same-shaped topics the reference semantics
+    stack each topic's heaviest partitions onto the same consumers (global
+    max/mean ~2 on uniform lag); carrying totals drives it to ~1."""
+    rng = np.random.default_rng(3)
+    T, P, C = 64, 16, 16
+    lag_map = {
+        f"t{t:03d}": tpl(
+            f"t{t:03d}",
+            [(p, int(v)) for p, v in enumerate(rng.integers(0, 1000, size=P))],
+        )
+        for t in range(T)
+    }
+    members = [f"m{j:02d}" for j in range(C)]
+    subs = {m: list(lag_map) for m in members}
+
+    ref = member_lag_totals(assign_greedy(lag_map, subs), lag_map)
+    glob = member_lag_totals(
+        assign_device(lag_map, subs, kernel="global"), lag_map
+    )
+    imb = lambda d: max(d.values()) / (sum(d.values()) / len(d))
+    assert imb(glob) < imb(ref)
+    assert imb(glob) < 1.05
+
+
+def test_device_vs_host_oracle_fuzz():
+    """Random multi-topic instances with asymmetric subscriptions (several
+    subscriber-set groups per call) must match the host oracle exactly —
+    including per-member list ORDER."""
+    rng = np.random.default_rng(29)
+    for trial in range(25):
+        n_topics = int(rng.integers(1, 6))
+        n_members = int(rng.integers(1, 6))
+        members = [f"m{j:02d}" for j in range(n_members)]
+        lag_map = {}
+        subs = {m: [] for m in members}
+        for t in range(n_topics):
+            topic = f"topic{t}"
+            n_parts = int(rng.integers(0, 18))
+            vals = rng.integers(0, 4, size=n_parts)  # tie-heavy
+            lag_map[topic] = tpl(
+                topic, [(p, int(v)) for p, v in enumerate(vals)]
+            )
+            for m in members:
+                if rng.random() < 0.6:
+                    subs[m].append(topic)
+        if all(not v for v in subs.values()):
+            subs[members[0]].append("topic0")
+        assert assign_device(
+            lag_map, subs, kernel="global"
+        ) == assign_greedy_global(lag_map, subs), f"trial {trial}"
+
+
+def test_oracle_scopes_totals_per_subscriber_group():
+    """Totals carry only within a subscriber-set group: a topic subscribed
+    by a different member set starts from that group's own totals, so the
+    lone subscriber of topic "solo" is not penalized for load it carries in
+    the shared group."""
+    lag_map = {
+        "shared": tpl("shared", [(0, 100), (1, 0)]),
+        "solo": tpl("solo", [(0, 50)]),
+    }
+    subs = {"a": ["shared", "solo"], "b": ["shared"]}
+    result = assign_greedy_global(lag_map, subs)
+    # "solo" has only member a; in the shared group a's 100-vs-0 history
+    # must not leak into solo's (trivial) solve.
+    assert [tp.topic for tp in result["a"]].count("solo") == 1
+    assert assign_device(lag_map, subs, kernel="global") == result
+
+
+def test_config_accepts_global_solver():
+    from kafka_lag_based_assignor_tpu.utils.config import parse_config
+
+    cfg = parse_config({"group.id": "g", "tpu.assignor.solver": "global"})
+    assert cfg.solver == "global"
+
+
+def test_host_fallback_for_preserves_semantics():
+    from kafka_lag_based_assignor_tpu.models.greedy import host_fallback_for
+
+    assert host_fallback_for("global") is assign_greedy_global
+    for solver in ("rounds", "scan", "native", "sinkhorn"):
+        assert host_fallback_for(solver) is assign_greedy
+
+
+def test_plugin_fallback_keeps_global_semantics(monkeypatch):
+    """A device failure under solver='global' must fall back to the GLOBAL
+    host oracle, not the per-topic reference greedy — on a workload where
+    the two modes genuinely differ."""
+    import kafka_lag_based_assignor_tpu.ops.dispatch as dispatch
+    from kafka_lag_based_assignor_tpu.assignor import LagBasedPartitionAssignor
+    from kafka_lag_based_assignor_tpu.testing import FakeBroker
+    from kafka_lag_based_assignor_tpu.types import (
+        GroupSubscription,
+        Subscription,
+    )
+
+    broker = FakeBroker()
+    # Two identical topics: per-topic mode gives one member both heavy
+    # partitions; global mode alternates them.
+    for topic in ("ta", "tb"):
+        broker.with_partition(topic, 0, begin=0, end=1000, committed=0)
+        broker.with_partition(topic, 1, begin=0, end=0, committed=0)
+    lag_map = {
+        t: tpl(t, [(0, 1000), (1, 0)]) for t in ("ta", "tb")
+    }
+    subs_map = {"C0": ["ta", "tb"], "C1": ["ta", "tb"]}
+    want = assign_greedy_global(lag_map, subs_map)
+    assert want != assign_greedy(lag_map, subs_map)  # the modes differ here
+
+    def boom(*a, **k):
+        raise RuntimeError("simulated TPU unreachable")
+
+    monkeypatch.setattr(dispatch, "assign_device", boom)
+    a = LagBasedPartitionAssignor(metadata_consumer_factory=lambda props: broker)
+    a.configure({"group.id": "g", "tpu.assignor.solver": "global"})
+    result = a.assign(
+        broker.cluster(),
+        GroupSubscription(
+            {m: Subscription(tuple(ts)) for m, ts in subs_map.items()}
+        ),
+    )
+    assert a.last_stats.fallback_used
+    for member, tps in want.items():
+        assert list(result.group_assignment[member].partitions) == tps
