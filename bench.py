@@ -67,6 +67,22 @@ def rtt_floor_ms(iters: int = 6) -> float:
     return float(np.median(times))
 
 
+def timed_solve(once, iters=20):
+    """The one timing harness every config uses: ``once()`` performs a full
+    solve ending in its single blocking device->host readback and returns
+    the materialized result.  One untimed warm-up call pays the compile,
+    then the median of ``iters`` timed calls is reported.
+
+    Returns (median_ms, last_result)."""
+    once()  # warm-up/compile
+    times, out = [], None
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = once()
+        times.append((time.perf_counter() - t0) * 1000.0)
+    return float(np.median(times)), out
+
+
 def device_assign_ms(lags, pids, valid, C, iters=20):
     """Steady-state end-to-end ms for one batched device solve: host numpy
     in, choices materialized to host out (a single device->host readback;
@@ -74,26 +90,18 @@ def device_assign_ms(lags, pids, valid, C, iters=20):
     from kafka_lag_based_assignor_tpu.ops.batched import assign_batched_rounds
 
     def once():
-        t0 = time.perf_counter()
         choice, _, _ = assign_batched_rounds(
             lags, pids, valid, num_consumers=C
         )
-        choice = np.asarray(choice)  # the one blocking readback
-        ms = (time.perf_counter() - t0) * 1000.0
-        return ms, choice
+        return np.asarray(choice)  # the one blocking readback
 
-    once()  # warm-up/compile
-    times = []
-    choice = None
-    for _ in range(iters):
-        ms, choice = once()
-        times.append(ms)
+    ms, choice = timed_solve(once, iters)
 
     totals = np.zeros((lags.shape[0], C), dtype=np.int64)
     for t in range(lags.shape[0]):
         sel = valid[t] & (choice[t] >= 0)
         np.add.at(totals[t], choice[t][sel], lags[t][sel])
-    return float(np.median(times)), choice, totals
+    return ms, choice, totals
 
 
 def imbalance(member_totals: np.ndarray) -> float:
@@ -162,24 +170,18 @@ def config3_vmap():
     )
 
     def global_once():
-        t0 = time.perf_counter()
         _, _, g_totals = assign_global_rounds(
             lags, pids, valid, num_consumers=C
         )
-        g_totals = np.asarray(g_totals)  # the one blocking readback
-        return (time.perf_counter() - t0) * 1000.0, g_totals
+        return np.asarray(g_totals)  # the one blocking readback
 
-    global_once()  # warm-up/compile
-    g_times, g_totals = [], None
-    for _ in range(10):
-        g_ms, g_totals = global_once()
-        g_times.append(g_ms)
+    g_ms, g_totals = timed_solve(global_once, iters=10)
 
     return {
         "config": "vmap_256t_64p_64c",
         "assign_ms": ms,
         "max_mean_imbalance_global": imbalance(member_load),
-        "global_mode_assign_ms": float(np.median(g_times)),
+        "global_mode_assign_ms": g_ms,
         "global_mode_max_mean_imbalance": imbalance(g_totals),
     }
 
@@ -195,11 +197,36 @@ def config4_skew():
         lags[None, :], np.arange(P, dtype=np.int32)[None, :],
         np.ones((1, P), dtype=bool), C,
     )
+
+    # Sinkhorn quality mode on the same instance (the BASELINE config-4
+    # comparison): implicit-plan OT relaxation + exchange refinement.
+    from kafka_lag_based_assignor_tpu.models.sinkhorn import (
+        assign_topic_sinkhorn,
+    )
+    from kafka_lag_based_assignor_tpu.ops.dispatch import pad_bucket
+
+    P_pad = pad_bucket(P)
+    lags_p = np.zeros(P_pad, dtype=np.int64)
+    lags_p[:P] = lags
+    pids = np.arange(P_pad, dtype=np.int32)
+    valid = np.zeros(P_pad, dtype=bool)
+    valid[:P] = True
+
+    def sink_once():
+        _, _, s_totals = assign_topic_sinkhorn(
+            lags_p, pids, valid, num_consumers=C
+        )
+        return np.asarray(s_totals)  # the one blocking readback
+
+    s_ms, s_totals = timed_solve(sink_once, iters=5)
+
     return {
         "config": "skew_10k_512c",
         "assign_ms": ms,
         "max_mean_imbalance": imbalance(totals[0]),
         "bound": float(lags.max() / (lags.sum() / C)),
+        "sinkhorn_assign_ms": s_ms,
+        "sinkhorn_max_mean_imbalance": imbalance(s_totals),
     }
 
 
@@ -219,12 +246,9 @@ def config5_northstar():
         choice = np.asarray(assign_stream(arr, num_consumers=C))
         return (time.perf_counter() - t0) * 1000.0, choice
 
-    stream_once(lags0)  # warm-up/compile
-    times = []
-    for _ in range(20):
-        ms, choice = stream_once(lags0)
-        times.append(ms)
-    ms = float(np.median(times))
+    ms, choice = timed_solve(
+        lambda: np.asarray(assign_stream(lags0, num_consumers=C)), iters=20
+    )
     totals = np.zeros(C, dtype=np.int64)
     np.add.at(totals, choice.astype(np.int64), lags0)
     imb = imbalance(totals)

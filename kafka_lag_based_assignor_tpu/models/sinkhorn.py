@@ -1,4 +1,4 @@
-"""Sinkhorn-style optimal-transport relaxation solver.
+"""Sinkhorn-style optimal-transport relaxation solver (implicit plan).
 
 The greedy LPT core (reference semantics) is a 4/3-approximation for makespan
 and is what the reference prescribes; this solver is the framework's
@@ -10,31 +10,40 @@ imbalance — while preserving the count-primary invariant
 Method: entropic mirror descent on the squared-load objective over the
 transport polytope, with Sinkhorn-style alternating marginal scaling
 (pattern references: the OT papers in PAPERS.md — FlashSinkhorn's
-tile-friendly iteration, push-relabel additive approximation for rounding
+tile-streaming iteration, push-relabel additive approximation for rounding
 intuition; patterns only, no code).
 
 * relaxation variable  X in [0,1]^{P x C}, row-stochastic: X[p] is a
   distribution of partition p over consumers;
 * objective  sum_j load_j^2  with  load_j = sum_p lag_p X[p,j]  — minimized
   exactly when loads are equal;
-* update     X <- X * exp(-eta * lag_p * (load_j - mean load) / scale)
-  (mirror/multiplicative-weights step on the gradient), followed by one
-  Sinkhorn pair: column scaling toward the balanced count marginal P/C,
-  then row re-normalization;
-* rounding   partitions in descending-lag order pick their argmax-X
-  consumer among those with remaining count capacity (capacities
-  floor/ceil(P/C)), a lax.scan with a masked vectorized argmax — integral,
-  count-balanced by construction.
+* update     X <- X * exp(-eta * ws_p * (load_j - mean load))  (mirror /
+  multiplicative-weights step on the centered gradient, ws = lag/scale),
+  followed by one Sinkhorn pair: column scaling toward the balanced count
+  marginal P/C, then row re-normalization;
+* rounding   partitions in descending-lag order pick the least-loaded open
+  consumer (capacities floor/ceil(P/C)) with the plan as a continuous
+  tie-break bonus — integral, count-balanced by construction — then a
+  pairwise-exchange refinement pass (:mod:`..ops.refine`).
 
-Everything is [P, C] dense elementwise + row/col reductions — ideal XLA
-fusion shape — and the iteration count is static (lax.fori_loop), so one
-compiled program serves every rebalance at a bucketed shape.
+**TPU-native key idea — the plan is never materialized.**  Every update
+above is rank-structured, so by induction the log-plan stays exactly
+
+    logX[p, j] = noise(p, j) - ws_p * A_j + B_j   (+ row normalizer)
+
+where ``A`` accumulates the mirror steps and ``B`` the column corrections —
+the row normalizer cancels in the row softmax.  The iteration state is two
+f32[C] vectors instead of a [P, C] matrix (524 MB at the 100k x 1k north
+star), and each iteration needs only the plan's two marginal statistics,
+computed by the fused tile-streaming kernel in :mod:`..ops.plan_stats`
+(Pallas on TPU, tiled lax elsewhere) with O(P) HBM traffic.  The symmetry-
+breaking noise is a deterministic integer hash, recomputable anywhere.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Dict, List, Mapping, Sequence
+from typing import Mapping, Sequence
 
 import numpy as np
 
@@ -42,58 +51,158 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..ops.plan_stats import (
+    _pallas_available,
+    implicit_plan_argmax,
+    implicit_plan_rows,
+    plan_stats,
+)
 from ..types import AssignmentMap, TopicPartitionLag
 
+# Above this many partition rows the sequential rounding scan (one step per
+# partition) dominates wall time, so the parallel argmax+repair rounding
+# takes over (see _round_parallel).
+_SCAN_ROUNDING_MAX_P = 32768
 
-@functools.partial(
-    jax.jit, static_argnames=("num_consumers", "iters")
-)
-def sinkhorn_plan(
+
+def sinkhorn_duals(
     lags: jax.Array,
     valid: jax.Array,
     num_consumers: int,
     iters: int = 60,
     eta: float = 8.0,
 ):
-    """Relaxed transport plan X [P, C] (rows of padding are uniform)."""
+    """Run the implicit-plan iteration; returns ``(A, B, ws)``.
+
+    ``A``/``B`` are the f32[C] state vectors of the rank-structured
+    log-plan; ``ws`` the f32[P] scaled lags (lag / ideal-per-consumer-load).
+    Plan rows can be materialized on demand with
+    :func:`..ops.plan_stats.implicit_plan_rows`.
+    """
+    # Resolve the Pallas-vs-lax choice EAGERLY: inside the trace below the
+    # probe could not execute (a lowering failure would abort the compile
+    # with no fallback, see plan_stats._pallas_available).
+    _pallas_available()
+    return _sinkhorn_duals_jit(
+        lags, valid, num_consumers=num_consumers, iters=iters, eta=eta
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("num_consumers", "iters"))
+def _sinkhorn_duals_jit(
+    lags: jax.Array,
+    valid: jax.Array,
+    num_consumers: int,
+    iters: int = 60,
+    eta: float = 8.0,
+):
     C = int(num_consumers)
-    P = lags.shape[0]
     w = jnp.where(valid, lags, 0).astype(jnp.float32)
     total = jnp.maximum(jnp.sum(w), 1.0)
     scale = total / C  # ideal per-consumer load
-    n_valid = jnp.maximum(jnp.sum(valid), 1)
-    # Keep everything float32 (x64 mode would otherwise promote the carry).
-    cap = n_valid.astype(jnp.float32) / C  # balanced count marginal
+    ws = w / scale
+    maskf = valid.astype(jnp.float32)
+    n_valid = jnp.maximum(jnp.sum(maskf), 1.0)
+    cap = n_valid / C  # balanced count marginal
 
-    # Symmetry breaking: from an exactly-uniform init every consumer is
-    # identical and mirror descent preserves the symmetry forever (the
-    # relaxed fixpoint is any row-stochastic plan with equal loads) — a tiny
-    # deterministic perturbation lets the plan commit per partition.
-    key = jax.random.PRNGKey(0)
-    logX = 0.01 * jax.random.normal(key, (P, C), dtype=jnp.float32)
+    eta32 = jnp.float32(eta)
 
-    def body(_, logX):
-        X = jax.nn.softmax(logX, axis=1)
-        load = w @ X  # [C]
-        # Mirror step on d/dX sum_j load_j^2 = lag_p * 2 load_j, centered so
-        # the step is invariant to uniform load shifts.
-        grad = (load - jnp.mean(load)) / scale
-        logX = logX - eta * (w / scale)[:, None] * grad[None, :]
-        # Sinkhorn pair: scale columns toward the balanced count marginal,
-        # rows back to stochastic (in log space for stability).
-        X = jax.nn.softmax(logX, axis=1)
-        colsum = jnp.sum(X, axis=0, where=valid[:, None]) + 1e-9
-        logX = logX + jnp.log(cap / colsum)[None, :]
-        logX = logX - jax.nn.logsumexp(logX, axis=1, keepdims=True)
-        return logX
+    def body(_, AB):
+        A, B = AB
+        # Mirror step on d/dX sum_j load_j^2 ∝ ws_p * load_j, centered so
+        # the step is invariant to uniform load shifts.  load is already in
+        # ws units (= absolute load / scale).
+        load, _ = plan_stats(ws, maskf, A, B)
+        A = A + eta32 * (load - jnp.mean(load))
+        # Sinkhorn pair: scale columns toward the balanced count marginal
+        # (rows re-normalize implicitly in the softmax).
+        _, colsum = plan_stats(ws, maskf, A, B)
+        B = B + jnp.log(cap / (colsum + jnp.float32(1e-9)))
+        return A, B
 
-    logX = lax.fori_loop(0, iters, body, logX)
-    return jax.nn.softmax(logX, axis=1)
+    A0 = jnp.zeros((C,), jnp.float32)
+    B0 = jnp.zeros((C,), jnp.float32)
+    A, B = lax.fori_loop(0, iters, body, (A0, B0))
+    return A, B, ws
 
 
-@functools.partial(
-    jax.jit, static_argnames=("num_consumers", "iters", "refine_iters")
-)
+def _round_parallel(lags, ws, valid, A, B, C: int, floor_cap, extras):
+    """Parallel (O(P log P), no per-partition scan) plan rounding.
+
+    1. each partition takes its plan-argmax consumer (tiled, parallel);
+    2. capacity repair: within each consumer's takers (sorted lag desc) the
+       first cap_j keep their seat — the plan is near-balanced after the
+       Sinkhorn iteration, so few overflow;
+    3. the overflow re-seats positionally: the k-th largest-lag overflow
+       partition takes the k-th open slot, slots ordered round-robin over
+       consumers by ascending kept load (a one-shot round decomposition —
+       each "round" hands every open consumer one partition, lightest
+       first).  Count spread <= 1 holds by construction; the exchange
+       refinement pass afterwards re-tightens lag balance.
+
+    Returns choice int32[P] (input order, -1 for invalid rows).
+    """
+    P = ws.shape[0]
+    cap = floor_cap + (jnp.arange(C, dtype=jnp.int32) < extras).astype(
+        jnp.int32
+    )  # int32[C], sums to n_valid
+
+    jstar = implicit_plan_argmax(ws, valid, A, B)  # C sentinel for invalid
+
+    # Group rows by (consumer, lag desc); sentinel group sorts last.
+    neg_lag = jnp.where(valid, -lags, jnp.iinfo(lags.dtype).max)
+    idx = jnp.arange(P, dtype=jnp.int32)
+    _, _, perm = lax.sort((jstar, neg_lag, idx), num_keys=2)
+    sj = jstar[perm]
+    pos = idx - jnp.searchsorted(sj, jnp.arange(C + 1, dtype=jnp.int32))[
+        jnp.clip(sj, 0, C)
+    ].astype(jnp.int32)
+    keep = (sj < C) & (pos < cap[jnp.clip(sj, 0, C - 1)])
+
+    ws_s = ws[perm]
+    sj_safe = jnp.clip(sj, 0, C - 1)
+    kept_load = jnp.zeros((C,), jnp.float32).at[sj_safe].add(
+        jnp.where(keep, ws_s, 0.0)
+    )
+    kept_cnt = jnp.zeros((C,), jnp.int32).at[sj_safe].add(
+        keep.astype(jnp.int32)
+    )
+    rem = cap - kept_cnt  # open seats per consumer, >= 0
+
+    # Open slots in (round, load-rank) order: slot (j, r) exists iff
+    # r < rem_j; lighter consumers seat first within a round.
+    load_rank = jnp.zeros((C,), jnp.int32).at[
+        jnp.argsort(kept_load).astype(jnp.int32)
+    ].set(jnp.arange(C, dtype=jnp.int32))
+    cap_max = P // C + 1
+    slot_r = jnp.repeat(
+        jnp.arange(cap_max, dtype=jnp.int32)[:, None], C, axis=1
+    ).reshape(-1)
+    slot_j = jnp.repeat(
+        jnp.arange(C, dtype=jnp.int32)[None, :], cap_max, axis=0
+    ).reshape(-1)
+    slot_open = slot_r < rem[slot_j]
+    slot_key = jnp.where(
+        slot_open,
+        slot_r * jnp.int32(C) + load_rank[slot_j],
+        jnp.iinfo(jnp.int32).max,
+    )
+    _, slot_j_sorted = lax.sort((slot_key, slot_j), num_keys=1)
+
+    # Overflow rows in lag-desc order meet slots positionally.
+    overflow = valid[perm] & ~keep
+    okey = jnp.where(overflow, neg_lag[perm], jnp.iinfo(lags.dtype).max)
+    _, oorder = lax.sort((okey, idx), num_keys=1)
+    n_over = jnp.sum(overflow.astype(jnp.int32))
+    seat = jnp.where(
+        idx < n_over, slot_j_sorted[jnp.minimum(idx, C * cap_max - 1)], -1
+    )
+    choice_sorted = jnp.where(keep, sj, -1)
+    choice_sorted = choice_sorted.at[oorder].max(seat)
+
+    return jnp.full((P,), -1, jnp.int32).at[perm].set(choice_sorted)
+
+
 def assign_topic_sinkhorn(
     lags: jax.Array,
     partition_ids: jax.Array,
@@ -102,32 +211,63 @@ def assign_topic_sinkhorn(
     iters: int = 60,
     refine_iters: int = 24,
 ):
-    """Integral, count-balanced assignment from the Sinkhorn plan.
+    """Integral, count-balanced assignment from the implicit Sinkhorn plan.
 
     Rounding: partitions in descending-lag order pick the *least-loaded*
-    open consumer (capacity floor/ceil(n/C)), with the transport plan as a
-    continuous tie-break bonus — i.e. LPT steered by the OT relaxation.
-    A pairwise-exchange refinement pass (:mod:`..ops.refine`) then tightens
-    max/mean imbalance below what any single greedy pass reaches.
+    open consumer (capacity floor/ceil(n/C)), with the plan row —
+    materialized per step from the implicit state — as a continuous
+    tie-break bonus, i.e. LPT steered by the OT relaxation.  A pairwise-
+    exchange refinement pass (:mod:`..ops.refine`) then tightens max/mean
+    imbalance below what any single greedy pass reaches.
 
     Same output contract as the greedy kernels: (choice int32[P] in input
     order, counts int32[C], totals[C]).
     """
+    _pallas_available()  # resolve kernel choice eagerly, outside the trace
+    return _assign_topic_sinkhorn_jit(
+        lags, partition_ids, valid, num_consumers=num_consumers,
+        iters=iters, refine_iters=refine_iters,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_consumers", "iters", "refine_iters")
+)
+def _assign_topic_sinkhorn_jit(
+    lags: jax.Array,
+    partition_ids: jax.Array,
+    valid: jax.Array,
+    num_consumers: int,
+    iters: int = 60,
+    refine_iters: int = 24,
+):
     from ..ops.refine import refine_assignment
 
     C = int(num_consumers)
     P = lags.shape[0]
-    X = sinkhorn_plan(lags, valid, num_consumers=C, iters=iters)
+    A, B, ws = _sinkhorn_duals_jit(lags, valid, num_consumers=C, iters=iters)
 
     n_valid = jnp.sum(valid.astype(jnp.int32))
     floor_cap = n_valid // C
     extras = n_valid - floor_cap * C  # this many consumers may hit ceil
 
-    neg_lag = jnp.where(valid, -lags, jnp.iinfo(lags.dtype).max)
-    order = jnp.argsort(neg_lag)  # lag desc, padding last
+    if P > _SCAN_ROUNDING_MAX_P:
+        # Large topics: the per-partition scan below would dominate wall
+        # time; round in parallel and lean on the refinement pass.  The
+        # one-shot rounding starts coarser than the sequential scan, so
+        # floor the refinement budget (each round retires up to C//2
+        # disjoint exchanges — at these shapes 96 rounds is ~ms and takes
+        # max/mean to within a fraction of a percent of the bound).
+        choice = _round_parallel(
+            lags, ws, valid, A, B, C, floor_cap, extras
+        )
+        return refine_assignment(
+            lags, valid, choice, num_consumers=C,
+            iters=max(refine_iters, 96),
+        )
 
-    w = jnp.where(valid, lags, 0).astype(jnp.float32)
-    scale = jnp.maximum(jnp.sum(w), 1.0) / C
+    neg_lag = jnp.where(valid, -lags, jnp.iinfo(lags.dtype).max)
+    order = jnp.argsort(neg_lag).astype(jnp.int32)  # lag desc, padding last
 
     def step(carry, p):
         counts, totals, extras_left = carry
@@ -137,22 +277,23 @@ def assign_topic_sinkhorn(
         under_floor = counts < floor_cap
         at_floor = (counts == floor_cap) & (extras_left > 0)
         open_mask = under_floor | at_floor
-        # Least load first; the plan contributes a sub-lag-unit bonus so it
-        # decides ties without overriding the load ordering.
-        score = totals.astype(jnp.float32) / scale - 0.01 * X[p]
+        # Least (scaled) load first; the plan row contributes a sub-unit
+        # bonus so it decides ties without overriding the load ordering.
+        xrow = implicit_plan_rows(p[None], ws[p][None], A, B)[0]
+        score = totals - jnp.float32(0.01) * xrow
         score = jnp.where(open_mask, score, jnp.inf)
         who = jnp.argmin(score).astype(jnp.int32)
         take = is_valid
         one_hot = (jnp.arange(C, dtype=jnp.int32) == who) & take
         used_extra = take & at_floor[who]
         counts = counts + one_hot.astype(jnp.int32)
-        totals = totals + jnp.where(one_hot, lags[p], 0).astype(totals.dtype)
+        totals = totals + jnp.where(one_hot, ws[p], 0.0)
         extras_left = extras_left - used_extra.astype(jnp.int32)
         return (counts, totals, extras_left), jnp.where(take, who, -1)
 
     init = (
         jnp.zeros((C,), jnp.int32),
-        jnp.zeros((C,), lags.dtype),
+        jnp.zeros((C,), jnp.float32),
         extras,
     )
     (_, _, _), sorted_choice = lax.scan(step, init, order)

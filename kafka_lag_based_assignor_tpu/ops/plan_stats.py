@@ -1,0 +1,271 @@
+"""Fused implicit-transport-plan statistics — the framework's Pallas hot op.
+
+The Sinkhorn solver's iteration state admits an exact rank-structured form
+(see :mod:`..models.sinkhorn`): the log-plan is
+
+    logX[p, j] = noise(p, j) - ws_p * A_j + B_j     (ws_p = lag_p / scale)
+
+up to a per-row normalizer that cancels in the softmax, so the [P, C] plan
+never needs to exist in HBM.  Each solver iteration only needs the two
+marginal statistics of the implicit plan X = softmax_j(logX):
+
+    load_j   = sum_p  ws_p * mask_p * X[p, j]     (scaled consumer loads)
+    colsum_j = sum_p  mask_p * X[p, j]            (count marginal)
+
+This module computes both in ONE fused pass over P-tiles.  The Pallas
+kernel keeps a (TILE_P, C) logits tile in VMEM, materializes the noise with
+an integer hash (no PRNG state, no HBM), does the row softmax and both
+reductions in-register, and accumulates into [1, C] output blocks across
+sequential grid steps — HBM traffic is O(P) for the lag vector instead of
+O(P*C) for a materialized plan, turning the memory-bound iteration into a
+compute-bound one (the TPU analog of the tile-streaming FlashSinkhorn
+pattern, PAPERS.md — pattern only).
+
+A pure-`lax` tiled reference (`lax.map` over the same row tiles, identical
+arithmetic) serves as the fallback on backends without Pallas support and
+as the exactness oracle in tests (the two paths are bit-compared in Pallas
+interpret mode on CPU).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+LOGGER = logging.getLogger(__name__)
+
+# Hash-noise amplitude: large enough to break the symmetric fixpoint of
+# mirror descent (all-identical consumers), small enough (<< 1, the scale
+# of ws*A terms after a few iterations) not to distort the converged plan.
+NOISE_AMP = 0.02
+
+_TILE_P = 512  # rows per grid step; (512, C<=2048) f32 tiles fit VMEM easily
+
+
+def noise(p_idx: jax.Array, j_idx: jax.Array) -> jax.Array:
+    """Deterministic per-(partition, consumer) symmetry-breaking noise in
+    [-NOISE_AMP/2, NOISE_AMP/2], from a cheap integer hash (Knuth
+    multiplicative mixing) — identical on every backend and recomputable
+    anywhere without carrying PRNG state into kernels."""
+    h = p_idx.astype(jnp.int32) * jnp.int32(-1640531527) + j_idx.astype(
+        jnp.int32
+    ) * jnp.int32(40503)
+    h = h ^ (h >> 15)
+    h = h * jnp.int32(-1028477387)
+    h = h ^ (h >> 13)
+    u = (h >> 8) & jnp.int32(0xFFFF)
+    # Explicit f32 scalars: under x64 mode a weak Python float can lower as
+    # an f64 constant, which Mosaic cannot legalize inside the TPU kernel.
+    return jnp.float32(NOISE_AMP) * (
+        u.astype(jnp.float32) / jnp.float32(65536.0) - jnp.float32(0.5)
+    )
+
+
+def implicit_plan_rows(
+    p_idx: jax.Array, ws: jax.Array, A: jax.Array, B: jax.Array
+) -> jax.Array:
+    """Materialize rows of the implicit plan: X[p] = softmax_j(logits) for
+    the given partition indices.  ``ws`` are the rows' scaled lags.  Shapes:
+    p_idx int[R], ws f32[R], A/B f32[C] -> f32[R, C]."""
+    logits = (
+        noise(p_idx[:, None], jnp.arange(A.shape[0], dtype=jnp.int32)[None, :])
+        - ws[:, None] * A[None, :]
+        + B[None, :]
+    )
+    return jax.nn.softmax(logits, axis=1)
+
+
+def _pad_rows(x: jax.Array, P_pad: int) -> jax.Array:
+    return jnp.pad(x, (0, P_pad - x.shape[0]))
+
+
+def implicit_plan_argmax(ws, valid, A, B):
+    """Each partition's most-preferred consumer under the implicit plan:
+    argmax_j(noise(p, j) - ws_p * A_j + B_j), computed in O(TILE x C) live
+    memory by the same tile streaming as :func:`plan_stats_lax` (softmax is
+    monotone, so the logits argmax IS the plan argmax).  Invalid rows
+    return C (a sentinel one past the last consumer).  int32[P]."""
+    P, C = ws.shape[0], A.shape[0]
+    P_pad = -(-P // _TILE_P) * _TILE_P
+    nt = P_pad // _TILE_P
+    ws_t = _pad_rows(ws, P_pad).reshape(nt, _TILE_P)
+    p_t = jnp.arange(P_pad, dtype=jnp.int32).reshape(nt, _TILE_P)
+
+    def tile_argmax(args):
+        w_i, p_i = args
+        logits = (
+            noise(p_i[:, None], jnp.arange(C, dtype=jnp.int32)[None, :])
+            - w_i[:, None] * A[None, :]
+            + B[None, :]
+        )
+        return jnp.argmax(logits, axis=1).astype(jnp.int32)
+
+    jstar = lax.map(tile_argmax, (ws_t, p_t)).reshape(P_pad)[:P]
+    return jnp.where(valid, jstar, jnp.int32(C))
+
+
+def plan_stats_lax(ws, mask, A, B):
+    """Reference implementation: same tile loop as the Pallas kernel, in
+    pure lax (`lax.map` keeps live memory at one (TILE_P, C) tile).
+
+    Args:
+      ws: f32[P] scaled lags (lag/scale), padded rows arbitrary.
+      mask: f32[P] 1.0 for valid rows, 0.0 for padding.
+      A, B: f32[C] dual-like state vectors.
+    Returns (load f32[C] — in ws units — and colsum f32[C]).
+    """
+    P, C = ws.shape[0], A.shape[0]
+    P_pad = -(-P // _TILE_P) * _TILE_P
+    nt = P_pad // _TILE_P
+    ws_t = _pad_rows(ws, P_pad).reshape(nt, _TILE_P)
+    mask_t = _pad_rows(mask, P_pad).reshape(nt, _TILE_P)
+    p_t = jnp.arange(P_pad, dtype=jnp.int32).reshape(nt, _TILE_P)
+
+    def tile_stats(args):
+        w_i, m_i, p_i = args
+        s = implicit_plan_rows(p_i, w_i, A, B)
+        wm = (w_i * m_i)[:, None]
+        return (wm * s).sum(axis=0), (m_i[:, None] * s).sum(axis=0)
+
+    loads, colsums = lax.map(tile_stats, (ws_t, mask_t, p_t))
+    return loads.sum(axis=0), colsums.sum(axis=0)
+
+
+def plan_stats_pallas(ws, mask, A, B, interpret: bool = False):
+    """Pallas TPU path of :func:`plan_stats_lax` (identical arithmetic).
+
+    Toolchain-shaped design (this image's Mosaic AOT path rejects ANY
+    ``grid``— even a trivial one — with "failed to legalize func.return"):
+    a single grid-less invocation with an in-kernel ``fori_loop`` over
+    partition tiles, accumulators loop-carried, and a **transposed tile
+    layout** — consumers on the sublane axis, partitions on the lane axis.
+    The transpose matters for VMEM: a column-vector [P, 1] input would be
+    tiled T(8, 128), padding the lane dim 128x (64 MB for the lag vector at
+    P=131072); packing partitions along lanes as an [nt, TILE_P] matrix
+    keeps the whole input at its true size.  All loop offsets are explicit
+    int32: under x64 mode a weak Python int lowers as an i64 constant,
+    which Mosaic cannot legalize.
+
+    ``interpret=True`` runs the kernel in the Pallas interpreter (any
+    backend) — used by the CPU test suite to compare against the lax
+    reference without TPU hardware."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    P, C = ws.shape[0], A.shape[0]
+    C_pad = max(128, -(-C // 128) * 128)
+    P_pad = -(-P // _TILE_P) * _TILE_P
+    nt = P_pad // _TILE_P
+
+    ws_p = _pad_rows(ws, P_pad).reshape(nt, _TILE_P)
+    mask_p = _pad_rows(mask, P_pad).reshape(nt, _TILE_P)
+    A_p = jnp.pad(A, (0, C_pad - C)).reshape(C_pad, 1)
+    B_p = jnp.pad(B, (0, C_pad - C)).reshape(C_pad, 1)
+
+    def kernel(ws_ref, mask_ref, A_ref, B_ref, load_ref, col_ref):
+        # Tile axes: sublanes = consumers j, lanes = partitions p.
+        j_idx = lax.broadcasted_iota(jnp.int32, (C_pad, _TILE_P), 0)
+        p_idx0 = lax.broadcasted_iota(jnp.int32, (C_pad, _TILE_P), 1)
+
+        def tile(t, acc):
+            acc_load, acc_col = acc
+            off = t * jnp.int32(_TILE_P)
+            w = ws_ref[pl.ds(t, 1), :]  # (1, TILE_P)
+            m_t = mask_ref[pl.ds(t, 1), :]
+            logits = noise(p_idx0 + off, j_idx) - w * A_ref[:] + B_ref[:]
+            logits = jnp.where(j_idx < C, logits, jnp.float32(-1e30))
+            mx = jnp.max(logits, axis=0, keepdims=True)
+            e = jnp.exp(logits - mx)
+            s = e / jnp.sum(e, axis=0, keepdims=True)  # softmax over j
+            wm = w * m_t
+            acc_load = acc_load + jnp.sum(wm * s, axis=1, keepdims=True)
+            acc_col = acc_col + jnp.sum(m_t * s, axis=1, keepdims=True)
+            return acc_load, acc_col
+
+        zero = jnp.zeros((C_pad, 1), jnp.float32)
+        acc_load, acc_col = lax.fori_loop(
+            jnp.int32(0), jnp.int32(nt), tile, (zero, zero)
+        )
+        load_ref[:] = acc_load
+        col_ref[:] = acc_col
+
+    load, colsum = pl.pallas_call(
+        kernel,
+        in_specs=[
+            pl.BlockSpec((nt, _TILE_P), lambda: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((nt, _TILE_P), lambda: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((C_pad, 1), lambda: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((C_pad, 1), lambda: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((C_pad, 1), lambda: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((C_pad, 1), lambda: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((C_pad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((C_pad, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(ws_p, mask_p, A_p, B_p)
+    return load[:C, 0], colsum[:C, 0]
+
+
+_pallas_ok: bool | None = None
+
+
+def _trace_state_clean() -> bool:
+    """True when not inside any JAX trace (safe to execute ops for real)."""
+    try:
+        from jax._src.core import trace_state_clean  # not in public jax.core
+
+        return trace_state_clean()
+    except Exception:  # API moved — assume tracing to stay safe
+        return False
+
+
+def _pallas_available() -> bool:
+    """Probe-once gate: Pallas lowering may be unsupported on a backend (or
+    an experimental platform plugin); any failure falls back to the lax
+    path permanently for the process.
+
+    Inside a jit trace the probe cannot run for real (its ops would be
+    staged, block_until_ready would no-op on tracers, and a lowering
+    failure would abort the enclosing compile with no fallback), so under
+    an active trace an unknown state conservatively answers False WITHOUT
+    caching — the decision is baked per-trace anyway.  The jitted solver
+    entry points call this eagerly before tracing
+    (:func:`..models.sinkhorn.sinkhorn_duals`), so the real probe happens
+    exactly once, outside any trace."""
+    global _pallas_ok
+    if _pallas_ok is None:
+        if not _trace_state_clean():
+            return False  # unknown while tracing: don't probe, don't cache
+        try:
+            # Probe on any accelerator backend (the image's TPU registers
+            # as an experimental platform plugin, so don't gate on the
+            # name "tpu"); CPU always takes the lax path.
+            if jax.default_backend() == "cpu":
+                _pallas_ok = False
+            else:
+                ws = jnp.ones((4,), jnp.float32)
+                z = jnp.zeros((4,), jnp.float32)
+                jax.block_until_ready(plan_stats_pallas(ws, ws, z, z))
+                _pallas_ok = True
+        except Exception:
+            LOGGER.warning(
+                "Pallas plan-stats kernel unavailable; using lax fallback",
+                exc_info=True,
+            )
+            _pallas_ok = False
+    return _pallas_ok
+
+
+def plan_stats(ws, mask, A, B):
+    """Dispatch: fused Pallas kernel on TPU, tiled lax everywhere else."""
+    if _pallas_available():
+        return plan_stats_pallas(ws, mask, A, B)
+    return plan_stats_lax(ws, mask, A, B)
