@@ -14,6 +14,13 @@ It exists for three reasons:
 
 Unlike the reference, the input lag lists are NOT mutated (SURVEY §2.4.10
 calls the in-place sort an implementation wart, not a contract).
+
+Defined domain: per-topic TOTAL lag < 2**63.  Beyond that the Java
+reference's ``long`` accumulator (reference :216-219, :266) silently wraps
+— as do the device kernels' int64 totals — while this oracle's Python ints
+keep exact counts, so bit-parity is only meaningful (and only asserted)
+inside the int64 domain.  Kafka lags are message counts; real totals sit
+many orders of magnitude below the bound.
 """
 
 from __future__ import annotations

@@ -311,17 +311,12 @@ def assign_sinkhorn(
     """Map-level Sinkhorn solve (same surface as
     :func:`..ops.dispatch.assign_device`); per-topic independence preserved."""
     from ..ops.dispatch import assign_per_topic, ensure_x64
-    from ..ops.packing import pad_bucket
+    from ..ops.packing import pad_topic_rows
 
     ensure_x64()
 
     def solve_topic(lags, pids, num_consumers):
-        P = lags.shape[0]
-        P_pad = pad_bucket(P)
-        lags_p = np.zeros(P_pad, dtype=np.int64)
-        pids_p = np.zeros(P_pad, dtype=np.int32)
-        valid = np.zeros(P_pad, dtype=bool)
-        lags_p[:P], pids_p[:P], valid[:P] = lags, pids, True
+        lags_p, pids_p, valid = pad_topic_rows(lags, pids)
         choice, _, _ = assign_topic_sinkhorn(
             lags_p, pids_p, valid, num_consumers=num_consumers, iters=iters
         )

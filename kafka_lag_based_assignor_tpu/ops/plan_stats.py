@@ -264,8 +264,30 @@ def _pallas_available() -> bool:
     return _pallas_ok
 
 
+# Conservative VMEM budget for the kernel (per-core VMEM is ~16 MB; leave
+# headroom for Mosaic's own buffers and double-buffered DMA).  Calibrated
+# so the hardware-verified north-star shape (P=131072, C=1000) passes.
+_VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+
+
+def _fits_vmem(P: int, C: int) -> bool:
+    """Shape guard for the grid-less kernel: ALL inputs live in VMEM at
+    once plus the per-tile temporaries, so availability of the kernel is
+    shape-dependent — the probe's verdict alone is not enough.  Estimate:
+    ws+mask [nt, TILE] (true-sized), ~4 live (C_pad, TILE) f32 temporaries
+    per tile step (Mosaic reuses buffers), and the (C_pad, 1) vectors at
+    128-lane padding."""
+    C_pad = max(128, -(-C // 128) * 128)
+    P_pad = -(-P // _TILE_P) * _TILE_P
+    inputs = 2 * P_pad * 4
+    temps = 4 * C_pad * _TILE_P * 4
+    vectors = 4 * C_pad * 128 * 4
+    return inputs + temps + vectors <= _VMEM_BUDGET_BYTES
+
+
 def plan_stats(ws, mask, A, B):
-    """Dispatch: fused Pallas kernel on TPU, tiled lax everywhere else."""
-    if _pallas_available():
+    """Dispatch: fused Pallas kernel on TPU (when the shape fits the VMEM
+    budget), tiled lax everywhere else."""
+    if _fits_vmem(ws.shape[0], A.shape[0]) and _pallas_available():
         return plan_stats_pallas(ws, mask, A, B)
     return plan_stats_lax(ws, mask, A, B)
