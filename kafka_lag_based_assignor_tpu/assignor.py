@@ -155,13 +155,18 @@ class LagBasedPartitionAssignor:
         solver = self._config.solver
         if solver == "host":
             return assign_greedy(lags, topic_subscriptions)
+        options = {
+            "sinkhorn_iters": self._config.sinkhorn_iters,
+            "refine_iters": self._config.refine_iters,
+        }
         try:
             # Device/native solves run under the watchdog: a wedged
             # accelerator transport can HANG rather than raise, and a
             # rebalance must never block past its deadline (SURVEY §5,
             # failure-detection row).
             return self._watchdog.call(
-                self._solve_accelerated, solver, lags, topic_subscriptions
+                self._solve_accelerated, solver, lags, topic_subscriptions,
+                options,
             )
         except Exception:
             if not self._config.host_fallback:
@@ -175,11 +180,17 @@ class LagBasedPartitionAssignor:
             return host_fallback_for(solver)(lags, topic_subscriptions)
 
     @staticmethod
-    def _solve_accelerated(solver, lags, topic_subscriptions):
+    def _solve_accelerated(solver, lags, topic_subscriptions, options=None):
+        options = options or {}
         if solver == "sinkhorn":
             from .models.sinkhorn import assign_sinkhorn
 
-            return assign_sinkhorn(lags, topic_subscriptions)
+            return assign_sinkhorn(
+                lags,
+                topic_subscriptions,
+                iters=int(options.get("sinkhorn_iters", 60)),
+                refine_iters=int(options.get("refine_iters", 24)),
+            )
         if solver == "native":
             from .native import assign_native
 

@@ -307,9 +307,14 @@ def assign_sinkhorn(
     partition_lag_per_topic: Mapping[str, Sequence[TopicPartitionLag]],
     subscriptions: Mapping[str, Sequence[str]],
     iters: int = 60,
+    refine_iters: int = 24,
 ) -> AssignmentMap:
     """Map-level Sinkhorn solve (same surface as
-    :func:`..ops.dispatch.assign_device`); per-topic independence preserved."""
+    :func:`..ops.dispatch.assign_device`); per-topic independence preserved.
+
+    ``iters``/``refine_iters`` are the quality-vs-latency knobs, exposed
+    through the config layer as ``tpu.assignor.sinkhorn.iters`` /
+    ``tpu.assignor.refine.iters``."""
     from ..ops.dispatch import assign_per_topic, ensure_x64
     from ..ops.packing import pad_topic_rows
 
@@ -318,7 +323,8 @@ def assign_sinkhorn(
     def solve_topic(lags, pids, num_consumers):
         lags_p, pids_p, valid = pad_topic_rows(lags, pids)
         choice, _, _ = assign_topic_sinkhorn(
-            lags_p, pids_p, valid, num_consumers=num_consumers, iters=iters
+            lags_p, pids_p, valid, num_consumers=num_consumers,
+            iters=iters, refine_iters=refine_iters,
         )
         return choice
 

@@ -50,7 +50,10 @@ from .utils.watchdog import Watchdog
 LOGGER = logging.getLogger(__name__)
 
 
-def _solve(topics, subscriptions, solver, watchdog=None, host_fallback=True):
+def _solve(
+    topics, subscriptions, solver, watchdog=None, host_fallback=True,
+    options=None,
+):
     lag_map = {
         topic: [
             TopicPartitionLag(topic, int(pid), int(lag)) for pid, lag in rows
@@ -70,9 +73,9 @@ def _solve(topics, subscriptions, solver, watchdog=None, host_fallback=True):
         solve = LagBasedPartitionAssignor._solve_accelerated
         try:
             if watchdog is not None:
-                raw = watchdog.call(solve, solver, lag_map, subs)
+                raw = watchdog.call(solve, solver, lag_map, subs, options)
             else:
-                raw = solve(solver, lag_map, subs)
+                raw = solve(solver, lag_map, subs, options)
         except Exception:
             if not host_fallback:
                 raise
@@ -175,6 +178,7 @@ class AssignorService:
                     solver,
                     watchdog=self._watchdog,
                     host_fallback=self._host_fallback,
+                    options=params.get("options") or {},
                 )
                 result = {
                     "assignments": assignments,

@@ -29,6 +29,8 @@ SOLVER_CONFIG = (
 FALLBACK_CONFIG = "tpu.assignor.host.fallback"  # bool: greedy host fallback
 PROFILE_CONFIG = "tpu.assignor.profile"  # bool: jax.profiler traces
 SOLVE_TIMEOUT_CONFIG = "tpu.assignor.solve.timeout.ms"  # 0/empty disables
+SINKHORN_ITERS_CONFIG = "tpu.assignor.sinkhorn.iters"  # int > 0
+REFINE_ITERS_CONFIG = "tpu.assignor.refine.iters"  # int >= 0
 
 VALID_SOLVERS = ("rounds", "scan", "global", "sinkhorn", "native", "host")
 
@@ -48,6 +50,9 @@ class AssignorConfig:
     # persistent cache); a trip only sidelines the accelerator for the
     # watchdog cooldown, not forever.
     solve_timeout_s: Optional[float] = 120.0
+    # Quality-mode iteration budgets (sinkhorn solver / exchange refinement).
+    sinkhorn_iters: int = 60
+    refine_iters: int = 24
     consumer_group_props: Dict[str, Any] = field(default_factory=dict)
     metadata_consumer_props: Dict[str, Any] = field(default_factory=dict)
 
@@ -90,6 +95,19 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
     metadata_consumer_props[ENABLE_AUTO_COMMIT_CONFIG] = "false"
     metadata_consumer_props[CLIENT_ID_CONFIG] = f"{group_id}.assignor"
 
+    def _as_int(key: str, default: int, minimum: int) -> int:
+        raw = consumer_group_props.get(key, default)
+        try:
+            value = int(raw)
+        except (TypeError, ValueError):
+            raise ValueError(f"{key}={raw!r} is not an integer")
+        if value < minimum:
+            raise ValueError(f"{key}={value} must be >= {minimum}")
+        return value
+
+    sinkhorn_iters = _as_int(SINKHORN_ITERS_CONFIG, 60, 1)
+    refine_iters = _as_int(REFINE_ITERS_CONFIG, 24, 0)
+
     raw_timeout = consumer_group_props.get(SOLVE_TIMEOUT_CONFIG, 120_000)
     try:
         timeout_ms = float(raw_timeout) if raw_timeout not in ("", None) else 0.0
@@ -108,6 +126,8 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
         host_fallback=_as_bool(consumer_group_props.get(FALLBACK_CONFIG, True)),
         profile=_as_bool(consumer_group_props.get(PROFILE_CONFIG, False)),
         solve_timeout_s=solve_timeout_s,
+        sinkhorn_iters=sinkhorn_iters,
+        refine_iters=refine_iters,
         consumer_group_props=consumer_group_props,
         metadata_consumer_props=metadata_consumer_props,
     )

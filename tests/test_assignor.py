@@ -135,6 +135,51 @@ def test_fallback_disabled_propagates(monkeypatch):
         a.assign(broker.cluster(), subs({"C0": ["t0"]}))
 
 
+def test_quality_iteration_knobs_parse_and_validate():
+    from kafka_lag_based_assignor_tpu.utils.config import parse_config
+
+    cfg = parse_config({"group.id": "g"})
+    assert cfg.sinkhorn_iters == 60 and cfg.refine_iters == 24
+    cfg = parse_config(
+        {
+            "group.id": "g",
+            "tpu.assignor.sinkhorn.iters": "90",
+            "tpu.assignor.refine.iters": 0,
+        }
+    )
+    assert cfg.sinkhorn_iters == 90 and cfg.refine_iters == 0
+    with pytest.raises(ValueError, match="sinkhorn.iters"):
+        parse_config({"group.id": "g", "tpu.assignor.sinkhorn.iters": 0})
+    with pytest.raises(ValueError, match="refine.iters"):
+        parse_config({"group.id": "g", "tpu.assignor.refine.iters": "nope"})
+
+
+def test_quality_knobs_reach_the_solver(monkeypatch):
+    """The configured iteration budgets must flow through to the sinkhorn
+    solver call."""
+    import kafka_lag_based_assignor_tpu.models.sinkhorn as sk
+
+    seen = {}
+    real = sk.assign_sinkhorn
+
+    def spy(lags, subs, iters=60, refine_iters=24):
+        seen.update(iters=iters, refine_iters=refine_iters)
+        return real(lags, subs, iters=iters, refine_iters=refine_iters)
+
+    monkeypatch.setattr(sk, "assign_sinkhorn", spy)
+    broker = readme_broker()
+    a = make_assignor(
+        broker,
+        {
+            "tpu.assignor.solver": "sinkhorn",
+            "tpu.assignor.sinkhorn.iters": 7,
+            "tpu.assignor.refine.iters": 3,
+        },
+    )
+    a.assign(broker.cluster(), subs({"C0": ["t0"], "C1": ["t0"]}))
+    assert seen == {"iters": 7, "refine_iters": 3}
+
+
 def test_solver_host_runs_pure_python():
     broker = readme_broker()
     a = make_assignor(broker, {"tpu.assignor.solver": "host"})
