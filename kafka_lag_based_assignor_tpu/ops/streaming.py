@@ -38,17 +38,37 @@ from .refine import refine_assignment
 @dataclass
 class StreamingStats:
     cold_start: bool = False
+    guardrail_tripped: bool = False  # warm quality fell past the guardrail
     churn: int = 0  # partitions whose consumer changed vs previous epoch
     max_mean_imbalance: float = 1.0
+    imbalance_bound: float = 1.0  # input-driven lower bound max_lag/mean
     count_spread: int = 0
 
 
 class StreamingAssignor:
-    """Stateful engine for one topic's periodic rebalance at fixed scale."""
+    """Stateful engine for one topic's periodic rebalance at fixed scale.
 
-    def __init__(self, num_consumers: int, refine_iters: int = 128):
+    ``imbalance_guardrail`` bounds how far the bounded-churn warm path may
+    drift from balance across epochs: after a warm rebalance, if
+    ``max_mean_imbalance > guardrail * max(input bound, 1)`` the epoch is
+    re-solved cold (unbounded churn, restored quality) — quality
+    degradation is capped at the cost of occasional full reshuffles.
+    ``None`` disables the guardrail (pure bounded-churn behavior).
+    """
+
+    def __init__(
+        self,
+        num_consumers: int,
+        refine_iters: int = 128,
+        imbalance_guardrail: Optional[float] = None,
+    ):
         self.num_consumers = int(num_consumers)
         self.refine_iters = int(refine_iters)
+        if imbalance_guardrail is not None and imbalance_guardrail < 1.0:
+            raise ValueError(
+                f"imbalance_guardrail={imbalance_guardrail} must be >= 1.0"
+            )
+        self.imbalance_guardrail = imbalance_guardrail
         self._prev_choice: Optional[np.ndarray] = None
         self.last_stats = StreamingStats()
 
@@ -97,17 +117,40 @@ class StreamingAssignor:
             choice = np.asarray(choice)[:P]
             prev_for_churn = prev
 
+        self._fill_quality_stats(stats, choice, lags)
+
+        # Quality guardrail: a warm epoch whose imbalance drifted past the
+        # allowance re-solves cold (the churn bound intentionally yields).
+        if (
+            self.imbalance_guardrail is not None
+            and not stats.cold_start
+            and stats.max_mean_imbalance
+            > self.imbalance_guardrail * max(stats.imbalance_bound, 1.0)
+        ):
+            stats.guardrail_tripped = True
+            stats.cold_start = True
+            choice = np.asarray(
+                assign_stream(lags, num_consumers=self.num_consumers)
+            ).astype(np.int32)
+            self._fill_quality_stats(stats, choice, lags)
+
+        if prev_for_churn is not None:
+            stats.churn = int((choice != prev_for_churn).sum())
+        self._prev_choice = choice
+        self.last_stats = stats
+        return choice
+
+    def _fill_quality_stats(
+        self, stats: StreamingStats, choice: np.ndarray, lags: np.ndarray
+    ) -> None:
         totals = np.zeros(self.num_consumers, dtype=np.int64)
         np.add.at(totals, choice.astype(np.int64), lags)
         counts = np.bincount(choice, minlength=self.num_consumers)
         mean = totals.mean()
         stats.max_mean_imbalance = float(totals.max() / mean) if mean else 1.0
         stats.count_spread = int(counts.max() - counts.min())
-        if prev_for_churn is not None:
-            stats.churn = int((choice != prev_for_churn).sum())
-        self._prev_choice = choice
-        self.last_stats = stats
-        return choice
+        # Input-driven bound: the hottest partition sits on SOME consumer.
+        stats.imbalance_bound = float(lags.max() / mean) if mean else 1.0
 
     def reset(self) -> None:
         """Drop warm state (e.g. on membership change)."""

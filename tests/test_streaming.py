@@ -1,6 +1,7 @@
 """Streaming warm-start tests: bounded churn, preserved invariants, reset."""
 
 import numpy as np
+import pytest
 
 from kafka_lag_based_assignor_tpu.ops.batched import assign_stream
 from kafka_lag_based_assignor_tpu.ops.streaming import StreamingAssignor
@@ -72,6 +73,49 @@ def test_zero_budget_keeps_previous_assignment():
     assert (first == second).all()
     assert engine.last_stats.churn == 0
     assert not engine.last_stats.cold_start
+
+
+def test_guardrail_trips_on_quality_drift():
+    """With zero refine budget the warm path keeps a stale assignment; once
+    drifted lags make its imbalance exceed the guardrail allowance, the
+    engine must re-solve cold and restore quality."""
+    rng = np.random.default_rng(31)
+    P, C = 512, 8
+    lags = rng.integers(1, 1000, P).astype(np.int64)
+    engine = StreamingAssignor(
+        num_consumers=C, refine_iters=0, imbalance_guardrail=1.5
+    )
+    engine.rebalance(lags)
+    assert engine.last_stats.cold_start
+
+    # Adversarial drift: all lag moves onto one consumer's partitions.
+    prev = engine.rebalance(lags)  # warm no-op (budget 0, balanced enough)
+    assert not engine.last_stats.guardrail_tripped
+    hot = prev == 0
+    drifted = np.where(hot, 10**6, 1).astype(np.int64)
+    engine.rebalance(drifted)
+    stats = engine.last_stats
+    assert stats.guardrail_tripped and stats.cold_start
+    assert stats.max_mean_imbalance <= 1.5 * max(stats.imbalance_bound, 1.0)
+
+
+def test_guardrail_disabled_keeps_bounded_churn():
+    """Without a guardrail the zero-budget warm path never reshuffles, no
+    matter how bad the drifted imbalance gets (documented trade-off)."""
+    rng = np.random.default_rng(32)
+    P, C = 512, 8
+    lags = rng.integers(1, 1000, P).astype(np.int64)
+    engine = StreamingAssignor(num_consumers=C, refine_iters=0)
+    prev = engine.rebalance(lags).copy()
+    drifted = np.where(prev == 0, 10**6, 1).astype(np.int64)
+    engine.rebalance(drifted)
+    assert engine.last_stats.churn == 0
+    assert not engine.last_stats.guardrail_tripped
+
+
+def test_guardrail_validation():
+    with pytest.raises(ValueError, match="guardrail"):
+        StreamingAssignor(num_consumers=2, imbalance_guardrail=0.5)
 
 
 def test_reset_forces_cold_start():
