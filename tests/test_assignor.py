@@ -135,6 +135,28 @@ def test_fallback_disabled_propagates(monkeypatch):
         a.assign(broker.cluster(), subs({"C0": ["t0"]}))
 
 
+def test_host_solver_never_touches_the_backend(monkeypatch):
+    """The never-fail contract's foundation: with solver='host' a full
+    configure+assign must not initialize any JAX backend — a wedged
+    accelerator transport can hang backend init forever (observed on this
+    image), and the host path must be immune, not merely watchdog-rescued."""
+    import jax
+    from jax._src import xla_bridge
+
+    def poisoned(*a, **k):
+        raise AssertionError("host path touched the JAX backend")
+
+    monkeypatch.setattr(xla_bridge, "get_backend", poisoned)
+    monkeypatch.setattr(jax, "devices", poisoned)
+
+    broker = readme_broker()
+    a = make_assignor(broker, {"tpu.assignor.solver": "host"})
+    result = a.assign(broker.cluster(), subs({"C0": ["t0"], "C1": ["t0"]}))
+    assert list(result.group_assignment["C0"].partitions) == [
+        TopicPartition("t0", 0)
+    ]
+
+
 def test_quality_iteration_knobs_parse_and_validate():
     from kafka_lag_based_assignor_tpu.utils.config import parse_config
 
