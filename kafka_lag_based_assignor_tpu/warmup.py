@@ -1,0 +1,159 @@
+"""Compile-cache warm-up for latency-critical rebalances.
+
+A rebalance is on the consumer group's critical path, but the FIRST solve
+at a new padded shape pays an XLA compile — tens of seconds through a
+remote-compile transport (this image: ~20-70 s/shape).  The shapes are
+predictable, though: every kernel input is padded to power-of-two buckets
+(:func:`.ops.packing.pad_bucket`), so a deployment can pre-compile every
+shape it will ever see at startup, populating both the in-process jit
+cache and (when ``jax_compilation_cache_dir`` is set) the persistent
+on-disk cache shared across processes.
+
+Usage (at consumer startup or image build, NOT inside a rebalance)::
+
+    from kafka_lag_based_assignor_tpu.warmup import warmup
+    shapes = warmup(max_partitions=100_000, consumers=[1000], topics=[1])
+
+The warm-up runs each bucketed shape through the same jitted entry points
+the rebalance path uses (batched rounds kernel, transfer-lean stream path,
+and optionally the quality solvers), on synthetic data.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from .ops.packing import pad_bucket
+
+LOGGER = logging.getLogger(__name__)
+
+
+def bucket_range(max_value: int, minimum: int = 8) -> List[int]:
+    """All power-of-two buckets that inputs in [1, max_value] pad to."""
+    buckets = []
+    b = minimum
+    while True:
+        buckets.append(b)
+        if b >= max_value:
+            break
+        b *= 2
+    return buckets
+
+
+def warmup(
+    max_partitions: int,
+    consumers: Sequence[int],
+    topics: Sequence[int] = (1,),
+    solvers: Sequence[str] = ("rounds", "stream"),
+    all_partition_buckets: bool = False,
+    sinkhorn_iters: int = 60,
+    refine_iters: int = 24,
+) -> List[Tuple[str, int, int, int, float]]:
+    """Pre-compile kernels for every shape the deployment will see.
+
+    Args:
+      max_partitions: largest per-topic partition count expected.
+      consumers: exact consumer-group sizes to warm (C is not bucketed —
+        it is a static kernel parameter).
+      topics: topic-batch sizes to warm for the batched kernels (bucketed).
+      solvers: subset of {"rounds", "global", "stream", "sinkhorn"}.
+      all_partition_buckets: warm every bucket up to the max (True) or only
+        the single bucket ``max_partitions`` pads to (default — smaller
+        shapes still trigger one compile each on first sight).
+      sinkhorn_iters / refine_iters: must match the production config
+        (they are static jit parameters; different values = new compile).
+
+    Returns a list of (solver, T, P_bucket, C, seconds) for each shape
+    compiled.  Failures are logged and skipped — warm-up must never take a
+    deployment down.
+    """
+    from .ops.batched import (
+        assign_batched_rounds,
+        assign_stream,
+    )
+    from .ops.dispatch import ensure_x64
+    from .ops.rounds_kernel import assign_global_rounds
+
+    ensure_x64()
+    p_buckets = (
+        bucket_range(max_partitions)
+        if all_partition_buckets
+        else [pad_bucket(max_partitions)]
+    )
+    t_buckets = sorted({pad_bucket(t, minimum=1) for t in topics})
+
+    done: List[Tuple[str, int, int, int, float]] = []
+    rng = np.random.default_rng(0)
+    for P in p_buckets:
+        lags1d = rng.integers(0, 1000, size=P).astype(np.int64)
+        pids1d = np.arange(P, dtype=np.int32)
+        for C in consumers:
+            jobs = []
+            if "stream" in solvers:
+                jobs.append(
+                    ("stream", 1, lambda: assign_stream(lags1d, num_consumers=C))
+                )
+            if "sinkhorn" in solvers:
+                from .models.sinkhorn import assign_topic_sinkhorn
+
+                valid1d = np.ones(P, dtype=bool)
+                jobs.append(
+                    (
+                        "sinkhorn",
+                        1,
+                        lambda: assign_topic_sinkhorn(
+                            lags1d, pids1d, valid1d, num_consumers=C,
+                            iters=sinkhorn_iters, refine_iters=refine_iters,
+                        ),
+                    )
+                )
+            for T in t_buckets:
+                lags = np.broadcast_to(lags1d, (T, P)).copy()
+                pids = np.broadcast_to(pids1d, (T, P)).copy()
+                valid = np.ones((T, P), dtype=bool)
+                if "rounds" in solvers:
+                    jobs.append(
+                        (
+                            "rounds",
+                            T,
+                            lambda lags=lags, pids=pids, valid=valid: (
+                                assign_batched_rounds(
+                                    lags, pids, valid, num_consumers=C
+                                )
+                            ),
+                        )
+                    )
+                if "global" in solvers:
+                    jobs.append(
+                        (
+                            "global",
+                            T,
+                            lambda lags=lags, pids=pids, valid=valid: (
+                                assign_global_rounds(
+                                    lags, pids, valid, num_consumers=C
+                                )
+                            ),
+                        )
+                    )
+            for name, T, job in jobs:
+                t0 = time.perf_counter()
+                try:
+                    import jax
+
+                    jax.block_until_ready(job())
+                except Exception:
+                    LOGGER.warning(
+                        "warmup %s T=%d P=%d C=%d failed (skipped)",
+                        name, T, P, C, exc_info=True,
+                    )
+                    continue
+                secs = time.perf_counter() - t0
+                done.append((name, T, P, C, secs))
+                LOGGER.info(
+                    "warmup %s T=%d P=%d C=%d in %.1fs", name, T, P, C, secs
+                )
+    return done
