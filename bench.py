@@ -73,7 +73,6 @@ def rtt_floor_ms(iters: int = 6) -> float:
     be tens of ms and bounds ANY implementation's end-to-end latency here;
     on a locally attached TPU it is microseconds."""
     import jax
-    import jax.numpy as jnp
 
     x = jax.device_put(np.arange(1024, dtype=np.int32))
     f = jax.jit(lambda x: (x * 2 + 1).sum())

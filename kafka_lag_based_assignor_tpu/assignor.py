@@ -37,14 +37,18 @@ from .types import (
     GroupSubscription,
     TopicPartition,
 )
-from .utils.config import AssignorConfig, parse_config
+from .utils.config import PARITY_SOLVERS, AssignorConfig, parse_config
 from .utils.watchdog import Watchdog
 from .utils.observability import (
+    TRACE,
     RebalanceStats,
     log_rebalance,
+    log_topic_summaries,
     profile_trace,
     stopwatch,
     summarize_assignment,
+    summarize_topics,
+    trace_decisions,
 )
 
 LOGGER = logging.getLogger(__name__)
@@ -79,6 +83,17 @@ class LagBasedPartitionAssignor:
             self._config.group_id,
             self._config.client_id,
             self._config.solver,
+        )
+        # Full derived metadata-consumer property map (reference :122-128).
+        LOGGER.debug(
+            "Derived metadata consumer properties:\n%s",
+            "".join(
+                f"\t{k} = {v}\n"
+                for k, v in sorted(
+                    self._config.metadata_consumer_props.items(),
+                    key=lambda kv: kv[0],
+                )
+            ),
         )
 
     # -- ConsumerPartitionAssignor SPI ------------------------------------
@@ -146,6 +161,20 @@ class LagBasedPartitionAssignor:
         }
         stats.total_lag = sum(lag_by_tp.values())
         summarize_assignment(stats, raw, lag_by_tp)
+        # Per-topic breakdown + per-decision trace + per-topic debug
+        # summary, all gated like the reference's isDebugEnabled guard
+        # (:280) so the O(partitions) aggregation and the multi-KB log
+        # payloads are only paid when the level is on.
+        if LOGGER.isEnabledFor(logging.DEBUG):
+            summarize_topics(stats, raw, lags)
+            # The decision replay assumes per-topic sequential greedy —
+            # only true for the parity solvers; 'global' carries totals
+            # across topics and 'sinkhorn' has no decision sequence.
+            if self._config.solver in PARITY_SOLVERS and LOGGER.isEnabledFor(
+                TRACE
+            ):
+                trace_decisions(raw, lags, logger=LOGGER)
+            log_topic_summaries(stats, raw, logger=LOGGER)
 
         return GroupAssignment(
             {member: Assignment(tuple(tps)) for member, tps in raw.items()}

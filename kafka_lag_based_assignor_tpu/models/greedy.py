@@ -121,12 +121,18 @@ def assign_greedy_global(
 
 
 def host_fallback_for(solver: str):
-    """The host solver whose semantics match ``solver`` — used by both the
-    in-process plugin adapter and the sidecar service when a device solve
-    fails or times out, so a fallback never silently changes the assignment
-    semantics the caller configured: the ``global`` quality mode falls back
-    to :func:`assign_greedy_global`; every other solver is parity-bound to
-    the reference and falls back to :func:`assign_greedy`."""
+    """The host solver used by both the in-process plugin adapter and the
+    sidecar service when a device solve fails or times out.
+
+    Exactness of the fallback depends on the solver: ``global`` keeps its
+    semantics exactly (:func:`assign_greedy_global` is the same algorithm
+    on host); the reference-parity kernels (``rounds``/``scan``/``native``)
+    fall back to :func:`assign_greedy`, which is bit-identical to them.
+    ``sinkhorn`` has no host equivalent — its fallback is
+    :func:`assign_greedy`, a *quality downgrade* (OT-optimized balance ->
+    4/3-approximation greedy) that still satisfies every invariant
+    (count spread <= 1, determinism).  Callers see the downgrade via
+    ``RebalanceStats.fallback_used`` plus the warning log."""
     return assign_greedy_global if solver == "global" else assign_greedy
 
 

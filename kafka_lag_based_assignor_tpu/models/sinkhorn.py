@@ -45,8 +45,6 @@ from __future__ import annotations
 import functools
 from typing import Mapping, Sequence
 
-import numpy as np
-
 import jax
 import jax.numpy as jnp
 from jax import lax

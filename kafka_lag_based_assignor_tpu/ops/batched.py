@@ -1,4 +1,4 @@
-"""Batched (vmap-over-topics) assignment kernels.
+"""Batched (vmap-over-topics) assignment kernels + the streaming fast path.
 
 One kernel launch assigns every topic in a :class:`..ops.packing.TopicGroup`
 — the vmap stress shape of BASELINE config 3 (256 topics x 64 partitions x
@@ -11,20 +11,34 @@ from __future__ import annotations
 
 import functools
 
+import numpy as np
+
 import jax
 
-from .rounds_kernel import assign_topic_rounds
-from .scan_kernel import assign_topic_scan
+from .rounds_kernel import (
+    assign_presorted_rounds,
+    assign_topic_rounds,
+)
+from .scan_kernel import assign_topic_scan, pack_shift_for
 
 
-@functools.partial(jax.jit, static_argnames=("num_consumers",))
-def assign_batched_rounds(lags, partition_ids, valid, num_consumers: int):
+@functools.partial(
+    jax.jit, static_argnames=("num_consumers", "pack_shift")
+)
+def assign_batched_rounds(
+    lags, partition_ids, valid, num_consumers: int, pack_shift: int = 0
+):
     """Rounds kernel over a topic batch.
 
-    Args: lags int64[T, P], partition_ids int32[T, P], valid bool[T, P].
+    Args: lags int64[T, P], partition_ids int32[T, P], valid bool[T, P];
+    ``pack_shift`` (static) as in :func:`..ops.scan_kernel.sort_partitions`.
     Returns (choice int32[T, P], counts int32[T, C], totals[T, C]).
     """
-    fn = functools.partial(assign_topic_rounds, num_consumers=num_consumers)
+    fn = functools.partial(
+        assign_topic_rounds,
+        num_consumers=num_consumers,
+        pack_shift=pack_shift,
+    )
     return jax.vmap(fn)(lags, partition_ids, valid)
 
 
@@ -36,37 +50,80 @@ def assign_batched_scan(lags, partition_ids, valid, num_consumers: int):
     return jax.vmap(fn)(lags, partition_ids, valid)
 
 
+def _narrow_choice(choice, num_consumers: int):
+    import jax.numpy as jnp
+
+    if num_consumers <= 32767:
+        return choice.astype(jnp.int16)
+    return choice
+
+
 @functools.partial(jax.jit, static_argnames=("num_consumers",))
-def assign_stream(lags, num_consumers: int):
-    """Transfer-lean single-topic path for streaming rebalances.
+def _stream_presorted(lags, perm, num_consumers: int):
+    """CPU-backend inner: host-presorted, exact shape, minimum rounds."""
+    choice, _, _ = assign_presorted_rounds(
+        lags[perm], perm, num_consumers=num_consumers
+    )
+    return _narrow_choice(choice, num_consumers)
 
-    Takes ONLY the exact-size lag vector (int64[P]); partition ids are the
-    dense 0..P-1 range and the validity mask is all-true, both generated
-    device-side, and the returned choice is int16 when C fits — so the
-    host<->device traffic is the minimum possible (8 bytes/partition in,
-    2 bytes/partition out).  Trace-cached per exact P, which is the shape
-    stability profile of a streaming rebalance loop (BASELINE config 5:
-    same topic every 30 s under drifting lag).
 
-    Returns choice[P] (int16 if C <= 32767 else int32).
-    """
+@functools.partial(
+    jax.jit, static_argnames=("num_consumers", "pack_shift")
+)
+def _stream_device(lags, num_consumers: int, pack_shift: int = 0):
+    """Accelerator inner: device sort at a power-of-two padded shape.
+
+    Pads device-side to a power-of-two bucket: the transfer stays
+    exact-size while the sort network compiles at a friendly shape
+    (non-power-of-two sorts compile pathologically slowly on some
+    backends)."""
     import jax.numpy as jnp
 
     from .packing import pad_bucket
 
-    # Pad device-side to a power-of-two bucket: the transfer stays
-    # exact-size while the sort network compiles at a friendly shape
-    # (non-power-of-two sorts compile pathologically slowly on some
-    # backends).
     P = lags.shape[0]
     P_pad = pad_bucket(P)
     lags_p = jnp.pad(lags, (0, P_pad - P))
     pids = jnp.arange(P_pad, dtype=jnp.int32)
     valid = pids < P
     choice, _, _ = assign_topic_rounds(
-        lags_p, pids, valid, num_consumers=num_consumers
+        lags_p, pids, valid, num_consumers=num_consumers,
+        pack_shift=pack_shift,
     )
-    choice = choice[:P]
-    if num_consumers <= 32767:
-        choice = choice.astype(jnp.int16)
-    return choice
+    return _narrow_choice(choice[:P], num_consumers)
+
+
+def assign_stream(lags, num_consumers: int):
+    """Transfer-lean single-topic path for streaming rebalances.
+
+    Takes ONLY the exact-size lag vector (int64[P]); partition ids are the
+    dense 0..P-1 range and the validity mask is all-true, and the returned
+    choice is int16 when C fits — so the host<->device traffic is the
+    minimum possible (8 bytes/partition in, 2 bytes/partition out).
+    Trace-cached per exact P, which is the shape stability profile of a
+    streaming rebalance loop (BASELINE config 5: same topic every 30 s
+    under drifting lag).
+
+    Backend-aware host wrapper: on the CPU backend the processing-order
+    permutation is computed host-side (``np.argsort``, ~3x faster than
+    XLA:CPU's comparator sort at P=100k) and the scan runs at the exact
+    shape; on accelerators the sort runs on-device at a padded
+    power-of-two shape, packed single-key when the value ranges allow.
+
+    Returns choice[P] (int16 if C <= 32767 else int32).
+    """
+    if isinstance(lags, np.ndarray):
+        lags = np.ascontiguousarray(lags, dtype=np.int64)
+        if jax.default_backend() == "cpu":
+            # Stable argsort of -lags == (lag desc, pid asc): input row
+            # order IS pid order on this dense path.
+            perm = np.argsort(-lags, kind="stable").astype(np.int32)
+            return _stream_presorted(lags, perm, num_consumers=num_consumers)
+        from .packing import pad_bucket
+
+        max_lag = int(lags.max()) if lags.size else 0
+        shift = pack_shift_for(max_lag, pad_bucket(lags.shape[0]) - 1)
+        return _stream_device(
+            lags, num_consumers=num_consumers, pack_shift=shift
+        )
+    return _stream_device(lags, num_consumers=num_consumers)

@@ -26,6 +26,7 @@ from ..types import AssignmentMap, TopicPartition, TopicPartitionLag
 from .batched import assign_batched_rounds, assign_batched_scan
 from .packing import TopicGroup, build_groups, pad_bucket
 from .rounds_kernel import assign_global_rounds
+from .scan_kernel import pack_shift_for
 
 # "global" returns a single [C] totals vector (cross-topic) instead of
 # [T, C]; choice/counts contracts are identical across all three.
@@ -84,6 +85,19 @@ def assign_group_device(group: TopicGroup, kernel: str = "rounds"):
     """
     ensure_x64()
     kernel_fn = _BATCHED_KERNELS[kernel]
+    if kernel in ("rounds", "global"):
+        # Packed single-key sort when the group's value ranges allow —
+        # checked host-side on the numpy inputs (padding rows included:
+        # their values only widen the bound).
+        max_lag = int(group.lags.max()) if group.lags.size else 0
+        max_pid = (
+            int(group.partition_ids.max()) if group.partition_ids.size else 0
+        )
+        return kernel_fn(
+            group.lags, group.partition_ids, group.valid,
+            num_consumers=group.num_consumers,
+            pack_shift=pack_shift_for(max_lag, max_pid),
+        )
     return kernel_fn(
         group.lags, group.partition_ids, group.valid,
         num_consumers=group.num_consumers,

@@ -34,6 +34,16 @@ def pad_bucket(n: int, minimum: int = 8) -> int:
     return b
 
 
+def pad_chunk(n: int, chunk: int = 4096) -> int:
+    """Next multiple of ``chunk`` >= n — the fine-grained bucket for
+    backends where exact-ish shapes are cheap (XLA:CPU's comparison sort
+    costs O(n log n) regardless of shape, so a power-of-two pad wastes up
+    to ~2x work) but the jit cache still needs bounding as n drifts:
+    quantizing to 4096 keeps at most P_max/4096 executables alive instead
+    of one per distinct n."""
+    return max(chunk, -(-n // chunk) * chunk)
+
+
 def pad_topic_rows(lags, partition_ids=None):
     """Pad one topic's columns to its power-of-two bucket.
 

@@ -29,7 +29,6 @@ interpret mode on CPU).
 
 from __future__ import annotations
 
-import functools
 import logging
 
 import jax

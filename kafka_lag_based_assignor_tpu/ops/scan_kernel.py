@@ -31,13 +31,50 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def sort_partitions(lags: jax.Array, partition_ids: jax.Array, valid: jax.Array):
+def pack_shift_for(max_lag: int, max_pid: int) -> int:
+    """Pick the pid bit-shift for a packed single-key processing-order sort,
+    or 0 if the value ranges make packing unsafe.
+
+    The packed key is ``-(lag << shift) + pid``: lag descending is the
+    primary order, pid ascending breaks ties (reference :228-235) — valid
+    whenever every pid fits in ``shift`` bits and ``lag << shift`` cannot
+    overflow int64.  The host checks both from the numpy inputs (O(P) max,
+    ~microseconds) and passes the shift as a static jit argument; 0 selects
+    the general two-key lexicographic sort.  A single-key sort halves the
+    comparator stages, which is the dominant cost of the device sort at
+    north-star scale.
+    """
+    shift = max(1, int(max_pid)).bit_length()
+    if int(max_lag) < (1 << (62 - shift)):
+        return shift
+    return 0
+
+
+def sort_partitions(
+    lags: jax.Array,
+    partition_ids: jax.Array,
+    valid: jax.Array,
+    pack_shift: int = 0,
+):
     """Return the processing-order permutation: lag desc, partition id asc,
     padding last (reference :228-235).
 
     Works because valid lags are >= 0: negated they are <= 0, and padding
-    gets key +1 which sorts after every valid row in ascending order.
+    gets key +1 (two-key path) / int64 max (packed path), which sorts after
+    every valid row in ascending order.
+
+    ``pack_shift`` (static, from :func:`pack_shift_for`) selects the packed
+    single-key sort; 0 the general two-key sort.  Identical permutations —
+    enforced by differential fuzzing in tests/test_kernels.py.
     """
+    if pack_shift:
+        key = jnp.where(
+            valid,
+            -(lags.astype(jnp.int64) << pack_shift)
+            + partition_ids.astype(jnp.int64),
+            jnp.iinfo(jnp.int64).max,
+        )
+        return jnp.argsort(key).astype(jnp.int32)
     neg_lag = jnp.where(valid, -lags, 1)
     pid_key = jnp.where(valid, partition_ids, jnp.iinfo(jnp.int32).max)
     idx = jnp.arange(lags.shape[0], dtype=jnp.int32)

@@ -31,7 +31,7 @@ import numpy as np
 
 from .batched import assign_stream
 from .dispatch import ensure_x64
-from .packing import pad_bucket
+from .packing import pad_bucket, pad_chunk
 from .refine import refine_assignment
 
 
@@ -92,10 +92,18 @@ class StreamingAssignor:
             choice = prev
             prev_for_churn = prev
         else:
-            # Pad to the power-of-two bucket (padding rows invalid/-1) so
-            # the refine kernel's P-sized sorts hit fast shapes and the jit
-            # cache stays bounded across slowly-varying P.
-            B = pad_bucket(P)
+            # Pad so the refine kernel's P-sized sorts hit fast shapes and
+            # the jit cache stays bounded across slowly-varying P: the
+            # power-of-two bucket on accelerators (sort-network-friendly),
+            # the fine 4096-chunk on CPU where a pow2 pad wastes up to ~2x
+            # sort work but the cache still needs bounding.
+            import jax
+
+            B = (
+                pad_chunk(P)
+                if jax.default_backend() == "cpu"
+                else pad_bucket(P)
+            )
             lags_p = np.zeros(B, dtype=np.int64)
             lags_p[:P] = lags
             valid = np.zeros(B, dtype=bool)

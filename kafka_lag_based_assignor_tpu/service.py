@@ -28,6 +28,14 @@ Also supported: ``{"method": "ping"}`` -> ``{"result": "pong"}`` and
 ``{"method": "stats"}`` -> counters since start.  One request per line;
 responses preserve the request ``id``.  Malformed JSON gets an error
 response with ``id: null`` rather than a dropped connection.
+
+Wire limits: a request line may be at most ``MAX_LINE_BYTES`` (16 MiB —
+comfortably above a 100k-partition request, ~2 MB); longer lines are
+answered with an error and drained without buffering.  ``params.options``
+accepts only ``sinkhorn_iters`` (int, 1..4096) and ``refine_iters`` (int,
+0..65536) — these become static jit arguments, so every distinct value
+compiles a fresh executable; out-of-range or non-integer values are
+rejected as client errors, never silently downgraded to a host fallback.
 """
 
 from __future__ import annotations
@@ -48,6 +56,40 @@ from .utils.observability import RebalanceStats, summarize_assignment
 from .utils.watchdog import Watchdog
 
 LOGGER = logging.getLogger(__name__)
+
+# Upper bound on one request line.  A north-star-scale assign request
+# (100k partitions with 7-digit lags) serializes to ~2 MB; 16 MiB leaves
+# ample headroom while preventing a malformed client from streaming an
+# unbounded "line" into memory.
+MAX_LINE_BYTES = 16 * 1024 * 1024
+
+# params.options whitelist: (min, max) per key.  Both are *static* jit
+# arguments downstream — every distinct value costs a fresh XLA compile
+# (tens of seconds on this image) — so unknown keys, non-integers, and
+# out-of-range values are client errors at the wire boundary, not inputs
+# to the solve path.
+_OPTION_BOUNDS = {"sinkhorn_iters": (1, 4096), "refine_iters": (0, 65536)}
+
+
+def _validate_options(options: Any) -> Dict[str, int]:
+    if not isinstance(options, dict):
+        raise ValueError("params.options must be a JSON object")
+    out: Dict[str, int] = {}
+    for key, value in options.items():
+        bounds = _OPTION_BOUNDS.get(key)
+        if bounds is None:
+            raise ValueError(
+                f"unknown option {key!r}; valid: {sorted(_OPTION_BOUNDS)}"
+            )
+        if isinstance(value, bool) or not isinstance(value, int):
+            raise ValueError(f"option {key} must be an integer, got {value!r}")
+        lo, hi = bounds
+        if not lo <= value <= hi:
+            raise ValueError(
+                f"option {key}={value} out of range [{lo}, {hi}]"
+            )
+        out[key] = value
+    return out
 
 
 def _solve(
@@ -110,13 +152,37 @@ def _solve(
 
 class _Handler(socketserver.StreamRequestHandler):
     def handle(self):
-        for line in self.rfile:
+        app = self.server.app  # type: ignore[attr-defined]
+        while True:
+            # Bounded read: readline(n) returns at most n bytes, so an
+            # oversized "line" surfaces as a chunk with no trailing newline
+            # instead of an unbounded buffer.
+            line = self.rfile.readline(MAX_LINE_BYTES + 1)
+            if not line:
+                break
+            if len(line) > MAX_LINE_BYTES and not line.endswith(b"\n"):
+                response = app.reject_oversized()
+                self.wfile.write(response + b"\n")
+                self.wfile.flush()
+                if not self._drain_line():
+                    break
+                continue
             line = line.strip()
             if not line:
                 continue
-            response = self.server.app.handle_line(line)  # type: ignore[attr-defined]
+            response = app.handle_line(line)
             self.wfile.write(response + b"\n")
             self.wfile.flush()
+
+    def _drain_line(self) -> bool:
+        """Discard the remainder of an oversized line in bounded chunks;
+        returns False on EOF."""
+        while True:
+            chunk = self.rfile.readline(MAX_LINE_BYTES)
+            if not chunk:
+                return False
+            if chunk.endswith(b"\n"):
+                return True
 
 
 class AssignorService:
@@ -150,6 +216,20 @@ class AssignorService:
 
     # -- request processing ------------------------------------------------
 
+    def reject_oversized(self) -> bytes:
+        with self._counter_lock:
+            self.errors += 1
+        LOGGER.warning("rejected oversized request line (> %d bytes)",
+                       MAX_LINE_BYTES)
+        return json.dumps(
+            {
+                "id": None,
+                "error": {
+                    "message": f"request line exceeds {MAX_LINE_BYTES} bytes"
+                },
+            }
+        ).encode()
+
     def handle_line(self, line: bytes) -> bytes:
         req_id = None
         try:
@@ -172,13 +252,14 @@ class AssignorService:
                     raise ValueError(
                         f"unknown solver {solver!r}; valid: {list(VALID_SOLVERS)}"
                     )
+                options = _validate_options(params.get("options") or {})
                 assignments, stats = _solve(
                     params.get("topics") or {},
                     params.get("subscriptions") or {},
                     solver,
                     watchdog=self._watchdog,
                     host_fallback=self._host_fallback,
-                    options=params.get("options") or {},
+                    options=options,
                 )
                 result = {
                     "assignments": assignments,

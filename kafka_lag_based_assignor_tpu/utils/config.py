@@ -34,6 +34,11 @@ REFINE_ITERS_CONFIG = "tpu.assignor.refine.iters"  # int >= 0
 
 VALID_SOLVERS = ("rounds", "scan", "global", "sinkhorn", "native", "host")
 
+# Solvers whose output is bit-identical to the reference's per-topic greedy
+# (and therefore whose decision sequence can be replayed for trace logging,
+# utils/observability.replay_decisions).
+PARITY_SOLVERS = ("rounds", "scan", "native", "host")
+
 
 @dataclass
 class AssignorConfig:

@@ -19,9 +19,15 @@ import json
 import logging
 import time
 from dataclasses import asdict, dataclass, field
-from typing import Dict, Iterator, List, Optional
+from typing import Dict, Iterator, List
 
 LOGGER = logging.getLogger("kafka_lag_based_assignor_tpu")
+
+# slf4j has a TRACE level below DEBUG (the reference logs every
+# partition->consumer decision at trace, LagBasedPartitionAssignor.java:268-275);
+# Python's logging does not, so register one.
+TRACE = 5
+logging.addLevelName(TRACE, "TRACE")
 
 
 @dataclass
@@ -40,6 +46,12 @@ class RebalanceStats:
     # Per-member totals across all topics (host-aggregated).
     member_total_lag: Dict[str, int] = field(default_factory=dict)
     member_partition_count: Dict[str, int] = field(default_factory=dict)
+    # Per-topic breakdown: topic -> member -> {"count": n, "total_lag": L}.
+    # The structured analog of the reference's per-topic debug summary block
+    # (LagBasedPartitionAssignor.java:280-306).
+    per_topic: Dict[str, Dict[str, Dict[str, int]]] = field(
+        default_factory=dict
+    )
 
     @property
     def max_mean_lag_imbalance(self) -> float:
@@ -73,6 +85,112 @@ def summarize_assignment(
         stats.member_partition_count[member] = len(tps)
         stats.member_total_lag[member] = sum(lag_by_tp.get(tp, 0) for tp in tps)
     return stats
+
+
+def summarize_topics(
+    stats: RebalanceStats,
+    assignment: Dict[str, List],
+    lags: Dict[str, List],
+) -> RebalanceStats:
+    """Fill the per-topic member count/total-lag breakdown.
+
+    ``lags`` maps topic -> list of TopicPartitionLag rows (the core's input);
+    ``assignment`` maps member -> list of TopicPartition.  Mirrors the data
+    the reference aggregates for its per-topic debug block
+    (LagBasedPartitionAssignor.java:280-306), but structured.
+    """
+    lag_of = {
+        (r.topic, r.partition): r.lag for rows in lags.values() for r in rows
+    }
+    for member, tps in assignment.items():
+        for tp in tps:
+            entry = stats.per_topic.setdefault(tp.topic, {}).setdefault(
+                member, {"count": 0, "total_lag": 0}
+            )
+            entry["count"] += 1
+            entry["total_lag"] += lag_of.get((tp.topic, tp.partition), 0)
+    return stats
+
+
+def replay_decisions(
+    assignment: Dict[str, List], lags: Dict[str, List]
+) -> Iterator[tuple]:
+    """Reconstruct the per-partition decision sequence from a finished
+    assignment.
+
+    The core consumes each topic's partitions in a deterministic order (lag
+    descending, partition id ascending — reference :228-235), so the decision
+    sequence, including each member's running total at decision time, is
+    recoverable host-side from the result alone.  That lets the trace work
+    identically for the host oracle and the device kernels, without threading
+    logging through jit-compiled code.
+
+    Only meaningful for the reference-parity solvers (``rounds``/``scan``/
+    ``native``/``host``), whose decisions ARE per-topic sequential greedy;
+    for ``global`` (cross-topic totals) or ``sinkhorn`` (no sequential
+    decisions at all) the replayed running totals would be fiction — callers
+    must not trace those solvers.
+
+    Yields ``(topic, partition, member, partition_lag, member_running_total)``
+    — the exact fields of the reference's trace line (:268-275).
+    """
+    member_of = {
+        (tp.topic, tp.partition): member
+        for member, tps in assignment.items()
+        for tp in tps
+    }
+    for topic, rows in lags.items():
+        ordered = sorted(rows, key=lambda r: (-r.lag, r.partition))
+        running: Dict[str, int] = {}
+        for r in ordered:
+            member = member_of.get((topic, r.partition))
+            if member is None:  # topic had no eligible consumers
+                continue
+            running[member] = running.get(member, 0) + r.lag
+            yield (topic, r.partition, member, r.lag, running[member])
+
+
+def trace_decisions(
+    assignment: Dict[str, List],
+    lags: Dict[str, List],
+    logger: logging.Logger = LOGGER,
+) -> None:
+    """Opt-in per-decision trace, reference format (:268-275)."""
+    for topic, partition, member, lag, total in replay_decisions(
+        assignment, lags
+    ):
+        logger.log(
+            TRACE,
+            "Assigned partition %s-%d to consumer %s.  partition_lag=%d, "
+            "consumer_current_total_lag=%d",
+            topic,
+            partition,
+            member,
+            lag,
+            total,
+        )
+
+
+def log_topic_summaries(
+    stats: RebalanceStats,
+    assignment: Dict[str, List],
+    logger: logging.Logger = LOGGER,
+) -> None:
+    """Debug-level per-topic summary block, reference format (:280-306)."""
+    if not logger.isEnabledFor(logging.DEBUG):
+        return
+    # One O(total partitions) grouping pass, then O(1) lookups per line.
+    grouped: Dict[str, Dict[str, List]] = {}
+    for member, tps in assignment.items():
+        for tp in tps:
+            grouped.setdefault(tp.topic, {}).setdefault(member, []).append(tp)
+    for topic, members in stats.per_topic.items():
+        lines = []
+        for member, entry in members.items():
+            lines.append(f"\t{member} (total_lag={entry['total_lag']})\n")
+            for tp in grouped.get(topic, {}).get(member, ()):
+                lines.append(f"\t\t{tp.topic}-{tp.partition}\n")
+        logger.debug("Assignment for %s:\n%s", topic, "".join(lines))
 
 
 def log_rebalance(stats: RebalanceStats) -> None:

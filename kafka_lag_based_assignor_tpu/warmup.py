@@ -52,6 +52,7 @@ def warmup(
     all_partition_buckets: bool = False,
     sinkhorn_iters: int = 60,
     refine_iters: int = 24,
+    stream_refine_iters: int = 128,
 ) -> List[Tuple[str, int, int, int, float]]:
     """Pre-compile kernels for every shape the deployment will see.
 
@@ -66,17 +67,22 @@ def warmup(
         shapes still trigger one compile each on first sight).
       sinkhorn_iters / refine_iters: must match the production config
         (they are static jit parameters; different values = new compile).
+      stream_refine_iters: the StreamingAssignor exchange budget to warm —
+        the "stream" warm-up runs a cold+warm rebalance pair so BOTH the
+        cold :func:`..ops.batched.assign_stream` compile and the warm-path
+        :func:`..ops.refine.refine_assignment` compile (at the padded
+        bucket shape) happen here, not on the first warm rebalance's
+        critical path.  Must match the production ``refine_iters`` passed
+        to :class:`..ops.streaming.StreamingAssignor`.
 
     Returns a list of (solver, T, P_bucket, C, seconds) for each shape
     compiled.  Failures are logged and skipped — warm-up must never take a
     deployment down.
     """
-    from .ops.batched import (
-        assign_batched_rounds,
-        assign_stream,
-    )
+    from .ops.batched import assign_batched_rounds
     from .ops.dispatch import ensure_x64
     from .ops.rounds_kernel import assign_global_rounds
+    from .ops.scan_kernel import pack_shift_for
 
     ensure_x64()
     p_buckets = (
@@ -94,9 +100,21 @@ def warmup(
         for C in consumers:
             jobs = []
             if "stream" in solvers:
-                jobs.append(
-                    ("stream", 1, lambda: assign_stream(lags1d, num_consumers=C))
-                )
+
+                def stream_job(lags1d=lags1d, C=C):
+                    # Cold + warm pair through the production engine: the
+                    # cold call compiles assign_stream, the warm call
+                    # compiles refine_assignment at the padded bucket shape
+                    # with the production exchange budget.
+                    from .ops.streaming import StreamingAssignor
+
+                    engine = StreamingAssignor(
+                        num_consumers=C, refine_iters=stream_refine_iters
+                    )
+                    engine.rebalance(lags1d)
+                    return engine.rebalance(lags1d)
+
+                jobs.append(("stream", 1, stream_job))
             if "sinkhorn" in solvers:
                 from .models.sinkhorn import assign_topic_sinkhorn
 
@@ -115,14 +133,23 @@ def warmup(
                 lags = np.broadcast_to(lags1d, (T, P)).copy()
                 pids = np.broadcast_to(pids1d, (T, P)).copy()
                 valid = np.ones((T, P), dtype=bool)
+                # Production dispatch (ops/dispatch.assign_group_device)
+                # derives pack_shift from the group's max lag/pid — warm the
+                # SAME static-arg variant, or the warmed executable is never
+                # hit.  Dense pids 0..P-1 give the same shift as production
+                # dense groups; realistic lags stay under the packing bound,
+                # so pack_shift_for returns the same value for both.
+                shift = pack_shift_for(int(lags.max()), int(pids.max()))
                 if "rounds" in solvers:
                     jobs.append(
                         (
                             "rounds",
                             T,
-                            lambda lags=lags, pids=pids, valid=valid: (
+                            lambda lags=lags, pids=pids, valid=valid,
+                            shift=shift: (
                                 assign_batched_rounds(
-                                    lags, pids, valid, num_consumers=C
+                                    lags, pids, valid, num_consumers=C,
+                                    pack_shift=shift,
                                 )
                             ),
                         )
@@ -132,9 +159,11 @@ def warmup(
                         (
                             "global",
                             T,
-                            lambda lags=lags, pids=pids, valid=valid: (
+                            lambda lags=lags, pids=pids, valid=valid,
+                            shift=shift: (
                                 assign_global_rounds(
-                                    lags, pids, valid, num_consumers=C
+                                    lags, pids, valid, num_consumers=C,
+                                    pack_shift=shift,
                                 )
                             ),
                         )

@@ -1,0 +1,120 @@
+"""Parity tests for the performance fast paths added for the north-star
+latency budget: the packed single-key processing-order sort, the
+host-presorted exact-shape rounds path, and the backend-aware
+``assign_stream`` wrapper.  Every path must be bit-identical to the
+two-key/device path, which is itself bit-identical to the host oracle
+(tests/test_kernels.py)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from kafka_lag_based_assignor_tpu.ops.batched import (
+    _stream_device,
+    _stream_presorted,
+    assign_stream,
+)
+from kafka_lag_based_assignor_tpu.ops.rounds_kernel import (
+    assign_presorted_rounds,
+    assign_topic_rounds,
+)
+from kafka_lag_based_assignor_tpu.ops.scan_kernel import (
+    pack_shift_for,
+    sort_partitions,
+)
+
+
+def random_case(seed, P=257, sparse_pids=False):
+    rng = np.random.default_rng(seed)
+    lags = rng.integers(0, 10**6, size=P).astype(np.int64)
+    lags[rng.random(P) < 0.3] = 0  # plenty of lag ties
+    if sparse_pids:
+        pids = np.sort(rng.choice(10 * P, size=P, replace=False)).astype(
+            np.int32
+        )
+    else:
+        pids = np.arange(P, dtype=np.int32)
+    valid = rng.random(P) < 0.9
+    return lags, pids, valid
+
+
+def test_pack_shift_for_bounds():
+    assert pack_shift_for(0, 0) == 1
+    assert pack_shift_for(10**6, 131071) == 17
+    # Shift of 17 leaves 45 bits of lag headroom.
+    assert pack_shift_for((1 << 45) - 1, 131071) == 17
+    assert pack_shift_for(1 << 45, 131071) == 0  # overflow risk -> two-key
+    assert pack_shift_for(2**62, 1) == 0
+
+
+@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize("sparse", [False, True])
+def test_packed_sort_matches_two_key(seed, sparse):
+    lags, pids, valid = random_case(seed, sparse_pids=sparse)
+    shift = pack_shift_for(int(lags.max()), int(pids.max()))
+    assert shift > 0
+    two_key = np.asarray(sort_partitions(lags, pids, valid, 0))
+    packed = np.asarray(sort_partitions(lags, pids, valid, shift))
+    # Valid prefix must be identical; padding rows may permute arbitrarily
+    # among themselves (their relative order is never observed).
+    n_valid = int(valid.sum())
+    assert np.array_equal(two_key[:n_valid], packed[:n_valid])
+    assert np.array_equal(
+        np.sort(two_key[n_valid:]), np.sort(packed[n_valid:])
+    )
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_rounds_kernel_packed_parity(seed):
+    lags, pids, valid = random_case(seed)
+    shift = pack_shift_for(int(lags.max()), int(pids.max()))
+    base = assign_topic_rounds(lags, pids, valid, num_consumers=7)
+    fast = assign_topic_rounds(
+        lags, pids, valid, num_consumers=7, pack_shift=shift
+    )
+    for a, b in zip(base, fast):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_presorted_rounds_parity(seed):
+    rng = np.random.default_rng(seed)
+    P, C = 1000, 13
+    lags = rng.integers(0, 10**6, size=P).astype(np.int64)
+    lags[rng.random(P) < 0.3] = 0
+    pids = np.arange(P, dtype=np.int32)
+    valid = np.ones(P, dtype=bool)
+    base = assign_topic_rounds(lags, pids, valid, num_consumers=C)
+    perm = np.argsort(-lags, kind="stable").astype(np.int32)
+    fast = assign_presorted_rounds(lags[perm], perm, num_consumers=C)
+    for a, b in zip(base, fast):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_assign_stream_paths_agree(seed):
+    """The public wrapper (whatever backend path it picks) must match both
+    inner paths exactly."""
+    rng = np.random.default_rng(seed)
+    P, C = 1500, 16
+    lags = rng.integers(0, 10**9, size=P).astype(np.int64)
+    out = np.asarray(assign_stream(lags, num_consumers=C))
+    perm = np.argsort(-lags, kind="stable").astype(np.int32)
+    host = np.asarray(_stream_presorted(lags, perm, num_consumers=C))
+    dev0 = np.asarray(_stream_device(lags, num_consumers=C, pack_shift=0))
+    shift = pack_shift_for(int(lags.max()), 2047)  # pad bucket 2048
+    devp = np.asarray(
+        _stream_device(lags, num_consumers=C, pack_shift=shift)
+    )
+    assert np.array_equal(out, host)
+    assert np.array_equal(out, dev0)
+    assert np.array_equal(out, devp)
+    assert out.dtype == np.int16  # C <= 32767 narrows the readback
+
+
+def test_assign_stream_jax_array_input():
+    lags = jnp.asarray(np.arange(64, dtype=np.int64) * 3)
+    out = np.asarray(assign_stream(lags, num_consumers=4))
+    counts = np.bincount(out.astype(np.int64), minlength=4)
+    assert counts.sum() == 64 and counts.max() - counts.min() == 0

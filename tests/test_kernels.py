@@ -6,7 +6,7 @@ import numpy as np
 import pytest
 
 from kafka_lag_based_assignor_tpu import TopicPartition, TopicPartitionLag, assign_greedy
-from kafka_lag_based_assignor_tpu.ops.dispatch import assign_device, assign_topic_device
+from kafka_lag_based_assignor_tpu.ops.dispatch import assign_device
 
 KERNELS = ["scan", "rounds"]
 

@@ -1,0 +1,40 @@
+"""The lint gate as part of the test suite — warnings fail the build,
+matching the reference's ``-Xlint:all`` + ``failOnWarning``
+(/root/reference/pom.xml:143-146).  Rules live in tools/lint.py."""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import lint  # noqa: E402
+
+
+def test_repo_is_lint_clean():
+    findings = lint.lint_paths(iter(lint.repo_python_files(REPO)))
+    assert not findings, "\n" + "\n".join(str(f) for f in findings)
+
+
+def test_lint_rules_fire():
+    """The gate is only meaningful if the rules actually detect violations."""
+    bad = (
+        "from os import *\n"
+        "import json\n"
+        "def f(x=[]):\n"
+        "    try:\n"
+        "        pass\n"
+        "    except:\n"
+        "        pass\n"
+        "    return x == None\n"
+        "def f():\n"
+        "    return f'no placeholders'   \n"
+    )
+    findings = lint.lint_source(Path("bad.py"), bad)
+    codes = {f.code for f in findings}
+    assert {"L002", "L003", "L004", "L005", "L006", "L008", "L009", "L010"} <= codes
+
+
+def test_lint_no_false_positives_on_format_specs():
+    src = 'x = 3\nprint(f"{x:02d}")\n'
+    assert lint.lint_source(Path("ok.py"), src) == []

@@ -3,7 +3,7 @@
 import numpy as np
 import pytest
 
-from kafka_lag_based_assignor_tpu import TopicPartition, TopicPartitionLag, assign_greedy
+from kafka_lag_based_assignor_tpu import TopicPartitionLag, assign_greedy
 from kafka_lag_based_assignor_tpu.native import (
     assign_native,
     assign_topic_native,

@@ -1,0 +1,181 @@
+"""Per-topic observability: decision trace + per-topic summary.
+
+The reference trace-logs every partition->consumer decision
+(LagBasedPartitionAssignor.java:268-275) and debug-logs a per-topic
+per-consumer count/total-lag summary block (:280-306).  Here the breakdown
+is additionally a structured field on RebalanceStats (``per_topic``) and
+the decision sequence is reconstructed host-side from the finished
+assignment (``replay_decisions``), so it works identically for the host
+oracle and the device kernels.
+"""
+
+import logging
+
+from kafka_lag_based_assignor_tpu.assignor import LagBasedPartitionAssignor
+from kafka_lag_based_assignor_tpu.testing import FakeBroker
+from kafka_lag_based_assignor_tpu.types import (
+    GroupSubscription,
+    Subscription,
+    TopicPartitionLag,
+)
+from kafka_lag_based_assignor_tpu.models.greedy import assign_greedy
+from kafka_lag_based_assignor_tpu.utils.observability import (
+    TRACE,
+    RebalanceStats,
+    log_topic_summaries,
+    replay_decisions,
+    summarize_topics,
+    trace_decisions,
+)
+
+LOGNAME = "kafka_lag_based_assignor_tpu"
+
+
+def golden_inputs():
+    """The reference golden scenario (Test.java:83-131): two topics,
+    asymmetric subscriptions."""
+    lags = {
+        "topic1": [
+            TopicPartitionLag("topic1", 0, 100_000),
+            TopicPartitionLag("topic1", 1, 50_000),
+            TopicPartitionLag("topic1", 2, 60_000),
+            TopicPartitionLag("topic1", 3, 30_000),
+        ],
+        "topic2": [
+            TopicPartitionLag("topic2", 0, 70_000),
+            TopicPartitionLag("topic2", 1, 40_000),
+        ],
+    }
+    subs = {"consumer-1": ["topic1", "topic2"], "consumer-2": ["topic1"]}
+    return lags, subs
+
+
+def test_per_topic_breakdown_golden():
+    lags, subs = golden_inputs()
+    assignment = assign_greedy(lags, subs)
+    stats = summarize_topics(RebalanceStats(), assignment, lags)
+
+    # Every assigned (topic, member) pair appears, counts sum to the number
+    # of partitions, totals sum to the topic's total lag.
+    for topic, rows in lags.items():
+        members = stats.per_topic[topic]
+        assert sum(e["count"] for e in members.values()) == len(rows)
+        assert sum(e["total_lag"] for e in members.values()) == sum(
+            r.lag for r in rows
+        )
+    # topic2 has a single subscriber: consumer-1 gets both partitions.
+    assert stats.per_topic["topic2"] == {
+        "consumer-1": {"count": 2, "total_lag": 110_000}
+    }
+
+
+def test_replay_decisions_order_and_running_totals():
+    lags, subs = golden_inputs()
+    assignment = assign_greedy(lags, subs)
+    decisions = list(replay_decisions(assignment, lags))
+
+    # One decision per assigned partition.
+    assert len(decisions) == 6
+    # Per topic, decisions appear in lag-descending order (ties by pid).
+    for topic in ("topic1", "topic2"):
+        seq = [d for d in decisions if d[0] == topic]
+        lags_seq = [d[3] for d in seq]
+        assert lags_seq == sorted(lags_seq, reverse=True)
+        # Running totals accumulate per member within the topic.
+        running = {}
+        for _, _, member, lag, total in seq:
+            running[member] = running.get(member, 0) + lag
+            assert total == running[member]
+
+
+def test_replay_skips_unassigned_topics():
+    lags = {"orphan": [TopicPartitionLag("orphan", 0, 5)]}
+    assert list(replay_decisions({}, lags)) == []
+
+
+def test_trace_decisions_log_lines(caplog):
+    lags, subs = golden_inputs()
+    assignment = assign_greedy(lags, subs)
+    with caplog.at_level(TRACE, logger=LOGNAME):
+        trace_decisions(assignment, lags)
+    lines = [r.getMessage() for r in caplog.records]
+    assert len(lines) == 6
+    assert any(
+        "Assigned partition topic1-0 to consumer" in ln
+        and "partition_lag=100000" in ln
+        for ln in lines
+    )
+
+
+def test_topic_summary_debug_block(caplog):
+    lags, subs = golden_inputs()
+    assignment = assign_greedy(lags, subs)
+    stats = summarize_topics(RebalanceStats(), assignment, lags)
+    with caplog.at_level(logging.DEBUG, logger=LOGNAME):
+        log_topic_summaries(stats, assignment)
+    messages = [r.getMessage() for r in caplog.records]
+    topic2 = next(m for m in messages if m.startswith("Assignment for topic2"))
+    assert "consumer-1 (total_lag=110000)" in topic2
+    assert "\t\ttopic2-0" in topic2 and "\t\ttopic2-1" in topic2
+
+
+def test_summary_block_skipped_when_debug_off(caplog):
+    lags, subs = golden_inputs()
+    assignment = assign_greedy(lags, subs)
+    stats = summarize_topics(RebalanceStats(), assignment, lags)
+    with caplog.at_level(logging.INFO, logger=LOGNAME):
+        log_topic_summaries(stats, assignment)
+    assert not caplog.records
+
+
+def _run_readme_assign():
+    broker = (
+        FakeBroker()
+        .with_partition("t0", 0, end=100_000, committed=0)
+        .with_partition("t0", 1, end=50_000, committed=0)
+        .with_partition("t0", 2, end=60_000, committed=0)
+    )
+    a = LagBasedPartitionAssignor(metadata_consumer_factory=lambda p: broker)
+    a.configure({"group.id": "g1", "tpu.assignor.solver": "host"})
+    a.assign(
+        broker.cluster(),
+        GroupSubscription(
+            {
+                "C0": Subscription(("t0",)),
+                "C1": Subscription(("t0",)),
+            }
+        ),
+    )
+    return a
+
+
+def test_assignor_populates_per_topic_stats_when_debug(caplog):
+    with caplog.at_level(
+        logging.DEBUG, logger="kafka_lag_based_assignor_tpu.assignor"
+    ):
+        a = _run_readme_assign()
+    per_topic = a.last_stats.per_topic["t0"]
+    assert per_topic["C0"] == {"count": 1, "total_lag": 100_000}
+    assert per_topic["C1"] == {"count": 2, "total_lag": 110_000}
+
+
+def test_per_topic_aggregation_skipped_at_info_level(caplog):
+    """The O(partitions) breakdown (and its log payload) is only built when
+    debug logging is on — the reference's isDebugEnabled guard (:280)."""
+    with caplog.at_level(
+        logging.INFO, logger="kafka_lag_based_assignor_tpu.assignor"
+    ):
+        a = _run_readme_assign()
+    assert a.last_stats.per_topic == {}
+
+
+def test_configure_logs_derived_property_map(caplog):
+    a = LagBasedPartitionAssignor()
+    with caplog.at_level(
+        logging.DEBUG, logger="kafka_lag_based_assignor_tpu.assignor"
+    ):
+        a.configure({"group.id": "orders", "bootstrap.servers": "b:9092"})
+    joined = "\n".join(r.getMessage() for r in caplog.records)
+    assert "enable.auto.commit = false" in joined
+    assert "client.id = orders.assignor" in joined
+    assert "bootstrap.servers = b:9092" in joined

@@ -78,7 +78,12 @@ def test_readme_worked_example():
     # README lists C1 as [t0p1, t0p2] in display order; append order is by
     # descending lag (p2=60k before p1=50k).
     assert set(result["C1"]) == {TopicPartition("t0", 1), TopicPartition("t0", 2)}
-    assert sum(l.lag for l in lags["t0"] if TopicPartition("t0", l.partition) in result["C1"]) == 110000
+    c1_lag = sum(
+        row.lag
+        for row in lags["t0"]
+        if TopicPartition("t0", row.partition) in result["C1"]
+    )
+    assert c1_lag == 110000
 
 
 def test_unassigned_member_present_with_empty_list():

@@ -123,8 +123,6 @@ def test_pallas_probe_success_enables_kernel(monkeypatch):
     """On a backend where the kernel works (CPU interpret stands in for
     TPU here), the eager probe enables the Pallas path and the jitted
     solve then uses it."""
-    import functools
-
     import kafka_lag_based_assignor_tpu.ops.plan_stats as ps
 
     monkeypatch.setattr(ps, "_pallas_ok", None)

@@ -8,7 +8,6 @@ Reference semantics under test: SURVEY §2.4 items 1-4 (selection order,
 total determinism, per-topic independence, all members present).
 """
 
-import numpy as np
 from hypothesis import given, settings, strategies as st
 
 from kafka_lag_based_assignor_tpu import TopicPartitionLag, assign_greedy

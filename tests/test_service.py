@@ -100,3 +100,64 @@ def test_concurrent_clients(service):
     assert len(results) == 8
     for r in results:
         assert sum(len(v) for v in r.values()) == 10
+
+
+def test_oversized_line_rejected_connection_survives(service, monkeypatch):
+    import kafka_lag_based_assignor_tpu.service as service_mod
+
+    monkeypatch.setattr(service_mod, "MAX_LINE_BYTES", 1024)
+    host, port = service.address
+    with socket.create_connection((host, port)) as s:
+        f = s.makefile("rwb")
+        f.write(b"x" * 5000 + b"\n")
+        f.flush()
+        resp = json.loads(f.readline())
+        assert resp["id"] is None
+        assert "exceeds" in resp["error"]["message"]
+        # The oversized line was drained, not buffered: the connection is
+        # still usable for a well-formed request.
+        f.write(json.dumps({"id": 7, "method": "ping"}).encode() + b"\n")
+        f.flush()
+        resp2 = json.loads(f.readline())
+    assert resp2 == {"id": 7, "result": "pong"}
+    assert service.errors >= 1
+
+
+@pytest.mark.parametrize(
+    "options, message",
+    [
+        ({"refine_iters": "sixty"}, "must be an integer"),
+        ({"refine_iters": True}, "must be an integer"),
+        ({"sinkhorn_iters": 0}, "out of range"),
+        ({"sinkhorn_iters": 10**9}, "out of range"),
+        ({"refine_iters": -1}, "out of range"),
+        ({"warp_factor": 9}, "unknown option"),
+    ],
+)
+def test_bad_options_rejected_not_fallback(service, options, message):
+    with client_for(service) as c:
+        with pytest.raises(RuntimeError, match=message):
+            c.request(
+                "assign",
+                {
+                    "topics": {"t": [[0, 1]]},
+                    "subscriptions": {"m": ["t"]},
+                    "solver": "host",
+                    "options": options,
+                },
+            )
+
+
+def test_valid_options_accepted(service):
+    with client_for(service) as c:
+        result = c.request(
+            "assign",
+            {
+                "topics": {"t": [[0, 5], [1, 3]]},
+                "subscriptions": {"m": ["t"]},
+                "solver": "host",
+                "options": {"sinkhorn_iters": 8, "refine_iters": 0},
+            },
+        )
+    assert result["assignments"]["m"] == [["t", 0], ["t", 1]]
+    assert result["stats"]["fallback_used"] is False

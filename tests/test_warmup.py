@@ -28,11 +28,15 @@ def test_warmup_compiles_requested_shapes():
 
 def test_warmup_all_buckets_and_failures_skipped(monkeypatch):
     import kafka_lag_based_assignor_tpu.ops.batched as batched
+    import kafka_lag_based_assignor_tpu.ops.streaming as streaming
 
     def boom(*a, **k):
         raise RuntimeError("simulated compile failure")
 
     monkeypatch.setattr(batched, "assign_stream", boom)
+    # ops.streaming binds assign_stream at import time; patch its copy too
+    # so the simulated failure reaches the stream warm-up's engine path.
+    monkeypatch.setattr(streaming, "assign_stream", boom)
     done = warmup(
         max_partitions=20,
         consumers=[2],

@@ -1,0 +1,213 @@
+"""Self-contained lint gate (stdlib-only).
+
+The reference builds with ``-Xlint:all`` + ``failOnWarning``
+(/root/reference/pom.xml:143-146): warnings fail the build.  This image has
+no ruff/mypy (and installs are not allowed), so this module enforces the
+core rules with ``ast``/``tokenize`` alone and runs inside the pytest gate
+(tests/test_lint.py) — a warning here fails the suite.  The full ruff/mypy
+configuration for richer environments lives in pyproject.toml.
+
+Rules:
+  L001  syntax error (file does not parse)
+  L002  star import (``from x import *``)
+  L003  unused import (exempt: ``__init__.py`` re-export surfaces)
+  L004  mutable default argument (list/dict/set literal)
+  L005  bare ``except:``
+  L006  comparison to None with ``==`` / ``!=``
+  L007  line longer than 100 characters
+  L008  trailing whitespace
+  L009  duplicate top-level definition name
+  L010  f-string without placeholders
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import Iterator, List, NamedTuple
+
+MAX_LINE = 100
+
+
+class Finding(NamedTuple):
+    path: str
+    line: int
+    code: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+def _imported_names(node: ast.AST) -> Iterator[tuple[str, int]]:
+    for child in ast.walk(node):
+        if isinstance(child, ast.Import):
+            for alias in child.names:
+                name = alias.asname or alias.name.split(".")[0]
+                yield name, child.lineno
+        elif isinstance(child, ast.ImportFrom):
+            if child.module == "__future__":
+                continue
+            for alias in child.names:
+                if alias.name == "*":
+                    continue
+                yield (alias.asname or alias.name), child.lineno
+
+
+def _used_names(tree: ast.AST) -> set:
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            # the root of a dotted access counts as a use of the import
+            root = node
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name):
+                used.add(root.id)
+    # `__all__` strings are re-export uses
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Name) and target.id == "__all__":
+                    for elt in ast.walk(node.value):
+                        if isinstance(elt, ast.Constant) and isinstance(
+                            elt.value, str
+                        ):
+                            used.add(elt.value)
+    return used
+
+
+def lint_source(path: Path, source: str) -> List[Finding]:
+    findings: List[Finding] = []
+    rel = str(path)
+
+    try:
+        tree = ast.parse(source, filename=rel)
+    except SyntaxError as exc:
+        return [Finding(rel, exc.lineno or 0, "L001", f"syntax error: {exc.msg}")]
+
+    is_init = path.name == "__init__.py"
+
+    # A format spec (the ":02d" in f"{j:02d}") parses as a nested JoinedStr
+    # of constants — not a placeholder-less f-string.
+    format_specs = {
+        id(node.format_spec)
+        for node in ast.walk(tree)
+        if isinstance(node, ast.FormattedValue) and node.format_spec is not None
+    }
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and any(
+            a.name == "*" for a in node.names
+        ):
+            findings.append(Finding(rel, node.lineno, "L002", "star import"))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defaults = list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None
+            ]
+            for d in defaults:
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                    findings.append(
+                        Finding(
+                            rel,
+                            d.lineno,
+                            "L004",
+                            f"mutable default argument in {node.name}()",
+                        )
+                    )
+        elif isinstance(node, ast.ExceptHandler) and node.type is None:
+            findings.append(Finding(rel, node.lineno, "L005", "bare except"))
+        elif isinstance(node, ast.Compare):
+            for op, comparator in zip(node.ops, node.comparators):
+                if isinstance(op, (ast.Eq, ast.NotEq)) and (
+                    (
+                        isinstance(comparator, ast.Constant)
+                        and comparator.value is None
+                    )
+                    or (
+                        isinstance(node.left, ast.Constant)
+                        and node.left.value is None
+                    )
+                ):
+                    findings.append(
+                        Finding(
+                            rel,
+                            node.lineno,
+                            "L006",
+                            "comparison to None with ==/!= (use is/is not)",
+                        )
+                    )
+        elif isinstance(node, ast.JoinedStr):
+            if id(node) not in format_specs and not any(
+                isinstance(v, ast.FormattedValue) for v in node.values
+            ):
+                findings.append(
+                    Finding(
+                        rel, node.lineno, "L010", "f-string without placeholders"
+                    )
+                )
+
+    if not is_init:
+        used = _used_names(tree)
+        for name, lineno in _imported_names(tree):
+            if name not in used:
+                findings.append(
+                    Finding(rel, lineno, "L003", f"unused import {name!r}")
+                )
+
+    seen: dict = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            if node.name in seen:
+                findings.append(
+                    Finding(
+                        rel,
+                        node.lineno,
+                        "L009",
+                        f"duplicate top-level definition {node.name!r} "
+                        f"(first at line {seen[node.name]})",
+                    )
+                )
+            else:
+                seen[node.name] = node.lineno
+
+    for i, line in enumerate(source.splitlines(), start=1):
+        if len(line) > MAX_LINE:
+            findings.append(
+                Finding(rel, i, "L007", f"line too long ({len(line)} > {MAX_LINE})")
+            )
+        if line != line.rstrip():
+            findings.append(Finding(rel, i, "L008", "trailing whitespace"))
+
+    return findings
+
+
+def lint_paths(paths: Iterator[Path]) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in paths:
+        findings.extend(lint_source(path, path.read_text(encoding="utf-8")))
+    return findings
+
+
+def repo_python_files(root: Path) -> List[Path]:
+    files = [root / "bench.py", root / "__graft_entry__.py"]
+    files += sorted((root / "kafka_lag_based_assignor_tpu").rglob("*.py"))
+    files += sorted((root / "tests").glob("*.py"))
+    files += sorted((root / "tools").glob("*.py"))
+    return [f for f in files if f.exists() and "__pycache__" not in f.parts]
+
+
+def main() -> int:
+    root = Path(__file__).resolve().parent.parent
+    findings = lint_paths(iter(repo_python_files(root)))
+    for f in findings:
+        print(f)
+    print(f"{len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
