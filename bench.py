@@ -26,24 +26,40 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def device_reachable(timeout_s: float = 120.0) -> bool:
+def device_reachable(timeout_s: float = 240.0, attempts: int = 2) -> bool:
     """Probe the accelerator in a SUBPROCESS with a hard timeout.
 
     The tunneled chip can wedge such that even ``jax.devices()`` blocks
-    forever (observed in practice); a hung probe in-process would hang the
-    whole benchmark and break the one-JSON-line driver contract.  A
-    subprocess can be killed; in-process jax calls cannot."""
+    forever (observed in practice: >550 s with no progress); a hung probe
+    in-process would hang the whole benchmark and break the one-JSON-line
+    driver contract.  A subprocess can be killed; in-process jax calls
+    cannot.  The probe makes ``attempts`` tries — a transient relay drop
+    should not condemn the whole run to the CPU number — and goes past
+    ``jax.devices()`` to an actual computation + readback, since device
+    discovery succeeding does not prove the transport can execute."""
     import subprocess
 
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
-            timeout=timeout_s,
-            capture_output=True,
-        )
-        return r.returncode == 0 and b"ok" in r.stdout
-    except Exception:
-        return False
+    code = (
+        "import jax, numpy as np;"
+        "jax.devices();"
+        "x = jax.device_put(np.arange(8, dtype=np.int32));"
+        "print(int(jax.jit(lambda v: (v + 1).sum())(x)))"
+    )
+    for attempt in range(attempts):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code],
+                timeout=timeout_s,
+                capture_output=True,
+            )
+            if r.returncode == 0 and b"36" in r.stdout:
+                return True
+            log(f"bench: device probe attempt {attempt + 1} failed "
+                f"(rc={r.returncode})")
+        except Exception as exc:
+            log(f"bench: device probe attempt {attempt + 1}: "
+                f"{type(exc).__name__}")
+    return False
 
 
 def host_baseline_greedy(lags: np.ndarray, C: int) -> tuple[np.ndarray, float]:
@@ -128,6 +144,16 @@ def imbalance(member_totals: np.ndarray) -> float:
     return float(member_totals.max() / mean) if mean > 0 else 1.0
 
 
+def quality_ratio(imb: float, bound: float) -> float:
+    """Achieved max/mean imbalance normalized to the input-driven lower
+    bound ``max_lag / mean_load`` (clamped at 1): the hottest partition
+    must sit on SOME consumer, so no assignment can score below the bound.
+    The <=1.05 quality target is judged against THIS ratio — on skewed
+    draws the raw imbalance is input-infeasible (a single partition can
+    exceed a fair share many times over) and would misread as a miss."""
+    return imb / max(bound, 1.0)
+
+
 def zipf_lags(rng, P, a=1.1, scale=1000):
     # Bounded Zipf via inverse-power sampling (np.random.zipf can overflow).
     ranks = rng.permutation(P) + 1
@@ -157,18 +183,46 @@ def config1_readme():
 
 
 def config2_zipf():
-    """1 topic, 1k partitions, 16 consumers, Zipf(1.1)."""
+    """1 topic, 1k partitions, 16 consumers, Zipf(1.1) — the config where
+    greedy leaves real slack (imbalance ~2.15 vs bound ~1.57), so the
+    quality modes are benchmarked HERE, not only on config 4 where greedy
+    already sits at the optimum plateau."""
+    from kafka_lag_based_assignor_tpu.models.sinkhorn import (
+        assign_topic_sinkhorn,
+    )
+    from kafka_lag_based_assignor_tpu.ops.packing import pad_topic_rows
+
     rng = np.random.default_rng(2)
     P, C = 1000, 16
-    lags = zipf_lags(rng, P)[None, :]
+    lags1d = zipf_lags(rng, P)
+    lags = lags1d[None, :]
     pids = np.arange(P, dtype=np.int32)[None, :]
     valid = np.ones((1, P), dtype=bool)
     ms, _, totals = device_assign_ms(lags, pids, valid, C)
+    bound = float(lags.max() / (lags.sum() / C))
+    imb = imbalance(totals[0])
+
+    lags_p, pids_p, valid_p = pad_topic_rows(lags1d)
+
+    def sink_once():
+        _, _, s_totals = assign_topic_sinkhorn(
+            lags_p, pids_p, valid_p, num_consumers=C
+        )
+        return np.asarray(s_totals)  # the one blocking readback
+
+    s_ms, s_totals = timed_solve(sink_once, iters=10)
+    s_imb = imbalance(s_totals)
+
     return {
         "config": "zipf1.1_1k_16c",
         "assign_ms": ms,
-        "max_mean_imbalance": imbalance(totals[0]),
-        "bound": float(lags.max() / (lags.sum() / C)),
+        "max_mean_imbalance": imb,
+        "bound": bound,
+        "quality_ratio": quality_ratio(imb, bound),
+        "sinkhorn_assign_ms": s_ms,
+        "sinkhorn_max_mean_imbalance": s_imb,
+        "sinkhorn_quality_ratio": quality_ratio(s_imb, bound),
+        "sinkhorn_vs_greedy_imbalance_gain": imb - s_imb,
     }
 
 
@@ -234,13 +288,18 @@ def config4_skew():
 
     s_ms, s_totals = timed_solve(sink_once, iters=5)
 
+    bound = float(lags.max() / (lags.sum() / C))
+    imb = imbalance(totals[0])
+    s_imb = imbalance(s_totals)
     return {
         "config": "skew_10k_512c",
         "assign_ms": ms,
-        "max_mean_imbalance": imbalance(totals[0]),
-        "bound": float(lags.max() / (lags.sum() / C)),
+        "max_mean_imbalance": imb,
+        "bound": bound,
+        "quality_ratio": quality_ratio(imb, bound),
         "sinkhorn_assign_ms": s_ms,
-        "sinkhorn_max_mean_imbalance": imbalance(s_totals),
+        "sinkhorn_max_mean_imbalance": s_imb,
+        "sinkhorn_quality_ratio": quality_ratio(s_imb, bound),
     }
 
 
@@ -280,8 +339,13 @@ def config5_northstar():
 
     lags = lags0.astype(np.float64)
     stream_times = []
-    warm_times, warm_churn, warm_imb = [], [], []
-    engine = StreamingAssignor(num_consumers=C, refine_iters=128)
+    warm_times, warm_churn, warm_ratio, warm_trips = [], [], [], 0
+    # Guardrail 1.25x the per-epoch input bound: the bounded-churn warm
+    # path re-solves cold if its quality drifts past the allowance
+    # (exercises the guardrail feature in the recorded numbers).
+    engine = StreamingAssignor(
+        num_consumers=C, refine_iters=128, imbalance_guardrail=1.25
+    )
     engine.rebalance(lags0)  # cold start (assign_stream, already compiled)
     # Throwaway warm rebalance so refine_assignment's first-call compile
     # stays out of the timed loop.
@@ -295,14 +359,19 @@ def config5_northstar():
         t0 = time.perf_counter()
         engine.rebalance(arr)
         warm_times.append((time.perf_counter() - t0) * 1000.0)
-        warm_churn.append(engine.last_stats.churn)
-        warm_imb.append(engine.last_stats.max_mean_imbalance)
+        s = engine.last_stats
+        warm_churn.append(s.churn)
+        warm_ratio.append(
+            quality_ratio(s.max_mean_imbalance, s.imbalance_bound)
+        )
+        warm_trips += int(s.guardrail_tripped)
 
     return {
         "config": "northstar_100k_1kc",
         "assign_ms": ms,
         "max_mean_imbalance": imb,
         "imbalance_bound": bound,
+        "quality_ratio": quality_ratio(imb, bound),
         "baseline_host_greedy_ms": base_ms,
         "baseline_imbalance": base_imb,
         "speedup_vs_baseline": base_ms / ms,
@@ -310,8 +379,11 @@ def config5_northstar():
         "streaming_p95_ms": float(np.percentile(stream_times, 95)),
         "warm_p50_ms": float(np.percentile(warm_times, 50)),
         "warm_churn_p50": float(np.percentile(warm_churn, 50)),
-        "warm_imbalance_p50": float(np.percentile(warm_imb, 50)),
+        "warm_quality_ratio_p50": float(np.percentile(warm_ratio, 50)),
+        "warm_guardrail_trips": warm_trips,
+        "guardrail": 1.25,
         "target_ms": 50.0,
+        "quality_target_ratio": 1.05,
     }
 
 
@@ -356,6 +428,9 @@ def main():
         "value": round(ns["assign_ms"], 3),
         "unit": "ms",
         "vs_baseline": round(ns["speedup_vs_baseline"], 1),
+        # Quality normalized to the input-driven bound (see quality_ratio):
+        # the <=1.05 target reads against this, not the raw imbalance.
+        "quality_ratio": round(ns["quality_ratio"], 4),
     }
     if device_fallback:
         line["device_fallback"] = True  # accelerator was unreachable

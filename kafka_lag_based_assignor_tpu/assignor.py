@@ -214,11 +214,12 @@ class LagBasedPartitionAssignor:
         if solver == "sinkhorn":
             from .models.sinkhorn import assign_sinkhorn
 
+            refine = options.get("refine_iters")
             return assign_sinkhorn(
                 lags,
                 topic_subscriptions,
-                iters=int(options.get("sinkhorn_iters", 60)),
-                refine_iters=int(options.get("refine_iters", 24)),
+                iters=int(options.get("sinkhorn_iters", 24)),
+                refine_iters=None if refine is None else int(refine),
             )
         if solver == "native":
             from .native import assign_native

@@ -29,21 +29,29 @@ intuition; patterns only, no code).
 **TPU-native key idea — the plan is never materialized.**  Every update
 above is rank-structured, so by induction the log-plan stays exactly
 
-    logX[p, j] = noise(p, j) - ws_p * A_j + B_j   (+ row normalizer)
+    logX[p, j] = -ws_p * A_j + B_j   (+ row normalizer)
 
 where ``A`` accumulates the mirror steps and ``B`` the column corrections —
 the row normalizer cancels in the row softmax.  The iteration state is two
 f32[C] vectors instead of a [P, C] matrix (524 MB at the 100k x 1k north
-star), and each iteration needs only the plan's two marginal statistics,
-computed by the fused tile-streaming kernel in :mod:`..ops.plan_stats`
-(Pallas on TPU, tiled lax elsewhere) with O(P) HBM traffic.  The symmetry-
-breaking noise is a deterministic integer hash, recomputable anywhere.
+star), and — since rows with equal ``ws`` are identical — each iteration
+needs only the plan's two marginal statistics over the DEDUPLICATED
+lag-value axis, computed by the fused tile-streaming kernel in
+:mod:`..ops.plan_stats` (Pallas on TPU, tiled lax elsewhere).  Symmetry
+is broken by a deterministic hash seed in ``B0``; per-(p, j) hash noise
+remains only as the rounding tie-break.
+
+**Quality guarantee:** the returned assignment is the better (by max
+consumer load) of the refined OT rounding and the plain greedy rounds
+kernel — the quality mode never loses to greedy.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Mapping, Sequence
+from typing import Mapping, Optional, Sequence
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -53,21 +61,75 @@ from ..ops.plan_stats import (
     _pallas_available,
     implicit_plan_argmax,
     implicit_plan_rows,
+    noise,
     plan_stats,
 )
 from ..types import AssignmentMap, TopicPartitionLag
 
-# Above this many partition rows the sequential rounding scan (one step per
-# partition) dominates wall time, so the parallel argmax+repair rounding
-# takes over (see _round_parallel).
-_SCAN_ROUNDING_MAX_P = 32768
+# At or below this many partition rows the sequential rounding scan (one
+# step per partition) is cheap and slightly better-steered than the
+# parallel rounding; above it the scan's P sequential steps dominate wall
+# time, so the parallel argmax+repair rounding takes over
+# (see _round_parallel).  The refinement pass equalizes final quality
+# between the two (measured: identical imbalance at BASELINE config 2).
+_SCAN_ROUNDING_MAX_P = 4096
+
+# Auto refinement budgets per rounding path (used when the caller passes
+# refine_iters=None): the parallel rounding starts coarser than the scan,
+# so it gets a larger floor.  An EXPLICIT refine_iters is always honored
+# exactly (utils/config.REFINE_ITERS_CONFIG documents the auto rule).
+_AUTO_REFINE_SCAN = 24
+_AUTO_REFINE_PARALLEL = 96
+
+
+def _scale_np(lags: np.ndarray, valid: np.ndarray, C: int) -> float:
+    """Host half of THE scale definition: ideal per-consumer load
+    ``max(total valid lag, 1) / C``.  Must stay the same formula as
+    :func:`_scaled_ws` (the traced half) — the dedup identity requires the
+    host-aggregated ``ws_u`` and the traced per-row ``ws`` to describe the
+    same normalization (pinned by test_plan_stats.py)."""
+    return max(float(lags[valid].sum()), 1.0) / C
+
+
+def _scaled_ws(lags: jax.Array, valid: jax.Array, C: int) -> jax.Array:
+    """Traced half of THE scale definition (see :func:`_scale_np`):
+    f32 per-row scaled lags, invalid rows 0."""
+    w = jnp.where(valid, lags, 0).astype(jnp.float32)
+    return w / (jnp.maximum(jnp.sum(w), 1.0) / C)
+
+
+def _dedup_weights(lags: np.ndarray, valid: np.ndarray, C: int):
+    """Host-side aggregation onto the unique-lag-value axis.
+
+    Partitions with equal scaled lag have identical (noise-free) plan rows,
+    so the duals iteration only needs per-unique-value weights
+    (plan_stats module docstring).  Padded to the power-of-two bucket so
+    the jit cache stays bounded as U drifts; padding rows carry
+    count=wsum=0 and contribute exactly nothing.
+
+    Returns (ws_u f32[U_pad], count_u f32[U_pad], wsum_u f32[U_pad]).
+    """
+    from ..ops.packing import pad_bucket
+
+    vals = lags[valid]
+    scale = _scale_np(lags, valid, C)
+    uniq, counts = np.unique(vals, return_counts=True)
+    U = max(len(uniq), 1)
+    U_pad = pad_bucket(U)
+    ws_u = np.zeros(U_pad, np.float32)
+    count_u = np.zeros(U_pad, np.float32)
+    wsum_u = np.zeros(U_pad, np.float32)
+    ws_u[: len(uniq)] = uniq / scale
+    count_u[: len(uniq)] = counts
+    wsum_u[: len(uniq)] = uniq * counts / scale
+    return ws_u, count_u, wsum_u
 
 
 def sinkhorn_duals(
-    lags: jax.Array,
-    valid: jax.Array,
+    lags,
+    valid,
     num_consumers: int,
-    iters: int = 60,
+    iters: int = 24,
     eta: float = 8.0,
 ):
     """Run the implicit-plan iteration; returns ``(A, B, ws)``.
@@ -81,26 +143,27 @@ def sinkhorn_duals(
     # probe could not execute (a lowering failure would abort the compile
     # with no fallback, see plan_stats._pallas_available).
     _pallas_available()
-    return _sinkhorn_duals_jit(
-        lags, valid, num_consumers=num_consumers, iters=iters, eta=eta
+    lags_np = np.asarray(lags)
+    valid_np = np.asarray(valid)
+    C = int(num_consumers)
+    ws_u, count_u, wsum_u = _dedup_weights(lags_np, valid_np, C)
+    A, B = _sinkhorn_duals_jit(
+        ws_u, count_u, wsum_u, num_consumers=C, iters=iters, eta=eta
     )
+    return A, B, _scaled_ws(lags, valid, C)
 
 
 @functools.partial(jax.jit, static_argnames=("num_consumers", "iters"))
 def _sinkhorn_duals_jit(
-    lags: jax.Array,
-    valid: jax.Array,
+    ws_u: jax.Array,
+    count_u: jax.Array,
+    wsum_u: jax.Array,
     num_consumers: int,
-    iters: int = 60,
+    iters: int = 24,
     eta: float = 8.0,
 ):
     C = int(num_consumers)
-    w = jnp.where(valid, lags, 0).astype(jnp.float32)
-    total = jnp.maximum(jnp.sum(w), 1.0)
-    scale = total / C  # ideal per-consumer load
-    ws = w / scale
-    maskf = valid.astype(jnp.float32)
-    n_valid = jnp.maximum(jnp.sum(maskf), 1.0)
+    n_valid = jnp.maximum(jnp.sum(count_u), 1.0)
     cap = n_valid / C  # balanced count marginal
 
     eta32 = jnp.float32(eta)
@@ -110,18 +173,24 @@ def _sinkhorn_duals_jit(
         # Mirror step on d/dX sum_j load_j^2 ∝ ws_p * load_j, centered so
         # the step is invariant to uniform load shifts.  load is already in
         # ws units (= absolute load / scale).
-        load, _ = plan_stats(ws, maskf, A, B)
+        load, _ = plan_stats(ws_u, count_u, wsum_u, A, B)
         A = A + eta32 * (load - jnp.mean(load))
         # Sinkhorn pair: scale columns toward the balanced count marginal
         # (rows re-normalize implicitly in the softmax).
-        _, colsum = plan_stats(ws, maskf, A, B)
+        _, colsum = plan_stats(ws_u, count_u, wsum_u, A, B)
         B = B + jnp.log(cap / (colsum + jnp.float32(1e-9)))
         return A, B
 
     A0 = jnp.zeros((C,), jnp.float32)
-    B0 = jnp.zeros((C,), jnp.float32)
+    # Symmetry-breaking seed: the noise-free iteration has a symmetric
+    # fixpoint (all consumers identical => zero gradient); a tiny
+    # deterministic per-consumer offset in B0 breaks it, replacing the
+    # per-(p, j) noise the deduplicated stats no longer carry.
+    B0 = noise(
+        jnp.zeros((C,), jnp.int32), jnp.arange(C, dtype=jnp.int32)
+    )
     A, B = lax.fori_loop(0, iters, body, (A0, B0))
-    return A, B, ws
+    return A, B
 
 
 def _round_parallel(lags, ws, valid, A, B, C: int, floor_cap, extras):
@@ -202,29 +271,50 @@ def _round_parallel(lags, ws, valid, A, B, C: int, floor_cap, extras):
 
 
 def assign_topic_sinkhorn(
-    lags: jax.Array,
-    partition_ids: jax.Array,
-    valid: jax.Array,
+    lags,
+    partition_ids,
+    valid,
     num_consumers: int,
-    iters: int = 60,
-    refine_iters: int = 24,
+    iters: int = 24,
+    refine_iters: Optional[int] = None,
 ):
     """Integral, count-balanced assignment from the implicit Sinkhorn plan.
 
-    Rounding: partitions in descending-lag order pick the *least-loaded*
-    open consumer (capacity floor/ceil(n/C)), with the plan row —
-    materialized per step from the implicit state — as a continuous
-    tie-break bonus, i.e. LPT steered by the OT relaxation.  A pairwise-
-    exchange refinement pass (:mod:`..ops.refine`) then tightens max/mean
-    imbalance below what any single greedy pass reaches.
+    Rounding (path chosen by size, ``_SCAN_ROUNDING_MAX_P``): partitions in
+    descending-lag order pick the *least-loaded* open consumer (capacity
+    floor/ceil(n/C)) with the plan row as a continuous tie-break bonus —
+    LPT steered by the OT relaxation — or, for large topics, the parallel
+    argmax+repair rounding.  A pairwise-exchange refinement pass
+    (:mod:`..ops.refine`) then tightens max/mean imbalance.
+    ``refine_iters=None`` selects the per-path auto budget
+    (``_AUTO_REFINE_SCAN`` / ``_AUTO_REFINE_PARALLEL``); an explicit value
+    is honored exactly.
+
+    **Quality guarantee (portfolio):** the greedy rounds kernel runs as
+    well (its cost is dwarfed by the duals iteration), and whichever
+    assignment has the smaller maximum consumer load is returned — the
+    quality mode can steer better than greedy where slack exists
+    (BASELINE config 2) but can never return something worse (config 4,
+    where greedy is already at the optimum plateau).
 
     Same output contract as the greedy kernels: (choice int32[P] in input
     order, counts int32[C], totals[C]).
     """
     _pallas_available()  # resolve kernel choice eagerly, outside the trace
+    C = int(num_consumers)
+    ws_u, count_u, wsum_u = _dedup_weights(
+        np.asarray(lags), np.asarray(valid), C
+    )
+    if refine_iters is None:
+        P = lags.shape[0]
+        refine_iters = (
+            _AUTO_REFINE_PARALLEL
+            if P > _SCAN_ROUNDING_MAX_P
+            else _AUTO_REFINE_SCAN
+        )
     return _assign_topic_sinkhorn_jit(
-        lags, partition_ids, valid, num_consumers=num_consumers,
-        iters=iters, refine_iters=refine_iters,
+        lags, partition_ids, valid, ws_u, count_u, wsum_u,
+        num_consumers=num_consumers, iters=iters, refine_iters=refine_iters,
     )
 
 
@@ -235,15 +325,22 @@ def _assign_topic_sinkhorn_jit(
     lags: jax.Array,
     partition_ids: jax.Array,
     valid: jax.Array,
+    ws_u: jax.Array,
+    count_u: jax.Array,
+    wsum_u: jax.Array,
     num_consumers: int,
-    iters: int = 60,
-    refine_iters: int = 24,
+    iters: int,
+    refine_iters: int,
 ):
     from ..ops.refine import refine_assignment
+    from ..ops.rounds_kernel import assign_topic_rounds
 
     C = int(num_consumers)
     P = lags.shape[0]
-    A, B, ws = _sinkhorn_duals_jit(lags, valid, num_consumers=C, iters=iters)
+    A, B = _sinkhorn_duals_jit(
+        ws_u, count_u, wsum_u, num_consumers=C, iters=iters
+    )
+    ws = _scaled_ws(lags, valid, C)
 
     n_valid = jnp.sum(valid.astype(jnp.int32))
     floor_cap = n_valid // C
@@ -251,61 +348,69 @@ def _assign_topic_sinkhorn_jit(
 
     if P > _SCAN_ROUNDING_MAX_P:
         # Large topics: the per-partition scan below would dominate wall
-        # time; round in parallel and lean on the refinement pass.  The
-        # one-shot rounding starts coarser than the sequential scan, so
-        # floor the refinement budget (each round retires up to C//2
-        # disjoint exchanges — at these shapes 96 rounds is ~ms and takes
-        # max/mean to within a fraction of a percent of the bound).
+        # time; round in parallel and lean on the refinement pass.
         choice = _round_parallel(
             lags, ws, valid, A, B, C, floor_cap, extras
         )
-        return refine_assignment(
-            lags, valid, choice, num_consumers=C,
-            iters=max(refine_iters, 96),
+    else:
+        neg_lag = jnp.where(valid, -lags, jnp.iinfo(lags.dtype).max)
+        order = jnp.argsort(neg_lag).astype(jnp.int32)  # lag desc, pad last
+
+        def step(carry, p):
+            counts, totals, extras_left = carry
+            is_valid = valid[p]
+            # A consumer is open if under floor cap, or at floor cap while
+            # ceil-slots remain.
+            under_floor = counts < floor_cap
+            at_floor = (counts == floor_cap) & (extras_left > 0)
+            open_mask = under_floor | at_floor
+            # Least (scaled) load first; the plan row contributes a
+            # sub-unit bonus so it decides ties without overriding the
+            # load ordering.
+            xrow = implicit_plan_rows(p[None], ws[p][None], A, B)[0]
+            score = totals - jnp.float32(0.01) * xrow
+            score = jnp.where(open_mask, score, jnp.inf)
+            who = jnp.argmin(score).astype(jnp.int32)
+            take = is_valid
+            one_hot = (jnp.arange(C, dtype=jnp.int32) == who) & take
+            used_extra = take & at_floor[who]
+            counts = counts + one_hot.astype(jnp.int32)
+            totals = totals + jnp.where(one_hot, ws[p], 0.0)
+            extras_left = extras_left - used_extra.astype(jnp.int32)
+            return (counts, totals, extras_left), jnp.where(take, who, -1)
+
+        init = (
+            jnp.zeros((C,), jnp.int32),
+            jnp.zeros((C,), jnp.float32),
+            extras,
         )
+        (_, _, _), sorted_choice = lax.scan(step, init, order)
+        choice = jnp.full((P,), -1, jnp.int32).at[order].set(sorted_choice)
 
-    neg_lag = jnp.where(valid, -lags, jnp.iinfo(lags.dtype).max)
-    order = jnp.argsort(neg_lag).astype(jnp.int32)  # lag desc, padding last
-
-    def step(carry, p):
-        counts, totals, extras_left = carry
-        is_valid = valid[p]
-        # A consumer is open if under floor cap, or at floor cap while
-        # ceil-slots remain.
-        under_floor = counts < floor_cap
-        at_floor = (counts == floor_cap) & (extras_left > 0)
-        open_mask = under_floor | at_floor
-        # Least (scaled) load first; the plan row contributes a sub-unit
-        # bonus so it decides ties without overriding the load ordering.
-        xrow = implicit_plan_rows(p[None], ws[p][None], A, B)[0]
-        score = totals - jnp.float32(0.01) * xrow
-        score = jnp.where(open_mask, score, jnp.inf)
-        who = jnp.argmin(score).astype(jnp.int32)
-        take = is_valid
-        one_hot = (jnp.arange(C, dtype=jnp.int32) == who) & take
-        used_extra = take & at_floor[who]
-        counts = counts + one_hot.astype(jnp.int32)
-        totals = totals + jnp.where(one_hot, ws[p], 0.0)
-        extras_left = extras_left - used_extra.astype(jnp.int32)
-        return (counts, totals, extras_left), jnp.where(take, who, -1)
-
-    init = (
-        jnp.zeros((C,), jnp.int32),
-        jnp.zeros((C,), jnp.float32),
-        extras,
-    )
-    (_, _, _), sorted_choice = lax.scan(step, init, order)
-    choice = jnp.full((P,), -1, jnp.int32).at[order].set(sorted_choice)
-    return refine_assignment(
+    s_choice, s_counts, s_totals = refine_assignment(
         lags, valid, choice, num_consumers=C, iters=refine_iters
+    )
+
+    # Portfolio: never return worse than greedy.  Greedy's cost (one sort +
+    # ceil(P/C) rounds) is negligible next to the duals iteration, and on
+    # instances where greedy already sits at the optimum plateau (heavy
+    # skew, BASELINE config 4) the OT rounding cannot reach it.
+    g_choice, g_counts, g_totals = assign_topic_rounds(
+        lags, partition_ids, valid, num_consumers=C
+    )
+    use_s = jnp.max(s_totals) < jnp.max(g_totals)
+    return (
+        jnp.where(use_s, s_choice, g_choice),
+        jnp.where(use_s, s_counts, g_counts),
+        jnp.where(use_s, s_totals, g_totals),
     )
 
 
 def assign_sinkhorn(
     partition_lag_per_topic: Mapping[str, Sequence[TopicPartitionLag]],
     subscriptions: Mapping[str, Sequence[str]],
-    iters: int = 60,
-    refine_iters: int = 24,
+    iters: int = 24,
+    refine_iters: Optional[int] = None,
 ) -> AssignmentMap:
     """Map-level Sinkhorn solve (same surface as
     :func:`..ops.dispatch.assign_device`); per-topic independence preserved.

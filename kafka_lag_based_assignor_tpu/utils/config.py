@@ -30,7 +30,11 @@ FALLBACK_CONFIG = "tpu.assignor.host.fallback"  # bool: greedy host fallback
 PROFILE_CONFIG = "tpu.assignor.profile"  # bool: jax.profiler traces
 SOLVE_TIMEOUT_CONFIG = "tpu.assignor.solve.timeout.ms"  # 0/empty disables
 SINKHORN_ITERS_CONFIG = "tpu.assignor.sinkhorn.iters"  # int > 0
-REFINE_ITERS_CONFIG = "tpu.assignor.refine.iters"  # int >= 0
+# int >= 0, or unset/"auto" for the per-rounding-path budget
+# (models/sinkhorn: 24 for the sequential scan rounding, 96 for the
+# parallel rounding, which starts coarser).  An explicit integer is
+# honored exactly on every path.
+REFINE_ITERS_CONFIG = "tpu.assignor.refine.iters"
 
 VALID_SOLVERS = ("rounds", "scan", "global", "sinkhorn", "native", "host")
 
@@ -55,9 +59,10 @@ class AssignorConfig:
     # persistent cache); a trip only sidelines the accelerator for the
     # watchdog cooldown, not forever.
     solve_timeout_s: Optional[float] = 120.0
-    # Quality-mode iteration budgets (sinkhorn solver / exchange refinement).
-    sinkhorn_iters: int = 60
-    refine_iters: int = 24
+    # Quality-mode iteration budgets (sinkhorn solver / exchange
+    # refinement); refine_iters None = per-path auto budget.
+    sinkhorn_iters: int = 24
+    refine_iters: Optional[int] = None
     consumer_group_props: Dict[str, Any] = field(default_factory=dict)
     metadata_consumer_props: Dict[str, Any] = field(default_factory=dict)
 
@@ -110,8 +115,13 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
             raise ValueError(f"{key}={value} must be >= {minimum}")
         return value
 
-    sinkhorn_iters = _as_int(SINKHORN_ITERS_CONFIG, 60, 1)
-    refine_iters = _as_int(REFINE_ITERS_CONFIG, 24, 0)
+    sinkhorn_iters = _as_int(SINKHORN_ITERS_CONFIG, 24, 1)
+    raw_refine = consumer_group_props.get(REFINE_ITERS_CONFIG, None)
+    refine_iters = (
+        None
+        if raw_refine in (None, "", "auto")
+        else _as_int(REFINE_ITERS_CONFIG, raw_refine, 0)
+    )
 
     raw_timeout = consumer_group_props.get(SOLVE_TIMEOUT_CONFIG, 120_000)
     try:

@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import logging
 import time
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -50,8 +50,8 @@ def warmup(
     topics: Sequence[int] = (1,),
     solvers: Sequence[str] = ("rounds", "stream"),
     all_partition_buckets: bool = False,
-    sinkhorn_iters: int = 60,
-    refine_iters: int = 24,
+    sinkhorn_iters: int = 24,
+    refine_iters: Optional[int] = None,
     stream_refine_iters: int = 128,
 ) -> List[Tuple[str, int, int, int, float]]:
     """Pre-compile kernels for every shape the deployment will see.
@@ -67,6 +67,11 @@ def warmup(
         shapes still trigger one compile each on first sight).
       sinkhorn_iters / refine_iters: must match the production config
         (they are static jit parameters; different values = new compile).
+        The defaults mirror the production defaults (utils/config.py):
+        iters=24, refine_iters=None = the per-path auto budget — the
+        warm-up goes through the same public solver wrapper that resolves
+        the auto rule, so default warm-up compiles exactly the executables
+        a default-config rebalance uses.
       stream_refine_iters: the StreamingAssignor exchange budget to warm —
         the "stream" warm-up runs a cold+warm rebalance pair so BOTH the
         cold :func:`..ops.batched.assign_stream` compile and the warm-path

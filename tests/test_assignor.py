@@ -161,7 +161,9 @@ def test_quality_iteration_knobs_parse_and_validate():
     from kafka_lag_based_assignor_tpu.utils.config import parse_config
 
     cfg = parse_config({"group.id": "g"})
-    assert cfg.sinkhorn_iters == 60 and cfg.refine_iters == 24
+    assert cfg.sinkhorn_iters == 24 and cfg.refine_iters is None
+    cfg = parse_config({"group.id": "g", "tpu.assignor.refine.iters": "auto"})
+    assert cfg.refine_iters is None
     cfg = parse_config(
         {
             "group.id": "g",

@@ -1,6 +1,7 @@
 """Fused implicit-plan statistics op: Pallas-interpret vs lax-reference
-agreement, marginal identities, and the rank-structure invariant that lets
-the Sinkhorn solver drop its [P, C] state."""
+agreement, marginal identities, dedup-weighting equivalence, and the
+rank-structure invariant that lets the Sinkhorn solver drop its [P, C]
+state."""
 
 import numpy as np
 import pytest
@@ -9,58 +10,104 @@ import jax
 import jax.numpy as jnp
 
 from kafka_lag_based_assignor_tpu.ops.plan_stats import (
-    implicit_plan_rows,
     noise,
     plan_stats_lax,
     plan_stats_pallas,
 )
 
 
-def random_state(P, C, seed=0):
+def random_state(U, C, seed=0):
+    """Random weighted stats inputs: U unique values with counts >= 0
+    (zero-count rows are padding)."""
     rng = np.random.default_rng(seed)
-    ws = jnp.asarray(rng.random(P), jnp.float32)
-    mask = jnp.asarray(rng.random(P) > 0.15, jnp.float32)
+    ws_u = jnp.asarray(rng.random(U), jnp.float32)
+    count_u = jnp.asarray(
+        np.where(rng.random(U) > 0.15, rng.integers(1, 5, U), 0), jnp.float32
+    )
+    wsum_u = ws_u * count_u
     A = jnp.asarray(rng.normal(size=C), jnp.float32)
     B = jnp.asarray(rng.normal(size=C), jnp.float32)
-    return ws, mask, A, B
+    return ws_u, count_u, wsum_u, A, B
+
+
+def explicit_rows(ws_u, A, B):
+    """Noise-free plan rows X_u = softmax_j(-ws_u * A_j + B_j)."""
+    logits = -ws_u[:, None] * A[None, :] + B[None, :]
+    return jax.nn.softmax(logits, axis=1)
 
 
 @pytest.mark.parametrize(
-    "P,C", [(4, 3), (1000, 37), (513, 128), (2048, 200)]
+    "U,C", [(4, 3), (1000, 37), (513, 128), (2048, 200)]
 )
-def test_pallas_interpret_matches_lax(P, C):
+def test_pallas_interpret_matches_lax(U, C):
     """The Pallas kernel (interpret mode on CPU) and the lax reference are
     the same arithmetic — agreement to f32 reduction-order tolerance."""
-    ws, mask, A, B = random_state(P, C, seed=P + C)
-    l1, c1 = plan_stats_lax(ws, mask, A, B)
-    l2, c2 = plan_stats_pallas(ws, mask, A, B, interpret=True)
+    ws_u, count_u, wsum_u, A, B = random_state(U, C, seed=U + C)
+    l1, c1 = plan_stats_lax(ws_u, count_u, wsum_u, A, B)
+    l2, c2 = plan_stats_pallas(ws_u, count_u, wsum_u, A, B, interpret=True)
     np.testing.assert_allclose(l1, l2, rtol=1e-4, atol=1e-4)
     np.testing.assert_allclose(c1, c2, rtol=1e-4, atol=1e-4)
 
 
 def test_marginal_identities():
-    """colsum sums to the valid-row count (rows are stochastic); load sums
-    to the total scaled lag of valid rows."""
-    ws, mask, A, B = random_state(777, 63, seed=5)
-    load, colsum = plan_stats_lax(ws, mask, A, B)
-    np.testing.assert_allclose(colsum.sum(), float(mask.sum()), rtol=1e-5)
-    np.testing.assert_allclose(
-        load.sum(), float((ws * mask).sum()), rtol=1e-5
-    )
+    """colsum sums to the total row count (rows are stochastic); load sums
+    to the total scaled lag."""
+    ws_u, count_u, wsum_u, A, B = random_state(777, 63, seed=5)
+    load, colsum = plan_stats_lax(ws_u, count_u, wsum_u, A, B)
+    np.testing.assert_allclose(colsum.sum(), float(count_u.sum()), rtol=1e-5)
+    np.testing.assert_allclose(load.sum(), float(wsum_u.sum()), rtol=1e-5)
 
 
 def test_stats_match_explicit_plan():
-    """plan_stats == the marginals of the explicitly materialized plan."""
-    ws, mask, A, B = random_state(300, 17, seed=9)
-    X = implicit_plan_rows(jnp.arange(300, dtype=jnp.int32), ws, A, B)
+    """plan_stats == the marginals of the explicitly materialized
+    (noise-free) plan."""
+    ws_u, count_u, wsum_u, A, B = random_state(300, 17, seed=9)
+    X = explicit_rows(ws_u, A, B)
     np.testing.assert_allclose(X.sum(axis=1), 1.0, rtol=1e-5)  # stochastic
-    load, colsum = plan_stats_lax(ws, mask, A, B)
+    load, colsum = plan_stats_lax(ws_u, count_u, wsum_u, A, B)
     np.testing.assert_allclose(
-        load, ((ws * mask)[:, None] * X).sum(axis=0), rtol=1e-4, atol=1e-4
+        load, (wsum_u[:, None] * X).sum(axis=0), rtol=1e-4, atol=1e-4
     )
     np.testing.assert_allclose(
-        colsum, (mask[:, None] * X).sum(axis=0), rtol=1e-4, atol=1e-4
+        colsum, (count_u[:, None] * X).sum(axis=0), rtol=1e-4, atol=1e-4
     )
+
+
+def test_dedup_equals_expanded():
+    """The deduplicated weighted stats equal the stats over the expanded
+    per-partition rows — the identity that makes U << P legal."""
+    rng = np.random.default_rng(21)
+    C = 11
+    uniq = jnp.asarray([0.0, 0.25, 1.0, 3.5], jnp.float32)
+    counts = np.array([500, 3, 2, 1])
+    A = jnp.asarray(rng.normal(size=C), jnp.float32)
+    B = jnp.asarray(rng.normal(size=C), jnp.float32)
+
+    expanded = jnp.asarray(np.repeat(np.asarray(uniq), counts), jnp.float32)
+    ones = jnp.ones_like(expanded)
+    l_exp, c_exp = plan_stats_lax(expanded, ones, expanded, A, B)
+
+    count_u = jnp.asarray(counts, jnp.float32)
+    l_ded, c_ded = plan_stats_lax(uniq, count_u, uniq * count_u, A, B)
+    np.testing.assert_allclose(l_exp, l_ded, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(c_exp, c_ded, rtol=1e-3, atol=1e-3)
+
+
+def test_dedup_weights_aggregation():
+    """Host aggregation: unique values, counts, ws sums, zero padding."""
+    from kafka_lag_based_assignor_tpu.models.sinkhorn import _dedup_weights
+
+    lags = np.array([5, 0, 5, 7, 0, 0, 9], dtype=np.int64)
+    valid = np.array([True, True, True, True, True, True, False])
+    C = 2
+    ws_u, count_u, wsum_u = _dedup_weights(lags, valid, C)
+    scale = 17 / C  # valid lag total / C
+    # Unique valid values 0, 5, 7 with counts 3, 2, 1.
+    np.testing.assert_allclose(ws_u[:3] * scale, [0, 5, 7], rtol=1e-6)
+    np.testing.assert_allclose(count_u[:3], [3, 2, 1])
+    np.testing.assert_allclose(wsum_u[:3] * scale, [0, 10, 7], rtol=1e-6)
+    assert (count_u[3:] == 0).all() and (wsum_u[3:] == 0).all()
+    assert float(jnp.asarray(count_u).sum()) == 6  # invalid row excluded
 
 
 def test_noise_deterministic_and_bounded():
@@ -76,14 +123,17 @@ def test_noise_deterministic_and_bounded():
 
 
 def test_padding_rows_do_not_contribute():
-    """Masked rows must not affect either marginal (pad-and-mask safety)."""
-    ws, _, A, B = random_state(256, 20, seed=3)
-    mask_all = jnp.ones(256, jnp.float32)
-    half = jnp.asarray([1.0] * 128 + [0.0] * 128, jnp.float32)
-    l_half, c_half = plan_stats_lax(ws, half, A, B)
-    l_ref, c_ref = plan_stats_lax(ws[:128], mask_all[:128], A, B)
-    np.testing.assert_allclose(l_half, l_ref, rtol=1e-5, atol=1e-5)
-    np.testing.assert_allclose(c_half, c_ref, rtol=1e-5, atol=1e-5)
+    """Zero-count rows must not affect either marginal."""
+    ws_u, count_u, wsum_u, A, B = random_state(128, 20, seed=3)
+    padded = (
+        jnp.pad(ws_u, (0, 128), constant_values=7.5),
+        jnp.pad(count_u, (0, 128)),
+        jnp.pad(wsum_u, (0, 128)),
+    )
+    l_pad, c_pad = plan_stats_lax(*padded, A, B)
+    l_ref, c_ref = plan_stats_lax(ws_u, count_u, wsum_u, A, B)
+    np.testing.assert_allclose(l_pad, l_ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(c_pad, c_ref, rtol=1e-5, atol=1e-5)
 
 
 def test_pallas_probe_failure_falls_back(monkeypatch):
@@ -101,15 +151,15 @@ def test_pallas_probe_failure_falls_back(monkeypatch):
 
     monkeypatch.setattr(ps, "plan_stats_pallas", boom)
 
-    ws, mask, A, B = random_state(64, 5, seed=2)
+    ws_u, count_u, wsum_u, A, B = random_state(64, 5, seed=2)
 
     @jax.jit
-    def solve(ws, mask, A, B):
-        return ps.plan_stats(ws, mask, A, B)
+    def solve(ws_u, count_u, wsum_u, A, B):
+        return ps.plan_stats(ws_u, count_u, wsum_u, A, B)
 
     # Jitted call with unknown probe state: conservative lax, no caching.
-    load, colsum = solve(ws, mask, A, B)  # must not raise
-    l_ref, c_ref = plan_stats_lax(ws, mask, A, B)
+    load, colsum = solve(ws_u, count_u, wsum_u, A, B)  # must not raise
+    l_ref, c_ref = plan_stats_lax(ws_u, count_u, wsum_u, A, B)
     np.testing.assert_allclose(load, l_ref, rtol=1e-5)
     np.testing.assert_allclose(colsum, c_ref, rtol=1e-5)
     assert ps._pallas_ok is None  # in-trace call must not cache a verdict
@@ -138,14 +188,14 @@ def test_pallas_probe_success_enables_kernel(monkeypatch):
     assert ps._pallas_available() is True  # the eager probe ran the kernel
     assert calls["n"] == 1
 
-    ws, mask, A, B = random_state(64, 5, seed=3)
+    ws_u, count_u, wsum_u, A, B = random_state(64, 5, seed=3)
 
     @jax.jit
-    def solve(ws, mask, A, B):
-        return ps.plan_stats(ws, mask, A, B)
+    def solve(ws_u, count_u, wsum_u, A, B):
+        return ps.plan_stats(ws_u, count_u, wsum_u, A, B)
 
-    load, colsum = solve(ws, mask, A, B)
-    l_ref, _ = plan_stats_lax(ws, mask, A, B)
+    load, colsum = solve(ws_u, count_u, wsum_u, A, B)
+    l_ref, _ = plan_stats_lax(ws_u, count_u, wsum_u, A, B)
     np.testing.assert_allclose(load, l_ref, rtol=1e-4, atol=1e-4)
     assert calls["n"] == 2  # the traced solve took the Pallas path
 
@@ -172,11 +222,29 @@ def test_sinkhorn_duals_converge_toward_balance():
     lags = jnp.asarray(rng.integers(1, 10**6, P), jnp.int64)
     valid = jnp.ones(P, bool)
     A, B, ws = sinkhorn_duals(lags, valid, num_consumers=C, iters=40)
-    load, colsum = plan_stats_lax(
-        ws, valid.astype(jnp.float32), A, B
-    )
+    ones = jnp.ones((P,), jnp.float32)
+    load, colsum = plan_stats_lax(ws, ones, ws, A, B)
     # Ideal scaled load per consumer is sum(ws)/C; within a few percent.
     ideal = float(ws.sum()) / C
     assert float(jnp.abs(load - ideal).max()) < 0.1 * ideal
     # Count marginal near P/C.
     assert float(jnp.abs(colsum - P / C).max()) < 0.15 * (P / C)
+
+
+def test_host_and_traced_scale_agree():
+    """_scale_np (host, feeds _dedup_weights) and _scaled_ws (traced, feeds
+    the rounding) are the two halves of one scale definition — they must
+    describe the same normalization to f32 tolerance."""
+    from kafka_lag_based_assignor_tpu.models.sinkhorn import (
+        _scale_np,
+        _scaled_ws,
+    )
+
+    rng = np.random.default_rng(13)
+    lags = rng.integers(0, 10**9, 500).astype(np.int64)
+    valid = rng.random(500) > 0.2
+    C = 7
+    scale = _scale_np(lags, valid, C)
+    ws = np.asarray(_scaled_ws(jnp.asarray(lags), jnp.asarray(valid), C))
+    expect = np.where(valid, lags, 0) / scale
+    np.testing.assert_allclose(ws, expect, rtol=1e-5)
