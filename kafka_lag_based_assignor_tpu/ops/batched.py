@@ -76,14 +76,16 @@ def _stream_device(lags, num_consumers: int, pack_shift: int = 0):
     Pads device-side to a power-of-two bucket: the transfer stays
     exact-size while the sort network compiles at a friendly shape
     (non-power-of-two sorts compile pathologically slowly on some
-    backends)."""
+    backends).  Accepts int32 lags (widened on device) — the host wrapper
+    downcasts when the lag range allows, halving the host->device bytes
+    on the latency-critical streaming path."""
     import jax.numpy as jnp
 
     from .packing import pad_bucket
 
     P = lags.shape[0]
     P_pad = pad_bucket(P)
-    lags_p = jnp.pad(lags, (0, P_pad - P))
+    lags_p = jnp.pad(lags.astype(jnp.int64), (0, P_pad - P))
     pids = jnp.arange(P_pad, dtype=jnp.int32)
     valid = pids < P
     choice, _, _ = assign_topic_rounds(
@@ -123,6 +125,13 @@ def assign_stream(lags, num_consumers: int):
 
         max_lag = int(lags.max()) if lags.size else 0
         shift = pack_shift_for(max_lag, pad_bucket(lags.shape[0]) - 1)
+        from .dispatch import observe_pack_shift
+
+        observe_pack_shift(("stream", lags.shape, num_consumers), shift)
+        if 0 <= max_lag < 2**31 and (lags.size == 0 or int(lags.min()) >= 0):
+            # Lag range fits int32: halve the transfer (the kernel widens
+            # back to int64 on device; semantics unchanged).
+            lags = lags.astype(np.int32)
         return _stream_device(
             lags, num_consumers=num_consumers, pack_shift=shift
         )

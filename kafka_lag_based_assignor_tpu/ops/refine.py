@@ -15,20 +15,32 @@ to ``max_pairs`` *disjoint* exchanges simultaneously:
 2. for every pair independently, pick the best single-partition **move**
    (heavy → light, lag closest to half the load gap, only while the count
    spread stays <= 1) and the best **swap** — the light side is sorted by
-   (pair, lag) once per round, and one vectorized ``searchsorted`` finds,
-   for every heavy-side partition p, the light-side q whose lag is
-   closest to ``lag_p - delta`` (the exact best counterpart), reduced to
-   the best (p, q) per pair by O(P) segment-argmin scatter ops;
+   (pair, quantized lag) once per round, and one vectorized
+   ``searchsorted`` finds, for every heavy-side partition p, the
+   light-side q whose lag is closest to ``lag_p - delta`` (the best
+   counterpart), reduced to the best (p, q) per pair by sort-based
+   segmented argmins;
 3. apply every strictly-improving exchange at once.  Pairs are disjoint
    (each consumer belongs to at most one), so parallel application is
    race-free, and since any transferred amount d satisfies
    0 < d < load_heavy - load_light, no consumer's load ever exceeds the
    running maximum — the global max is monotone non-increasing.
 
-A round costs one P-sized sort plus a handful of O(P) gathers/scatters
-and retires up to K exchanges, versus the sequential kernel's one
+A round costs two P-sized sorts plus a handful of O(P) elementwise ops and
+gathers and retires up to K exchanges, versus the sequential kernel's one
 exchange per round; at P=100k / C=1k this is ~3 orders of magnitude more
 exchange throughput.  Churn is bounded by ``2 * iters * max_pairs``.
+
+Device-cost discipline (measured on the target TPU, tools/probe_ops.py):
+P-sized scatters (8-15 ms) and the sequential ``searchsorted`` method
+(18 ms) are banned from the round body — segmented reductions and
+permutation handling go through the sort-based primitives in
+:mod:`.sortops` (~0.2 ms per P-sized sort), candidate keys are packed
+integers (f64 compares are emulated on v5e), and per-row lookups are
+packed so each round performs the minimum number of ~2 ms P-sized gathers.
+Candidate *selection* works on quantized keys; every candidate's
+improvement is re-checked EXACTLY (int64) before being applied, so
+quantization never admits a worsening exchange.
 
 The refinement is solver-agnostic: it accepts the (choice, lags) pair in
 input order from the greedy kernels or the Sinkhorn rounding.  It
@@ -44,21 +56,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .sortops import bincount_sorted, segment_argmin_first, segment_sum
 
-def _segment_argmin(score, seg, num_segments, P):
-    """Deterministic per-segment argmin: returns (min value, first index
-    attaining it) per segment.  ``seg`` entries equal to ``num_segments``
-    are parked in a discard slot.  Two O(P) scatter-mins."""
-    big = jnp.iinfo(score.dtype).max
-    minv = jnp.full((num_segments + 1,), big, score.dtype).at[seg].min(score)
-    hit = (score == minv[seg]) & (seg < num_segments)
-    idx_cand = jnp.where(hit, jnp.arange(P, dtype=jnp.int32), P)
-    idx = jnp.full((num_segments + 1,), P, jnp.int32).at[seg].min(idx_cand)
-    return minv[:num_segments], idx[:num_segments]
+_PAIR_BITS = 14  # pair-id field width in the packed per-row combo lookup
 
 
 @functools.partial(
-    jax.jit, static_argnames=("num_consumers", "iters", "max_pairs")
+    jax.jit, static_argnames=("num_consumers", "iters", "max_pairs",
+                              "patience")
 )
 def refine_assignment(
     lags: jax.Array,
@@ -67,11 +72,12 @@ def refine_assignment(
     num_consumers: int,
     iters: int = 16,
     max_pairs: int | None = None,
+    patience: int = 8,
 ):
     """Improve an integral assignment by rounds of parallel exchanges.
 
     Args:
-      lags: [P] lag per partition row.
+      lags: [P] lag per partition row (non-negative, contract §2.4.6).
       valid: [P] mask; invalid rows must have choice == -1.
       choice: int32[P] consumer index per row (count-balanced).
       num_consumers: static C.
@@ -79,34 +85,63 @@ def refine_assignment(
         strictly-improving exchanges (or no-ops once converged).
       max_pairs: concurrent consumer pairs per round (default C // 2).
         Total churn is bounded by ``2 * iters * max_pairs`` partitions.
+      patience: adaptive budget — stop early once this many CONSECUTIVE
+        rounds failed to reduce the MAXIMUM consumer load.  The metric is
+        max/mean and the mean is invariant (total lag is conserved), so
+        only peak reduction counts as progress; exchanges between
+        non-peak pairs matter only as enablers of a later peak reduction,
+        and ``patience`` rounds of a stuck peak (the heaviest consumer
+        meets a different rotated partner each round) make further
+        progress unlikely.  Early stop only ever reduces churn, so the
+        documented churn bound still holds.
 
     Returns (choice int32[P], counts int32[C], totals[C]).
     """
     C = int(num_consumers)
     P = lags.shape[0]
     K = max(1, min(C // 2, max_pairs if max_pairs is not None else C // 2))
+    if K >= (1 << _PAIR_BITS) - 1:
+        raise ValueError(
+            f"max_pairs={K} exceeds the packed pair-id field "
+            f"({_PAIR_BITS} bits)"
+        )
     big = jnp.iinfo(lags.dtype).max
     arangeC = jnp.arange(C, dtype=jnp.int32)
+    arangeP = jnp.arange(P, dtype=jnp.int32)
 
     choice = choice.astype(jnp.int32)
-    safe0 = jnp.clip(choice, 0, C - 1)
     assigned = valid & (choice >= 0)
-    totals0 = jnp.zeros((C,), lags.dtype).at[safe0].add(
-        jnp.where(assigned, lags, 0)
-    )
-    counts0 = jnp.zeros((C,), jnp.int32).at[safe0].add(
-        assigned.astype(jnp.int32)
-    )
+    seg0 = jnp.where(assigned, choice, -1)
+    totals0 = segment_sum(jnp.where(assigned, lags, 0), seg0, C)
+    counts0 = bincount_sorted(seg0, C)
     if C < 2:
         return choice, counts0, totals0
 
-    # Float key scale for the (pair, lag) composite sort.  Approximate
-    # (52-bit mantissa vs 63-bit lags) is fine: candidates are re-checked
-    # exactly before being applied.
-    scale = (jnp.max(jnp.where(assigned, lags, 0)) + 1).astype(jnp.float64)
+    # Packed integer key for the (pair, lag) composite sort: pair id in the
+    # high bits, the lag quantized (right-shifted) into the remaining low
+    # bits.  int32 keys whenever the pair id fits comfortably — TPU sorts
+    # 32-bit keys natively, vs emulated 64-bit float compares (the previous
+    # f64 keys made one refine round cost more than a full greedy solve on
+    # v5e).  Quantization is safe: candidates are re-checked EXACTLY before
+    # being applied, the key only has to make searchsorted land near the
+    # best counterpart.
+    pair_bits = max(1, (K - 1).bit_length())
+    if pair_bits <= 12:  # lag keeps >= 19 significant bits
+        key_dtype, key_bits = jnp.int32, 31
+    else:
+        key_dtype, key_bits = jnp.int64, 63
+    lag_bits = key_bits - pair_bits
+    key_big = jnp.iinfo(key_dtype).max
+    maxlag = jnp.maximum(jnp.max(jnp.where(assigned, lags, 0)), 1)
+    bitlen = 64 - lax.clz(maxlag.astype(jnp.int64))  # bit length of maxlag
+    qshift = jnp.maximum(bitlen - lag_bits, 0).astype(jnp.int64)
 
-    def body(it, state):
-        choice, totals, counts = state
+    def pack_key(pair, lag_like):
+        q = jnp.clip(lag_like, 0, None).astype(jnp.int64) >> qshift
+        return (pair.astype(key_dtype) << lag_bits) | q.astype(key_dtype)
+
+    def body(state):
+        it, since, choice, totals, counts = state
         safe_choice = jnp.clip(choice, 0, C - 1)
 
         # Rank consumers by load.  Pair the k-th heaviest with a partner
@@ -115,67 +150,78 @@ def refine_assignment(
         order = jnp.argsort(totals).astype(jnp.int32)  # ascending
         rank = jnp.zeros((C,), jnp.int32).at[order].set(arangeC)
         n_light = C - K
-        shift = jnp.asarray(it, jnp.int32) % jnp.int32(n_light)
+        shift = it % jnp.int32(n_light)
         light_slot = (jnp.arange(K, dtype=jnp.int32) + shift) % n_light
         light = order[light_slot]             # [K]
         heavy = order[C - 1 - jnp.arange(K)]  # [K]
         diff = totals[heavy] - totals[light]  # [K] >= 0
-        delta = diff // 2
 
-        # Map consumers to pair ids (K = unpaired) and partitions to sides.
-        r = rank
+        # Map consumers to pair ids (K = unpaired) and rows to sides via a
+        # single packed [C] table -> ONE P-sized gather for both fields.
         slot_to_pair = (
             jnp.full((n_light,), K, jnp.int32)
             .at[light_slot]
             .set(jnp.arange(K, dtype=jnp.int32))
         )
         pair_of = jnp.where(
-            r < n_light, slot_to_pair[jnp.clip(r, 0, n_light - 1)], C - 1 - r
+            rank < n_light,
+            slot_to_pair[jnp.clip(rank, 0, n_light - 1)],
+            C - 1 - rank,
         )
-        heavy_side = r >= C - K
-        k_p = jnp.where(assigned, pair_of[safe_choice], K)
-        on_heavy = assigned & heavy_side[safe_choice] & (k_p < K)
-        on_light = assigned & ~heavy_side[safe_choice] & (k_p < K)
+        heavy_side = rank >= C - K
+        combo_tab = pair_of | (heavy_side.astype(jnp.int32) << _PAIR_BITS)
+        combo = jnp.where(assigned, combo_tab[safe_choice], K)
+        k_p = combo & ((1 << _PAIR_BITS) - 1)
+        row_heavy = combo >= (1 << _PAIR_BITS)
+        on_heavy = assigned & row_heavy & (k_p < K)
+        on_light = assigned & ~row_heavy & (k_p < K)
         kc = jnp.clip(k_p, 0, K - 1)
-        diff_p = diff[kc]
-        delta_p = delta[kc]
+        diff_p = diff[kc]       # the round's second P-sized gather
+        delta_p = diff_p >> 1   # diff >= 0, so >>1 == //2
         seg_h = jnp.where(on_heavy, k_p, K)
 
         # Candidate 1 — MOVE: heavy-side partition with lag closest to
         # delta; improving iff 0 < lag < diff.
         ok_move = on_heavy & (lags > 0) & (lags < diff_p)
         score_move = jnp.where(ok_move, jnp.abs(lags - delta_p), big)
-        err_move, p_move = _segment_argmin(score_move, seg_h, K, P)
+        err_move, p_move = segment_argmin_first(score_move, seg_h, K, P)
 
-        # Candidate 2 — exact best SWAP: sort light-side partitions by
-        # (pair, lag); for each heavy p, searchsorted its ideal
-        # counterpart lag_p - delta and examine the two neighbours.
-        keyl = jnp.where(
-            on_light,
-            k_p.astype(jnp.float64) + lags.astype(jnp.float64) / scale,
-            jnp.inf,
+        # Candidate 2 — best SWAP: sort light-side rows by (pair,
+        # quantized lag) with (lag, pair, row) riding the sort; for each
+        # heavy p, searchsorted its ideal counterpart lag_p - delta and
+        # examine the two neighbours with exact arithmetic.
+        keyl = jnp.where(on_light, pack_key(k_p, lags), key_big)
+        _skey, slag, skp, sidx = lax.sort(
+            (
+                keyl,
+                jnp.where(on_light, lags, 0),
+                jnp.where(on_light, k_p, -1),
+                arangeP,
+            ),
+            num_keys=1,
         )
-        perm = jnp.argsort(keyl).astype(jnp.int32)
-        skey = keyl[perm]
-        tgt = jnp.clip(lags - delta_p, 0, None).astype(jnp.float64) / scale
-        query = jnp.where(on_heavy, k_p.astype(jnp.float64) + tgt, jnp.inf)
-        pos = jnp.searchsorted(skey, query).astype(jnp.int32)
+        tgt = jnp.clip(lags - delta_p, 0, None)
+        query = jnp.where(on_heavy, pack_key(k_p, tgt), key_big)
+        pos = jnp.searchsorted(_skey, query, method="sort").astype(jnp.int32)
 
         def neighbour(nb):
             inb = jnp.clip(nb, 0, P - 1)
-            qi = perm[inb]
-            okq = (nb >= 0) & (nb < P) & on_light[qi] & (k_p[qi] == k_p)
-            d = lags - lags[qi]
+            q_lag = slag[inb]
+            q_kp = skp[inb]
+            okq = (nb >= 0) & (nb < P) & (q_kp == k_p)  # light + same pair
+            d = lags - q_lag
             ok = on_heavy & okq & (d > 0) & (d < diff_p)
-            return jnp.where(ok, jnp.abs(d - delta_p), big), qi
+            return jnp.where(ok, jnp.abs(d - delta_p), big)
 
-        err_a, q_a = neighbour(pos - 1)
-        err_b, q_b = neighbour(pos)
+        err_a = neighbour(pos - 1)
+        err_b = neighbour(pos)
         use_b = err_b < err_a
         err_pq = jnp.where(use_b, err_b, err_a)
-        q_of_p = jnp.where(use_b, q_b, q_a)
-        err_swap, p_swap = _segment_argmin(err_pq, seg_h, K, P)
-        q_swap = q_of_p[jnp.clip(p_swap, 0, P - 1)]
+        nb_of_p = jnp.where(use_b, pos, pos - 1)
+        err_swap, p_swap = segment_argmin_first(err_pq, seg_h, K, P)
+        nb_sel = jnp.clip(nb_of_p[jnp.clip(p_swap, 0, P - 1)], 0, P - 1)
+        q_swap = sidx[nb_sel]            # [K]
+        lag_q_swap = slag[nb_sel]        # [K], exact lag of q
 
         # Choose per pair; moves must keep the count spread <= 1.
         move_allowed = (counts[heavy] > counts[light]) & (err_move < big)
@@ -186,11 +232,13 @@ def refine_assignment(
 
         p_sel = jnp.where(use_move, p_move, p_swap)
         p_safe = jnp.clip(p_sel, 0, P - 1)
-        lag_q = jnp.where(use_swap, lags[jnp.clip(q_swap, 0, P - 1)], 0)
-        d = jnp.where(use_move, lags[p_safe], lags[p_safe] - lag_q)
+        lag_p_sel = lags[p_safe]  # [K]
+        lag_q = jnp.where(use_swap, lag_q_swap, 0)
+        d = jnp.where(use_move, lag_p_sel, lag_p_sel - lag_q)
         d = jnp.where(do, d, 0)
 
-        # Apply all exchanges at once (pairs are disjoint -> race-free).
+        # Apply all exchanges at once (pairs are disjoint -> race-free);
+        # K-sized scatters, cost proportional to the K updates.
         upd_p = jnp.where(do, p_sel, P)
         upd_q = jnp.where(use_swap, q_swap, P)
         new_choice = choice.at[upd_p].set(light, mode="drop")
@@ -198,9 +246,17 @@ def refine_assignment(
         new_totals = totals.at[heavy].add(-d).at[light].add(d)
         dc = use_move.astype(jnp.int32)
         new_counts = counts.at[heavy].add(-dc).at[light].add(dc)
-        return new_choice, new_totals, new_counts
+        peak_dropped = jnp.max(new_totals) < jnp.max(totals)
+        new_since = jnp.where(peak_dropped, 0, since + 1)
+        return it + 1, new_since, new_choice, new_totals, new_counts
 
-    choice, totals, counts = lax.fori_loop(
-        0, iters, body, (choice, totals0, counts0)
+    def cond(state):
+        it, since = state[0], state[1]
+        return (it < iters) & (since < patience)
+
+    _, _, choice, totals, counts = lax.while_loop(
+        cond,
+        body,
+        (jnp.int32(0), jnp.int32(0), choice, totals0, counts0),
     )
     return choice, counts, totals

@@ -1,0 +1,71 @@
+"""One-off probe of the tunneled TPU transport: RTT floor, transfer cost,
+compute cost, readback scaling.  Not part of the package; diagnostic only."""
+
+import time
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+print("devices:", jax.devices())
+
+
+def med(f, iters=10):
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        f()
+        ts.append((time.perf_counter() - t0) * 1000.0)
+    return float(np.median(ts)), float(np.min(ts))
+
+
+# 1. RTT floor: fetch freshly computed 4-byte scalar.
+x = jax.device_put(np.arange(1024, dtype=np.int32))
+f = jax.jit(lambda x: (x * 2 + 1).sum())
+float(f(x))
+m, mn = med(lambda: float(f(x)))
+print(f"scalar compute+readback: median {m:.2f} min {mn:.2f} ms")
+
+# 1b. readback of an ALREADY-computed scalar (no dispatch).
+r = f(x)
+r.block_until_ready()
+m, mn = med(lambda: float(r))
+print(f"resident scalar readback: median {m:.2f} min {mn:.2f} ms")
+
+# 2. block_until_ready without readback (dispatch + sync only).
+m, mn = med(lambda: f(x).block_until_ready())
+print(f"dispatch+sync no readback: median {m:.2f} min {mn:.2f} ms")
+
+# 3. host->device transfer of 100k int64 (the north-star lag vector).
+lags = np.random.randint(0, 1 << 40, size=100_000).astype(np.int64)
+m, mn = med(lambda: jax.device_put(lags).block_until_ready())
+print(f"h2d 800KB int64: median {m:.2f} min {mn:.2f} ms")
+
+# 4. d2h of int16[100k] (choice vector readback).
+g = jax.jit(lambda v: (v % 7).astype(np.int16))
+y = g(jax.device_put(lags))
+y.block_until_ready()
+m, mn = med(lambda: np.asarray(y))
+print(f"d2h 200KB int16 resident: median {m:.2f} min {mn:.2f} ms")
+
+# 5. empty dispatch round-trip: tiny jit, readback scalar, repeatedly.
+h = jax.jit(lambda s: s + 1)
+s = jax.device_put(np.int32(0))
+s = h(s)
+float(s)
+m, mn = med(lambda: float(h(s)))
+print(f"tiny dispatch+scalar readback: median {m:.2f} min {mn:.2f} ms")
+
+# 6. two back-to-back readbacks (does RTT pipeline?)
+r1, r2 = f(x), g(jax.device_put(lags))
+r1.block_until_ready(); r2.block_until_ready()
+def two():
+    a = f(x)
+    b = h(s)
+    float(a); float(b)
+m, mn = med(two)
+print(f"two dispatch+2 readbacks: median {m:.2f} min {mn:.2f} ms")

@@ -1,0 +1,123 @@
+"""Probe 2: transfer latency vs size, overlap behavior, north-star anatomy."""
+
+import time
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+print("devices:", jax.devices())
+
+
+def med(f, iters=8):
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        f()
+        ts.append((time.perf_counter() - t0) * 1000.0)
+    return float(np.median(ts)), float(np.min(ts))
+
+
+# Transfer latency vs size
+for n in (1, 1024, 10_240, 102_400, 1_024_000):
+    a = np.arange(n, dtype=np.int64)
+    m, mn = med(lambda a=a: jax.device_put(a).block_until_ready())
+    print(f"h2d int64[{n}] ({n*8/1024:.0f} KB): median {m:.2f} min {mn:.2f} ms")
+
+# d2h fresh (uncached) readback vs size: compute on device then fetch
+for n in (1024, 102_400, 1_024_000):
+    a = jax.device_put(np.arange(n, dtype=np.int64)).block_until_ready()
+    g = jax.jit(lambda v: v + 1)
+
+    def once(a=a, g=g):
+        r = g(a)
+        return np.asarray(r)
+
+    once()
+    m, mn = med(once)
+    print(f"dispatch+d2h int64[{n}]: median {m:.2f} min {mn:.2f} ms")
+
+# North-star anatomy with assign_stream
+import sys
+
+sys.path.insert(0, "/root/repo")
+from kafka_lag_based_assignor_tpu.ops.batched import (
+    _stream_device,
+    assign_stream,
+)
+from kafka_lag_based_assignor_tpu.ops.scan_kernel import pack_shift_for
+from kafka_lag_based_assignor_tpu.ops.packing import pad_bucket
+
+rng = np.random.default_rng(5)
+P, C = 100_000, 1000
+ranks = rng.permutation(P) + 1
+lags = (1000 * (P / ranks) ** (1.0 / 1.1)).astype(np.int64)
+shift = pack_shift_for(int(lags.max()), pad_bucket(P) - 1)
+
+# full path (numpy in, numpy out)
+m, mn = med(lambda: np.asarray(assign_stream(lags, num_consumers=C)))
+print(f"assign_stream e2e: median {m:.2f} min {mn:.2f} ms")
+
+# device-resident input, sync only (pure dispatch+compute, no h2d/d2h)
+dl = jax.device_put(lags).block_until_ready()
+m, mn = med(
+    lambda: _stream_device(
+        dl, num_consumers=C, pack_shift=shift
+    ).block_until_ready()
+)
+print(f"stream resident dispatch+sync: median {m:.2f} min {mn:.2f} ms")
+
+# resident input, with d2h readback
+def res_read():
+    r = _stream_device(dl, num_consumers=C, pack_shift=shift)
+    return np.asarray(r)
+
+res_read()
+m, mn = med(res_read)
+print(f"stream resident + readback: median {m:.2f} min {mn:.2f} ms")
+
+# h2d put followed by dispatch referencing it (two transport ops queued)
+def put_then_dispatch():
+    d = jax.device_put(lags)
+    r = _stream_device(d, num_consumers=C, pack_shift=shift)
+    return np.asarray(r)
+
+put_then_dispatch()
+m, mn = med(put_then_dispatch)
+print(f"explicit put + dispatch + readback: median {m:.2f} min {mn:.2f} ms")
+
+# pipelined steady state: issue epoch N+1 before reading epoch N
+def pipelined(iters=8):
+    res = []
+    r_prev = _stream_device(dl, num_consumers=C, pack_shift=shift)
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        r = _stream_device(dl, num_consumers=C, pack_shift=shift)
+        np.asarray(r_prev)
+        r_prev = r
+        ts.append((time.perf_counter() - t0) * 1000.0)
+    print(f"pipelined per-epoch (resident): median {np.median(ts):.2f} "
+          f"min {np.min(ts):.2f} ms")
+
+pipelined()
+
+
+# pipelined with fresh numpy input each epoch (the real streaming shape)
+def pipelined_np(iters=8):
+    r_prev = assign_stream(lags, num_consumers=C)
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        r = assign_stream(lags, num_consumers=C)
+        np.asarray(r_prev)
+        r_prev = r
+        ts.append((time.perf_counter() - t0) * 1000.0)
+    print(f"pipelined per-epoch (numpy in): median {np.median(ts):.2f} "
+          f"min {np.min(ts):.2f} ms")
+
+pipelined_np()

@@ -1,0 +1,99 @@
+"""Probe 3: separate device compute from transport via chained kernels."""
+
+import functools
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+import sys
+
+sys.path.insert(0, "/root/repo")
+from kafka_lag_based_assignor_tpu.ops.rounds_kernel import assign_topic_rounds
+from kafka_lag_based_assignor_tpu.ops.scan_kernel import pack_shift_for
+from kafka_lag_based_assignor_tpu.ops.packing import pad_bucket
+
+print("devices:", jax.devices())
+
+
+def med(f, iters=8):
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        f()
+        ts.append((time.perf_counter() - t0) * 1000.0)
+    return float(np.median(ts)), float(np.min(ts))
+
+
+rng = np.random.default_rng(5)
+P, C = 100_000, 1000
+ranks = rng.permutation(P) + 1
+lags = (1000 * (P / ranks) ** (1.0 / 1.1)).astype(np.int64)
+shift = pack_shift_for(int(lags.max()), pad_bucket(P) - 1)
+
+
+@functools.partial(jax.jit, static_argnames=("reps",))
+def chained(lags, reps: int):
+    P = lags.shape[0]
+    P_pad = pad_bucket(P)
+    pids = jnp.arange(P_pad, dtype=jnp.int32)
+    valid = pids < P
+
+    def body(i, carry):
+        lg, acc = carry
+        lags_p = jnp.pad(lg, (0, P_pad - P))
+        choice, _, _ = assign_topic_rounds(
+            lags_p, pids, valid, num_consumers=C, pack_shift=shift
+        )
+        c = choice[:P]
+        # dependency so iterations can't be collapsed
+        return lg + (c[0] - c[0]).astype(lg.dtype), acc + c
+
+    _, acc = jax.lax.fori_loop(
+        0, reps, body, (lags, jnp.zeros((P,), jnp.int32))
+    )
+    return acc.astype(jnp.int16)
+
+
+for reps in (1, 4):
+    f = lambda reps=reps: np.asarray(chained(lags, reps=reps))
+    f()
+    m, mn = med(f)
+    print(f"chained x{reps}: median {m:.2f} min {mn:.2f} ms")
+
+
+# trivial kernel, identical I/O shapes (int64[100k] in, int16[100k] out)
+@jax.jit
+def trivial(lags):
+    return (lags % 997).astype(jnp.int16)
+
+
+f = lambda: np.asarray(trivial(lags))
+f()
+m, mn = med(f)
+print(f"trivial same-IO e2e: median {m:.2f} min {mn:.2f} ms")
+
+
+# scalar-out trivial (transport floor with real input upload)
+@jax.jit
+def trivial_scalar(lags):
+    return lags.sum()
+
+
+f = lambda: float(trivial_scalar(lags))
+f()
+m, mn = med(f)
+print(f"trivial scalar-out e2e: median {m:.2f} min {mn:.2f} ms")
+
+# tiny-in tiny-out (pure dispatch floor, re-measured now)
+x = np.arange(1024, dtype=np.int32)
+g = jax.jit(lambda v: (v * 2 + 1).sum())
+float(g(x))
+m, mn = med(lambda: float(g(x)))
+print(f"tiny dispatch floor now: median {m:.2f} min {mn:.2f} ms")

@@ -1,0 +1,165 @@
+"""Probe: per-op device cost via chained fori_loop, e2e numpy in/out."""
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+print("devices:", jax.devices())
+
+P, C, K = 131072, 1000, 501
+
+
+def e2e(f, *args, iters=5):
+    f(*args)
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        np.asarray(f(*args))
+        ts.append((time.perf_counter() - t0) * 1000.0)
+    return float(np.median(ts))
+
+
+def slope(make, *args):
+    f1 = make(1)
+    f32 = make(33)
+    a = e2e(f1, *args)
+    b = e2e(f32, *args)
+    return (b - a) / 32.0, a
+
+
+rng = np.random.default_rng(0)
+keys32 = rng.integers(0, 1 << 31, size=P).astype(np.int32)
+vals64 = rng.integers(0, 1 << 60, size=P).astype(np.int64)
+seg = rng.integers(0, K + 1, size=P).astype(np.int32)
+idx = rng.permutation(P).astype(np.int32)
+
+
+def mk_argsort(n):
+    @jax.jit
+    def f(k):
+        def body(i, acc):
+            p = jnp.argsort(k + acc[0])
+            return p.astype(jnp.int32)
+
+        return lax.fori_loop(0, n, body, k * 0)[:1]
+
+    return f
+
+
+s, base = slope(mk_argsort, keys32)
+print(f"argsort int32[{P}]: {s:.2f} ms/op (base {base:.1f})")
+
+
+def mk_sort64(n):
+    @jax.jit
+    def f(v):
+        def body(i, acc):
+            return jnp.sort(v + acc[0]).astype(v.dtype)
+
+        return lax.fori_loop(0, n, body, v * 0)[:1]
+
+    return f
+
+
+s, base = slope(mk_sort64, vals64)
+print(f"sort int64[{P}]: {s:.2f} ms/op (base {base:.1f})")
+
+
+def mk_scatter_min(n):
+    @jax.jit
+    def f(v, seg):
+        def body(i, acc):
+            m = jnp.full((K + 1,), jnp.iinfo(v.dtype).max, v.dtype).at[
+                seg
+            ].min(v + acc[0])
+            return m
+
+        return lax.fori_loop(0, n, body, jnp.zeros(K + 1, vals64.dtype))[:1]
+
+    return f
+
+
+s, base = slope(mk_scatter_min, vals64, seg)
+print(f"scatter-min int64[{P}]->[{K+1}]: {s:.2f} ms/op (base {base:.1f})")
+
+
+def mk_scatter_set(n):
+    @jax.jit
+    def f(v, i32):
+        def body(i, acc):
+            return acc.at[i32].set(v + acc[0], mode="drop")
+
+        return lax.fori_loop(0, n, body, v * 0)[:1]
+
+    return f
+
+
+s, base = slope(mk_scatter_set, vals64, idx)
+print(f"scatter-set int64[{P}]: {s:.2f} ms/op (base {base:.1f})")
+
+
+def mk_gather(n):
+    @jax.jit
+    def f(v, i32):
+        def body(i, acc):
+            return (v + acc[0])[i32]
+
+        return lax.fori_loop(0, n, body, v * 0)[:1]
+
+    return f
+
+
+s, base = slope(mk_gather, vals64, idx)
+print(f"gather int64[{P}]: {s:.2f} ms/op (base {base:.1f})")
+
+
+def mk_searchsorted(method):
+    def mk(n):
+        @jax.jit
+        def f(k, q):
+            sk = jnp.sort(k)
+
+            def body(i, acc):
+                return jnp.searchsorted(
+                    sk, q + acc[0], method=method
+                ).astype(jnp.int32)
+
+            return lax.fori_loop(0, n, body, q * 0)[:1]
+
+        return f
+
+    return mk
+
+
+for method in ("scan", "sort"):
+    s, base = slope(mk_searchsorted(method), keys32, keys32)
+    print(f"searchsorted[{method}] [{P}]: {s:.2f} ms/op (base {base:.1f})")
+
+
+def mk_segmin_sortbased(n):
+    # segment argmin via ONE extra sort instead of scatter-min
+    @jax.jit
+    def f(v, seg):
+        def body(i, acc):
+            key = (seg.astype(jnp.int64) << 50) | ((v + acc[0]) >> 14)
+            sk = jnp.sort(key)
+            bound = jnp.searchsorted(
+                sk, jnp.arange(K + 1, dtype=jnp.int64) << 50, method="scan"
+            )
+            return bound.astype(jnp.int64)
+
+        return lax.fori_loop(0, n, body, jnp.zeros(K + 1, jnp.int64))[:1]
+
+    return f
+
+
+s, base = slope(mk_segmin_sortbased, vals64, seg)
+print(f"segmin via sort+searchsorted: {s:.2f} ms/op (base {base:.1f})")

@@ -1,0 +1,107 @@
+"""Probe: refine round cost anatomy on device."""
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+import sys
+
+sys.path.insert(0, "/root/repo")
+from kafka_lag_based_assignor_tpu.ops.refine import refine_assignment
+
+print("devices:", jax.devices())
+
+
+def med(f, iters=6):
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        f()
+        ts.append((time.perf_counter() - t0) * 1000.0)
+    return float(np.median(ts)), float(np.min(ts))
+
+
+rng = np.random.default_rng(0)
+P, C = 131072, 1000
+lags = rng.integers(0, 1 << 30, size=P).astype(np.int64)
+valid = np.ones(P, bool)
+choice = rng.integers(0, C, size=P).astype(np.int32)
+dl = jax.device_put(lags)
+dv = jax.device_put(valid)
+dc = jax.device_put(choice)
+jax.block_until_ready((dl, dv, dc))
+
+for it in (1, 2, 4, 8):
+    f = lambda it=it: jax.block_until_ready(
+        refine_assignment(dl, dv, dc, num_consumers=C, iters=it,
+                          patience=10_000)
+    )
+    f()
+    m, mn = med(f)
+    print(f"refine iters={it}: median {m:.2f} min {mn:.2f} ms")
+
+
+# micro: argsort int32[P], searchsorted scan vs sort, segment scatter-min
+keys = rng.integers(0, 1 << 31, size=P).astype(np.int32)
+dkeys = jax.device_put(keys)
+q = jax.device_put(rng.integers(0, 1 << 31, size=P).astype(np.int32))
+jax.block_until_ready((dkeys, q))
+
+f = jax.jit(lambda k: jnp.argsort(k))
+jax.block_until_ready(f(dkeys))
+m, mn = med(lambda: jax.block_until_ready(f(dkeys)))
+print(f"argsort int32[{P}]: median {m:.2f} min {mn:.2f} ms")
+
+sk = jax.block_until_ready(jax.jit(jnp.sort)(dkeys))
+for method in ("scan", "sort", "compare_all"):
+    try:
+        g = jax.jit(
+            lambda a, v, method=method: jnp.searchsorted(a, v, method=method)
+        )
+        jax.block_until_ready(g(sk, q))
+        m, mn = med(lambda: jax.block_until_ready(g(sk, q)))
+        print(f"searchsorted[{method}]: median {m:.2f} min {mn:.2f} ms")
+    except Exception as e:
+        print(f"searchsorted[{method}]: failed {type(e).__name__}")
+
+seg = jax.device_put(rng.integers(0, 501, size=P).astype(np.int32))
+score = jax.device_put(rng.integers(0, 1 << 60, size=P).astype(np.int64))
+jax.block_until_ready((seg, score))
+
+
+@jax.jit
+def segmin(score, seg):
+    minv = jnp.full((501 + 1,), jnp.iinfo(score.dtype).max,
+                    score.dtype).at[seg].min(score)
+    hit = (score == minv[seg]) & (seg < 501)
+    idx_cand = jnp.where(hit, jnp.arange(P, dtype=jnp.int32), P)
+    idx = jnp.full((501 + 1,), P, jnp.int32).at[seg].min(idx_cand)
+    return minv, idx
+
+
+jax.block_until_ready(segmin(score, seg))
+m, mn = med(lambda: jax.block_until_ready(segmin(score, seg)))
+print(f"segment argmin x1: median {m:.2f} min {mn:.2f} ms")
+
+
+# scatter set at[P-sized idx].set
+idx = jax.device_put(rng.permutation(P).astype(np.int32))
+vals = jax.device_put(rng.integers(0, C, size=P).astype(np.int32))
+jax.block_until_ready((idx, vals))
+h = jax.jit(lambda c, i, v: c.at[i].set(v, mode="drop"))
+jax.block_until_ready(h(dc, idx, vals))
+m, mn = med(lambda: jax.block_until_ready(h(dc, idx, vals)))
+print(f"scatter set [P]: median {m:.2f} min {mn:.2f} ms")
+
+# gather [P]
+g2 = jax.jit(lambda a, i: a[i])
+jax.block_until_ready(g2(dl, idx))
+m, mn = med(lambda: jax.block_until_ready(g2(dl, idx)))
+print(f"gather int64[P]: median {m:.2f} min {mn:.2f} ms")

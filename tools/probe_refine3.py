@@ -1,0 +1,48 @@
+"""Probe: e2e refine cost vs iters (numpy in, numpy out — the real pattern)."""
+
+import time
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+import sys
+
+sys.path.insert(0, "/root/repo")
+from kafka_lag_based_assignor_tpu.ops.refine import refine_assignment
+
+print("devices:", jax.devices())
+
+
+def med(f, iters=6):
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        f()
+        ts.append((time.perf_counter() - t0) * 1000.0)
+    return float(np.median(ts)), float(np.min(ts))
+
+
+rng = np.random.default_rng(0)
+
+for P, C in ((131072, 1000), (16384, 512)):
+    lags = rng.integers(0, 1 << 30, size=P).astype(np.int64)
+    valid = np.ones(P, bool)
+    # count-balanced start
+    choice = (rng.permutation(P) % C).astype(np.int32)
+    for it in (1, 16, 64):
+        def f(it=it):
+            c, _, t = refine_assignment(
+                lags, valid, choice, num_consumers=C, iters=it,
+                patience=10_000
+            )
+            return np.asarray(c), np.asarray(t)
+
+        f()
+        m, mn = med(f)
+        print(f"P={P} C={C} e2e refine iters={it}: "
+              f"median {m:.2f} min {mn:.2f} ms")
