@@ -1,0 +1,586 @@
+package com.github.lagassignor.tpu;
+
+import java.io.BufferedReader;
+import java.io.BufferedWriter;
+import java.io.IOException;
+import java.io.InputStreamReader;
+import java.io.OutputStreamWriter;
+import java.net.InetSocketAddress;
+import java.net.Socket;
+import java.nio.charset.StandardCharsets;
+import java.util.ArrayList;
+import java.util.Collections;
+import java.util.HashMap;
+import java.util.HashSet;
+import java.util.List;
+import java.util.Map;
+import java.util.Optional;
+import java.util.PriorityQueue;
+import java.util.Properties;
+import java.util.Set;
+import java.util.TreeMap;
+import java.util.TreeSet;
+
+import org.apache.kafka.clients.consumer.Consumer;
+import org.apache.kafka.clients.consumer.ConsumerConfig;
+import org.apache.kafka.clients.consumer.ConsumerPartitionAssignor;
+import org.apache.kafka.clients.consumer.KafkaConsumer;
+import org.apache.kafka.clients.consumer.OffsetAndMetadata;
+import org.apache.kafka.common.Cluster;
+import org.apache.kafka.common.Configurable;
+import org.apache.kafka.common.PartitionInfo;
+import org.apache.kafka.common.TopicPartition;
+import org.apache.kafka.common.serialization.ByteArrayDeserializer;
+import org.slf4j.Logger;
+import org.slf4j.LoggerFactory;
+
+/**
+ * JVM-side shim for the TPU lag-balanced partition assignor.
+ *
+ * <p>This class is the {@code partition.assignment.strategy} entry point the
+ * north star keeps on the JVM: Kafka instantiates it by reflection on every
+ * consumer, and on the elected group leader calls {@link #assign(Cluster,
+ * GroupSubscription)} during a rebalance.  It keeps the host-side
+ * responsibilities of the reference assignor — group bookkeeping and the
+ * offset/lag broker RPCs (reference LagBasedPartitionAssignor.java:317-365) —
+ * and marshals only the pure combinatorial core across a process boundary to
+ * the co-located TPU sidecar ({@code python -m
+ * kafka_lag_based_assignor_tpu.service}), which runs the batched JAX solve
+ * and returns the member→partitions map.
+ *
+ * <p>Wire protocol: newline-delimited JSON over TCP, one request per line —
+ * see the sidecar module docstring (service.py) and the golden conformance
+ * fixtures in {@code tests/fixtures/wire_conformance.jsonl}, which pin the
+ * exact request/response byte shapes this class must produce/consume (they
+ * are exercised against the Python service by tests/test_service.py, so the
+ * protocol cannot drift without a test failing).
+ *
+ * <p>Failure model: if the sidecar is unreachable, times out, or answers
+ * with an error, the shim falls back to a local greedy solve with identical
+ * semantics (count-primary, lag-secondary, member-id tiebreak — reference
+ * :246-259), so a rebalance never fails because of the accelerator.  This
+ * mirrors the Python framework's watchdog + host-fallback design
+ * (utils/watchdog.py, SURVEY §5 failure row).
+ *
+ * <p>Configuration (all via the consumer config map, reference-compatible):
+ * <ul>
+ *   <li>{@code group.id} — required (reference :107-113 fails fast).</li>
+ *   <li>{@code auto.offset.reset} — no-committed-offset fallback mode
+ *       (reference :346-347; default {@code latest}).</li>
+ *   <li>{@code tpu.assignor.sidecar.host} / {@code .port} — sidecar address
+ *       (default 127.0.0.1:7531).</li>
+ *   <li>{@code tpu.assignor.sidecar.timeout.ms} — socket/solve timeout
+ *       (default 120000, covering a cold first-compile).</li>
+ *   <li>{@code tpu.assignor.solver} — {@code rounds} (default), {@code scan},
+ *       {@code global}, {@code sinkhorn}, or {@code host}.</li>
+ * </ul>
+ */
+public class TpuLagBasedPartitionAssignor
+        implements ConsumerPartitionAssignor, Configurable {
+
+    private static final Logger LOG =
+            LoggerFactory.getLogger(TpuLagBasedPartitionAssignor.class);
+
+    public static final String PROTOCOL_NAME = "lag";
+    public static final String SIDECAR_HOST_CONFIG =
+            "tpu.assignor.sidecar.host";
+    public static final String SIDECAR_PORT_CONFIG =
+            "tpu.assignor.sidecar.port";
+    public static final String SIDECAR_TIMEOUT_MS_CONFIG =
+            "tpu.assignor.sidecar.timeout.ms";
+    public static final String SOLVER_CONFIG = "tpu.assignor.solver";
+
+    private Properties consumerGroupProps;
+    private Properties metadataConsumerProps;
+    private Consumer<byte[], byte[]> metadataConsumer;
+
+    private String sidecarHost = "127.0.0.1";
+    private int sidecarPort = 7531;
+    private int sidecarTimeoutMs = 120_000;
+    private String solver = "rounds";
+    private long requestId = 0;
+
+    // ------------------------------------------------------------------
+    // Configurable
+    // ------------------------------------------------------------------
+
+    @Override
+    public void configure(Map<String, ?> configs) {
+        consumerGroupProps = new Properties();
+        for (Map.Entry<String, ?> e : configs.entrySet()) {
+            if (e.getValue() != null) {
+                consumerGroupProps.put(e.getKey(), e.getValue().toString());
+            }
+        }
+        String groupId =
+                consumerGroupProps.getProperty(ConsumerConfig.GROUP_ID_CONFIG);
+        if (groupId == null || groupId.isEmpty()) {
+            // Reference :107-113: the assignor is useless without the group
+            // whose committed offsets define lag.
+            throw new IllegalArgumentException(
+                    PROTOCOL_NAME + " assignor requires " +
+                    ConsumerConfig.GROUP_ID_CONFIG + " to be configured");
+        }
+        // Derived metadata-consumer config (reference :116-120): never
+        // auto-commit on the probe consumer, and tag its client.id.
+        metadataConsumerProps = new Properties();
+        metadataConsumerProps.putAll(consumerGroupProps);
+        metadataConsumerProps.put(
+                ConsumerConfig.ENABLE_AUTO_COMMIT_CONFIG, "false");
+        metadataConsumerProps.put(
+                ConsumerConfig.CLIENT_ID_CONFIG, groupId + ".assignor");
+
+        sidecarHost = consumerGroupProps.getProperty(
+                SIDECAR_HOST_CONFIG, sidecarHost);
+        sidecarPort = Integer.parseInt(consumerGroupProps.getProperty(
+                SIDECAR_PORT_CONFIG, Integer.toString(sidecarPort)));
+        sidecarTimeoutMs = Integer.parseInt(consumerGroupProps.getProperty(
+                SIDECAR_TIMEOUT_MS_CONFIG,
+                Integer.toString(sidecarTimeoutMs)));
+        solver = consumerGroupProps.getProperty(SOLVER_CONFIG, solver);
+        LOG.debug("configured {} assignor: sidecar {}:{} solver {}",
+                PROTOCOL_NAME, sidecarHost, sidecarPort, solver);
+    }
+
+    // ------------------------------------------------------------------
+    // ConsumerPartitionAssignor
+    // ------------------------------------------------------------------
+
+    @Override
+    public String name() {
+        return PROTOCOL_NAME;  // the JoinGroup protocol name (reference :132)
+    }
+
+    @Override
+    public GroupAssignment assign(Cluster metadata,
+                                  GroupSubscription groupSubscription) {
+        Map<String, Subscription> subscriptions =
+                groupSubscription.groupSubscription();
+
+        // member -> subscribed topics; union of all topics (reference
+        // :140-146).  TreeMap/TreeSet for deterministic JSON ordering.
+        Map<String, List<String>> memberTopics = new TreeMap<>();
+        Set<String> allTopics = new TreeSet<>();
+        for (Map.Entry<String, Subscription> e : subscriptions.entrySet()) {
+            List<String> topics = new ArrayList<>(new TreeSet<>(
+                    e.getValue().topics()));
+            memberTopics.put(e.getKey(), topics);
+            allTopics.addAll(topics);
+        }
+
+        Map<String, List<long[]>> topicLags =
+                readTopicPartitionLags(metadata, allTopics);
+
+        Map<String, List<TopicPartition>> assignment;
+        try {
+            assignment = sidecarAssign(topicLags, memberTopics);
+        } catch (Exception ex) {
+            LOG.warn("TPU sidecar assign failed; falling back to local "
+                    + "greedy", ex);
+            assignment = localGreedyAssign(topicLags, memberTopics);
+        }
+
+        Map<String, Assignment> result = new HashMap<>();
+        for (String member : subscriptions.keySet()) {
+            // Every member appears in the result, possibly empty
+            // (reference :171-174).
+            result.put(member, new Assignment(assignment.getOrDefault(
+                    member, Collections.emptyList())));
+        }
+        return new GroupAssignment(result);
+    }
+
+    // ------------------------------------------------------------------
+    // Lag acquisition (stays JVM-side; reference :317-404 semantics)
+    // ------------------------------------------------------------------
+
+    /** topic -> [[partition, lag], ...] using three batch RPCs per topic. */
+    private Map<String, List<long[]>> readTopicPartitionLags(
+            Cluster metadata, Set<String> topics) {
+        if (metadataConsumer == null) {
+            // Lazy shared probe consumer, never closed (reference :322-324).
+            metadataConsumer = createMetadataConsumer();
+        }
+        String resetMode = consumerGroupProps.getProperty(
+                ConsumerConfig.AUTO_OFFSET_RESET_CONFIG, "latest");
+        Map<String, List<long[]>> out = new TreeMap<>();
+        for (String topic : topics) {
+            List<PartitionInfo> infos = metadata.partitionsForTopic(topic);
+            if (infos == null || infos.isEmpty()) {
+                // Tolerated fault: warn and skip (reference :358-360).
+                LOG.warn("skipping topic {}: no partition metadata", topic);
+                continue;
+            }
+            List<TopicPartition> tps = new ArrayList<>(infos.size());
+            for (PartitionInfo info : infos) {
+                tps.add(new TopicPartition(topic, info.partition()));
+            }
+            // The network boundary (reference :339-342).  No try/catch: an
+            // RPC failure aborts the rebalance and Kafka retries
+            // (reference behavior, SURVEY §2.4.9).
+            Map<TopicPartition, Long> begin =
+                    metadataConsumer.beginningOffsets(tps);
+            Map<TopicPartition, Long> end = metadataConsumer.endOffsets(tps);
+            Map<TopicPartition, OffsetAndMetadata> committed =
+                    metadataConsumer.committed(new HashSet<>(tps));
+            List<long[]> rows = new ArrayList<>(tps.size());
+            for (TopicPartition tp : tps) {
+                long lag = computePartitionLag(
+                        Optional.ofNullable(committed.get(tp))
+                                .map(OffsetAndMetadata::offset),
+                        begin.getOrDefault(tp, 0L),
+                        end.getOrDefault(tp, 0L),
+                        resetMode);
+                rows.add(new long[] {tp.partition(), lag});
+            }
+            rows.sort((a, b) -> Long.compare(a[0], b[0]));
+            out.put(topic, rows);
+        }
+        return out;
+    }
+
+    /**
+     * The exact lag formula (reference :376-404): committed offset if
+     * present; otherwise {@code latest} ⇒ end offset (lag 0), any other
+     * reset mode ⇒ beginning offset (full backlog); clamped at 0 to guard
+     * failed end-offset reads.
+     */
+    static long computePartitionLag(Optional<Long> committed, long begin,
+                                    long end, String resetMode) {
+        long next = committed.orElseGet(
+                () -> "latest".equals(resetMode) ? end : begin);
+        return Math.max(end - next, 0L);
+    }
+
+    protected Consumer<byte[], byte[]> createMetadataConsumer() {
+        return new KafkaConsumer<>(metadataConsumerProps,
+                new ByteArrayDeserializer(), new ByteArrayDeserializer());
+    }
+
+    // ------------------------------------------------------------------
+    // Sidecar wire protocol (pinned by tests/fixtures/wire_conformance.jsonl)
+    // ------------------------------------------------------------------
+
+    private Map<String, List<TopicPartition>> sidecarAssign(
+            Map<String, List<long[]>> topicLags,
+            Map<String, List<String>> memberTopics) throws IOException {
+        StringBuilder sb = new StringBuilder(1 << 16);
+        sb.append("{\"id\": ").append(++requestId)
+          .append(", \"method\": \"assign\", \"params\": {\"topics\": {");
+        boolean firstTopic = true;
+        for (Map.Entry<String, List<long[]>> e : topicLags.entrySet()) {
+            if (!firstTopic) sb.append(", ");
+            firstTopic = false;
+            Json.writeString(sb, e.getKey());
+            sb.append(": [");
+            for (int i = 0; i < e.getValue().size(); i++) {
+                long[] row = e.getValue().get(i);
+                if (i > 0) sb.append(", ");
+                sb.append('[').append(row[0]).append(", ").append(row[1])
+                  .append(']');
+            }
+            sb.append(']');
+        }
+        sb.append("}, \"subscriptions\": {");
+        boolean firstMember = true;
+        for (Map.Entry<String, List<String>> e : memberTopics.entrySet()) {
+            if (!firstMember) sb.append(", ");
+            firstMember = false;
+            Json.writeString(sb, e.getKey());
+            sb.append(": [");
+            for (int i = 0; i < e.getValue().size(); i++) {
+                if (i > 0) sb.append(", ");
+                Json.writeString(sb, e.getValue().get(i));
+            }
+            sb.append(']');
+        }
+        sb.append("}, \"solver\": ");
+        Json.writeString(sb, solver);
+        sb.append("}}");
+
+        String responseLine = roundTrip(sb.toString());
+        Object parsed = Json.parse(responseLine);
+        Map<?, ?> response = (Map<?, ?>) parsed;
+        Object error = response.get("error");
+        if (error != null) {
+            throw new IOException("sidecar error: "
+                    + ((Map<?, ?>) error).get("message"));
+        }
+        Map<?, ?> result = (Map<?, ?>) response.get("result");
+        Map<?, ?> assignments = (Map<?, ?>) result.get("assignments");
+        Map<String, List<TopicPartition>> out = new HashMap<>();
+        for (Map.Entry<?, ?> e : assignments.entrySet()) {
+            List<TopicPartition> tps = new ArrayList<>();
+            for (Object pair : (List<?>) e.getValue()) {
+                List<?> tp = (List<?>) pair;
+                tps.add(new TopicPartition((String) tp.get(0),
+                        ((Number) tp.get(1)).intValue()));
+            }
+            out.put((String) e.getKey(), tps);
+        }
+        return out;
+    }
+
+    private String roundTrip(String requestLine) throws IOException {
+        try (Socket socket = new Socket()) {
+            socket.connect(new InetSocketAddress(sidecarHost, sidecarPort),
+                    sidecarTimeoutMs);
+            socket.setSoTimeout(sidecarTimeoutMs);
+            BufferedWriter writer = new BufferedWriter(new OutputStreamWriter(
+                    socket.getOutputStream(), StandardCharsets.UTF_8));
+            BufferedReader reader = new BufferedReader(new InputStreamReader(
+                    socket.getInputStream(), StandardCharsets.UTF_8));
+            writer.write(requestLine);
+            writer.write('\n');
+            writer.flush();
+            String line = reader.readLine();
+            if (line == null) {
+                throw new IOException("sidecar closed the connection");
+            }
+            return line;
+        }
+    }
+
+    // ------------------------------------------------------------------
+    // Local greedy fallback — identical semantics to the sidecar's host
+    // solver (count primary, total lag secondary, member id tiebreak;
+    // reference :227-266) as an O(P log C) heap loop.
+    // ------------------------------------------------------------------
+
+    static Map<String, List<TopicPartition>> localGreedyAssign(
+            Map<String, List<long[]>> topicLags,
+            Map<String, List<String>> memberTopics) {
+        Map<String, List<TopicPartition>> out = new HashMap<>();
+        for (String member : memberTopics.keySet()) {
+            out.put(member, new ArrayList<>());
+        }
+        // topic -> subscribed members, sorted for the id tiebreak.
+        Map<String, List<String>> consumersPerTopic = new TreeMap<>();
+        for (Map.Entry<String, List<String>> e : memberTopics.entrySet()) {
+            for (String topic : e.getValue()) {
+                consumersPerTopic
+                        .computeIfAbsent(topic, t -> new ArrayList<>())
+                        .add(e.getKey());
+            }
+        }
+        for (Map.Entry<String, List<String>> e
+                : consumersPerTopic.entrySet()) {
+            String topic = e.getKey();
+            List<long[]> rows = topicLags.get(topic);
+            List<String> members = e.getValue();
+            if (rows == null || rows.isEmpty() || members.isEmpty()) {
+                continue;
+            }
+            Collections.sort(members);
+            // Partitions in descending lag, ties ascending partition id
+            // (reference :228-235).
+            List<long[]> sorted = new ArrayList<>(rows);
+            sorted.sort((a, b) -> a[1] != b[1]
+                    ? Long.compare(b[1], a[1]) : Long.compare(a[0], b[0]));
+            // Heap entries: {count, totalLag, memberRank}.  Pop-min /
+            // push-back reproduces the reference's linear min scan
+            // (:240-263) at O(P log C).
+            PriorityQueue<long[]> heap = new PriorityQueue<>((a, b) -> {
+                if (a[0] != b[0]) return Long.compare(a[0], b[0]);
+                if (a[1] != b[1]) return Long.compare(a[1], b[1]);
+                return Long.compare(a[2], b[2]);
+            });
+            for (int rank = 0; rank < members.size(); rank++) {
+                heap.add(new long[] {0, 0, rank});
+            }
+            for (long[] row : sorted) {
+                long[] top = heap.poll();
+                out.get(members.get((int) top[2]))
+                        .add(new TopicPartition(topic, (int) row[0]));
+                top[0] += 1;
+                top[1] += row[1];
+                heap.add(top);
+            }
+        }
+        return out;
+    }
+
+    // ------------------------------------------------------------------
+    // Minimal dependency-free JSON: a writer for strings and a
+    // recursive-descent parser covering exactly the protocol's value set
+    // (objects, arrays, strings, numbers, booleans, null).
+    // ------------------------------------------------------------------
+
+    static final class Json {
+        private final String s;
+        private int pos;
+
+        private Json(String s) {
+            this.s = s;
+        }
+
+        static void writeString(StringBuilder sb, String value) {
+            sb.append('"');
+            for (int i = 0; i < value.length(); i++) {
+                char c = value.charAt(i);
+                switch (c) {
+                    case '"': sb.append("\\\""); break;
+                    case '\\': sb.append("\\\\"); break;
+                    case '\n': sb.append("\\n"); break;
+                    case '\r': sb.append("\\r"); break;
+                    case '\t': sb.append("\\t"); break;
+                    default:
+                        if (c < 0x20) {
+                            sb.append(String.format("\\u%04x", (int) c));
+                        } else {
+                            sb.append(c);
+                        }
+                }
+            }
+            sb.append('"');
+        }
+
+        static Object parse(String text) {
+            Json p = new Json(text);
+            Object value = p.parseValue();
+            p.skipWhitespace();
+            if (p.pos != text.length()) {
+                throw new IllegalArgumentException(
+                        "trailing JSON content at " + p.pos);
+            }
+            return value;
+        }
+
+        private Object parseValue() {
+            skipWhitespace();
+            char c = peek();
+            if (c == '{') return parseObject();
+            if (c == '[') return parseArray();
+            if (c == '"') return parseString();
+            if (c == 't' || c == 'f') return parseBoolean();
+            if (c == 'n') { expect("null"); return null; }
+            return parseNumber();
+        }
+
+        private Map<String, Object> parseObject() {
+            Map<String, Object> out = new HashMap<>();
+            expectChar('{');
+            skipWhitespace();
+            if (peek() == '}') { pos++; return out; }
+            while (true) {
+                skipWhitespace();
+                String key = parseString();
+                skipWhitespace();
+                expectChar(':');
+                out.put(key, parseValue());
+                skipWhitespace();
+                char c = next();
+                if (c == '}') return out;
+                if (c != ',') {
+                    throw new IllegalArgumentException(
+                            "expected ',' or '}' at " + (pos - 1));
+                }
+            }
+        }
+
+        private List<Object> parseArray() {
+            List<Object> out = new ArrayList<>();
+            expectChar('[');
+            skipWhitespace();
+            if (peek() == ']') { pos++; return out; }
+            while (true) {
+                out.add(parseValue());
+                skipWhitespace();
+                char c = next();
+                if (c == ']') return out;
+                if (c != ',') {
+                    throw new IllegalArgumentException(
+                            "expected ',' or ']' at " + (pos - 1));
+                }
+            }
+        }
+
+        private String parseString() {
+            expectChar('"');
+            StringBuilder sb = new StringBuilder();
+            while (true) {
+                char c = next();
+                if (c == '"') return sb.toString();
+                if (c == '\\') {
+                    char esc = next();
+                    switch (esc) {
+                        case '"': sb.append('"'); break;
+                        case '\\': sb.append('\\'); break;
+                        case '/': sb.append('/'); break;
+                        case 'n': sb.append('\n'); break;
+                        case 'r': sb.append('\r'); break;
+                        case 't': sb.append('\t'); break;
+                        case 'b': sb.append('\b'); break;
+                        case 'f': sb.append('\f'); break;
+                        case 'u':
+                            sb.append((char) Integer.parseInt(
+                                    s.substring(pos, pos + 4), 16));
+                            pos += 4;
+                            break;
+                        default:
+                            throw new IllegalArgumentException(
+                                    "bad escape \\" + esc);
+                    }
+                } else {
+                    sb.append(c);
+                }
+            }
+        }
+
+        private Object parseNumber() {
+            int start = pos;
+            while (pos < s.length()
+                    && "+-0123456789.eE".indexOf(s.charAt(pos)) >= 0) {
+                pos++;
+            }
+            String token = s.substring(start, pos);
+            if (token.indexOf('.') >= 0 || token.indexOf('e') >= 0
+                    || token.indexOf('E') >= 0) {
+                return Double.parseDouble(token);
+            }
+            return Long.parseLong(token);
+        }
+
+        private Boolean parseBoolean() {
+            if (peek() == 't') { expect("true"); return Boolean.TRUE; }
+            expect("false");
+            return Boolean.FALSE;
+        }
+
+        private void expect(String literal) {
+            if (!s.startsWith(literal, pos)) {
+                throw new IllegalArgumentException(
+                        "expected '" + literal + "' at " + pos);
+            }
+            pos += literal.length();
+        }
+
+        private void expectChar(char c) {
+            if (next() != c) {
+                throw new IllegalArgumentException(
+                        "expected '" + c + "' at " + (pos - 1));
+            }
+        }
+
+        private void skipWhitespace() {
+            while (pos < s.length()
+                    && Character.isWhitespace(s.charAt(pos))) {
+                pos++;
+            }
+        }
+
+        private char peek() {
+            if (pos >= s.length()) {
+                throw new IllegalArgumentException("unexpected end of JSON");
+            }
+            return s.charAt(pos);
+        }
+
+        private char next() {
+            char c = peek();
+            pos++;
+            return c;
+        }
+    }
+
+}
