@@ -84,18 +84,46 @@ _AUTO_REFINE_PARALLEL = 96
 
 def _scale_np(lags: np.ndarray, valid: np.ndarray, C: int) -> float:
     """Host half of THE scale definition: ideal per-consumer load
-    ``max(total valid lag, 1) / C``.  Must stay the same formula as
-    :func:`_scaled_ws` (the traced half) — the dedup identity requires the
-    host-aggregated ``ws_u`` and the traced per-row ``ws`` to describe the
-    same normalization (pinned by test_plan_stats.py)."""
+    ``max(total valid lag, 1) / C``.  Must stay the same formula AND the
+    same accumulation dtype as :func:`_scaled_ws` (the traced half) — the
+    dedup identity requires the host-aggregated ``ws_u`` and the traced
+    per-row ``ws`` to describe the same normalization (pinned by
+    test_plan_stats.py).  Both halves accumulate the total in float64
+    (numpy's int64 sum here; an f64 ``jnp.sum`` there — x64 mode is
+    mandatory, ops/dispatch.ensure_x64) and divide in float64 before the
+    final f32 cast: bit-identical whenever the total lag stays below
+    2^53 (every f64 partial sum is then exact regardless of XLA's
+    reduction order), and within one f64 reduction rounding beyond —
+    versus wholesale f32-accumulation drift before this was unified."""
     return max(float(lags[valid].sum()), 1.0) / C
 
 
 def _scaled_ws(lags: jax.Array, valid: jax.Array, C: int) -> jax.Array:
     """Traced half of THE scale definition (see :func:`_scale_np`):
-    f32 per-row scaled lags, invalid rows 0."""
-    w = jnp.where(valid, lags, 0).astype(jnp.float32)
-    return w / (jnp.maximum(jnp.sum(w), 1.0) / C)
+    f32 per-row scaled lags, invalid rows 0.  The sum/divide run in f64 to
+    match the host half's accumulation exactly."""
+    w = jnp.where(valid, lags, 0).astype(jnp.float64)
+    scale = jnp.maximum(jnp.sum(w), 1.0) / C
+    return (w / scale).astype(jnp.float32)
+
+
+def _require_concrete(lags, valid, caller: str) -> None:
+    """Enforce the HOST-ONLY input contract of the public Sinkhorn entry
+    points: the dedup aggregation (:func:`_dedup_weights`) runs in numpy on
+    concrete values, so these functions cannot be called with tracers —
+    i.e. from inside ``jit``/``vmap``/``grad``.  Without this check a
+    traced call fails deep inside ``np.unique`` with an opaque
+    TracerArrayConversionError; with it, the contract violation is named
+    at the boundary."""
+    for name, x in (("lags", lags), ("valid", valid)):
+        if isinstance(x, jax.core.Tracer):
+            raise TypeError(
+                f"{caller} is host-only (its dedup pre-pass runs in numpy) "
+                f"and was called under a JAX trace with {name}= a tracer; "
+                "call it outside jit, or use the jitted inner "
+                "_assign_topic_sinkhorn_jit with host-prepared dedup "
+                "weights instead"
+            )
 
 
 def _dedup_weights(lags: np.ndarray, valid: np.ndarray, C: int):
@@ -134,6 +162,10 @@ def sinkhorn_duals(
 ):
     """Run the implicit-plan iteration; returns ``(A, B, ws)``.
 
+    HOST-ONLY: ``lags``/``valid`` must be concrete arrays (numpy or
+    committed jax arrays), never tracers — the dedup pre-pass runs in
+    numpy (enforced by :func:`_require_concrete`).
+
     ``A``/``B`` are the f32[C] state vectors of the rank-structured
     log-plan; ``ws`` the f32[P] scaled lags (lag / ideal-per-consumer-load).
     Plan rows can be materialized on demand with
@@ -143,6 +175,7 @@ def sinkhorn_duals(
     # probe could not execute (a lowering failure would abort the compile
     # with no fallback, see plan_stats._pallas_available).
     _pallas_available()
+    _require_concrete(lags, valid, "sinkhorn_duals")
     lags_np = np.asarray(lags)
     valid_np = np.asarray(valid)
     C = int(num_consumers)
@@ -280,6 +313,9 @@ def assign_topic_sinkhorn(
 ):
     """Integral, count-balanced assignment from the implicit Sinkhorn plan.
 
+    HOST-ONLY entry point (see :func:`_require_concrete`): the dedup
+    pre-pass runs in numpy, so this cannot be called under a JAX trace.
+
     Rounding (path chosen by size, ``_SCAN_ROUNDING_MAX_P``): partitions in
     descending-lag order pick the *least-loaded* open consumer (capacity
     floor/ceil(n/C)) with the plan row as a continuous tie-break bonus —
@@ -301,6 +337,7 @@ def assign_topic_sinkhorn(
     order, counts int32[C], totals[C]).
     """
     _pallas_available()  # resolve kernel choice eagerly, outside the trace
+    _require_concrete(lags, valid, "assign_topic_sinkhorn")
     C = int(num_consumers)
     ws_u, count_u, wsum_u = _dedup_weights(
         np.asarray(lags), np.asarray(valid), C

@@ -15,7 +15,8 @@ tie-break reproduces the reference's member-id string compare (:259).
 
 from __future__ import annotations
 
-from typing import Dict, List, Mapping, Sequence
+import logging
+from typing import Dict, List, Mapping, Sequence, Tuple
 
 import numpy as np
 
@@ -27,6 +28,31 @@ from .batched import assign_batched_rounds, assign_batched_scan
 from .packing import TopicGroup, build_groups, pad_bucket
 from .rounds_kernel import assign_global_rounds
 from .scan_kernel import pack_shift_for
+
+LOGGER = logging.getLogger(__name__)
+
+# Last pack_shift seen per (kernel, T, P, C) call signature: pack_shift is a
+# STATIC jit argument derived from the inputs' value ranges, so a lag
+# magnitude drifting across the packing bound silently triggers a fresh XLA
+# compile (tens of seconds on a remote-compile transport).  The flip itself
+# is correct — both shift values produce identical assignments — but it
+# must be observable, and deployments that can see both ranges should warm
+# both variants (warmup.warmup's stream job compiles the narrow- and
+# wide-lag variants for exactly this reason).
+_LAST_PACK_SHIFT: Dict[Tuple, int] = {}
+
+
+def observe_pack_shift(key: Tuple, shift: int) -> None:
+    """INFO-log pack_shift changes per call signature (recompile signal)."""
+    prev = _LAST_PACK_SHIFT.get(key)
+    if prev is not None and prev != shift:
+        LOGGER.info(
+            "pack_shift for %s changed %d -> %d (input value ranges "
+            "drifted): this solve compiles a fresh executable unless the "
+            "variant was warmed (see warmup.warmup)",
+            key, prev, shift,
+        )
+    _LAST_PACK_SHIFT[key] = shift
 
 # "global" returns a single [C] totals vector (cross-topic) instead of
 # [T, C]; choice/counts contracts are identical across all three.
@@ -93,10 +119,14 @@ def assign_group_device(group: TopicGroup, kernel: str = "rounds"):
         max_pid = (
             int(group.partition_ids.max()) if group.partition_ids.size else 0
         )
+        shift = pack_shift_for(max_lag, max_pid)
+        observe_pack_shift(
+            (kernel, group.lags.shape, group.num_consumers), shift
+        )
         return kernel_fn(
             group.lags, group.partition_ids, group.valid,
             num_consumers=group.num_consumers,
-            pack_shift=pack_shift_for(max_lag, max_pid),
+            pack_shift=shift,
         )
     return kernel_fn(
         group.lags, group.partition_ids, group.valid,

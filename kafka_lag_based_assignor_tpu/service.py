@@ -35,7 +35,12 @@ answered with an error and drained without buffering.  ``params.options``
 accepts only ``sinkhorn_iters`` (int, 1..4096) and ``refine_iters`` (int,
 0..65536) — these become static jit arguments, so every distinct value
 compiles a fresh executable; out-of-range or non-integer values are
-rejected as client errors, never silently downgraded to a host fallback.
+rejected as client errors, never silently downgraded to a host fallback,
+and accepted values are quantized to a power of two (``sinkhorn_iters``
+up — a quality floor; ``refine_iters`` down — a churn ceiling) so a
+value-cycling client cannot force unbounded compiles; the effective
+values are echoed in the response's ``options`` field (see
+``_OPTION_BOUNDS``).
 """
 
 from __future__ import annotations
@@ -67,8 +72,27 @@ MAX_LINE_BYTES = 16 * 1024 * 1024
 # arguments downstream — every distinct value costs a fresh XLA compile
 # (tens of seconds on this image) — so unknown keys, non-integers, and
 # out-of-range values are client errors at the wire boundary, not inputs
-# to the solve path.
+# to the solve path.  In-range values are additionally QUANTIZED to a
+# power of two (0 stays 0): without quantization a client cycling
+# in-range values could force an unbounded number of distinct compiles
+# (each cached forever in-process); with it the compile count per key is
+# bounded by ~log2(max) executables.  The rounding DIRECTION respects
+# what each option promises the client: ``sinkhorn_iters`` is a quality
+# floor, so it rounds UP (never less quality than asked); ``refine_iters``
+# is the exchange budget whose contract is "churn bounded by 2x this
+# value" (ops/refine.py), so it rounds DOWN (never more churn than the
+# client permitted).  The effective values are echoed in the response's
+# ``options`` field so the substitution is visible on the wire.
 _OPTION_BOUNDS = {"sinkhorn_iters": (1, 4096), "refine_iters": (0, 65536)}
+_OPTION_ROUNDS_UP = {"sinkhorn_iters": True, "refine_iters": False}
+
+
+def _quantize_pow2(value: int, up: bool) -> int:
+    if value == 0:
+        return 0
+    if up:
+        return 1 << (value - 1).bit_length()
+    return 1 << (value.bit_length() - 1)
 
 
 def _validate_options(options: Any) -> Dict[str, int]:
@@ -88,7 +112,7 @@ def _validate_options(options: Any) -> Dict[str, int]:
             raise ValueError(
                 f"option {key}={value} out of range [{lo}, {hi}]"
             )
-        out[key] = value
+        out[key] = _quantize_pow2(value, _OPTION_ROUNDS_UP[key])
     return out
 
 
@@ -196,6 +220,12 @@ class AssignorService:
         # enough for a cold first-rebalance XLA compile (~40 s/shape).
         solve_timeout_s: Optional[float] = 120.0,
         host_fallback: bool = True,
+        # (max_partitions, num_consumers) pairs to pre-compile at startup
+        # (VERDICT r3 item 6): without this, a cold sidecar's FIRST assign
+        # burns the XLA compile (~40 s/shape through this image's tunnel)
+        # inside the rebalance deadline.  ``start()`` runs the warm-up
+        # before the accept loop begins serving.
+        warmup_shapes: Optional[List[Tuple[int, int]]] = None,
     ):
         self._tcp = socketserver.ThreadingTCPServer(
             (host, port), _Handler, bind_and_activate=True
@@ -205,6 +235,7 @@ class AssignorService:
         self._thread: Optional[threading.Thread] = None
         self._watchdog = Watchdog(solve_timeout_s)
         self._host_fallback = host_fallback
+        self._warmup_shapes = list(warmup_shapes or [])
         self._counter_lock = threading.Lock()
         self.requests_served = 0
         self.errors = 0
@@ -264,6 +295,9 @@ class AssignorService:
                 result = {
                     "assignments": assignments,
                     "stats": json.loads(stats.to_json()),
+                    # Effective (quantized) option values actually used —
+                    # a client can see any pow2 substitution on the wire.
+                    "options": options,
                 }
             else:
                 raise ValueError(f"unknown method {method!r}")
@@ -281,6 +315,13 @@ class AssignorService:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "AssignorService":
+        if self._warmup_shapes:
+            # Pre-compile before serving: connections arriving meanwhile
+            # queue in the TCP backlog and are answered once warm.
+            from .warmup import warmup
+
+            for max_p, consumers in self._warmup_shapes:
+                warmup(max_partitions=max_p, consumers=[consumers])
         self._thread = threading.Thread(
             target=self._tcp.serve_forever, name="klba-service", daemon=True
         )
@@ -358,13 +399,29 @@ class AssignorServiceClient:
 
 
 def main() -> None:
-    """``python -m kafka_lag_based_assignor_tpu.service [host] [port]``"""
+    """``python -m kafka_lag_based_assignor_tpu.service [host] [port]
+    [--warmup=P:C[,P:C...]]``
+
+    ``--warmup`` pre-compiles the listed (max_partitions : num_consumers)
+    shapes before the service starts answering — a production sidecar
+    should always pass its expected shapes here so no rebalance ever pays
+    a first-compile.
+    """
     import sys
 
     logging.basicConfig(level=logging.INFO)
-    host = sys.argv[1] if len(sys.argv) > 1 else "127.0.0.1"
-    port = int(sys.argv[2]) if len(sys.argv) > 2 else 7531
-    service = AssignorService(host, port).start()
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    host = args[0] if len(args) > 0 else "127.0.0.1"
+    port = int(args[1]) if len(args) > 1 else 7531
+    warmup_shapes: List[Tuple[int, int]] = []
+    for arg in sys.argv[1:]:
+        if arg.startswith("--warmup="):
+            for pair in arg.split("=", 1)[1].split(","):
+                p, c = pair.split(":")
+                warmup_shapes.append((int(p), int(c)))
+    service = AssignorService(
+        host, port, warmup_shapes=warmup_shapes or None
+    ).start()
     print(f"listening on {service.address[0]}:{service.address[1]}", flush=True)
     try:
         threading.Event().wait()

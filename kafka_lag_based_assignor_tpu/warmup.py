@@ -111,13 +111,21 @@ def warmup(
                     # cold call compiles assign_stream, the warm call
                     # compiles refine_assignment at the padded bucket shape
                     # with the production exchange budget.
+                    from .ops.batched import assign_stream
                     from .ops.streaming import StreamingAssignor
 
                     engine = StreamingAssignor(
                         num_consumers=C, refine_iters=stream_refine_iters
                     )
                     engine.rebalance(lags1d)
-                    return engine.rebalance(lags1d)
+                    out = engine.rebalance(lags1d)
+                    # assign_stream downcasts the upload to int32 when the
+                    # lag range allows; ALSO warm the wide-lag (int64)
+                    # variant so a later rebalance whose lags exceed int32
+                    # doesn't hit a fresh compile mid-rebalance.
+                    wide = lags1d + (np.int64(1) << 32)
+                    assign_stream(wide, num_consumers=C)
+                    return out
 
                 jobs.append(("stream", 1, stream_job))
             if "sinkhorn" in solvers:

@@ -67,6 +67,68 @@ def test_mesh_shape_validation():
         make_mesh(jax.devices(), topics_axis=3, members_axis=2)
 
 
+def test_sharded_matches_single_device_config3_scale():
+    """Parity at the realistic BASELINE config-3 shape (256 topics x 64
+    partitions, 64 consumers) on the full 8-device mesh — the tiny-shape
+    parity tests above can miss sharding bugs that only appear when every
+    device holds a multi-topic block (VERDICT r3 item 9)."""
+    T, P, C = 256, 64, 64
+    lags, pids, valid = make_batch(T, P, C, seed=7)
+    mesh = make_mesh(jax.devices(), topics_axis=4, members_axis=2)
+    s_lags, s_pids, s_valid = shard_topic_batch(mesh, lags, pids, valid)
+    choice, counts, totals, member_load, member_count = assign_sharded(
+        mesh, s_lags, s_pids, s_valid, num_consumers=C
+    )
+    ref_choice, ref_counts, ref_totals = assign_batched_rounds(
+        lags, pids, valid, num_consumers=C
+    )
+    np.testing.assert_array_equal(np.asarray(choice), np.asarray(ref_choice))
+    np.testing.assert_array_equal(
+        np.asarray(member_load), np.asarray(ref_totals).sum(axis=0)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(member_count), np.asarray(ref_counts).sum(axis=0)
+    )
+
+
+def test_sharded_uneven_padded_topic_axis():
+    """Ragged reality: topics with different true partition counts (padding
+    rows valid=False) and a topic count that only reaches the mesh's topic
+    axis after padding with fully-invalid topics.  The sharded solve must
+    bit-match the unsharded kernel AND leave every padding row unassigned
+    (VERDICT r3 item 9: uneven/padded topic-axis case)."""
+    rng = np.random.default_rng(11)
+    C = 8
+    true_p = [64, 1, 17, 40, 64, 33]  # ragged per-topic partition counts
+    T_pad, P_pad = 8, 64  # topic axis padded 6 -> 8 for the 8-device mesh
+    lags = np.zeros((T_pad, P_pad), dtype=np.int64)
+    pids = np.tile(np.arange(P_pad, dtype=np.int32), (T_pad, 1))
+    valid = np.zeros((T_pad, P_pad), dtype=bool)
+    for t, p in enumerate(true_p):
+        lags[t, :p] = rng.integers(0, 10**9, size=p)
+        valid[t, :p] = True
+    mesh = make_mesh(jax.devices(), topics_axis=8, members_axis=1)
+    s_lags, s_pids, s_valid = shard_topic_batch(mesh, lags, pids, valid)
+    choice, counts, totals, member_load, member_count = assign_sharded(
+        mesh, s_lags, s_pids, s_valid, num_consumers=C
+    )
+    ref_choice, ref_counts, ref_totals = assign_batched_rounds(
+        lags, pids, valid, num_consumers=C
+    )
+    choice = np.asarray(choice)
+    np.testing.assert_array_equal(choice, np.asarray(ref_choice))
+    np.testing.assert_array_equal(
+        np.asarray(member_load), np.asarray(ref_totals).sum(axis=0)
+    )
+    # Padding rows (and fully-padded topics) are unassigned; valid rows of
+    # each true topic satisfy the count invariant.
+    assert (choice[~valid] == -1).all()
+    assert (choice[valid] >= 0).all()
+    for t, p in enumerate(true_p):
+        cnt = np.bincount(choice[t, :p], minlength=C)
+        assert cnt.max() - cnt.min() <= 1
+
+
 def test_determinism_across_runs():
     """Same input => bit-identical assignment across repeated sharded runs."""
     T, P, C = 8, 32, 4

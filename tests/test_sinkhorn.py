@@ -157,6 +157,35 @@ def test_kernel_padding_rows_unassigned():
     assert int(np.asarray(counts).sum()) == 2
 
 
+def test_host_only_contract_rejects_tracers():
+    """The public Sinkhorn entry points are host-only (numpy dedup
+    pre-pass); calling them under a JAX trace must fail with a named
+    contract error at the boundary, not an opaque numpy conversion error
+    (round-2 advisor finding)."""
+    import jax
+
+    from kafka_lag_based_assignor_tpu.models.sinkhorn import sinkhorn_duals
+
+    lags = np.arange(16, dtype=np.int64)
+    valid = np.ones(16, dtype=bool)
+
+    @jax.jit
+    def traced(lags, valid):
+        return assign_topic_sinkhorn(
+            lags, np.arange(16, dtype=np.int32), valid, num_consumers=2
+        )
+
+    with pytest.raises(TypeError, match="host-only"):
+        traced(lags, valid)
+
+    @jax.jit
+    def traced_duals(lags, valid):
+        return sinkhorn_duals(lags, valid, num_consumers=2)
+
+    with pytest.raises(TypeError, match="host-only"):
+        traced_duals(lags, valid)
+
+
 def test_more_consumers_than_partitions():
     lag_map = {"t": tpl("t", [(0, 100), (1, 50)])}
     subs = {f"m{j}": ["t"] for j in range(5)}
