@@ -29,6 +29,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..utils.observability import count_constrained_bound
 from .batched import assign_stream
 from .dispatch import ensure_x64
 from .packing import pad_bucket, pad_chunk
@@ -157,8 +158,12 @@ class StreamingAssignor:
         mean = totals.mean()
         stats.max_mean_imbalance = float(totals.max() / mean) if mean else 1.0
         stats.count_spread = int(counts.max() - counts.min())
-        # Input-driven bound: the hottest partition sits on SOME consumer.
-        stats.imbalance_bound = float(lags.max() / mean) if mean else 1.0
+        # Count-constrained input bound (shared with the benchmark's
+        # quality_ratio, see utils/observability.count_constrained_bound):
+        # a count-forced peak is not read as warm-path quality drift.
+        stats.imbalance_bound = count_constrained_bound(
+            lags, self.num_consumers
+        )
 
     def reset(self) -> None:
         """Drop warm state (e.g. on membership change)."""

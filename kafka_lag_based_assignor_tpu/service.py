@@ -224,8 +224,17 @@ class AssignorService:
         # (VERDICT r3 item 6): without this, a cold sidecar's FIRST assign
         # burns the XLA compile (~40 s/shape through this image's tunnel)
         # inside the rebalance deadline.  ``start()`` runs the warm-up
-        # before the accept loop begins serving.
+        # before the accept loop begins serving.  ``warmup_solvers``
+        # selects which solver executables to compile (default: every
+        # device solver at its default options).  Best-effort coverage:
+        # requests at an unwarmed (solver, shape, options) combination —
+        # e.g. a sinkhorn request with non-default quantized options, or a
+        # topic-batch size not in the warmed buckets — still pay their
+        # first compile on demand.
         warmup_shapes: Optional[List[Tuple[int, int]]] = None,
+        warmup_solvers: Tuple[str, ...] = (
+            "rounds", "stream", "global", "sinkhorn",
+        ),
     ):
         self._tcp = socketserver.ThreadingTCPServer(
             (host, port), _Handler, bind_and_activate=True
@@ -236,6 +245,7 @@ class AssignorService:
         self._watchdog = Watchdog(solve_timeout_s)
         self._host_fallback = host_fallback
         self._warmup_shapes = list(warmup_shapes or [])
+        self._warmup_solvers = tuple(warmup_solvers)
         self._counter_lock = threading.Lock()
         self.requests_served = 0
         self.errors = 0
@@ -321,7 +331,11 @@ class AssignorService:
             from .warmup import warmup
 
             for max_p, consumers in self._warmup_shapes:
-                warmup(max_partitions=max_p, consumers=[consumers])
+                warmup(
+                    max_partitions=max_p,
+                    consumers=[consumers],
+                    solvers=self._warmup_solvers,
+                )
         self._thread = threading.Thread(
             target=self._tcp.serve_forever, name="klba-service", daemon=True
         )
@@ -400,27 +414,45 @@ class AssignorServiceClient:
 
 def main() -> None:
     """``python -m kafka_lag_based_assignor_tpu.service [host] [port]
-    [--warmup=P:C[,P:C...]]``
+    [--warmup P:C[,P:C...]]``
 
     ``--warmup`` pre-compiles the listed (max_partitions : num_consumers)
-    shapes before the service starts answering — a production sidecar
-    should always pass its expected shapes here so no rebalance ever pays
-    a first-compile.
+    shapes for the default device solvers before the service starts
+    answering — a production sidecar should always pass its expected
+    shapes here so a default-configuration rebalance never pays a
+    first-compile (unwarmed solver/shape/option combinations still
+    compile on demand).  Unknown flags are an error, not silently
+    ignored.
     """
-    import sys
+    import argparse
 
     logging.basicConfig(level=logging.INFO)
-    args = [a for a in sys.argv[1:] if not a.startswith("--")]
-    host = args[0] if len(args) > 0 else "127.0.0.1"
-    port = int(args[1]) if len(args) > 1 else 7531
-    warmup_shapes: List[Tuple[int, int]] = []
-    for arg in sys.argv[1:]:
-        if arg.startswith("--warmup="):
-            for pair in arg.split("=", 1)[1].split(","):
-                p, c = pair.split(":")
-                warmup_shapes.append((int(p), int(c)))
+
+    def warmup_spec(text: str) -> List[Tuple[int, int]]:
+        shapes = []
+        for pair in text.split(","):
+            p, _, c = pair.partition(":")
+            if not c:
+                raise argparse.ArgumentTypeError(
+                    f"expected max_partitions:num_consumers, got {pair!r}"
+                )
+            shapes.append((int(p), int(c)))
+        return shapes
+
+    parser = argparse.ArgumentParser(
+        prog="kafka_lag_based_assignor_tpu.service",
+        description="TPU assignor sidecar (newline-JSON over TCP)",
+    )
+    parser.add_argument("host", nargs="?", default="127.0.0.1")
+    parser.add_argument("port", nargs="?", type=int, default=7531)
+    parser.add_argument(
+        "--warmup", type=warmup_spec, default=None, metavar="P:C[,P:C...]",
+        help="pre-compile these (max_partitions:num_consumers) shapes "
+             "before serving",
+    )
+    opts = parser.parse_args()
     service = AssignorService(
-        host, port, warmup_shapes=warmup_shapes or None
+        opts.host, opts.port, warmup_shapes=opts.warmup
     ).start()
     print(f"listening on {service.address[0]}:{service.address[1]}", flush=True)
     try:
