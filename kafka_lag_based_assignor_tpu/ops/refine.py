@@ -38,9 +38,12 @@ permutation handling go through the sort-based primitives in
 :mod:`.sortops` (~0.2 ms per P-sized sort), candidate keys are packed
 integers (f64 compares are emulated on v5e), and per-row lookups are
 packed so each round performs the minimum number of ~2 ms P-sized gathers.
-Candidate *selection* works on quantized keys; every candidate's
-improvement is re-checked EXACTLY (int64) before being applied, so
-quantization never admits a worsening exchange.
+Candidate *selection* works on quantized values, and validity is
+enforced by STRICT quantized inequalities that imply the exact ones
+(see the safety lemma at ``pack_payload``): quantization can only MISS
+boundary candidates, never admit a worsening exchange.  The amounts
+actually applied to the load accumulators are exact int64, gathered at
+the [K] winners.
 
 The refinement is solver-agnostic: it accepts the (choice, lags) pair in
 input order from the greedy kernels or the Sinkhorn rounding.  It
@@ -140,6 +143,34 @@ def refine_assignment(
         q = jnp.clip(lag_like, 0, None).astype(jnp.int64) >> qshift
         return (pair.astype(key_dtype) << lag_bits) | q.astype(key_dtype)
 
+    # Neighbour payload packing: (quantized lag << SB) | (pair id + 1) in
+    # one int64, so each neighbour probe is ONE P-sized gather instead of
+    # two (~2 ms each on the target TPU).  Zero means "not a light row"
+    # (pair id + 1 >= 1 for real entries).  ``pshift`` extends the key
+    # quantization only if lag_bits + SB would overflow 62 bits (only
+    # possible on the int64-key path).
+    #
+    # SAFETY LEMMA (why strict quantized validity implies exact validity,
+    # for non-negative a, b, diff and any shift s — there is NO exact
+    # recheck downstream, this argument is the whole guarantee):
+    #   d_q > 0:       a>>s > b>>s  ⟹  a >= ((b>>s)+1)<<s > b, so d > 0.
+    #   d_q < diff_q:  write a = (a>>s)<<s + ra, b = (b>>s)<<s + rb,
+    #     diff = (diff>>s)<<s + rd with 0 <= ra, rb, rd < 2^s.  Then
+    #     d = a - b = (d_q<<s) + ra - rb < (d_q + 1)<<s <= (diff>>s)<<s
+    #     <= diff.  So d < diff.
+    # Hence a selected exchange satisfies 0 < d < diff exactly —
+    # quantization can only MISS boundary candidates, never admit a
+    # worsening exchange, and the monotone non-increasing max is
+    # preserved.
+    sb = max(1, K.bit_length())
+    extra = max(0, (lag_bits + sb) - 62)
+    pshift = qshift + extra
+    pay_mask = (1 << sb) - 1
+
+    def pack_payload(pair1, lag_like):
+        q = jnp.clip(lag_like, 0, None).astype(jnp.int64) >> pshift
+        return (q << sb) | pair1.astype(jnp.int64)
+
     def body(state):
         it, since, choice, totals, counts = state
         safe_choice = jnp.clip(choice, 0, C - 1)
@@ -180,25 +211,30 @@ def refine_assignment(
         delta_p = diff_p >> 1   # diff >= 0, so >>1 == //2
         seg_h = jnp.where(on_heavy, k_p, K)
 
+        # All candidate SELECTION below runs in the quantized (>> pshift)
+        # lag domain — one consistent unit for comparing move vs swap
+        # errors; the APPLIED amounts are exact (gathered at the [K]
+        # winners).  Strict quantized checks guarantee exact validity.
+        qlag_row = lags >> pshift
+        diff_q = diff_p >> pshift
+        delta_q = delta_p >> pshift
+
         # Candidate 1 — MOVE: heavy-side partition with lag closest to
-        # delta; improving iff 0 < lag < diff.
+        # delta; improving iff 0 < lag < diff (exact elementwise check).
         ok_move = on_heavy & (lags > 0) & (lags < diff_p)
-        score_move = jnp.where(ok_move, jnp.abs(lags - delta_p), big)
+        score_move = jnp.where(ok_move, jnp.abs(qlag_row - delta_q), big)
         err_move, p_move = segment_argmin_first(score_move, seg_h, K, P)
 
         # Candidate 2 — best SWAP: sort light-side rows by (pair,
-        # quantized lag) with (lag, pair, row) riding the sort; for each
+        # quantized lag) with (payload, row) riding the sort; for each
         # heavy p, searchsorted its ideal counterpart lag_p - delta and
-        # examine the two neighbours with exact arithmetic.
+        # examine the two neighbours via their packed payloads.
         keyl = jnp.where(on_light, pack_key(k_p, lags), key_big)
-        _skey, slag, skp, sidx = lax.sort(
-            (
-                keyl,
-                jnp.where(on_light, lags, 0),
-                jnp.where(on_light, k_p, -1),
-                arangeP,
-            ),
-            num_keys=1,
+        payload = jnp.where(
+            on_light, pack_payload(k_p + 1, lags), 0
+        )
+        _skey, spayload, sidx = lax.sort(
+            (keyl, payload, arangeP), num_keys=1
         )
         tgt = jnp.clip(lags - delta_p, 0, None)
         query = jnp.where(on_heavy, pack_key(k_p, tgt), key_big)
@@ -206,12 +242,11 @@ def refine_assignment(
 
         def neighbour(nb):
             inb = jnp.clip(nb, 0, P - 1)
-            q_lag = slag[inb]
-            q_kp = skp[inb]
-            okq = (nb >= 0) & (nb < P) & (q_kp == k_p)  # light + same pair
-            d = lags - q_lag
-            ok = on_heavy & okq & (d > 0) & (d < diff_p)
-            return jnp.where(ok, jnp.abs(d - delta_p), big)
+            pl = spayload[inb]  # the round's ONE gather per neighbour
+            okq = (nb >= 0) & (nb < P) & ((pl & pay_mask) == k_p + 1)
+            d_q = qlag_row - (pl >> sb)
+            ok = on_heavy & okq & (d_q > 0) & (d_q < diff_q)
+            return jnp.where(ok, jnp.abs(d_q - delta_q), big)
 
         err_a = neighbour(pos - 1)
         err_b = neighbour(pos)
@@ -220,8 +255,8 @@ def refine_assignment(
         nb_of_p = jnp.where(use_b, pos, pos - 1)
         err_swap, p_swap = segment_argmin_first(err_pq, seg_h, K, P)
         nb_sel = jnp.clip(nb_of_p[jnp.clip(p_swap, 0, P - 1)], 0, P - 1)
-        q_swap = sidx[nb_sel]            # [K]
-        lag_q_swap = slag[nb_sel]        # [K], exact lag of q
+        q_swap = sidx[nb_sel]                        # [K]
+        lag_q_swap = lags[jnp.clip(q_swap, 0, P - 1)]  # [K], exact lag of q
 
         # Choose per pair; moves must keep the count spread <= 1.
         move_allowed = (counts[heavy] > counts[light]) & (err_move < big)
