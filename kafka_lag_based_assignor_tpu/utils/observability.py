@@ -80,16 +80,30 @@ class RebalanceStats:
     per_topic: Dict[str, Dict[str, Dict[str, int]]] = field(
         default_factory=dict
     )
+    # Count-constrained lower bound on the imbalance for this rebalance's
+    # input (see count_constrained_bound) — filled by summarize_assignment.
+    # Exact for the uniform-subscription case (every member subscribes to
+    # every topic, incl. all single-topic groups); with asymmetric
+    # subscriptions the count floor may not bind every member, so treat
+    # the recorded value as a normalizer, not a proof of optimality.
+    imbalance_bound: float = 1.0
 
     @property
     def max_mean_lag_imbalance(self) -> float:
-        """max(member lag) / mean(member lag) — 1.0 is perfect, and the
-        input-driven lower bound is max_partition_lag / mean(member lag)."""
+        """max(member lag) / mean(member lag) — 1.0 is perfect; no valid
+        assignment can score below ``imbalance_bound``."""
         lags = list(self.member_total_lag.values())
         if not lags:
             return 1.0
         mean = sum(lags) / len(lags)
         return max(lags) / mean if mean > 0 else 1.0
+
+    @property
+    def quality_ratio(self) -> float:
+        """Achieved imbalance normalized to the input-driven bound — the
+        north-star quality metric; 1.0 means provably optimal for the
+        input (same normalization as the benchmark's quality_ratio)."""
+        return self.max_mean_lag_imbalance / max(self.imbalance_bound, 1.0)
 
     @property
     def count_spread(self) -> int:
@@ -100,6 +114,7 @@ class RebalanceStats:
         d = asdict(self)
         d["max_mean_lag_imbalance"] = self.max_mean_lag_imbalance
         d["count_spread"] = self.count_spread
+        d["quality_ratio"] = self.quality_ratio
         return json.dumps(d, sort_keys=True)
 
 
@@ -108,10 +123,19 @@ def summarize_assignment(
     assignment: Dict[str, List],
     lag_by_tp: Dict,
 ) -> RebalanceStats:
-    """Fill member totals from an assignment map and a TopicPartition->lag map."""
+    """Fill member totals from an assignment map and a TopicPartition->lag
+    map, plus the input-driven imbalance bound over the ASSIGNED rows."""
     for member, tps in assignment.items():
         stats.member_partition_count[member] = len(tps)
         stats.member_total_lag[member] = sum(lag_by_tp.get(tp, 0) for tp in tps)
+    if lag_by_tp and stats.num_members:
+        import numpy as np
+
+        stats.imbalance_bound = count_constrained_bound(
+            np.fromiter(lag_by_tp.values(), dtype=np.int64,
+                        count=len(lag_by_tp)),
+            stats.num_members,
+        )
     return stats
 
 

@@ -179,3 +179,38 @@ def test_configure_logs_derived_property_map(caplog):
     assert "enable.auto.commit = false" in joined
     assert "client.id = orders.assignor" in joined
     assert "bootstrap.servers = b:9092" in joined
+
+
+def test_quality_ratio_and_bound_in_record():
+    """The structured record carries the count-constrained bound and the
+    normalized quality ratio (the north-star metric), matching the shared
+    library bound."""
+    import json
+
+    import numpy as np
+
+    from kafka_lag_based_assignor_tpu.types import TopicPartition
+    from kafka_lag_based_assignor_tpu.utils.observability import (
+        RebalanceStats,
+        count_constrained_bound,
+        summarize_assignment,
+    )
+
+    # One hot partition: the count floor binds (its holder must take 5
+    # partitions), so the bound exceeds 1 and normalizes the ratio.
+    vals = [10**6] + list(range(1, 10))
+    lags = {TopicPartition("t", p): vals[p] for p in range(10)}
+    assignment = {
+        "a": [TopicPartition("t", p) for p in range(0, 10, 2)],
+        "b": [TopicPartition("t", p) for p in range(1, 10, 2)],
+    }
+    stats = RebalanceStats(num_topics=1, num_partitions=10, num_members=2)
+    summarize_assignment(stats, assignment, lags)
+    expected_bound = count_constrained_bound(
+        np.array(vals, dtype=np.int64), 2
+    )
+    assert stats.imbalance_bound == expected_bound
+    assert expected_bound > 1.0  # count floor binds on this instance
+    record = json.loads(stats.to_json())
+    assert record["quality_ratio"] == stats.quality_ratio
+    assert record["imbalance_bound"] == expected_bound
