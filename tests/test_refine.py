@@ -1,0 +1,123 @@
+"""Property tests for the pairwise-exchange refinement kernel.
+
+Pin the kernel's contract directly (it was previously covered only
+through the streaming engine and the Sinkhorn solver): the peak load is
+monotone non-increasing, the count invariant is preserved, returned
+accumulators match the returned choice, churn respects the documented
+bound, and invalid rows are never touched.
+"""
+
+import numpy as np
+import pytest
+
+from kafka_lag_based_assignor_tpu.ops.refine import refine_assignment
+
+
+def recompute(lags, valid, choice, C):
+    totals = np.zeros(C, dtype=np.int64)
+    counts = np.zeros(C, dtype=np.int64)
+    sel = valid & (choice >= 0)
+    np.add.at(totals, choice[sel], lags[sel])
+    np.add.at(counts, choice[sel], 1)
+    return totals, counts
+
+
+def make_instance(seed, P=512, C=16, pad=64, hot=False):
+    rng = np.random.default_rng(seed)
+    lags = np.zeros(P + pad, dtype=np.int64)
+    lags[:P] = rng.integers(0, 10**9, P)
+    if hot:
+        lags[: P // 10] = rng.integers(10**11, 10**12, P // 10)
+    valid = np.zeros(P + pad, dtype=bool)
+    valid[:P] = True
+    choice = np.full(P + pad, -1, dtype=np.int32)
+    choice[:P] = rng.permutation(P) % C  # count-balanced start
+    return lags, valid, choice
+
+
+@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize("hot", [False, True])
+def test_invariants(seed, hot):
+    lags, valid, choice0 = make_instance(seed, hot=hot)
+    C = 16
+    t0, c0 = recompute(lags, valid, choice0, C)
+    choice, counts, totals = refine_assignment(
+        lags, valid, choice0, num_consumers=C, iters=32
+    )
+    choice = np.asarray(choice)
+    # Returned accumulators match the returned choice exactly.
+    t1, c1 = recompute(lags, valid, choice, C)
+    np.testing.assert_array_equal(np.asarray(totals), t1)
+    np.testing.assert_array_equal(np.asarray(counts), c1)
+    # Peak monotone non-increasing; count spread never grows.
+    assert t1.max() <= t0.max()
+    assert c1.max() - c1.min() <= max(c0.max() - c0.min(), 1)
+    # Invalid rows untouched; valid rows stay assigned.
+    assert (choice[~valid] == -1).all()
+    assert (choice[valid] >= 0).all() and (choice[valid] < C).all()
+    # Conservation: same multiset of work.
+    assert t1.sum() == t0.sum() and c1.sum() == c0.sum()
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_churn_bound(seed):
+    lags, valid, choice0 = make_instance(seed)
+    C = 16
+    iters, max_pairs = 3, 4
+    choice, _, _ = refine_assignment(
+        lags, valid, choice0, num_consumers=C, iters=iters,
+        max_pairs=max_pairs,
+    )
+    churn = int((np.asarray(choice) != choice0).sum())
+    assert churn <= 2 * iters * max_pairs
+
+
+def test_converged_instance_is_fixed_point():
+    """All-equal lags on a count-balanced start cannot be improved; the
+    patience stop must leave the assignment bit-identical."""
+    P, C = 128, 8
+    lags = np.full(P, 1000, dtype=np.int64)
+    valid = np.ones(P, dtype=bool)
+    choice0 = (np.arange(P) % C).astype(np.int32)
+    choice, _, _ = refine_assignment(
+        lags, valid, choice0, num_consumers=C, iters=64, patience=4
+    )
+    np.testing.assert_array_equal(np.asarray(choice), choice0)
+
+
+def test_two_consumer_gap_closes():
+    """A blatant imbalance (one consumer holds all the hot rows) must be
+    substantially repaired within a small budget."""
+    P, C = 64, 2
+    lags = np.ones(P, dtype=np.int64)
+    lags[: P // 2] = 1000
+    valid = np.ones(P, dtype=bool)
+    # Consumer 0 takes every hot row (count-balanced but lag-lopsided).
+    choice0 = np.zeros(P, dtype=np.int32)
+    choice0[P // 2:] = 1
+    t0, _ = recompute(lags, valid, choice0, C)
+    choice, counts, totals = refine_assignment(
+        lags, valid, choice0, num_consumers=C, iters=64
+    )
+    t1 = np.asarray(totals)
+    imb0 = t0.max() / t0.mean()
+    imb1 = t1.max() / t1.mean()
+    assert imb1 < 1.05 < imb0
+
+
+def test_zero_budget_returns_input():
+    lags, valid, choice0 = make_instance(0)
+    choice, _, _ = refine_assignment(
+        lags, valid, choice0, num_consumers=16, iters=0
+    )
+    np.testing.assert_array_equal(np.asarray(choice), choice0)
+
+
+def test_single_consumer_noop():
+    lags, valid, choice0 = make_instance(1, C=1)
+    choice0[valid] = 0
+    choice, counts, totals = refine_assignment(
+        lags, valid, choice0, num_consumers=1, iters=8
+    )
+    np.testing.assert_array_equal(np.asarray(choice), choice0)
+    assert int(np.asarray(totals)[0]) == int(lags[valid].sum())
