@@ -95,6 +95,44 @@ class LagBasedPartitionAssignor:
                 )
             ),
         )
+        # Optional kernel pre-compilation at consumer startup
+        # (tpu.assignor.warmup.shapes) so the first rebalance of the
+        # CONFIGURED solver never pays an XLA compile; same semantics as
+        # the sidecar's --warmup flag.  Only the configured solver is
+        # warmed (the plugin never dispatches the sidecar-only "stream"
+        # path, and "native"/"host" have no device executables).  Best
+        # effort by contract: a failing warm-up is logged and skipped —
+        # it must never prevent the consumer from starting (the host
+        # fallback still covers a broken accelerator at rebalance time).
+        solver_warm = {
+            "rounds": ("rounds",),
+            "scan": ("scan",),
+            "global": ("global",),
+            "sinkhorn": ("sinkhorn",),
+        }.get(self._config.solver)
+        if self._config.warmup_shapes and solver_warm:
+            try:
+                from .warmup import warmup
+
+                for max_p, consumers in self._config.warmup_shapes:
+                    warmup(
+                        max_partitions=max_p,
+                        consumers=[consumers],
+                        solvers=solver_warm,
+                        sinkhorn_iters=self._config.sinkhorn_iters,
+                        refine_iters=self._config.refine_iters,
+                    )
+            except Exception:
+                LOGGER.warning(
+                    "configure-time warm-up failed; continuing without it "
+                    "(first rebalance may pay an XLA compile)",
+                    exc_info=True,
+                )
+        elif self._config.warmup_shapes:
+            LOGGER.info(
+                "solver %r has no device executables; warmup.shapes ignored",
+                self._config.solver,
+            )
 
     # -- ConsumerPartitionAssignor SPI ------------------------------------
 

@@ -35,6 +35,11 @@ SINKHORN_ITERS_CONFIG = "tpu.assignor.sinkhorn.iters"  # int > 0
 # parallel rounding, which starts coarser).  An explicit integer is
 # honored exactly on every path.
 REFINE_ITERS_CONFIG = "tpu.assignor.refine.iters"
+# "P:C[,P:C...]" — shapes to pre-compile at configure() time (consumer
+# startup, NOT on the rebalance critical path): each entry warms the
+# kernels for max_partitions P / num_consumers C, same semantics as the
+# sidecar's --warmup flag.  Empty/unset skips warm-up.
+WARMUP_SHAPES_CONFIG = "tpu.assignor.warmup.shapes"
 
 VALID_SOLVERS = ("rounds", "scan", "global", "sinkhorn", "native", "host")
 
@@ -63,6 +68,8 @@ class AssignorConfig:
     # refinement); refine_iters None = per-path auto budget.
     sinkhorn_iters: int = 24
     refine_iters: Optional[int] = None
+    # (max_partitions, num_consumers) shapes to pre-compile at configure().
+    warmup_shapes: list = field(default_factory=list)
     consumer_group_props: Dict[str, Any] = field(default_factory=dict)
     metadata_consumer_props: Dict[str, Any] = field(default_factory=dict)
 
@@ -123,6 +130,27 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
         else _as_int(REFINE_ITERS_CONFIG, raw_refine, 0)
     )
 
+    raw_shapes = consumer_group_props.get(WARMUP_SHAPES_CONFIG, "")
+    warmup_shapes = []
+    if raw_shapes not in (None, ""):
+        for pair in str(raw_shapes).split(","):
+            p, sep, c = pair.partition(":")
+            try:
+                if not sep:
+                    raise ValueError
+                shape = (int(p), int(c))
+            except ValueError:
+                raise ValueError(
+                    f"{WARMUP_SHAPES_CONFIG}={raw_shapes!r} must be "
+                    "'max_partitions:num_consumers[,P:C...]'"
+                )
+            if shape[0] < 1 or shape[1] < 1:
+                raise ValueError(
+                    f"{WARMUP_SHAPES_CONFIG} entries must be positive, "
+                    f"got {pair!r}"
+                )
+            warmup_shapes.append(shape)
+
     raw_timeout = consumer_group_props.get(SOLVE_TIMEOUT_CONFIG, 120_000)
     try:
         timeout_ms = float(raw_timeout) if raw_timeout not in ("", None) else 0.0
@@ -143,6 +171,7 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
         solve_timeout_s=solve_timeout_s,
         sinkhorn_iters=sinkhorn_iters,
         refine_iters=refine_iters,
+        warmup_shapes=warmup_shapes,
         consumer_group_props=consumer_group_props,
         metadata_consumer_props=metadata_consumer_props,
     )

@@ -61,7 +61,7 @@ def warmup(
       consumers: exact consumer-group sizes to warm (C is not bucketed —
         it is a static kernel parameter).
       topics: topic-batch sizes to warm for the batched kernels (bucketed).
-      solvers: subset of {"rounds", "global", "stream", "sinkhorn"}.
+      solvers: subset of {"rounds", "scan", "global", "stream", "sinkhorn"}.
       all_partition_buckets: warm every bucket up to the max (True) or only
         the single bucket ``max_partitions`` pads to (default — smaller
         shapes still trigger one compile each on first sight).
@@ -84,7 +84,7 @@ def warmup(
     compiled.  Failures are logged and skipped — warm-up must never take a
     deployment down.
     """
-    from .ops.batched import assign_batched_rounds
+    from .ops.batched import assign_batched_rounds, assign_batched_scan
     from .ops.dispatch import ensure_x64
     from .ops.rounds_kernel import assign_global_rounds
     from .ops.scan_kernel import pack_shift_for
@@ -163,6 +163,18 @@ def warmup(
                                 assign_batched_rounds(
                                     lags, pids, valid, num_consumers=C,
                                     pack_shift=shift,
+                                )
+                            ),
+                        )
+                    )
+                if "scan" in solvers:
+                    jobs.append(
+                        (
+                            "scan",
+                            T,
+                            lambda lags=lags, pids=pids, valid=valid: (
+                                assign_batched_scan(
+                                    lags, pids, valid, num_consumers=C
                                 )
                             ),
                         )

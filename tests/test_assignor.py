@@ -255,3 +255,107 @@ def test_auto_offset_reset_earliest_full_backlog():
     a = make_assignor(broker, {"auto.offset.reset": "earliest"})
     a.assign(broker.cluster(), subs({"m1": ["t"], "m2": ["t"]}))
     assert a.last_stats.total_lag == 450
+
+
+def test_warmup_shapes_config_parsing():
+    """tpu.assignor.warmup.shapes parses 'P:C[,P:C...]' and rejects
+    malformed or non-positive entries at configure time."""
+    from kafka_lag_based_assignor_tpu.utils.config import parse_config
+
+    cfg = parse_config(
+        {"group.id": "g", "tpu.assignor.warmup.shapes": "1024:16,64:4"}
+    )
+    assert cfg.warmup_shapes == [(1024, 16), (64, 4)]
+    assert parse_config({"group.id": "g"}).warmup_shapes == []
+    for bad in ("1024", "0:4", "64:-1", "a:b", "64:4,oops"):
+        with pytest.raises(ValueError, match="warmup.shapes"):
+            parse_config(
+                {"group.id": "g", "tpu.assignor.warmup.shapes": bad}
+            )
+
+
+def test_configure_runs_warmup_for_shapes(monkeypatch):
+    """configure() pre-compiles the configured shapes via warmup.warmup
+    with the configured solver included (consumer-startup semantics)."""
+    import kafka_lag_based_assignor_tpu.warmup as warmup_mod
+
+    calls = []
+
+    def fake_warmup(**kwargs):
+        calls.append(kwargs)
+        return []
+
+    monkeypatch.setattr(warmup_mod, "warmup", fake_warmup)
+    a = LagBasedPartitionAssignor()
+    a.configure(
+        {
+            "group.id": "g",
+            "tpu.assignor.solver": "sinkhorn",
+            "tpu.assignor.warmup.shapes": "256:8",
+        }
+    )
+    assert len(calls) == 1
+    assert calls[0]["max_partitions"] == 256
+    assert calls[0]["consumers"] == [8]
+    # ONLY the configured solver is warmed: no sidecar-only "stream" job,
+    # no executables the configured path never dispatches.
+    assert calls[0]["solvers"] == ("sinkhorn",)
+
+
+def test_configure_warmup_failure_never_blocks_startup(monkeypatch, caplog):
+    """A broken accelerator during configure-time warm-up is logged and
+    skipped; the consumer still starts (warm-up must never take a
+    deployment down)."""
+    import logging
+
+    import kafka_lag_based_assignor_tpu.warmup as warmup_mod
+
+    def boom(**kwargs):
+        raise RuntimeError("simulated accelerator init failure")
+
+    monkeypatch.setattr(warmup_mod, "warmup", boom)
+    a = LagBasedPartitionAssignor()
+    with caplog.at_level(
+        logging.WARNING, logger="kafka_lag_based_assignor_tpu.assignor"
+    ):
+        a.configure(
+            {"group.id": "g", "tpu.assignor.warmup.shapes": "64:4"}
+        )
+    assert any("warm-up failed" in r.message for r in caplog.records)
+    assert a.name() == "lag"  # configured and usable
+
+
+def test_configure_warmup_host_solver_skipped(monkeypatch, caplog):
+    """host/native solvers have no device executables; shapes are ignored
+    with an INFO note instead of wasting startup time."""
+    import logging
+
+    import kafka_lag_based_assignor_tpu.warmup as warmup_mod
+
+    def boom(**kwargs):
+        raise AssertionError("warmup must not run for host solver")
+
+    monkeypatch.setattr(warmup_mod, "warmup", boom)
+    a = LagBasedPartitionAssignor()
+    with caplog.at_level(
+        logging.INFO, logger="kafka_lag_based_assignor_tpu.assignor"
+    ):
+        a.configure(
+            {
+                "group.id": "g",
+                "tpu.assignor.solver": "host",
+                "tpu.assignor.warmup.shapes": "64:4",
+            }
+        )
+    assert any("no device executables" in r.message for r in caplog.records)
+
+
+def test_configure_without_warmup_shapes_skips_warmup(monkeypatch):
+    import kafka_lag_based_assignor_tpu.warmup as warmup_mod
+
+    def boom(**kwargs):
+        raise AssertionError("warmup must not run without shapes")
+
+    monkeypatch.setattr(warmup_mod, "warmup", boom)
+    a = LagBasedPartitionAssignor()
+    a.configure({"group.id": "g"})

@@ -59,3 +59,23 @@ def test_warmed_solver_produces_valid_output():
     choice = np.asarray(assign_stream(lags, num_consumers=4))
     counts = np.bincount(choice, minlength=4)
     assert counts.sum() == 10 and counts.max() - counts.min() <= 1
+
+
+def test_warmup_scan_solver_compiles():
+    """The scan kernel is warmable (configure-time warm-up maps
+    tpu.assignor.solver=scan onto it)."""
+    from kafka_lag_based_assignor_tpu.ops.batched import assign_batched_scan
+    from kafka_lag_based_assignor_tpu.warmup import warmup
+
+    done = warmup(max_partitions=32, consumers=[2], solvers=("scan",))
+    assert [d[0] for d in done] == ["scan"]
+    before = assign_batched_scan._cache_size()
+    import numpy as np
+
+    lags = np.random.default_rng(0).integers(0, 1000, (1, 32)).astype(
+        np.int64
+    )
+    pids = np.arange(32, dtype=np.int32)[None, :]
+    valid = np.ones((1, 32), dtype=bool)
+    assign_batched_scan(lags, pids, valid, num_consumers=2)
+    assert assign_batched_scan._cache_size() == before
