@@ -437,6 +437,28 @@ def config5_northstar():
     base_totals, base_ms = host_baseline_greedy(lags0, C)
     base_imb = imbalance(base_totals)
 
+    # Quality mode at north-star scale (single shot — a quality record,
+    # not a latency one): the implicit-plan Sinkhorn + refinement.
+    from kafka_lag_based_assignor_tpu.models.sinkhorn import (
+        assign_topic_sinkhorn,
+    )
+    from kafka_lag_based_assignor_tpu.ops.packing import pad_topic_rows
+
+    lags_p, pids_p, valid_p = pad_topic_rows(lags0)
+    t0 = time.perf_counter()
+    _, _, s_tot = assign_topic_sinkhorn(
+        lags_p, pids_p, valid_p, num_consumers=C
+    )
+    s_tot = np.asarray(s_tot)
+    s_first_ms = (time.perf_counter() - t0) * 1000.0  # includes compile
+    t0 = time.perf_counter()
+    _, _, s_tot2 = assign_topic_sinkhorn(
+        lags_p, pids_p, valid_p, num_consumers=C
+    )
+    s_tot2 = np.asarray(s_tot2)
+    s_ms = (time.perf_counter() - t0) * 1000.0
+    s_imb = imbalance(s_tot2)
+
     # Streaming: rebalance repeatedly under multiplicative drift + churn,
     # reusing the compiled kernel (stable exact shape).  Run both modes:
     # from-scratch each epoch, and the warm-start engine (previous choice +
@@ -486,6 +508,10 @@ def config5_northstar():
         "baseline_host_greedy_ms": base_ms,
         "baseline_imbalance": base_imb,
         "speedup_vs_baseline": base_ms / ms,
+        "sinkhorn_assign_ms": s_ms,
+        "sinkhorn_first_call_ms": s_first_ms,
+        "sinkhorn_max_mean_imbalance": s_imb,
+        "sinkhorn_quality_ratio": quality_ratio(s_imb, bound),
         "streaming_p50_ms": float(np.percentile(stream_times, 50)),
         "streaming_p95_ms": float(np.percentile(stream_times, 95)),
         "warm_p50_ms": float(np.percentile(warm_times, 50)),

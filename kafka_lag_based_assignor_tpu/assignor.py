@@ -104,21 +104,19 @@ class LagBasedPartitionAssignor:
         # effort by contract: a failing warm-up is logged and skipped —
         # it must never prevent the consumer from starting (the host
         # fallback still covers a broken accelerator at rebalance time).
-        solver_warm = {
-            "rounds": ("rounds",),
-            "scan": ("scan",),
-            "global": ("global",),
-            "sinkhorn": ("sinkhorn",),
-        }.get(self._config.solver)
-        if self._config.warmup_shapes and solver_warm:
+        from .utils.config import DEVICE_SOLVERS
+
+        solver = self._config.solver
+        if self._config.warmup_shapes and solver in DEVICE_SOLVERS:
             try:
                 from .warmup import warmup
 
-                for max_p, consumers in self._config.warmup_shapes:
+                for max_p, consumers, topics in self._config.warmup_shapes:
                     warmup(
                         max_partitions=max_p,
                         consumers=[consumers],
-                        solvers=solver_warm,
+                        topics=[topics],
+                        solvers=(solver,),
                         sinkhorn_iters=self._config.sinkhorn_iters,
                         refine_iters=self._config.refine_iters,
                     )

@@ -35,13 +35,50 @@ SINKHORN_ITERS_CONFIG = "tpu.assignor.sinkhorn.iters"  # int > 0
 # parallel rounding, which starts coarser).  An explicit integer is
 # honored exactly on every path.
 REFINE_ITERS_CONFIG = "tpu.assignor.refine.iters"
-# "P:C[,P:C...]" — shapes to pre-compile at configure() time (consumer
-# startup, NOT on the rebalance critical path): each entry warms the
-# kernels for max_partitions P / num_consumers C, same semantics as the
-# sidecar's --warmup flag.  Empty/unset skips warm-up.
+# "P:C[:T][,P:C[:T]...]" — shapes to pre-compile at configure() time
+# (consumer startup, NOT on the rebalance critical path): each entry warms
+# the kernels for max_partitions P / num_consumers C / a topic batch of T
+# (default 1; multi-topic groups batch at pad_bucket(n_topics), so groups
+# subscribing to several topics should warm their T too).  Shared parser
+# with the sidecar's --warmup flag (parse_warmup_shapes).  Empty/unset
+# skips warm-up.
 WARMUP_SHAPES_CONFIG = "tpu.assignor.warmup.shapes"
 
+
+def parse_warmup_shapes(text: str) -> list:
+    """THE parser for warm-up shape lists — used by both this config key
+    and the sidecar's ``--warmup`` flag so the two surfaces cannot
+    diverge.  Returns [(max_partitions, num_consumers, topics), ...];
+    raises ValueError on malformed or non-positive entries."""
+    shapes = []
+    for pair in str(text).split(","):
+        parts = pair.split(":")
+        if len(parts) not in (2, 3):
+            raise ValueError(
+                f"warmup shape {pair!r} must be "
+                "'max_partitions:num_consumers[:topics]'"
+            )
+        try:
+            nums = [int(p) for p in parts]
+        except ValueError:
+            raise ValueError(
+                f"warmup shape {pair!r} must be "
+                "'max_partitions:num_consumers[:topics]'"
+            )
+        if len(nums) == 2:
+            nums.append(1)
+        if any(n < 1 for n in nums):
+            raise ValueError(
+                f"warmup shape entries must be positive, got {pair!r}"
+            )
+        shapes.append(tuple(nums))
+    return shapes
+
 VALID_SOLVERS = ("rounds", "scan", "global", "sinkhorn", "native", "host")
+
+# Solvers with device (XLA) executables — the ones configure-time warm-up
+# can usefully pre-compile ("native"/"host" run entirely host-side).
+DEVICE_SOLVERS = ("rounds", "scan", "global", "sinkhorn")
 
 # Solvers whose output is bit-identical to the reference's per-topic greedy
 # (and therefore whose decision sequence can be replayed for trace logging,
@@ -133,23 +170,10 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
     raw_shapes = consumer_group_props.get(WARMUP_SHAPES_CONFIG, "")
     warmup_shapes = []
     if raw_shapes not in (None, ""):
-        for pair in str(raw_shapes).split(","):
-            p, sep, c = pair.partition(":")
-            try:
-                if not sep:
-                    raise ValueError
-                shape = (int(p), int(c))
-            except ValueError:
-                raise ValueError(
-                    f"{WARMUP_SHAPES_CONFIG}={raw_shapes!r} must be "
-                    "'max_partitions:num_consumers[,P:C...]'"
-                )
-            if shape[0] < 1 or shape[1] < 1:
-                raise ValueError(
-                    f"{WARMUP_SHAPES_CONFIG} entries must be positive, "
-                    f"got {pair!r}"
-                )
-            warmup_shapes.append(shape)
+        try:
+            warmup_shapes = parse_warmup_shapes(raw_shapes)
+        except ValueError as exc:
+            raise ValueError(f"{WARMUP_SHAPES_CONFIG}: {exc}")
 
     raw_timeout = consumer_group_props.get(SOLVE_TIMEOUT_CONFIG, 120_000)
     try:

@@ -263,11 +263,12 @@ def test_warmup_shapes_config_parsing():
     from kafka_lag_based_assignor_tpu.utils.config import parse_config
 
     cfg = parse_config(
-        {"group.id": "g", "tpu.assignor.warmup.shapes": "1024:16,64:4"}
+        {"group.id": "g", "tpu.assignor.warmup.shapes": "1024:16,64:4:8"}
     )
-    assert cfg.warmup_shapes == [(1024, 16), (64, 4)]
+    assert cfg.warmup_shapes == [(1024, 16, 1), (64, 4, 8)]
     assert parse_config({"group.id": "g"}).warmup_shapes == []
-    for bad in ("1024", "0:4", "64:-1", "a:b", "64:4,oops"):
+    for bad in ("1024", "0:4", "64:-1", "a:b", "64:4,oops", "64:4:0",
+                "1:2:3:4"):
         with pytest.raises(ValueError, match="warmup.shapes"):
             parse_config(
                 {"group.id": "g", "tpu.assignor.warmup.shapes": bad}
@@ -297,6 +298,7 @@ def test_configure_runs_warmup_for_shapes(monkeypatch):
     assert len(calls) == 1
     assert calls[0]["max_partitions"] == 256
     assert calls[0]["consumers"] == [8]
+    assert calls[0]["topics"] == [1]
     # ONLY the configured solver is warmed: no sidecar-only "stream" job,
     # no executables the configured path never dispatches.
     assert calls[0]["solvers"] == ("sinkhorn",)
@@ -359,3 +361,39 @@ def test_configure_without_warmup_shapes_skips_warmup(monkeypatch):
     monkeypatch.setattr(warmup_mod, "warmup", boom)
     a = LagBasedPartitionAssignor()
     a.configure({"group.id": "g"})
+
+
+def test_configure_warmup_covers_multi_topic_batches():
+    """A 'P:C:T' warm-up shape pre-compiles the topic-BATCH executable, so
+    a multi-topic group's first rebalance hits the jit cache too (the
+    topic axis pads to pad_bucket(n_topics), same bucket the warm-up
+    compiles)."""
+    from kafka_lag_based_assignor_tpu.ops.batched import assign_batched_rounds
+    from kafka_lag_based_assignor_tpu.testing import FakeBroker
+    from kafka_lag_based_assignor_tpu.types import (
+        GroupSubscription,
+        Subscription,
+    )
+
+    broker = FakeBroker()
+    topics = ["ta", "tb", "tc"]  # pads to the T=4 bucket
+    for t in topics:
+        for p in range(64):
+            broker.with_partition(t, p, end=(p + 1) * 10, committed=0)
+
+    a = LagBasedPartitionAssignor()
+    a.configure(
+        {"group.id": "g", "tpu.assignor.warmup.shapes": "64:4:3"}
+    )
+    a._metadata_consumer = broker
+    before = assign_batched_rounds._cache_size()
+    ga = a.assign(
+        broker.cluster(),
+        GroupSubscription(
+            {f"m{i}": Subscription(topics) for i in range(4)}
+        ),
+    )
+    after = assign_batched_rounds._cache_size()
+    assert after == before, "multi-topic first rebalance compiled fresh"
+    total = sum(len(s.partitions) for s in ga.group_assignment.values())
+    assert total == 3 * 64
