@@ -1,0 +1,146 @@
+"""One-shot real-TPU validation of the round-4 latency work.
+
+Run when the tunnel is reachable:  python tools/probe_round4.py
+Measures: headline e2e, warm streaming p50, sinkhorn skew/zipf wall time
+(post start-selection), transport floor, and an exact-shape (P=100000)
+vs pow2-padded (131072) sort comparison for the headline kernel.
+"""
+
+import time
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+print("devices:", jax.devices(), flush=True)
+
+
+def med(f, iters=10):
+    f()
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        f()
+        ts.append((time.perf_counter() - t0) * 1000.0)
+    return float(np.median(ts)), float(np.min(ts))
+
+
+def zipf(seed, P, a=1.1, scale=1000):
+    rng = np.random.default_rng(seed)
+    ranks = rng.permutation(P) + 1
+    return (scale * (P / ranks) ** (1.0 / a)).astype(np.int64)
+
+
+from kafka_lag_based_assignor_tpu.ops.batched import assign_stream
+from kafka_lag_based_assignor_tpu.models.sinkhorn import assign_topic_sinkhorn
+from kafka_lag_based_assignor_tpu.ops.packing import pad_topic_rows
+from kafka_lag_based_assignor_tpu.ops.streaming import StreamingAssignor
+import bench as bench_mod
+
+P, C = 100_000, 1000
+lags = zipf(5, P)
+
+m, mn = med(lambda: np.asarray(assign_stream(lags, num_consumers=C)), 20)
+print(f"headline e2e: median {m:.2f} min {mn:.2f} ms", flush=True)
+
+fm, fmn = bench_mod.transport_floor_ms(lags, C)
+print(f"transport floor: median {fm:.2f} min {fmn:.2f} ms "
+      f"(above-floor {m - fm:.2f})", flush=True)
+
+# Warm streaming
+eng = StreamingAssignor(num_consumers=C, refine_iters=128,
+                        imbalance_guardrail=1.25)
+eng.rebalance(lags)
+eng.rebalance(lags)
+rng = np.random.default_rng(99)
+lf = lags.astype(np.float64)
+warm = []
+for _ in range(8):
+    lf = lf * rng.lognormal(0, 0.2, P) + rng.integers(0, 1000, P)
+    arr = lf.astype(np.int64)
+    t0 = time.perf_counter()
+    eng.rebalance(arr)
+    warm.append((time.perf_counter() - t0) * 1000.0)
+print(f"warm p50: {np.percentile(warm, 50):.2f} min {min(warm):.2f} ms",
+      flush=True)
+
+# Sinkhorn skew (start selection should pick greedy & stop fast)
+rng = np.random.default_rng(4)
+P2, C2 = 10_000, 512
+sl = np.zeros(P2, dtype=np.int64)
+hot = rng.choice(P2, size=P2 // 10, replace=False)
+sl[hot] = rng.integers(10**5, 10**7, size=hot.size)
+lp, pp, vp = pad_topic_rows(sl)
+
+
+def sk():
+    _, _, t = assign_topic_sinkhorn(lp, pp, vp, num_consumers=C2)
+    return np.asarray(t)
+
+
+m, mn = med(sk, 5)
+tot = sk()
+print(f"sinkhorn skew: median {m:.2f} min {mn:.2f} ms "
+      f"imb {float(tot.max()/tot.mean()):.4f}", flush=True)
+
+# Sinkhorn zipf
+zl = zipf(2, 1000)
+lp2, pp2, vp2 = pad_topic_rows(zl)
+
+
+def sk2():
+    _, _, t = assign_topic_sinkhorn(lp2, pp2, vp2, num_consumers=16)
+    return np.asarray(t)
+
+
+m, mn = med(sk2, 8)
+tot = sk2()
+print(f"sinkhorn zipf: median {m:.2f} min {mn:.2f} ms "
+      f"ratio {float(tot.max()/tot.mean())/1.755907398403936:.5f}",
+      flush=True)
+
+# Sinkhorn northstar quality (single shot)
+lp3, pp3, vp3 = pad_topic_rows(lags)
+t0 = time.perf_counter()
+_, _, t = assign_topic_sinkhorn(lp3, pp3, vp3, num_consumers=C)
+t = np.asarray(t)
+print(f"sinkhorn northstar: {1000*(time.perf_counter()-t0):.0f} ms "
+      f"(first call) imb {float(t.max()/t.mean()):.5f}", flush=True)
+
+# Exact-shape (non-pow2) sort experiment: is padding to 131072 worth it?
+import functools
+import jax.numpy as jnp
+from kafka_lag_based_assignor_tpu.ops.rounds_kernel import assign_topic_rounds
+from kafka_lag_based_assignor_tpu.ops.scan_kernel import pack_shift_for
+
+
+@functools.partial(jax.jit, static_argnames=("num_consumers", "pack_shift"))
+def stream_exact(lags, num_consumers: int, pack_shift: int = 0):
+    P = lags.shape[0]
+    pids = jnp.arange(P, dtype=jnp.int32)
+    valid = jnp.ones((P,), bool)
+    choice, _, _ = assign_topic_rounds(
+        lags.astype(jnp.int64), pids, valid, num_consumers=num_consumers,
+        pack_shift=pack_shift,
+    )
+    return choice.astype(jnp.int16)
+
+
+shift = pack_shift_for(int(lags.max()), P - 1)
+t0 = time.perf_counter()
+np.asarray(stream_exact(lags.astype(np.int32), num_consumers=C,
+                        pack_shift=shift))
+print(f"exact-shape compile+first: {time.perf_counter()-t0:.1f}s",
+      flush=True)
+m, mn = med(lambda: np.asarray(
+    stream_exact(lags.astype(np.int32), num_consumers=C, pack_shift=shift)
+), 20)
+print(f"exact-shape e2e: median {m:.2f} min {mn:.2f} ms", flush=True)
