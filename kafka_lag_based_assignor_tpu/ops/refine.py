@@ -59,7 +59,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .sortops import bincount_sorted, segment_argmin_first, segment_sum
+from .sortops import (
+    _cpu_backend,
+    bincount_sorted,
+    segment_argmin_first,
+    segment_sum,
+)
 
 _PAIR_BITS = 14  # pair-id field width in the packed per-row combo lookup
 
@@ -238,7 +243,11 @@ def refine_assignment(
         )
         tgt = jnp.clip(lags - delta_p, 0, None)
         query = jnp.where(on_heavy, pack_key(k_p, tgt), key_big)
-        pos = jnp.searchsorted(_skey, query, method="sort").astype(jnp.int32)
+        # method="sort" replaces the sequential binary search with one
+        # more bitonic sort — 7x faster on the TPU target; XLA:CPU's
+        # vectorized "scan" search beats an extra big sort there.
+        method = "scan" if _cpu_backend() else "sort"
+        pos = jnp.searchsorted(_skey, query, method=method).astype(jnp.int32)
 
         def neighbour(nb):
             inb = jnp.clip(nb, 0, P - 1)

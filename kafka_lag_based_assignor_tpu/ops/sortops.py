@@ -16,9 +16,14 @@ latency-critical path is therefore re-expressed as a sort:
 * segmented argmin (``segment_argmin_first``) — one packed-key sort taking
   the first row per segment instead of two ``at[].min`` scatters.
 
-All are deterministic (``lax.sort`` is stable).  CPU-backend behavior is
-identical; XLA:CPU sorts are slower than its scatters, but every caller
-here is on the accelerator latency path where the trade is ~50x in favor.
+The cost profile INVERTS on XLA:CPU (scatters are cheap there, big sorts
+slow), so each primitive picks its implementation by backend at trace
+time: scatter-based on the ``cpu`` backend, sort-based everywhere else.
+Both implementations satisfy the same contracts (results are equal except
+where documented — ``segment_argmin_first``'s winner may differ among
+near-minimal candidates) and are pinned by tests/test_sortops.py on both
+paths.  All are deterministic per backend (``lax.sort`` is stable;
+scatter-min uses a first-index rule).
 """
 
 from __future__ import annotations
@@ -26,6 +31,13 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+
+def _cpu_backend() -> bool:
+    """Trace-time backend check: this repo always executes on the default
+    backend (no explicit device placement), so the trace-time default
+    matches the execution backend."""
+    return jax.default_backend() == "cpu"
 
 
 def sort_with(keys: jax.Array, *payloads: jax.Array):
@@ -38,15 +50,20 @@ def sort_with(keys: jax.Array, *payloads: jax.Array):
 
 
 def unsort(perm: jax.Array, *sorted_vals: jax.Array):
-    """Invert a permutation scatter-free.
+    """Invert a permutation.
 
     Given ``sorted_vals[i]`` belonging to input row ``perm[i]``, returns
-    each values array re-ordered to input rows — exactly
-    ``out.at[perm].set(vals)`` for a true permutation, via one stable sort
-    on ``perm`` (whose sorted order is 0..P-1).
+    each values array re-ordered to input rows — ``out.at[perm].set(vals)``
+    for a true permutation.  Accelerators use one stable sort on ``perm``
+    (whose sorted order is 0..P-1) instead of the scatter.
 
     Returns a single array for one payload, else a tuple.
     """
+    if _cpu_backend():
+        out = tuple(
+            jnp.zeros_like(v).at[perm].set(v) for v in sorted_vals
+        )
+        return out[0] if len(out) == 1 else out
     out = lax.sort((perm, *sorted_vals), num_keys=1)[1:]
     return out[0] if len(out) == 1 else out
 
@@ -60,23 +77,38 @@ def _boundaries(sorted_vals: jax.Array, num_segments: int) -> jax.Array:
 
 
 def bincount_sorted(vals: jax.Array, num_segments: int) -> jax.Array:
-    """Histogram of ``vals`` over bins 0..S-1, scatter-free.
+    """Histogram of ``vals`` over bins 0..S-1.
 
     Out-of-range values (negative padding markers, sentinel S) fall outside
     the counted range.  Returns int32[S].
     """
+    S = int(num_segments)
+    if _cpu_backend():
+        in_range = (vals >= 0) & (vals < S)
+        return (
+            jnp.zeros((S,), jnp.int32)
+            .at[jnp.clip(vals, 0, S - 1)]
+            .add(in_range.astype(jnp.int32))
+        )
     sv = jnp.sort(vals)
-    b = _boundaries(sv.astype(jnp.int32), num_segments)
+    b = _boundaries(sv.astype(jnp.int32), S)
     return b[1:] - b[:-1]
 
 
 def segment_sum(
     vals: jax.Array, seg: jax.Array, num_segments: int
 ) -> jax.Array:
-    """Sum ``vals`` per segment id, scatter-free (sort + cumsum + boundary
+    """Sum ``vals`` per segment id (accelerators: sort + cumsum + boundary
     differences).  ``seg`` entries outside 0..S-1 are excluded.  Exact for
     integer dtypes (cumsum in the value dtype).  Returns vals-dtype[S]."""
     S = int(num_segments)
+    if _cpu_backend():
+        in_range = (seg >= 0) & (seg < S)
+        return (
+            jnp.zeros((S,), vals.dtype)
+            .at[jnp.clip(seg, 0, S - 1)]
+            .add(jnp.where(in_range, vals, 0))
+        )
     sseg, svals = sort_with(
         jnp.clip(seg, -1, S).astype(jnp.int32), vals
     )
@@ -98,13 +130,27 @@ def segment_argmin_first(
     EXACT score at the returned index, so quantization only ever perturbs
     which near-minimal candidate is picked, never validity.
 
-    ``seg`` entries equal to ``num_segments`` are parked in a discard
-    segment.  Returns (exact score at winner, winner index; index == P and
-    score == dtype-max for empty segments).
+    ``seg`` entries equal to ``num_segments`` — or out of range entirely
+    (negative padding markers, > S) — are discarded on both paths.
+    Returns (exact score at winner, winner index; index == P and score ==
+    dtype-max for empty segments).
+
+    CPU backend: exact scatter-min argmin (first index attaining the true
+    minimum) — same contract, winner may differ from the sort path's
+    among near-minimal candidates.
     """
     S = int(num_segments)
-    segbits = max(1, S.bit_length())
     big = jnp.iinfo(score.dtype).max
+    if _cpu_backend():
+        # Out-of-range seg entries (negative padding or the S sentinel)
+        # park in the discard bin S so they cannot contaminate bin 0.
+        seg_safe = jnp.where((seg < 0) | (seg > S), S, seg)
+        minv = jnp.full((S + 1,), big, score.dtype).at[seg_safe].min(score)
+        hit = (score == minv[seg_safe]) & (seg_safe < S)
+        idx_cand = jnp.where(hit, jnp.arange(P, dtype=jnp.int32), P)
+        idx = jnp.full((S + 1,), P, jnp.int32).at[seg_safe].min(idx_cand)
+        return minv[:S], idx[:S]
+    segbits = max(1, S.bit_length())
     key = (seg.astype(jnp.int64) << (63 - segbits)) | (
         score.astype(jnp.int64) >> segbits
     )

@@ -10,6 +10,7 @@ import pytest
 
 import jax.numpy as jnp
 
+import kafka_lag_based_assignor_tpu.ops.sortops as sortops
 from kafka_lag_based_assignor_tpu.ops.sortops import (
     bincount_sorted,
     segment_argmin_first,
@@ -17,6 +18,18 @@ from kafka_lag_based_assignor_tpu.ops.sortops import (
     sort_with,
     unsort,
 )
+
+
+@pytest.fixture(params=["scatter", "sort"], autouse=True)
+def both_paths(request, monkeypatch):
+    """Every test runs against BOTH implementations: the scatter path (the
+    CPU backend's) and the sort path (the accelerator production path) —
+    CI is CPU-only, so without this the sort branches would be dead code
+    under test."""
+    monkeypatch.setattr(
+        sortops, "_cpu_backend", lambda: request.param == "scatter"
+    )
+    return request.param
 
 
 @pytest.mark.parametrize("seed", range(5))
@@ -78,6 +91,18 @@ def test_segment_argmin_first_exact_value_and_validity():
     assert score[idx[0]] == minv[0]
     assert minv[1] == np.iinfo(np.int64).max and idx[1] == 5  # empty
     assert minv[2] == 5 and idx[2] == 4
+
+
+def test_segment_argmin_first_negative_seg_discarded():
+    """Out-of-range seg entries (negative padding markers) are parked in
+    the discard bin on BOTH paths — they must not contaminate bin 0."""
+    score = np.array([1, 5, 7], dtype=np.int64)
+    seg = np.array([-1, 0, 0], dtype=np.int32)
+    minv, idx = segment_argmin_first(
+        jnp.asarray(score), jnp.asarray(seg), 1, 3
+    )
+    assert int(np.asarray(minv)[0]) == 5
+    assert int(np.asarray(idx)[0]) == 1
 
 
 @pytest.mark.parametrize("seed", range(8))
