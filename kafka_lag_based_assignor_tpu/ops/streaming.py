@@ -18,6 +18,14 @@ the optional warm start for the streaming-rebalance benchmark):
   concurrent disjoint exchanges, so churn is bounded by 2 x refine_iters
   instead of O(P).
 
+* **membership change** — :meth:`StreamingAssignor.remap_members` carries
+  the warm state across a join/leave (the usual rebalance trigger, where
+  the stateless reference reshuffles O(P) partitions): surviving members
+  keep their partitions, a host-side repair pass re-seats only orphaned
+  rows and capacity overflow (count-primary greedy over the moving rows),
+  and the exchange refinement re-tightens balance — churn bounded by
+  ``repaired_rows + 2 * refine_iters``.
+
 The churn/quality trade-off is configurable per rebalance via
 ``refine_iters``.
 """
@@ -41,6 +49,7 @@ class StreamingStats:
     cold_start: bool = False
     guardrail_tripped: bool = False  # warm quality fell past the guardrail
     churn: int = 0  # partitions whose consumer changed vs previous epoch
+    repaired_rows: int = 0  # rows re-seated by the membership repair pass
     max_mean_imbalance: float = 1.0
     imbalance_bound: float = 1.0  # input-driven lower bound max_lag/mean
     count_spread: int = 0
@@ -89,10 +98,18 @@ class StreamingAssignor:
             prev_for_churn = None
         elif self.refine_iters <= 0:
             # Zero exchange budget: keep the previous assignment untouched
-            # (churn bound 2 * refine_iters = 0 holds exactly).
-            choice = prev
+            # up to MEMBERSHIP repair, which is not an exchange — orphaned
+            # rows must be owned regardless of budget (the churn bound
+            # reads repaired_rows + 2 * refine_iters).
             prev_for_churn = prev
+            choice, stats.repaired_rows = self._repair_choice(prev, lags)
         else:
+            # Membership repair: after remap_members the previous choice
+            # may hold orphaned rows (-1, owner left) or counts above the
+            # new ceiling (group shrank/grew).  Re-seat ONLY the moving
+            # rows host-side before the exchange refinement.
+            prev_for_churn = prev  # churn counts repair moves too
+            prev, stats.repaired_rows = self._repair_choice(prev, lags)
             # Pad so the refine kernel's P-sized sorts hit fast shapes and
             # the jit cache stays bounded across slowly-varying P: the
             # power-of-two bucket on accelerators (sort-network-friendly),
@@ -124,7 +141,6 @@ class StreamingAssignor:
                 max_pairs=pairs,
             )
             choice = np.asarray(choice)[:P]
-            prev_for_churn = prev
 
         self._fill_quality_stats(stats, choice, lags)
 
@@ -165,6 +181,79 @@ class StreamingAssignor:
             lags, self.num_consumers
         )
 
+    def remap_members(
+        self, old_to_new: np.ndarray, new_num_consumers: int
+    ) -> None:
+        """Carry warm state across a MEMBERSHIP change with bounded churn.
+
+        Kafka rebalances are usually triggered by a member joining or
+        leaving, and the reference — stateless — reshuffles from scratch
+        (O(P) churn).  This keeps every surviving member's partitions in
+        place: ``old_to_new[i]`` is consumer i's new dense index (-1 if it
+        left; joiners simply extend the range).  Orphaned rows (owners who
+        left) are re-seated by the next :meth:`rebalance`'s repair pass,
+        and joiners fill via the same pass, so churn is bounded by
+        ``orphans + capacity overflow + 2 * refine_iters`` instead of P.
+
+        Call this between rebalances when the group membership changed;
+        call :meth:`reset` instead to force a full re-solve.
+        """
+        old_to_new = np.ascontiguousarray(old_to_new, dtype=np.int32)
+        if self._prev_choice is not None:
+            prev = self._prev_choice
+            valid = (prev >= 0) & (prev < old_to_new.shape[0])
+            remapped = np.full(prev.shape[0], -1, dtype=np.int32)
+            remapped[valid] = old_to_new[prev[valid]]
+            self._prev_choice = remapped
+        self.num_consumers = int(new_num_consumers)
+
+    def _repair_choice(self, choice: np.ndarray, lags: np.ndarray):
+        """Seat unowned rows and enforce the count invariant host-side.
+
+        After :meth:`remap_members`, some rows are orphaned (-1) and the
+        surviving members' counts may exceed the new ceiling
+        ``ceil(P / C)``.  Overflowing owners release their SMALLEST-lag
+        rows (cheapest churn); then orphans, largest lag first, go to the
+        least-loaded open consumer — the count-primary greedy rule over
+        only the moving rows, O(moving * C) host work on a few hundred
+        rows, versus a full device re-solve.
+
+        Owns its trigger: returns ``(choice unchanged, 0)`` when there is
+        nothing to repair.  Returns ``(repaired choice, rows moved)``.
+        """
+        C = self.num_consumers
+        P = lags.shape[0]
+        cap = -(-P // C)  # ceil: no consumer may exceed the new ceiling
+        counts = np.bincount(choice[choice >= 0], minlength=C)
+        has_orphans = bool((choice < 0).any())
+        if not has_orphans and counts.max() <= cap:
+            return choice, 0
+        original = choice
+        choice = choice.copy()
+        totals = np.zeros(C, dtype=np.int64)
+        sel = choice >= 0
+        np.add.at(totals, choice[sel], lags[sel])
+        # Release overflow (smallest lag first -> cheapest to move).
+        for c in np.nonzero(counts > cap)[0]:
+            rows = np.nonzero(choice == c)[0]
+            release = rows[np.argsort(lags[rows])][: counts[c] - cap]
+            choice[release] = -1
+            counts[c] = cap
+            totals[c] -= lags[release].sum()
+        # Seat orphans: largest lag first, least (count, total) open seat.
+        orphans = np.nonzero(choice < 0)[0]
+        for p in orphans[np.argsort(-lags[orphans])]:
+            open_mask = counts < cap
+            key = np.where(open_mask, counts, np.iinfo(np.int64).max)
+            cand = key == key.min()
+            who = int(
+                np.argmin(np.where(cand, totals, np.iinfo(np.int64).max))
+            )
+            choice[p] = who
+            counts[who] += 1
+            totals[who] += lags[p]
+        return choice, int((choice != original).sum())
+
     def reset(self) -> None:
-        """Drop warm state (e.g. on membership change)."""
+        """Drop warm state (force the next rebalance to solve cold)."""
         self._prev_choice = None

@@ -126,3 +126,93 @@ def test_reset_forces_cold_start():
     engine.reset()
     engine.rebalance(lags)
     assert engine.last_stats.cold_start
+
+
+class TestMembershipChange:
+    """remap_members: warm state survives join/leave with bounded churn."""
+
+    def _engine_with_state(self, P=2000, C=10, seed=0):
+        rng = np.random.default_rng(seed)
+        lags = rng.integers(0, 10**9, P).astype(np.int64)
+        eng = StreamingAssignor(num_consumers=C, refine_iters=64)
+        choice = eng.rebalance(lags)
+        return eng, lags, choice
+
+    def test_member_leave_bounded_churn(self):
+        eng, lags, before = self._engine_with_state()
+        C = 10
+        # Consumer 3 leaves; survivors keep their dense rank order.
+        old_to_new = np.array(
+            [0, 1, 2, -1, 3, 4, 5, 6, 7, 8], dtype=np.int32
+        )
+        eng.remap_members(old_to_new, C - 1)
+        after = eng.rebalance(lags)
+        s = eng.last_stats
+        assert not s.cold_start
+        orphans = int((before == 3).sum())
+        assert s.repaired_rows >= orphans
+        # Churn: orphans move, plus the repair/refine budget — far from a
+        # full reshuffle.
+        assert s.churn <= s.repaired_rows + 2 * 64
+        assert s.churn < lags.shape[0] // 2
+        # Survivors keep their seats up to the bounded moves.
+        survivors = before != 3
+        moved = (after[survivors] != old_to_new[before[survivors]]).sum()
+        assert moved <= 2 * 64 + s.repaired_rows - orphans
+        cnt = np.bincount(after, minlength=C - 1)
+        assert cnt.max() - cnt.min() <= 1
+        assert s.count_spread <= 1
+
+    def test_member_join_bounded_churn(self):
+        eng, lags, before = self._engine_with_state()
+        C = 10
+        eng.remap_members(np.arange(C, dtype=np.int32), C + 1)
+        after = eng.rebalance(lags)
+        s = eng.last_stats
+        assert not s.cold_start
+        cnt = np.bincount(after, minlength=C + 1)
+        # The joiner received a fair share; invariant holds.
+        assert cnt[C] > 0
+        assert cnt.max() - cnt.min() <= 1
+        assert s.churn < lags.shape[0] // 2
+
+    def test_member_churn_quality_recovers(self):
+        eng, lags, _ = self._engine_with_state(seed=3)
+        C = 10
+        old_to_new = np.array(
+            [0, 1, 2, -1, 3, 4, 5, 6, 7, 8], dtype=np.int32
+        )
+        eng.remap_members(old_to_new, C - 1)
+        eng.rebalance(lags)
+        s = eng.last_stats
+        # Near-uniform lags: quality should return close to the bound.
+        assert s.max_mean_imbalance <= 1.1 * max(s.imbalance_bound, 1.0)
+
+    def test_remap_before_any_state_is_noop(self):
+        eng = StreamingAssignor(num_consumers=4, refine_iters=8)
+        eng.remap_members(np.arange(4, dtype=np.int32), 5)
+        assert eng.num_consumers == 5
+        lags = np.arange(100, dtype=np.int64)
+        choice = eng.rebalance(lags)
+        assert eng.last_stats.cold_start
+        cnt = np.bincount(choice, minlength=5)
+        assert cnt.max() - cnt.min() <= 1
+
+    def test_zero_budget_still_repairs_membership(self):
+        """refine_iters=0 means zero EXCHANGES, but membership repair must
+        still run: orphaned rows may never be returned unowned."""
+        rng = np.random.default_rng(1)
+        P, C = 400, 4
+        lags = rng.integers(0, 10**6, P).astype(np.int64)
+        eng = StreamingAssignor(num_consumers=C, refine_iters=0)
+        before = eng.rebalance(lags)
+        mapping = np.array([0, 1, 2, -1], dtype=np.int32)
+        eng.remap_members(mapping, 3)
+        after = eng.rebalance(lags)
+        s = eng.last_stats
+        assert (after >= 0).all()
+        assert s.repaired_rows >= int((before == 3).sum())
+        # Zero exchanges: churn == exactly the repaired rows.
+        assert s.churn == s.repaired_rows
+        cnt = np.bincount(after, minlength=3)
+        assert cnt.max() - cnt.min() <= 1
