@@ -214,3 +214,23 @@ def test_quality_ratio_and_bound_in_record():
     record = json.loads(stats.to_json())
     assert record["quality_ratio"] == stats.quality_ratio
     assert record["imbalance_bound"] == expected_bound
+
+
+def test_count_constrained_bound_edge_cases():
+    import numpy as np
+
+    from kafka_lag_based_assignor_tpu.utils.observability import (
+        count_constrained_bound,
+    )
+
+    # P < C: the count floor is 0, so the bound reduces to max/mean.
+    lags = np.array([5, 1], dtype=np.int64)
+    assert count_constrained_bound(lags, 4) == 5 / (6 / 4)
+    # All-zero lags: clamped to 1.0 (no meaningful mean).
+    assert count_constrained_bound(np.zeros(8, np.int64), 2) == 1.0
+    # Uniform lags, P divisible by C: bound == 1 * floor_cap/share... the
+    # peak holds exactly floor(P/C) equal rows == the fair share.
+    lags = np.full(100, 7, dtype=np.int64)
+    assert count_constrained_bound(lags, 10) == 1.0
+    # Single consumer: everything on it; bound == 1.
+    assert count_constrained_bound(np.arange(1, 6, dtype=np.int64), 1) == 1.0
