@@ -150,10 +150,18 @@ def assign_device(
     by_topic = consumers_per_topic(subscriptions)
     groups = build_groups(partition_lag_per_topic, by_topic)
 
+    # Dispatch EVERY group before materializing ANY result: JAX dispatch is
+    # async, and on a high-latency transport (the tunneled chip: ~50 ms per
+    # awaited round-trip, overlapping when in flight together —
+    # BASELINE.md) this turns G sequential round-trips into ~one.
+    dispatched = [
+        (group, assign_group_device(group, kernel=kernel)[0])
+        for group in groups
+    ]
+
     fragments: Dict[str, Dict[str, List[TopicPartition]]] = {}
-    for group in groups:
-        choice, _, _ = assign_group_device(group, kernel=kernel)
-        choice = np.asarray(choice)
+    for group, device_choice in dispatched:
+        choice = np.asarray(device_choice)
         for ti, topic in enumerate(group.topics):
             fragments[topic] = _rebuild_topic(
                 topic,
@@ -200,6 +208,10 @@ def assign_per_topic(
     """
     assignment: AssignmentMap = {m: [] for m in subscriptions}
     by_topic = consumers_per_topic(subscriptions)
+    # Two-phase for the same reason as assign_device: solve_topic's device
+    # dispatch is async, so issue every topic's solve before materializing
+    # any result (one overlapped round-trip instead of one per topic).
+    dispatched = []
     for topic in sorted(by_topic):
         members = sorted(set(by_topic[topic]))
         rows = partition_lag_per_topic.get(topic, ())
@@ -208,7 +220,12 @@ def assign_per_topic(
         P = len(rows)
         lags = np.fromiter((r.lag for r in rows), np.int64, count=P)
         pids = np.fromiter((r.partition for r in rows), np.int32, count=P)
-        choice = np.asarray(solve_topic(lags, pids, len(members)))[:P]
+        dispatched.append(
+            (topic, members, lags, pids, P,
+             solve_topic(lags, pids, len(members)))
+        )
+    for topic, members, lags, pids, P, result in dispatched:
+        choice = np.asarray(result)[:P]
         frag = _rebuild_topic(
             topic, members, lags, pids, np.ones(P, dtype=bool), choice
         )
