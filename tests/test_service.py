@@ -277,3 +277,30 @@ def test_valid_options_accepted(service):
         )
     assert result["assignments"]["m"] == [["t", 0], ["t", 1]]
     assert result["stats"]["fallback_used"] is False
+
+
+def test_concurrent_clients_device_solver(service):
+    """Concurrent assign requests through the DEVICE solver path: jax
+    dispatch from the server's worker threads must serialize safely and
+    every client gets a complete, count-balanced answer."""
+    topics = {"t0": [[p, (p + 1) * 7] for p in range(32)]}
+    results = []
+
+    def run(i):
+        with client_for(service) as c:
+            results.append(
+                c.assign(
+                    topics, {f"m{i}": ["t0"], "peer": ["t0"]},
+                    solver="rounds",
+                )
+            )
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(results) == 6
+    for r in results:
+        sizes = sorted(len(v) for v in r.values())
+        assert sizes == [16, 16]
