@@ -7,9 +7,11 @@ state invalidation for the consumers).  The streaming engine keeps the
 previous choice vector as a warm start (SURVEY §5 checkpoint/resume row —
 the optional warm start for the streaming-rebalance benchmark):
 
-* **cold start / membership or shape change** — full solve with the
-  transfer-lean :func:`..ops.batched.assign_stream` path (optionally plus
-  refinement);
+* **cold start / shape change / guardrail trip** — full solve with the
+  transfer-lean :func:`..ops.batched.assign_stream` path plus a
+  quality-refinement pass (churn is unbounded on cold paths anyway, and
+  refining makes a guardrail trip actually restore near-bound quality
+  rather than resetting to plain greedy's slack);
 * **warm rebalance** — keep the previous assignment and run only the
   parallel pairwise-exchange refinement (:mod:`.refine`) under the NEW
   lags.  The count invariant is preserved by construction, imbalance is
@@ -61,9 +63,9 @@ class StreamingAssignor:
     ``imbalance_guardrail`` bounds how far the bounded-churn warm path may
     drift from balance across epochs: after a warm rebalance, if
     ``max_mean_imbalance > guardrail * max(input bound, 1)`` the epoch is
-    re-solved cold (unbounded churn, restored quality) — quality
-    degradation is capped at the cost of occasional full reshuffles.
-    ``None`` disables the guardrail (pure bounded-churn behavior).
+    re-solved cold — greedy plus a refinement pass, so the trip restores
+    near-bound quality (unbounded churn for that epoch).  ``None``
+    disables the guardrail (pure bounded-churn behavior).
     """
 
     def __init__(
@@ -71,9 +73,17 @@ class StreamingAssignor:
         num_consumers: int,
         refine_iters: int = 128,
         imbalance_guardrail: Optional[float] = None,
+        # Refinement budget for cold solves (initial epoch, shape change,
+        # guardrail trip): churn is unbounded on those paths anyway, and
+        # refining makes a guardrail trip actually restore near-bound
+        # quality instead of resetting to plain greedy's slack (observed
+        # ratio 1.63 unrefined vs ~1.0x refined on a lognormal soak).
+        # 0 disables (cold solves return plain greedy).
+        cold_refine_iters: int = 64,
     ):
         self.num_consumers = int(num_consumers)
         self.refine_iters = int(refine_iters)
+        self.cold_refine_iters = int(cold_refine_iters)
         if imbalance_guardrail is not None and imbalance_guardrail < 1.0:
             raise ValueError(
                 f"imbalance_guardrail={imbalance_guardrail} must be >= 1.0"
@@ -92,9 +102,7 @@ class StreamingAssignor:
         prev = self._prev_choice
         if prev is None or prev.shape[0] != P:
             stats.cold_start = True
-            choice = np.asarray(
-                assign_stream(lags, num_consumers=self.num_consumers)
-            ).astype(np.int32)
+            choice = self._cold_solve(lags)
             prev_for_churn = None
         elif self.refine_iters <= 0:
             # Zero exchange budget: keep the previous assignment untouched
@@ -110,37 +118,11 @@ class StreamingAssignor:
             # rows host-side before the exchange refinement.
             prev_for_churn = prev  # churn counts repair moves too
             prev, stats.repaired_rows = self._repair_choice(prev, lags)
-            # Pad so the refine kernel's P-sized sorts hit fast shapes and
-            # the jit cache stays bounded across slowly-varying P: the
-            # power-of-two bucket on accelerators (sort-network-friendly),
-            # the fine 4096-chunk on CPU where a pow2 pad wastes up to ~2x
-            # sort work but the cache still needs bounding.
-            import jax
-
-            B = (
-                pad_chunk(P)
-                if jax.default_backend() == "cpu"
-                else pad_bucket(P)
-            )
-            lags_p = np.zeros(B, dtype=np.int64)
-            lags_p[:P] = lags
-            valid = np.zeros(B, dtype=bool)
-            valid[:P] = True
-            prev_p = np.full(B, -1, dtype=np.int32)
-            prev_p[:P] = prev
             # refine_iters is the exchange budget: rounds * pairs <= budget
             # keeps the documented churn bound of 2 * refine_iters.
             pairs = max(1, min(self.num_consumers // 2, self.refine_iters))
             rounds = max(1, self.refine_iters // pairs)
-            choice, _, _ = refine_assignment(
-                lags_p,
-                valid,
-                prev_p,
-                num_consumers=self.num_consumers,
-                iters=rounds,
-                max_pairs=pairs,
-            )
-            choice = np.asarray(choice)[:P]
+            choice = self._refine_padded(lags, prev, rounds, pairs)
 
         self._fill_quality_stats(stats, choice, lags)
 
@@ -154,9 +136,7 @@ class StreamingAssignor:
         ):
             stats.guardrail_tripped = True
             stats.cold_start = True
-            choice = np.asarray(
-                assign_stream(lags, num_consumers=self.num_consumers)
-            ).astype(np.int32)
+            choice = self._cold_solve(lags)
             self._fill_quality_stats(stats, choice, lags)
 
         if prev_for_churn is not None:
@@ -164,6 +144,47 @@ class StreamingAssignor:
         self._prev_choice = choice
         self.last_stats = stats
         return choice
+
+    def _cold_solve(self, lags: np.ndarray) -> np.ndarray:
+        """Fresh greedy solve + quality refinement (unbounded-churn path;
+        budget = ``cold_refine_iters``, 0 disables)."""
+        choice = np.asarray(
+            assign_stream(lags, num_consumers=self.num_consumers)
+        ).astype(np.int32)
+        if self.cold_refine_iters <= 0 or self.num_consumers < 2:
+            return choice
+        return self._refine_padded(
+            lags, choice, self.cold_refine_iters, None
+        )
+
+    def _refine_padded(
+        self,
+        lags: np.ndarray,
+        choice: np.ndarray,
+        iters: int,
+        max_pairs: Optional[int],
+    ) -> np.ndarray:
+        """THE pad-and-refine call both the warm path and the cold solve
+        use.  Pads so the refine kernel's P-sized sorts hit fast shapes
+        and the jit cache stays bounded across slowly-varying P: the
+        power-of-two bucket on accelerators (sort-network-friendly), the
+        fine 4096-chunk on CPU where a pow2 pad wastes up to ~2x sort
+        work but the cache still needs bounding."""
+        import jax
+
+        P = lags.shape[0]
+        B = pad_chunk(P) if jax.default_backend() == "cpu" else pad_bucket(P)
+        lags_p = np.zeros(B, dtype=np.int64)
+        lags_p[:P] = lags
+        valid = np.zeros(B, dtype=bool)
+        valid[:P] = True
+        choice_p = np.full(B, -1, dtype=np.int32)
+        choice_p[:P] = choice
+        refined, _, _ = refine_assignment(
+            lags_p, valid, choice_p, num_consumers=self.num_consumers,
+            iters=iters, max_pairs=max_pairs,
+        )
+        return np.asarray(refined)[:P]
 
     def _fill_quality_stats(
         self, stats: StreamingStats, choice: np.ndarray, lags: np.ndarray

@@ -108,8 +108,11 @@ def warmup(
 
                 def stream_job(lags1d=lags1d, C=C):
                     # Cold + warm pair through the production engine: the
-                    # cold call compiles assign_stream, the warm call
-                    # compiles refine_assignment at the padded bucket shape
+                    # cold call compiles assign_stream AND the cold-solve
+                    # refine executable (its iters/max_pairs static args
+                    # differ from the warm path's, so it is a separate
+                    # compile); the warm call compiles the warm-path
+                    # refine_assignment variant at the padded bucket shape
                     # with the production exchange budget.
                     from .ops.batched import assign_stream
                     from .ops.streaming import StreamingAssignor
