@@ -79,3 +79,25 @@ def test_warmup_scan_solver_compiles():
     valid = np.ones((1, 32), dtype=bool)
     assign_batched_scan(lags, pids, valid, num_consumers=2)
     assert assign_batched_scan._cache_size() == before
+
+
+def test_stream_warmup_covers_cold_refine_variant():
+    """The stream warm-up's cold call compiles the cold-solve refine
+    executable too, so a production guardrail trip never pays a fresh
+    compile (its static args differ from the warm path's)."""
+    import numpy as np
+
+    from kafka_lag_based_assignor_tpu.ops.refine import refine_assignment
+    from kafka_lag_based_assignor_tpu.ops.streaming import StreamingAssignor
+    from kafka_lag_based_assignor_tpu.warmup import warmup
+
+    warmup(max_partitions=64, consumers=[4], solvers=("stream",))
+    before = refine_assignment._cache_size()
+    # Fresh engine at the warmed shape: cold start (refined) then a
+    # guardrail-trip-style cold solve must both hit the cache.
+    eng = StreamingAssignor(num_consumers=4, refine_iters=128,
+                            imbalance_guardrail=1.25)
+    lags = np.arange(64, dtype=np.int64) * 100
+    eng.rebalance(lags)   # cold (refined)
+    eng.rebalance(lags)   # warm
+    assert refine_assignment._cache_size() == before
