@@ -1,0 +1,92 @@
+"""Direct tests for the pad-and-mask packing layer (previously covered
+only transitively through the batched/dispatch suites)."""
+
+import numpy as np
+import pytest
+
+from kafka_lag_based_assignor_tpu.ops.packing import (
+    build_groups,
+    pad_bucket,
+    pad_chunk,
+    pad_topic_rows,
+)
+from kafka_lag_based_assignor_tpu.types import TopicPartitionLag
+
+
+@pytest.mark.parametrize(
+    "n, expect",
+    [(0, 8), (1, 8), (8, 8), (9, 16), (100_000, 131072)],
+)
+def test_pad_bucket(n, expect):
+    assert pad_bucket(n) == expect
+
+
+def test_pad_bucket_minimum_one():
+    assert pad_bucket(1, minimum=1) == 1
+    assert pad_bucket(3, minimum=1) == 4
+
+
+@pytest.mark.parametrize(
+    "n, expect",
+    [(0, 4096), (1, 4096), (4096, 4096), (4097, 8192), (100_000, 102400)],
+)
+def test_pad_chunk(n, expect):
+    assert pad_chunk(n) == expect
+
+
+def test_pad_topic_rows_shapes_and_mask():
+    lags, pids, valid = pad_topic_rows([5, 3, 9])
+    assert lags.shape == (8,) and valid.sum() == 3
+    np.testing.assert_array_equal(lags[:3], [5, 3, 9])
+    np.testing.assert_array_equal(pids[:3], [0, 1, 2])
+    assert not valid[3:].any() and (lags[3:] == 0).all()
+
+
+def _rows(topic, n, base=100):
+    return [TopicPartitionLag(topic, p, base * (p + 1)) for p in range(n)]
+
+
+def test_build_groups_by_subscriber_set():
+    lag_map = {
+        "a": _rows("a", 3),
+        "b": _rows("b", 10),
+        "c": _rows("c", 1),
+    }
+    consumers = {"a": ["m1", "m2"], "b": ["m2", "m1", "m1"], "c": ["m3"]}
+    groups = build_groups(lag_map, consumers)
+    # a and b share the deduped subscriber set {m1, m2}; c is its own group.
+    assert [g.topics for g in groups] == [["a", "b"], ["c"]]
+    g0 = groups[0]
+    assert g0.members == ["m1", "m2"] and g0.num_consumers == 2
+    # T pads 2 -> 2 (pow2, minimum 1); P pads max(3, 10) -> 16.
+    assert g0.lags.shape == (2, 16)
+    assert g0.valid[0].sum() == 3 and g0.valid[1].sum() == 10
+    # Row values land in topic-sorted order with ids/lags aligned.
+    np.testing.assert_array_equal(g0.partition_ids[1, :10], np.arange(10))
+    np.testing.assert_array_equal(
+        g0.lags[1, :10], 100 * (np.arange(10) + 1)
+    )
+
+
+def test_build_groups_drops_empty_topics():
+    lag_map = {"has_rows": _rows("has_rows", 2), "no_rows": []}
+    consumers = {
+        "has_rows": ["m1"],
+        "no_rows": ["m1"],
+        "no_consumers_topic": [],
+    }
+    groups = build_groups(lag_map, consumers)
+    assert [g.topics for g in groups] == [["has_rows"]]
+
+
+def test_build_groups_empty_input():
+    assert build_groups({}, {}) == []
+
+
+def test_build_groups_single_topic_no_batch_padding():
+    """T buckets start at 1 so the flagship single-topic shape pays no
+    batch padding."""
+    groups = build_groups(
+        {"t": _rows("t", 5)}, {"t": ["m1", "m2", "m3"]}
+    )
+    assert groups[0].lags.shape[0] == 1
