@@ -326,13 +326,27 @@ def config2_zipf():
 
 
 def config3_vmap():
-    """256 topics x 64 partitions, 64 consumers, uniform lag."""
+    """256 topics x 64 partitions, 64 consumers, uniform lag.
+
+    Uses the dense transfer-lean batch path (lags-only upload — pids and
+    validity are derived on device for dense topics; the general
+    assign_batched_rounds path exists for ragged/sparse groups and is
+    parity-pinned against this one in tests/test_fast_paths.py)."""
+    from kafka_lag_based_assignor_tpu.ops.batched import assign_stream_batch
+
     rng = np.random.default_rng(3)
     T, P, C = 256, 64, 64
     lags = rng.integers(0, 1000, size=(T, P)).astype(np.int64)
     pids = np.tile(np.arange(P, dtype=np.int32), (T, 1))
     valid = np.ones((T, P), dtype=bool)
-    ms, _, totals = device_assign_ms(lags, pids, valid, C)
+
+    def once():
+        return np.asarray(assign_stream_batch(lags, num_consumers=C))
+
+    ms, choice = timed_solve(once)
+    totals = np.zeros((T, C), dtype=np.int64)
+    for t in range(T):
+        np.add.at(totals[t], choice[t].astype(np.int64), lags[t])
     member_load = totals.sum(axis=0)
 
     # Cross-topic global-balance quality mode (beyond-reference): same
@@ -437,6 +451,52 @@ def config5_northstar():
     base_totals, base_ms = host_baseline_greedy(lags0, C)
     base_imb = imbalance(base_totals)
 
+    # Streaming: rebalance repeatedly under multiplicative drift + churn,
+    # reusing the compiled kernel (stable exact shape).  Run both modes:
+    # from-scratch each epoch, and the warm-start engine (previous choice
+    # kept, refine dispatched only past the quality threshold).  Runs
+    # BEFORE the sinkhorn single-shot so its numbers are measured in the
+    # same transport window as the headline (the tunnel's latency drifts
+    # over minutes; the sinkhorn first call alone holds it for ~70 s).
+    from kafka_lag_based_assignor_tpu.ops.streaming import StreamingAssignor
+
+    lags = lags0.astype(np.float64)
+    stream_times = []
+    warm_times, warm_churn, warm_ratio = [], [], []
+    warm_trips, warm_refines = 0, 0
+    # Guardrail 1.25x the per-epoch input bound: the bounded-churn warm
+    # path re-solves cold if its quality drifts past the allowance
+    # (exercises the guardrail feature in the recorded numbers).
+    engine = StreamingAssignor(
+        num_consumers=C, refine_iters=128, imbalance_guardrail=1.25
+    )
+    # Pre-compile the warm-path refine executable OUT of the timed loop
+    # with a throwaway always-refine engine (the production engine's
+    # threshold may legitimately skip every dispatch, so its first real
+    # dispatch — wherever it lands — must not pay the compile).
+    warmer = StreamingAssignor(
+        num_consumers=C, refine_iters=128, refine_threshold=None
+    )
+    warmer.rebalance(lags0)
+    warmer.rebalance(lags0)
+    engine.rebalance(lags0)  # cold start (executables all compiled now)
+    for _ in range(10):
+        drift = rng.lognormal(0.0, 0.2, size=P)
+        lags = lags * drift + rng.integers(0, 1000, size=P)
+        arr = lags.astype(np.int64)
+        t, _ = stream_once(arr)
+        stream_times.append(t)
+        t0 = time.perf_counter()
+        engine.rebalance(arr)
+        warm_times.append((time.perf_counter() - t0) * 1000.0)
+        s = engine.last_stats
+        warm_churn.append(s.churn)
+        warm_ratio.append(
+            quality_ratio(s.max_mean_imbalance, s.imbalance_bound)
+        )
+        warm_trips += int(s.guardrail_tripped)
+        warm_refines += int(s.refined)
+
     # Quality mode at north-star scale (single shot — a quality record,
     # not a latency one): the implicit-plan Sinkhorn + refinement.
     from kafka_lag_based_assignor_tpu.models.sinkhorn import (
@@ -458,41 +518,6 @@ def config5_northstar():
     s_tot2 = np.asarray(s_tot2)
     s_ms = (time.perf_counter() - t0) * 1000.0
     s_imb = imbalance(s_tot2)
-
-    # Streaming: rebalance repeatedly under multiplicative drift + churn,
-    # reusing the compiled kernel (stable exact shape).  Run both modes:
-    # from-scratch each epoch, and the warm-start engine (previous choice +
-    # exchange refinement -> bounded churn).
-    from kafka_lag_based_assignor_tpu.ops.streaming import StreamingAssignor
-
-    lags = lags0.astype(np.float64)
-    stream_times = []
-    warm_times, warm_churn, warm_ratio, warm_trips = [], [], [], 0
-    # Guardrail 1.25x the per-epoch input bound: the bounded-churn warm
-    # path re-solves cold if its quality drifts past the allowance
-    # (exercises the guardrail feature in the recorded numbers).
-    engine = StreamingAssignor(
-        num_consumers=C, refine_iters=128, imbalance_guardrail=1.25
-    )
-    engine.rebalance(lags0)  # cold start (assign_stream, already compiled)
-    # Throwaway warm rebalance so refine_assignment's first-call compile
-    # stays out of the timed loop.
-    engine.rebalance(lags0)
-    for _ in range(10):
-        drift = rng.lognormal(0.0, 0.2, size=P)
-        lags = lags * drift + rng.integers(0, 1000, size=P)
-        arr = lags.astype(np.int64)
-        t, _ = stream_once(arr)
-        stream_times.append(t)
-        t0 = time.perf_counter()
-        engine.rebalance(arr)
-        warm_times.append((time.perf_counter() - t0) * 1000.0)
-        s = engine.last_stats
-        warm_churn.append(s.churn)
-        warm_ratio.append(
-            quality_ratio(s.max_mean_imbalance, s.imbalance_bound)
-        )
-        warm_trips += int(s.guardrail_tripped)
 
     return {
         "config": "northstar_100k_1kc",
@@ -517,6 +542,8 @@ def config5_northstar():
         "warm_p50_ms": float(np.percentile(warm_times, 50)),
         "warm_churn_p50": float(np.percentile(warm_churn, 50)),
         "warm_quality_ratio_p50": float(np.percentile(warm_ratio, 50)),
+        "warm_quality_ratio_max": float(np.max(warm_ratio)),
+        "warm_refine_dispatches": warm_refines,
         "warm_guardrail_trips": warm_trips,
         "guardrail": 1.25,
         "target_ms": 50.0,

@@ -108,26 +108,38 @@ def warmup(
 
                 def stream_job(lags1d=lags1d, C=C):
                     # Cold + warm pair through the production engine: the
-                    # cold call compiles assign_stream AND the cold-solve
+                    # cold call compiles assign_stream AND the cold-chain
                     # refine executable (its iters/max_pairs static args
                     # differ from the warm path's, so it is a separate
                     # compile); the warm call compiles the warm-path
-                    # refine_assignment variant at the padded bucket shape
-                    # with the production exchange budget.
+                    # refine variant at the padded bucket shape with the
+                    # production exchange budget.  refine_threshold=None
+                    # forces the warm dispatch — with the default
+                    # threshold a warm-up epoch on unchanged lags would
+                    # skip it (the no-op fast path) and leave the warm
+                    # executable cold.
                     from .ops.batched import assign_stream
                     from .ops.streaming import StreamingAssignor
 
                     engine = StreamingAssignor(
-                        num_consumers=C, refine_iters=stream_refine_iters
+                        num_consumers=C, refine_iters=stream_refine_iters,
+                        refine_threshold=None,
                     )
                     engine.rebalance(lags1d)
                     out = engine.rebalance(lags1d)
                     # assign_stream downcasts the upload to int32 when the
                     # lag range allows; ALSO warm the wide-lag (int64)
-                    # variant so a later rebalance whose lags exceed int32
+                    # variants of both the stream kernel and the warm
+                    # refine so a later rebalance whose lags exceed int32
                     # doesn't hit a fresh compile mid-rebalance.
                     wide = lags1d + (np.int64(1) << 32)
                     assign_stream(wide, num_consumers=C)
+                    engine.rebalance(wide)
+                    # Wide COLD chain too (guardrail trips re-solve cold
+                    # with whatever lags the epoch has; its refine iters
+                    # are a different static arg than the warm path's).
+                    engine.reset()
+                    engine.rebalance(wide)
                     return out
 
                 jobs.append(("stream", 1, stream_job))

@@ -118,3 +118,43 @@ def test_assign_stream_jax_array_input():
     out = np.asarray(assign_stream(lags, num_consumers=4))
     counts = np.bincount(out.astype(np.int64), minlength=4)
     assert counts.sum() == 64 and counts.max() - counts.min() == 0
+
+
+@pytest.mark.parametrize("seed,shape", [(0, (7, 100)), (1, (16, 64)),
+                                        (2, (3, 1000))])
+def test_assign_stream_batch_parity(seed, shape):
+    """The dense transfer-lean batch path must match assign_batched_rounds
+    with explicit dense pids / all-true valid, bit-exactly."""
+    from kafka_lag_based_assignor_tpu.ops.batched import (
+        assign_batched_rounds,
+        assign_stream_batch,
+    )
+
+    rng = np.random.default_rng(seed)
+    T, P = shape
+    C = 16
+    lags = rng.integers(0, 10**10, size=(T, P)).astype(np.int64)
+    out = np.asarray(assign_stream_batch(lags, num_consumers=C))
+    pids = np.tile(np.arange(P, dtype=np.int32), (T, 1))
+    valid = np.ones((T, P), dtype=bool)
+    base_choice, _, _ = assign_batched_rounds(
+        lags, pids, valid, num_consumers=C
+    )
+    assert np.array_equal(out, np.asarray(base_choice))
+    assert out.dtype == np.int16
+
+
+def test_assign_stream_batch_int32_downcast_parity():
+    """Lag ranges fitting int32 take the halved-payload upload; results
+    must be identical to the wide path."""
+    from kafka_lag_based_assignor_tpu.ops.batched import assign_stream_batch
+
+    rng = np.random.default_rng(5)
+    lags = rng.integers(0, 2**30, size=(4, 200)).astype(np.int64)
+    narrow = np.asarray(assign_stream_batch(lags, num_consumers=8))
+    wide = np.asarray(
+        assign_stream_batch(lags + (1 << 40), num_consumers=8)
+    )
+    # +constant shifts every lag equally: identical processing order and
+    # identical counts-primary choices.
+    assert np.array_equal(narrow, wide)
