@@ -67,10 +67,35 @@ def _stream_presorted(lags, perm, num_consumers: int):
     return _narrow_choice(choice, num_consumers)
 
 
+def totals_rank_bits_for(lags: np.ndarray, num_consumers: int) -> int:
+    """Static-arg helper for the packed scatter-free round body
+    (:func:`..ops.rounds_kernel._rounds_body_packed`): any consumer's
+    running total is bounded by the total lag sum, so packing
+    ``(total << rank_bits) | id`` into one int64 key is sound whenever
+    the shifted bound cannot overflow.  The sum is taken in f64 (cannot
+    wrap) and checked against a half-range margin so rounding near the
+    boundary stays conservative.  Returns the rank field width, or 0 when
+    packing is unsafe (the general two-key body runs instead)."""
+    rb = max(1, (int(num_consumers) - 1).bit_length())
+    if lags.size == 0:
+        return rb
+    arr = np.asarray(lags)
+    # Batched [T, P] inputs: each topic's totals are bounded by ITS row
+    # sum, so the guard reads the max per-row sum, not the batch sum.
+    total = float(arr.sum(axis=-1, dtype=np.float64).max())
+    if int(arr.min()) >= 0 and total < float(1 << (61 - rb)):
+        return rb
+    return 0
+
+
 @functools.partial(
-    jax.jit, static_argnames=("num_consumers", "pack_shift")
+    jax.jit,
+    static_argnames=("num_consumers", "pack_shift", "totals_rank_bits"),
 )
-def _stream_device(lags, num_consumers: int, pack_shift: int = 0):
+def _stream_device(
+    lags, num_consumers: int, pack_shift: int = 0,
+    totals_rank_bits: int = 0,
+):
     """Accelerator inner: device sort at a power-of-two padded shape.
 
     Pads device-side to a power-of-two bucket: the transfer stays
@@ -78,7 +103,11 @@ def _stream_device(lags, num_consumers: int, pack_shift: int = 0):
     (non-power-of-two sorts compile pathologically slowly on some
     backends).  Accepts int32 lags (widened on device) — the host wrapper
     downcasts when the lag range allows, halving the host->device bytes
-    on the latency-critical streaming path."""
+    on the latency-critical streaming path.  The exact row count P is
+    static here, so the rounds scan stops at ceil(P / C) rounds instead
+    of scanning the padding (n_valid), and ``totals_rank_bits`` (from
+    :func:`totals_rank_bits_for`) selects the scatter-free packed round
+    body."""
     import jax.numpy as jnp
 
     from .packing import pad_bucket
@@ -90,19 +119,25 @@ def _stream_device(lags, num_consumers: int, pack_shift: int = 0):
     valid = pids < P
     choice, _, _ = assign_topic_rounds(
         lags_p, pids, valid, num_consumers=num_consumers,
-        pack_shift=pack_shift,
+        pack_shift=pack_shift, n_valid=P,
+        totals_rank_bits=totals_rank_bits,
     )
     return _narrow_choice(choice[:P], num_consumers)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("num_consumers", "pack_shift")
+    jax.jit,
+    static_argnames=("num_consumers", "pack_shift", "totals_rank_bits"),
 )
-def _stream_batch_device(lags, num_consumers: int, pack_shift: int = 0):
+def _stream_batch_device(
+    lags, num_consumers: int, pack_shift: int = 0,
+    totals_rank_bits: int = 0,
+):
     """Accelerator inner for the dense topic-batch path: pids and the
     validity mask are derived on device (dense 0..P-1 rows, all valid), so
     the upload is the [T, P] lag matrix alone.  Pads the partition axis
-    device-side to the power-of-two bucket like :func:`_stream_device`."""
+    device-side to the power-of-two bucket like :func:`_stream_device`
+    and shares its trimmed-scan / packed-round-body static args."""
     import jax.numpy as jnp
 
     from .packing import pad_bucket
@@ -116,7 +151,8 @@ def _stream_batch_device(lags, num_consumers: int, pack_shift: int = 0):
     valid = pids < P
     fn = functools.partial(
         assign_topic_rounds, num_consumers=num_consumers,
-        pack_shift=pack_shift,
+        pack_shift=pack_shift, n_valid=P,
+        totals_rank_bits=totals_rank_bits,
     )
     choice, _, _ = jax.vmap(fn)(lags_p, pids, valid)
     return _narrow_choice(choice[:, :P], num_consumers)
@@ -136,9 +172,13 @@ def assign_stream_batch(lags, num_consumers: int):
 
     ensure_x64()  # int64 lags would silently truncate to int32 otherwise
     payload, shift = stream_payload(lags, partition_axis=1)
-    observe_pack_shift(("stream_batch", payload.shape, num_consumers), shift)
+    rb = totals_rank_bits_for(payload, num_consumers)
+    observe_pack_shift(
+        ("stream_batch", payload.shape, num_consumers), shift * 100 + rb
+    )
     return _stream_batch_device(
-        payload, num_consumers=num_consumers, pack_shift=shift
+        payload, num_consumers=num_consumers, pack_shift=shift,
+        totals_rank_bits=rb,
     )
 
 
@@ -195,10 +235,16 @@ def assign_stream(lags, num_consumers: int):
             perm = np.argsort(-lags, kind="stable").astype(np.int32)
             return _stream_presorted(lags, perm, num_consumers=num_consumers)
         payload, shift = stream_payload(lags)
+        rb = totals_rank_bits_for(payload, num_consumers)
         from .dispatch import observe_pack_shift
 
-        observe_pack_shift(("stream", lags.shape, num_consumers), shift)
+        # One observation key per executable-selecting tuple: a change in
+        # EITHER static arg (pack shift or rank bits) recompiles.
+        observe_pack_shift(
+            ("stream", lags.shape, num_consumers), shift * 100 + rb
+        )
         return _stream_device(
-            payload, num_consumers=num_consumers, pack_shift=shift
+            payload, num_consumers=num_consumers, pack_shift=shift,
+            totals_rank_bits=rb,
         )
     return _stream_device(lags, num_consumers=num_consumers)

@@ -14,36 +14,48 @@ to ``max_pairs`` *disjoint* exchanges simultaneously:
    every possible partner across rounds;
 2. for every pair independently, pick the best single-partition **move**
    (heavy → light, lag closest to half the load gap, only while the count
-   spread stays <= 1) and the best **swap** — the light side is sorted by
-   (pair, quantized lag) once per round, and one vectorized
-   ``searchsorted`` finds, for every heavy-side partition p, the
-   light-side q whose lag is closest to ``lag_p - delta`` (the best
-   counterpart), reduced to the best (p, q) per pair by sort-based
-   segmented argmins;
-3. apply every strictly-improving exchange at once.  Pairs are disjoint
-   (each consumer belongs to at most one), so parallel application is
+   spread stays <= 1) and the best **swap** — light rows and heavy-side
+   *queries* are co-sorted in ONE packed-key sort (pair id in the high
+   bits, quantized lag, a side bit), after which each heavy row's best
+   swap counterparts are its nearest light neighbours in sort order,
+   found with two cumulative scans (no searchsorted, no second sort);
+3. move and swap candidates merge into a single score stream (a tag bit
+   under the score keeps ties preferring moves), so ONE sort-based
+   segmented argmin picks each pair's exchange; apply every
+   strictly-improving exchange at once.  Pairs are disjoint (each
+   consumer belongs to at most one), so parallel application is
    race-free, and since any transferred amount d satisfies
    0 < d < load_heavy - load_light, no consumer's load ever exceeds the
    running maximum — the global max is monotone non-increasing.
 
-A round costs two P-sized sorts plus a handful of O(P) elementwise ops and
-gathers and retires up to K exchanges, versus the sequential kernel's one
-exchange per round; at P=100k / C=1k this is ~3 orders of magnitude more
-exchange throughput.  Churn is bounded by ``2 * iters * max_pairs``.
+A round is therefore TWO P-sized sorts (the combined neighbour sort and
+the segmented argmin) plus cumulative scans, elementwise ops, and a few
+gathers — versus the previous generation's five sort passes
+(light-key sort, a 2P sort-based searchsorted, and two segmented
+argmins); fetch-synchronized probes on the target TPU
+(tools/probe_round5c.py — ``block_until_ready`` is NOT a valid clock on
+this platform) put a P=131072 sort at ~0.4 ms, making op count, not
+element count, the budget.  Churn is bounded by ``2 * iters * max_pairs``.
 
-Device-cost discipline (measured on the target TPU, tools/probe_ops.py):
-P-sized scatters (8-15 ms) and the sequential ``searchsorted`` method
-(18 ms) are banned from the round body — segmented reductions and
-permutation handling go through the sort-based primitives in
-:mod:`.sortops` (~0.2 ms per P-sized sort), candidate keys are packed
-integers (f64 compares are emulated on v5e), and per-row lookups are
-packed so each round performs the minimum number of ~2 ms P-sized gathers.
-Candidate *selection* works on quantized values, and validity is
-enforced by STRICT quantized inequalities that imply the exact ones
-(see the safety lemma at ``pack_payload``): quantization can only MISS
-boundary candidates, never admit a worsening exchange.  The amounts
-actually applied to the load accumulators are exact int64, gathered at
-the [K] winners.
+Candidate *selection* works on quantized values; validity is enforced by
+STRICT quantized inequalities that imply the exact ones (see the safety
+lemma below): quantization can only MISS boundary candidates, never admit
+a worsening exchange.  With the single 48-bit value field the quantization
+shift is 0 (exact selection) for any lag below 2^48.  The amounts actually
+applied to the load accumulators are exact int64, gathered at the [K]
+winners.
+
+SAFETY LEMMA (why strict quantized validity implies exact validity, for
+non-negative a, b, diff and any shift s — there is NO exact recheck
+downstream for swaps, this argument is the whole guarantee):
+  d_q > 0:       a>>s > b>>s  ⟹  a >= ((b>>s)+1)<<s > b, so d > 0.
+  d_q < diff_q:  write a = (a>>s)<<s + ra, b = (b>>s)<<s + rb,
+    diff = (diff>>s)<<s + rd with 0 <= ra, rb, rd < 2^s.  Then
+    d = a - b = (d_q<<s) + ra - rb < (d_q + 1)<<s <= (diff>>s)<<s
+    <= diff.  So d < diff.
+Hence a selected swap satisfies 0 < d < diff exactly — the monotone
+non-increasing max is preserved.  (Moves check 0 < lag < diff on the
+exact lag directly.)
 
 The refinement is solver-agnostic: it accepts the (choice, lags) pair in
 input order from the greedy kernels or the Sinkhorn rounding.  It
@@ -59,14 +71,17 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .sortops import (
-    _cpu_backend,
-    bincount_sorted,
-    segment_argmin_first,
-    segment_sum,
-)
+from .sortops import bincount_sorted, segment_argmin_first, segment_sum
 
-_PAIR_BITS = 14  # pair-id field width in the packed per-row combo lookup
+_PAIR_BITS = 14  # pair-id field width in the packed keys
+_VBITS = 63 - _PAIR_BITS - 1  # quantized-lag field width (48)
+# Score sentinel (fits (x << 1) | 1 in int64).  A plain Python int on
+# purpose: a module-level ``jnp.int64(...)`` would be created EAGERLY at
+# import time, and if the importer has not enabled x64 yet it silently
+# truncates to int32 garbage (observed: every exchange candidate scored
+# "valid" 0 and the kernel became a no-op).  As a Python int it converts
+# at trace time, after the entry points' ensure_x64().
+_SBIG_INT = 1 << 60
 
 
 @functools.partial(
@@ -113,9 +128,11 @@ def refine_assignment(
             f"max_pairs={K} exceeds the packed pair-id field "
             f"({_PAIR_BITS} bits)"
         )
-    big = jnp.iinfo(lags.dtype).max
     arangeC = jnp.arange(C, dtype=jnp.int32)
     arangeP = jnp.arange(P, dtype=jnp.int32)
+    key_big = jnp.iinfo(jnp.int64).max
+    vmask = (jnp.int64(1) << _VBITS) - 1
+    sbig = jnp.asarray(_SBIG_INT, jnp.int64)
 
     choice = choice.astype(jnp.int32)
     assigned = valid & (choice >= 0)
@@ -125,56 +142,13 @@ def refine_assignment(
     if C < 2:
         return choice, counts0, totals0
 
-    # Packed integer key for the (pair, lag) composite sort: pair id in the
-    # high bits, the lag quantized (right-shifted) into the remaining low
-    # bits.  int32 keys whenever the pair id fits comfortably — TPU sorts
-    # 32-bit keys natively, vs emulated 64-bit float compares (the previous
-    # f64 keys made one refine round cost more than a full greedy solve on
-    # v5e).  Quantization is safe: candidates are re-checked EXACTLY before
-    # being applied, the key only has to make searchsorted land near the
-    # best counterpart.
-    pair_bits = max(1, (K - 1).bit_length())
-    if pair_bits <= 12:  # lag keeps >= 19 significant bits
-        key_dtype, key_bits = jnp.int32, 31
-    else:
-        key_dtype, key_bits = jnp.int64, 63
-    lag_bits = key_bits - pair_bits
-    key_big = jnp.iinfo(key_dtype).max
+    # Quantization shift: the 48-bit value field holds any lag below 2^48
+    # exactly (shift 0); larger lags shift just enough to fit.  Selection
+    # compares live in the shifted domain; strictness makes them sound
+    # (safety lemma, module docstring).
     maxlag = jnp.maximum(jnp.max(jnp.where(assigned, lags, 0)), 1)
-    bitlen = 64 - lax.clz(maxlag.astype(jnp.int64))  # bit length of maxlag
-    qshift = jnp.maximum(bitlen - lag_bits, 0).astype(jnp.int64)
-
-    def pack_key(pair, lag_like):
-        q = jnp.clip(lag_like, 0, None).astype(jnp.int64) >> qshift
-        return (pair.astype(key_dtype) << lag_bits) | q.astype(key_dtype)
-
-    # Neighbour payload packing: (quantized lag << SB) | (pair id + 1) in
-    # one int64, so each neighbour probe is ONE P-sized gather instead of
-    # two (~2 ms each on the target TPU).  Zero means "not a light row"
-    # (pair id + 1 >= 1 for real entries).  ``pshift`` extends the key
-    # quantization only if lag_bits + SB would overflow 62 bits (only
-    # possible on the int64-key path).
-    #
-    # SAFETY LEMMA (why strict quantized validity implies exact validity,
-    # for non-negative a, b, diff and any shift s — there is NO exact
-    # recheck downstream, this argument is the whole guarantee):
-    #   d_q > 0:       a>>s > b>>s  ⟹  a >= ((b>>s)+1)<<s > b, so d > 0.
-    #   d_q < diff_q:  write a = (a>>s)<<s + ra, b = (b>>s)<<s + rb,
-    #     diff = (diff>>s)<<s + rd with 0 <= ra, rb, rd < 2^s.  Then
-    #     d = a - b = (d_q<<s) + ra - rb < (d_q + 1)<<s <= (diff>>s)<<s
-    #     <= diff.  So d < diff.
-    # Hence a selected exchange satisfies 0 < d < diff exactly —
-    # quantization can only MISS boundary candidates, never admit a
-    # worsening exchange, and the monotone non-increasing max is
-    # preserved.
-    sb = max(1, K.bit_length())
-    extra = max(0, (lag_bits + sb) - 62)
-    pshift = qshift + extra
-    pay_mask = (1 << sb) - 1
-
-    def pack_payload(pair1, lag_like):
-        q = jnp.clip(lag_like, 0, None).astype(jnp.int64) >> pshift
-        return (q << sb) | pair1.astype(jnp.int64)
+    bitlen = 64 - lax.clz(maxlag.astype(jnp.int64))
+    pshift = jnp.maximum(bitlen - _VBITS, 0).astype(jnp.int64)
 
     def body(state):
         it, since, choice, totals, counts = state
@@ -192,8 +166,9 @@ def refine_assignment(
         heavy = order[C - 1 - jnp.arange(K)]  # [K]
         diff = totals[heavy] - totals[light]  # [K] >= 0
 
-        # Map consumers to pair ids (K = unpaired) and rows to sides via a
-        # single packed [C] table -> ONE P-sized gather for both fields.
+        # Per-consumer combo table -> ONE P-sized gather for pair id,
+        # side, and the move-permission bit (moves must keep the count
+        # spread <= 1, a per-pair property known before selection).
         slot_to_pair = (
             jnp.full((n_light,), K, jnp.int32)
             .at[light_slot]
@@ -205,90 +180,121 @@ def refine_assignment(
             C - 1 - rank,
         )
         heavy_side = rank >= C - K
-        combo_tab = pair_of | (heavy_side.astype(jnp.int32) << _PAIR_BITS)
-        combo = jnp.where(assigned, combo_tab[safe_choice], K)
+        move_ok_pair = counts[heavy] > counts[light]  # [K]
+        move_ok_of = jnp.where(
+            heavy_side,
+            jnp.pad(move_ok_pair, (0, 1))[jnp.clip(pair_of, 0, K)],
+            False,
+        )
+        combo_tab = (
+            pair_of
+            | (heavy_side.astype(jnp.int32) << _PAIR_BITS)
+            | (move_ok_of.astype(jnp.int32) << (_PAIR_BITS + 1))
+        )
+        combo = jnp.where(assigned, combo_tab[safe_choice], -1)
         k_p = combo & ((1 << _PAIR_BITS) - 1)
-        row_heavy = combo >= (1 << _PAIR_BITS)
-        on_heavy = assigned & row_heavy & (k_p < K)
-        on_light = assigned & ~row_heavy & (k_p < K)
+        row_heavy = (combo >> _PAIR_BITS) & 1
+        row_move_ok = (combo >> (_PAIR_BITS + 1)) & 1
+        participates = (combo >= 0) & (k_p < K)
         kc = jnp.clip(k_p, 0, K - 1)
-        diff_p = diff[kc]       # the round's second P-sized gather
-        delta_p = diff_p >> 1   # diff >= 0, so >>1 == //2
-        seg_h = jnp.where(on_heavy, k_p, K)
+        diff_p = jnp.where(participates, diff[kc], 0)
+        delta_p = diff_p >> 1  # diff >= 0, so >>1 == //2
 
-        # All candidate SELECTION below runs in the quantized (>> pshift)
-        # lag domain — one consistent unit for comparing move vs swap
-        # errors; the APPLIED amounts are exact (gathered at the [K]
-        # winners).  Strict quantized checks guarantee exact validity.
-        qlag_row = lags >> pshift
-        diff_q = diff_p >> pshift
-        delta_q = delta_p >> pshift
-
-        # Candidate 1 — MOVE: heavy-side partition with lag closest to
-        # delta; improving iff 0 < lag < diff (exact elementwise check).
-        ok_move = on_heavy & (lags > 0) & (lags < diff_p)
-        score_move = jnp.where(ok_move, jnp.abs(qlag_row - delta_q), big)
-        err_move, p_move = segment_argmin_first(score_move, seg_h, K, P)
-
-        # Candidate 2 — best SWAP: sort light-side rows by (pair,
-        # quantized lag) with (payload, row) riding the sort; for each
-        # heavy p, searchsorted its ideal counterpart lag_p - delta and
-        # examine the two neighbours via their packed payloads.
-        keyl = jnp.where(on_light, pack_key(k_p, lags), key_big)
-        payload = jnp.where(
-            on_light, pack_payload(k_p + 1, lags), 0
+        # THE round sort: light rows keyed by their own quantized lag,
+        # heavy rows keyed by their ideal counterpart lag (lag - delta),
+        # pair id in the high bits, side bit last (equal-valued lights
+        # sort before the heavy query).  After this one sort each heavy
+        # row's best swap counterparts are its nearest light neighbours.
+        qself = lags >> pshift
+        tgt = jnp.clip(lags - delta_p, 0, None) >> pshift
+        qval = jnp.where(row_heavy == 1, tgt, qself)
+        key = jnp.where(
+            participates,
+            (k_p.astype(jnp.int64) << (_VBITS + 1))
+            | (jnp.clip(qval, 0, vmask) << 1)
+            | row_heavy.astype(jnp.int64),
+            key_big,
         )
-        _skey, spayload, sidx = lax.sort(
-            (keyl, payload, arangeP), num_keys=1
+        skey, slag, srow, smove_ok = lax.sort(
+            (key, lags, arangeP, row_move_ok), num_keys=1
         )
-        tgt = jnp.clip(lags - delta_p, 0, None)
-        query = jnp.where(on_heavy, pack_key(k_p, tgt), key_big)
-        # method="sort" replaces the sequential binary search with one
-        # more bitonic sort — 7x faster on the TPU target; XLA:CPU's
-        # vectorized "scan" search beats an extra big sort there.
-        method = "scan" if _cpu_backend() else "sort"
-        pos = jnp.searchsorted(_skey, query, method=method).astype(jnp.int32)
+
+        part_s = skey < key_big
+        pair_s = (skey >> (_VBITS + 1)).astype(jnp.int32)
+        heavy_s = part_s & ((skey & 1) == 1)
+        light_s = part_s & ((skey & 1) == 0)
+        qlag_s = slag >> pshift
+        diff_s = jnp.where(
+            heavy_s, diff[jnp.clip(pair_s, 0, K - 1)], 0
+        )
+        delta_q_s = (diff_s >> 1) >> pshift
+        diff_q_s = diff_s >> pshift
+
+        # Nearest light neighbours via cumulative scans (replaces the
+        # previous sort-based searchsorted): prev = last light at or
+        # below, nxt = first light above.  A neighbour from another pair
+        # fails the pair check below, exactly like a searchsorted landing
+        # at a pair boundary did.
+        prev_l = lax.cummax(jnp.where(light_s, arangeP, -1))
+        nxt_l = lax.cummin(
+            jnp.where(light_s, arangeP, P), reverse=True
+        )
 
         def neighbour(nb):
             inb = jnp.clip(nb, 0, P - 1)
-            pl = spayload[inb]  # the round's ONE gather per neighbour
-            okq = (nb >= 0) & (nb < P) & ((pl & pay_mask) == k_p + 1)
-            d_q = qlag_row - (pl >> sb)
-            ok = on_heavy & okq & (d_q > 0) & (d_q < diff_q)
-            return jnp.where(ok, jnp.abs(d_q - delta_q), big)
+            nkey = skey[inb]  # one P-sized gather per neighbour
+            okq = (
+                (nb >= 0) & (nb < P)
+                & ((nkey & 1) == 0)
+                & ((nkey >> (_VBITS + 1)).astype(jnp.int32) == pair_s)
+            )
+            d_q = qlag_s - ((nkey >> 1) & vmask)
+            ok = heavy_s & okq & (d_q > 0) & (d_q < diff_q_s)
+            return jnp.where(ok, jnp.abs(d_q - delta_q_s), sbig)
 
-        err_a = neighbour(pos - 1)
-        err_b = neighbour(pos)
+        err_a = neighbour(prev_l)
+        err_b = neighbour(nxt_l)
         use_b = err_b < err_a
-        err_pq = jnp.where(use_b, err_b, err_a)
-        nb_of_p = jnp.where(use_b, pos, pos - 1)
-        err_swap, p_swap = segment_argmin_first(err_pq, seg_h, K, P)
-        nb_sel = jnp.clip(nb_of_p[jnp.clip(p_swap, 0, P - 1)], 0, P - 1)
-        q_swap = sidx[nb_sel]                        # [K]
-        lag_q_swap = lags[jnp.clip(q_swap, 0, P - 1)]  # [K], exact lag of q
+        err_swap = jnp.where(use_b, err_b, err_a)
+        nb_sel = jnp.where(use_b, nxt_l, prev_l)
 
-        # Choose per pair; moves must keep the count spread <= 1.
-        move_allowed = (counts[heavy] > counts[light]) & (err_move < big)
-        err_move_eff = jnp.where(move_allowed, err_move, big)
-        use_move = move_allowed & (err_move_eff <= err_swap)
-        use_swap = ~use_move & (err_swap < big)
-        do = use_move | use_swap
+        # Move candidate (exact validity on the resident lag) merged with
+        # the swap via a tag bit under the score: ties prefer the move.
+        ok_move = (
+            heavy_s & (smove_ok == 1) & (slag > 0) & (slag < diff_s)
+        )
+        score_move = jnp.where(
+            ok_move, jnp.abs(qlag_s - delta_q_s), sbig
+        )
+        combined = jnp.where(
+            score_move <= err_swap,
+            score_move << 1,
+            (err_swap << 1) | 1,
+        )
+        seg_h = jnp.where(heavy_s, pair_s, K)
+        minv, widx = segment_argmin_first(combined, seg_h, K, P)
 
-        p_sel = jnp.where(use_move, p_move, p_swap)
-        p_safe = jnp.clip(p_sel, 0, P - 1)
-        lag_p_sel = lags[p_safe]  # [K]
-        lag_q = jnp.where(use_swap, lag_q_swap, 0)
-        d = jnp.where(use_move, lag_p_sel, lag_p_sel - lag_q)
+        # Decode the [K] winners; all remaining work is K-sized.
+        do = minv < (sbig << 1)
+        is_swap = (minv & 1) == 1
+        wclip = jnp.clip(widx, 0, P - 1)
+        p_sel = srow[wclip]
+        lag_p = slag[wclip]
+        nb_k = jnp.clip(nb_sel[wclip], 0, P - 1)
+        q_sel = srow[nb_k]
+        lag_q = slag[nb_k]
+        use_swap = do & is_swap
+        d = jnp.where(use_swap, lag_p - lag_q, lag_p)
         d = jnp.where(do, d, 0)
 
         # Apply all exchanges at once (pairs are disjoint -> race-free);
         # K-sized scatters, cost proportional to the K updates.
         upd_p = jnp.where(do, p_sel, P)
-        upd_q = jnp.where(use_swap, q_swap, P)
+        upd_q = jnp.where(use_swap, q_sel, P)
         new_choice = choice.at[upd_p].set(light, mode="drop")
         new_choice = new_choice.at[upd_q].set(heavy, mode="drop")
         new_totals = totals.at[heavy].add(-d).at[light].add(d)
-        dc = use_move.astype(jnp.int32)
+        dc = (do & ~is_swap).astype(jnp.int32)
         new_counts = counts.at[heavy].add(-dc).at[light].add(dc)
         peak_dropped = jnp.max(new_totals) < jnp.max(totals)
         new_since = jnp.where(peak_dropped, 0, since + 1)

@@ -60,7 +60,34 @@ def _rounds_body(totals: jax.Array, xs, C: int):
     return totals, choice
 
 
-def _rounds_scan(sorted_lags, sorted_valid, totals0, C: int):
+def _rounds_body_packed(carry, xs, C: int, rank_bits: int):
+    """Scatter-free round body: the carry holds (total, consumer id) pairs
+    in the PREVIOUS round's sorted order, packed per round into one int64
+    key ``(total << rank_bits) | id`` whose single-key sort IS the
+    (total, id) lexicographic order (totals are non-negative and the
+    caller verified the shifted total cannot overflow).  The round's j-th
+    partition belongs to the j-th smallest key — which after the sort is
+    position j — so the gain add is POSITIONAL: no scatter, no gather,
+    and the sort carries one array instead of two.  At ~90 us/round of
+    tiny-op overhead in the scan body (tools/probe_round5d.py), dropping
+    ops per round is exactly what makes the 100-round north-star scan
+    cheaper.
+    """
+    totals_s, ids_s = carry
+    round_lags, round_valid = xs
+    key = (totals_s << rank_bits) | ids_s.astype(totals_s.dtype)
+    skey = lax.sort(key)
+    ids_new = (skey & ((1 << rank_bits) - 1)).astype(jnp.int32)
+    gain = jnp.where(round_valid, round_lags, 0)
+    totals_new = (skey >> rank_bits) + gain.astype(totals_s.dtype)
+    choice = jnp.where(round_valid, ids_new, -1)
+    return (totals_new, ids_new), choice
+
+
+def _rounds_scan(
+    sorted_lags, sorted_valid, totals0, C: int,
+    n_valid: int | None = None, totals_rank_bits: int = 0,
+):
     """Scan the round decomposition over one topic's sorted partitions.
 
     Pads the sorted axis to a whole number of rounds.  Padding sorts last
@@ -70,19 +97,52 @@ def _rounds_scan(sorted_lags, sorted_valid, totals0, C: int):
     for reference semantics (lag tiebreak local to the topic, SURVEY
     §2.4.3), or the running global totals for the cross-topic quality mode.
 
+    ``n_valid`` (static) is an upper bound on the number of valid rows —
+    when the caller knows it (the dense stream paths: P exact-size rows
+    padded to a pow2 bucket), the scan stops after ceil(n_valid / C)
+    rounds instead of burning ~90 us/round on rounds made only of padding
+    (24% of the north-star scan at P=100k in a 131072 bucket).  Rows past
+    the scanned prefix are all padding and get choice -1.
+
+    ``totals_rank_bits`` (static) > 0 selects the packed scatter-free
+    round body (:func:`_rounds_body_packed`); the caller guarantees
+    ``(max possible total) << totals_rank_bits`` fits the lag dtype and
+    that ``totals0`` is all zeros.  0 = the general two-key body.
+
     Returns (totals[C], sorted_choice int32[P] in sorted order).
     """
     P = sorted_lags.shape[0]
-    R = -(-P // C) if P else 0
-    pad = R * C - P
-    sorted_lags = jnp.pad(sorted_lags, (0, pad))
-    sorted_valid = jnp.pad(sorted_valid, (0, pad))
-    totals, round_choice = lax.scan(
-        functools.partial(_rounds_body, C=C),
-        totals0,
-        (sorted_lags.reshape(R, C), sorted_valid.reshape(R, C)),
-    )
-    return totals, round_choice.reshape(R * C)[:P]
+    L = P if n_valid is None else min(int(n_valid), P)
+    R = -(-L // C) if L else 0
+    head = R * C
+    if head <= P:
+        lags_h = sorted_lags[:head]
+        valid_h = sorted_valid[:head]
+    else:
+        lags_h = jnp.pad(sorted_lags, (0, head - P))
+        valid_h = jnp.pad(sorted_valid, (0, head - P))
+    xs = (lags_h.reshape(R, C), valid_h.reshape(R, C))
+    if totals_rank_bits > 0:
+        ids0 = jnp.arange(C, dtype=jnp.int32)
+        (totals_s, ids_s), round_choice = lax.scan(
+            functools.partial(
+                _rounds_body_packed, C=C, rank_bits=totals_rank_bits
+            ),
+            (totals0, ids0),
+            xs,
+        )
+        # Restore consumer order for the totals (one C-sized sort).
+        _, totals = lax.sort((ids_s, totals_s), num_keys=1)
+    else:
+        totals, round_choice = lax.scan(
+            functools.partial(_rounds_body, C=C), totals0, xs
+        )
+    flat = round_choice.reshape(head)[: min(head, P)]
+    if head < P:
+        flat = jnp.concatenate(
+            [flat, jnp.full((P - head,), -1, jnp.int32)]
+        )
+    return totals, flat
 
 
 def _unsort_choice(perm, sorted_choice, P: int, C: int):
@@ -96,7 +156,10 @@ def _unsort_choice(perm, sorted_choice, P: int, C: int):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("num_consumers", "pack_shift")
+    jax.jit,
+    static_argnames=(
+        "num_consumers", "pack_shift", "n_valid", "totals_rank_bits"
+    ),
 )
 def assign_topic_rounds(
     lags: jax.Array,
@@ -104,13 +167,19 @@ def assign_topic_rounds(
     valid: jax.Array,
     num_consumers: int,
     pack_shift: int = 0,
+    n_valid: int | None = None,
+    totals_rank_bits: int = 0,
 ):
     """Assign one topic's partitions via the round decomposition.
 
     Same contract as :func:`..ops.scan_kernel.assign_topic_scan` minus the
     ``eligible`` mask (all consumers eligible by pre-condition).
     ``pack_shift`` (static, see :func:`..ops.scan_kernel.pack_shift_for`)
-    selects the packed single-key processing-order sort.
+    selects the packed single-key processing-order sort; ``n_valid`` /
+    ``totals_rank_bits`` (static) select the trimmed scan and the
+    scatter-free packed round body (see :func:`_rounds_scan` — callers
+    guarantee their preconditions: valid rows <= n_valid, and shifted
+    totals cannot overflow).  All variants are bit-exact.
 
     Returns (choice int32[P] input order, counts int32[C], totals[C]).
     """
@@ -121,7 +190,10 @@ def assign_topic_rounds(
         lags, partition_ids, valid, pack_shift
     )
     totals0 = jnp.zeros((C,), dtype=lags.dtype)
-    totals, sorted_choice = _rounds_scan(sorted_lags, sorted_valid, totals0, C)
+    totals, sorted_choice = _rounds_scan(
+        sorted_lags, sorted_valid, totals0, C,
+        n_valid=n_valid, totals_rank_bits=totals_rank_bits,
+    )
     choice, counts = _unsort_choice(perm, sorted_choice, P, C)
     return choice, counts, totals
 

@@ -275,10 +275,16 @@ class StreamingAssignor:
             choice0 = assign_stream(lags, num_consumers=C)
             payload = lags
         else:
+            from .batched import totals_rank_bits_for
+
             payload, shift = stream_payload(lags)
-            observe_pack_shift(("stream", lags.shape, C), shift)
+            rb = totals_rank_bits_for(payload, C)
+            observe_pack_shift(("stream", lags.shape, C), shift * 100 + rb)
             payload = jax.device_put(payload)  # ONE upload, both kernels
-            choice0 = _stream_device(payload, num_consumers=C, pack_shift=shift)
+            choice0 = _stream_device(
+                payload, num_consumers=C, pack_shift=shift,
+                totals_rank_bits=rb,
+            )
         narrow, refined_pad = _refine_chain(
             payload, choice0, num_consumers=C,
             iters=self.cold_refine_iters, max_pairs=None,

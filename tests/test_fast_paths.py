@@ -120,6 +120,51 @@ def test_assign_stream_jax_array_input():
     assert counts.sum() == 64 and counts.max() - counts.min() == 0
 
 
+@pytest.mark.parametrize("seed", range(6))
+def test_packed_round_body_parity(seed):
+    """The scatter-free packed round body (totals_rank_bits > 0) and the
+    trimmed scan (n_valid) must be bit-exact vs the general two-key body
+    at ragged sizes, sparse/duplicate lags, and non-divisible P/C."""
+    from kafka_lag_based_assignor_tpu.ops.batched import (
+        totals_rank_bits_for,
+    )
+    from kafka_lag_based_assignor_tpu.ops.rounds_kernel import (
+        assign_topic_rounds,
+    )
+
+    rng = np.random.default_rng(seed)
+    P = int(rng.integers(1, 700))
+    C = int(rng.integers(1, 40))
+    B = 1024  # padded bucket, valid prefix of P rows
+    lags = np.zeros(B, np.int64)
+    lags[:P] = rng.integers(0, 10**12, size=P)
+    if seed % 2:
+        lags[:P] //= 10**10  # heavy duplicates incl. zeros
+    pids = np.arange(B, dtype=np.int32)
+    valid = pids < P
+    rb = totals_rank_bits_for(lags, C)
+    assert rb >= 1
+    base = assign_topic_rounds(lags, pids, valid, num_consumers=C)
+    fast = assign_topic_rounds(
+        lags, pids, valid, num_consumers=C, n_valid=P, totals_rank_bits=rb
+    )
+    for a, b in zip(base, fast):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_totals_rank_bits_overflow_guard():
+    """Lag sums that could overflow the packed key must disable packing."""
+    from kafka_lag_based_assignor_tpu.ops.batched import (
+        totals_rank_bits_for,
+    )
+
+    huge = np.full(4, 1 << 60, dtype=np.int64)
+    assert totals_rank_bits_for(huge, 16) == 0
+    assert totals_rank_bits_for(-huge, 16) == 0  # negative lags: unsafe
+    small = np.arange(100, dtype=np.int64)
+    assert totals_rank_bits_for(small, 16) == 4
+
+
 @pytest.mark.parametrize("seed,shape", [(0, (7, 100)), (1, (16, 64)),
                                         (2, (3, 1000))])
 def test_assign_stream_batch_parity(seed, shape):

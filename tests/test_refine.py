@@ -113,6 +113,39 @@ def test_zero_budget_returns_input():
     np.testing.assert_array_equal(np.asarray(choice), choice0)
 
 
+def test_fresh_process_without_x64_still_exchanges():
+    """Regression: importing the kernel before x64 mode is on must not
+    poison its constants.  A module-level ``jnp.int64`` sentinel would be
+    created eagerly at import, truncate to int32 garbage, and silently
+    turn every round into a no-op (churn always 0) — only visible in a
+    process that did NOT pre-enable x64, which the test session does, so
+    this drives a subprocess."""
+    import subprocess
+    import sys
+
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "from kafka_lag_based_assignor_tpu.ops.refine import"
+        " refine_assignment\n"
+        "from kafka_lag_based_assignor_tpu.ops.dispatch import ensure_x64\n"
+        "ensure_x64()\n"
+        "P, C = 64, 2\n"
+        "lags = np.ones(P, dtype=np.int64); lags[:32] = 1000\n"
+        "choice = np.zeros(P, dtype=np.int32); choice[32:] = 1\n"
+        "out, _, _ = refine_assignment(lags, np.ones(P, bool), choice,"
+        " num_consumers=C, iters=16)\n"
+        "churn = int((np.asarray(out) != choice).sum())\n"
+        "assert churn > 0, 'refine was a no-op in a fresh process'\n"
+        "print('ok', churn)\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=300,
+    )
+    assert r.returncode == 0 and "ok" in r.stdout, (r.stdout, r.stderr)
+
+
 def test_single_consumer_noop():
     lags, valid, choice0 = make_instance(1, C=1)
     choice0[valid] = 0

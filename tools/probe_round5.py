@@ -1,0 +1,120 @@
+"""Device-compute breakdown of the headline solve (round-5 probe).
+
+The tunnel's per-call round-trip (~40-70 ms, drifting) swamps single-call
+timings, so each stage is timed as a lax.map over N independent inputs
+inside ONE jit call: (e2e_N - e2e_1) / (N - 1) ~= per-solve device time
+with the RTT amortized out.
+
+Run on the real chip:  python tools/probe_round5.py
+"""
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+sys.path.insert(0, "/root/repo")
+
+import functools  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+from kafka_lag_based_assignor_tpu.ops.packing import pad_bucket  # noqa: E402
+from kafka_lag_based_assignor_tpu.ops.refine import (  # noqa: E402
+    refine_assignment,
+)
+from kafka_lag_based_assignor_tpu.ops.rounds_kernel import (  # noqa: E402
+    _rounds_scan,
+    _unsort_choice,
+)
+from kafka_lag_based_assignor_tpu.ops.scan_kernel import (  # noqa: E402
+    pack_shift_for,
+    sort_partitions_with,
+)
+
+print("devices:", jax.devices(), flush=True)
+
+P, C, N = 100_000, 1000, 8
+B = pad_bucket(P)
+rng = np.random.default_rng(0)
+ranks = rng.permutation(P) + 1
+lags1 = (1000.0 * (P / ranks) ** (1 / 1.1)).astype(np.int64)
+shift = pack_shift_for(int(lags1.max()), B - 1)
+batch = np.stack(
+    [np.roll(lags1, 17 * i).astype(np.int32) for i in range(N)]
+)
+
+
+def full_solve(lags32):
+    lags_p = jnp.pad(lags32.astype(jnp.int64), (0, B - P))
+    pids = jnp.arange(B, dtype=jnp.int32)
+    valid = pids < P
+    perm, sl, sv = sort_partitions_with(lags_p, pids, valid, shift)
+    totals0 = jnp.zeros((C,), dtype=jnp.int64)
+    totals, sc = _rounds_scan(sl, sv, totals0, C)
+    choice, _ = _unsort_choice(perm, sc, B, C)
+    return choice[:P].astype(jnp.int16)
+
+
+def sort_only(lags32):
+    lags_p = jnp.pad(lags32.astype(jnp.int64), (0, B - P))
+    pids = jnp.arange(B, dtype=jnp.int32)
+    valid = pids < P
+    perm, sl, sv = sort_partitions_with(lags_p, pids, valid, shift)
+    return (perm.sum() + sl.sum()).astype(jnp.int32)
+
+
+def sort_scan(lags32):
+    lags_p = jnp.pad(lags32.astype(jnp.int64), (0, B - P))
+    pids = jnp.arange(B, dtype=jnp.int32)
+    valid = pids < P
+    perm, sl, sv = sort_partitions_with(lags_p, pids, valid, shift)
+    totals, sc = _rounds_scan(sl, sv, jnp.zeros((C,), jnp.int64), C)
+    return (totals.sum() + sc.sum().astype(jnp.int64)).astype(jnp.int32)
+
+
+def refine1(lags32):
+    lags_p = jnp.pad(lags32.astype(jnp.int64), (0, B - P))
+    valid = jnp.arange(B, dtype=jnp.int32) < P
+    choice = jnp.where(valid, jnp.arange(B, dtype=jnp.int32) % C, -1)
+    refined, _, _ = refine_assignment(
+        lags_p, valid, choice, num_consumers=C, iters=1, max_pairs=C // 2
+    )
+    return refined[:P].astype(jnp.int16)
+
+
+def timed(name, fn, reduce_out=True):
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def many(b, n):
+        out = lax.map(fn, b[:n])
+        return out.sum(axis=0) if reduce_out else out
+
+    for n in (1, N):
+        many(batch, n=n).block_until_ready()
+    ts = {1: [], N: []}
+    for _ in range(8):
+        for n in (1, N):
+            t0 = time.perf_counter()
+            many(batch, n=n).block_until_ready()
+            ts[n].append((time.perf_counter() - t0) * 1000.0)
+    t1, tn = np.median(ts[1]), np.median(ts[N])
+    per = (tn - t1) / (N - 1)
+    print(
+        f"{name:12s} e2e1={t1:7.2f}ms e2e{N}={tn:7.2f}ms "
+        f"per-solve~{per:6.2f}ms",
+        flush=True,
+    )
+    return per
+
+
+timed("full", full_solve)
+timed("sort_only", sort_only)
+timed("sort+scan", sort_scan)
+timed("refine1", refine1)

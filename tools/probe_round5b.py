@@ -1,0 +1,81 @@
+"""Refine-round and sinkhorn-stage device costs (block-only timings on
+device-resident inputs: no host<->device payload, so the tunnel RTT term
+is the same small constant for every row — deltas are device compute)."""
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+sys.path.insert(0, "/root/repo")
+
+from kafka_lag_based_assignor_tpu.models.sinkhorn import (  # noqa: E402
+    _dedup_weights,
+    _sinkhorn_duals_jit,
+)
+from kafka_lag_based_assignor_tpu.ops.packing import pad_bucket  # noqa: E402
+from kafka_lag_based_assignor_tpu.ops.refine import (  # noqa: E402
+    refine_assignment,
+)
+
+print("devices:", jax.devices(), flush=True)
+
+
+def med(f, iters=10):
+    f()
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        f()
+        ts.append((time.perf_counter() - t0) * 1000.0)
+    return float(np.median(ts))
+
+
+P, C = 100_000, 1000
+B = pad_bucket(P)
+rng = np.random.default_rng(0)
+ranks = rng.permutation(P) + 1
+lags1 = (1000.0 * (P / ranks) ** (1 / 1.1)).astype(np.int64)
+lags_p = np.zeros(B, np.int64)
+lags_p[:P] = lags1
+valid = np.zeros(B, bool)
+valid[:P] = True
+choice = np.full(B, -1, np.int32)
+choice[:P] = rng.permutation(P) % C
+
+d_lags = jax.device_put(lags_p)
+d_valid = jax.device_put(valid)
+d_choice = jax.device_put(choice)
+
+base = None
+for it in (1, 2, 4, 16, 64):
+    def f(it=it):
+        r, _, _ = refine_assignment(
+            d_lags, d_valid, d_choice, num_consumers=C, iters=it,
+            max_pairs=C // 2,
+        )
+        r.block_until_ready()
+
+    m = med(f)
+    extra = "" if base is None else f"  (+{(m - base) / max(it - 1, 1):.2f}ms/round)"
+    if base is None:
+        base = m
+    print(f"refine iters={it:3d}: {m:7.2f}ms{extra}", flush=True)
+
+# Sinkhorn duals iteration at the north-star shape (zipf: U ~= P).
+ws_u, count_u, wsum_u = _dedup_weights(lags_p, valid, C)
+print(f"dedup U_pad={ws_u.shape[0]}", flush=True)
+for iters in (1, 24):
+    def g(iters=iters):
+        A, _B = _sinkhorn_duals_jit(
+            ws_u, count_u, wsum_u, num_consumers=C, iters=iters
+        )
+        A.block_until_ready()
+
+    print(f"duals iters={iters:3d}: {med(g, 5):7.2f}ms", flush=True)

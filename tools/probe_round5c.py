@@ -1,0 +1,141 @@
+"""Device-compute isolation with REAL synchronization.
+
+On this tunneled platform ``block_until_ready`` returns at dispatch, not
+completion (probe_round5b recorded 0.04 ms for 64 refine rounds), so the
+only trustworthy clock is a host fetch of freshly computed data.  A fetch
+costs one RTT (~40-70 ms, drifting), so each stage is measured at two
+in-executable repetition counts and differenced:
+
+    per_unit = (t[n_hi] - t[n_lo]) / (n_hi - n_lo)
+
+which cancels the RTT and the constant dispatch overhead.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+sys.path.insert(0, "/root/repo")
+
+import functools  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+from kafka_lag_based_assignor_tpu.models.sinkhorn import (  # noqa: E402
+    _dedup_weights,
+    _sinkhorn_duals_jit,
+)
+from kafka_lag_based_assignor_tpu.ops.packing import pad_bucket  # noqa: E402
+from kafka_lag_based_assignor_tpu.ops.refine import (  # noqa: E402
+    refine_assignment,
+)
+from kafka_lag_based_assignor_tpu.ops.rounds_kernel import (  # noqa: E402
+    _rounds_scan,
+    _unsort_choice,
+)
+from kafka_lag_based_assignor_tpu.ops.scan_kernel import (  # noqa: E402
+    pack_shift_for,
+    sort_partitions_with,
+)
+
+print("devices:", jax.devices(), flush=True)
+
+P, C = 100_000, 1000
+B = pad_bucket(P)
+rng = np.random.default_rng(0)
+ranks = rng.permutation(P) + 1
+lags1 = (1000.0 * (P / ranks) ** (1 / 1.1)).astype(np.int64)
+shift = pack_shift_for(int(lags1.max()), B - 1)
+N_HI = 8
+batch = jax.device_put(
+    np.stack([np.roll(lags1, 17 * i).astype(np.int32) for i in range(N_HI)])
+)
+
+
+def fetch_med(f, iters=10):
+    f()
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        f()
+        ts.append((time.perf_counter() - t0) * 1000.0)
+    return float(np.median(ts))
+
+
+def report(name, unit, t_lo, t_hi, n_lo, n_hi):
+    per = (t_hi - t_lo) / (n_hi - n_lo)
+    print(
+        f"{name:14s} t[{n_lo}]={t_lo:7.2f}ms t[{n_hi}]={t_hi:7.2f}ms "
+        f"-> {per:6.3f} ms/{unit}",
+        flush=True,
+    )
+
+
+def full_solve(lags32):
+    lags_p = jnp.pad(lags32.astype(jnp.int64), (0, B - P))
+    pids = jnp.arange(B, dtype=jnp.int32)
+    valid = pids < P
+    perm, sl, sv = sort_partitions_with(lags_p, pids, valid, shift)
+    totals, sc = _rounds_scan(sl, sv, jnp.zeros((C,), jnp.int64), C)
+    choice, _ = _unsort_choice(perm, sc, B, C)
+    return choice[:P].astype(jnp.int32).sum()
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def solve_many(b, n):
+    return lax.map(full_solve, b[:n]).sum()
+
+
+ts = {}
+for n in (1, N_HI):
+    ts[n] = fetch_med(lambda n=n: int(solve_many(batch, n=n)))
+report("full_solve", "solve", ts[1], ts[N_HI], 1, N_HI)
+
+# Refine: chained rounds inside one executable (patience disabled so the
+# round count is exactly `iters`).
+lags_p = np.zeros(B, np.int64)
+lags_p[:P] = lags1
+valid_np = np.zeros(B, bool)
+valid_np[:P] = True
+choice_np = np.full(B, -1, np.int32)
+choice_np[:P] = rng.permutation(P) % C
+d_lags = jax.device_put(lags_p)
+d_valid = jax.device_put(valid_np)
+d_choice = jax.device_put(choice_np)
+
+
+def refine_n(iters):
+    r, _, _ = refine_assignment(
+        d_lags, d_valid, d_choice, num_consumers=C, iters=iters,
+        max_pairs=C // 2, patience=10**6,
+    )
+    return int(np.asarray(r[:1])[0])
+
+
+t1 = fetch_med(lambda: refine_n(1))
+t65 = fetch_med(lambda: refine_n(65))
+report("refine_round", "round", t1, t65, 1, 65)
+
+# Sinkhorn duals iteration (zipf: dedup collapses ~3x at this draw).
+ws_u, count_u, wsum_u = _dedup_weights(lags_p, valid_np, C)
+print(f"dedup U_pad={ws_u.shape[0]}", flush=True)
+
+
+def duals_n(iters):
+    A, _Bd = _sinkhorn_duals_jit(
+        ws_u, count_u, wsum_u, num_consumers=C, iters=iters
+    )
+    return float(np.asarray(A[:1])[0])
+
+
+t1 = fetch_med(lambda: duals_n(1), 6)
+t97 = fetch_med(lambda: duals_n(97), 6)
+report("duals_iter", "iter", t1, t97, 1, 97)

@@ -1,0 +1,107 @@
+"""Component breakdown of the full solve and one refine round, with
+fetch-synchronized amortized timing (see probe_round5c.py header)."""
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+sys.path.insert(0, "/root/repo")
+
+import functools  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+from kafka_lag_based_assignor_tpu.ops.packing import pad_bucket  # noqa: E402
+from kafka_lag_based_assignor_tpu.ops.rounds_kernel import (  # noqa: E402
+    _rounds_scan,
+)
+from kafka_lag_based_assignor_tpu.ops.scan_kernel import (  # noqa: E402
+    pack_shift_for,
+    sort_partitions_with,
+)
+from kafka_lag_based_assignor_tpu.ops.sortops import unsort  # noqa: E402
+
+print("devices:", jax.devices(), flush=True)
+
+P, C = 100_000, 1000
+B = pad_bucket(P)
+rng = np.random.default_rng(0)
+ranks = rng.permutation(P) + 1
+lags1 = (1000.0 * (P / ranks) ** (1 / 1.1)).astype(np.int64)
+shift = pack_shift_for(int(lags1.max()), B - 1)
+N_HI = 8
+batch = jax.device_put(
+    np.stack([np.roll(lags1, 17 * i).astype(np.int32) for i in range(N_HI)])
+)
+
+
+def fetch_med(f, iters=8):
+    f()
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        f()
+        ts.append((time.perf_counter() - t0) * 1000.0)
+    return float(np.median(ts))
+
+
+def measure(name, body):
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def many(b, n):
+        return lax.map(body, b[:n]).sum()
+
+    t1 = fetch_med(lambda: int(many(batch, n=1)))
+    t8 = fetch_med(lambda: int(many(batch, n=N_HI)))
+    print(f"{name:18s} {(t8 - t1) / (N_HI - 1):7.3f} ms", flush=True)
+
+
+def prep(lags32):
+    lags_p = jnp.pad(lags32.astype(jnp.int64), (0, B - P))
+    pids = jnp.arange(B, dtype=jnp.int32)
+    return lags_p, pids, pids < P
+
+
+def body_sort(lags32):
+    lags_p, pids, valid = prep(lags32)
+    perm, sl, sv = sort_partitions_with(lags_p, pids, valid, shift)
+    return perm.sum() + sl.sum().astype(jnp.int32)
+
+
+def body_sort_scan(lags32):
+    lags_p, pids, valid = prep(lags32)
+    perm, sl, sv = sort_partitions_with(lags_p, pids, valid, shift)
+    totals, sc = _rounds_scan(sl, sv, jnp.zeros((C,), jnp.int64), C)
+    return totals.sum().astype(jnp.int32) + sc.sum()
+
+
+def body_full(lags32):
+    lags_p, pids, valid = prep(lags32)
+    perm, sl, sv = sort_partitions_with(lags_p, pids, valid, shift)
+    totals, sc = _rounds_scan(sl, sv, jnp.zeros((C,), jnp.int64), C)
+    choice = unsort(perm, sc)
+    return choice.sum() + totals.sum().astype(jnp.int32)
+
+
+def body_raw_sort64(lags32):
+    lags_p, _, _ = prep(lags32)
+    return jnp.sort(lags_p).sum().astype(jnp.int32)
+
+
+def body_raw_sort32(lags32):
+    s = jnp.sort(jnp.pad(lags32, (0, B - P)))
+    return s.sum()
+
+
+measure("raw_sort_int64", body_raw_sort64)
+measure("raw_sort_int32", body_raw_sort32)
+measure("pack_sort", body_sort)
+measure("pack_sort+scan", body_sort_scan)
+measure("full(+unsort)", body_full)

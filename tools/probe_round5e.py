@@ -1,0 +1,100 @@
+"""Primitive device costs with fetch-synchronized amortized timing:
+gathers, scatters, cummax, searchsorted, segment argmin — the refine
+round's building blocks (probe_round5d showed P-sorts are ~0.4 ms and the
+rounds scan ~90 us/round; this locates the refine round's 10 ms)."""
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+sys.path.insert(0, "/root/repo")
+
+import functools  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+from kafka_lag_based_assignor_tpu.ops.sortops import (  # noqa: E402
+    segment_argmin_first,
+)
+
+print("devices:", jax.devices(), flush=True)
+
+B = 131072
+K = 500
+N_HI = 8
+rng = np.random.default_rng(0)
+batch = jax.device_put(
+    np.stack(
+        [rng.permutation(B).astype(np.int32) for _ in range(N_HI)]
+    )
+)
+vals64 = jax.device_put(rng.integers(0, 1 << 40, B).astype(np.int64))
+vals32 = jax.device_put(rng.integers(0, 1 << 30, B).astype(np.int32))
+sorted64 = jax.device_put(np.sort(rng.integers(0, 1 << 40, B)).astype(np.int64))
+seg = jax.device_put(rng.integers(0, K + 1, B).astype(np.int32))
+
+
+def fetch_med(f, iters=8):
+    f()
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        f()
+        ts.append((time.perf_counter() - t0) * 1000.0)
+    return float(np.median(ts))
+
+
+def measure(name, body):
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def many(b, n):
+        return lax.map(body, b[:n]).sum()
+
+    t1 = fetch_med(lambda: int(many(batch, n=1)))
+    t8 = fetch_med(lambda: int(many(batch, n=N_HI)))
+    print(f"{name:22s} {(t8 - t1) / (N_HI - 1):7.3f} ms", flush=True)
+
+
+measure("gather64[P]", lambda idx: vals64[idx].sum().astype(jnp.int32))
+measure("gather32[P]", lambda idx: vals32[idx].sum())
+measure(
+    "gather64[P]x3",
+    lambda idx: (
+        vals64[idx].sum() + vals64[(idx + 1) % B].sum()
+        + vals64[(idx * 3) % B].sum()
+    ).astype(jnp.int32),
+)
+measure(
+    "scatter_set[P]",
+    lambda idx: jnp.zeros((B,), jnp.int32).at[idx].set(idx).sum(),
+)
+measure(
+    "cummax[P]",
+    lambda idx: lax.cummax(idx).sum() + lax.cummax(idx, reverse=True).sum(),
+)
+measure(
+    "searchsorted_sort",
+    lambda idx: jnp.searchsorted(
+        sorted64, vals64[idx], method="sort"
+    ).sum(),
+)
+measure(
+    "seg_argmin[P]",
+    lambda idx: sum(
+        segment_argmin_first(vals64 + idx[0], seg, K, B)[1].sum()
+        for _ in range(1)
+    ),
+)
+measure(
+    "small_gather[K->P]",
+    lambda idx: jnp.arange(K + 1, dtype=jnp.int32)[
+        jnp.clip(idx, 0, K)
+    ].sum(),
+)
