@@ -212,7 +212,7 @@ def phase_breakdown(lags: np.ndarray, C: int, iters: int = 10) -> dict:
     comparison — each median over ``iters``.  On a tunneled chip the phases
     overlap inside one round-trip, so they need not sum to the e2e time;
     the deltas against ``transport_floor`` are the engineering signal.
-    Uploads use the same dtype as the real path (see ``_upload_dtype``)."""
+    Uploads use the same dtype as the real path (see ``_stream_args``)."""
     import jax
 
     from kafka_lag_based_assignor_tpu.ops.batched import _stream_device

@@ -260,19 +260,23 @@ def _round_parallel(lags, ws, valid, A, B, C: int, floor_cap, extras):
     idx = jnp.arange(P, dtype=jnp.int32)
     _, _, perm = lax.sort((jstar, neg_lag, idx), num_keys=2)
     sj = jstar[perm]
-    pos = idx - jnp.searchsorted(sj, jnp.arange(C + 1, dtype=jnp.int32))[
-        jnp.clip(sj, 0, C)
-    ].astype(jnp.int32)
+    # Consumer-segment boundaries in the sorted order: one searchsorted
+    # with C+1 scalar queries serves the keep test, the kept counts
+    # (min(segment length, cap)) and the kept loads (masked cumsum +
+    # boundary differences) — no P-sized scatters.
+    bnd = jnp.searchsorted(
+        sj, jnp.arange(C + 1, dtype=jnp.int32)
+    ).astype(jnp.int32)
+    pos = idx - bnd[jnp.clip(sj, 0, C)]
     keep = (sj < C) & (pos < cap[jnp.clip(sj, 0, C - 1)])
 
     ws_s = ws[perm]
-    sj_safe = jnp.clip(sj, 0, C - 1)
-    kept_load = jnp.zeros((C,), jnp.float32).at[sj_safe].add(
-        jnp.where(keep, ws_s, 0.0)
+    kept_cnt = jnp.minimum(bnd[1:] - bnd[:-1], cap)
+    csum = jnp.concatenate(
+        [jnp.zeros((1,), jnp.float32),
+         jnp.cumsum(jnp.where(keep, ws_s, 0.0))]
     )
-    kept_cnt = jnp.zeros((C,), jnp.int32).at[sj_safe].add(
-        keep.astype(jnp.int32)
-    )
+    kept_load = csum[bnd[1:]] - csum[bnd[:-1]]
     rem = cap - kept_cnt  # open seats per consumer, >= 0
 
     # Open slots in (round, load-rank) order: slot (j, r) exists iff
@@ -303,10 +307,15 @@ def _round_parallel(lags, ws, valid, A, B, C: int, floor_cap, extras):
     seat = jnp.where(
         idx < n_over, slot_j_sorted[jnp.minimum(idx, C * cap_max - 1)], -1
     )
-    choice_sorted = jnp.where(keep, sj, -1)
-    choice_sorted = choice_sorted.at[oorder].max(seat)
+    # Both remaining placements are permutation scatters; route them
+    # through the backend-conditional inversion (sort-based on
+    # accelerators, scatter on CPU — ops/sortops.unsort).
+    from ..ops.sortops import unsort
 
-    return jnp.full((P,), -1, jnp.int32).at[perm].set(choice_sorted)
+    choice_sorted = jnp.maximum(
+        jnp.where(keep, sj, -1), unsort(oorder, seat)
+    )
+    return unsort(perm, choice_sorted)
 
 
 def assign_topic_sinkhorn(

@@ -122,6 +122,11 @@ def _rounds_scan(
         lags_h = jnp.pad(sorted_lags, (0, head - P))
         valid_h = jnp.pad(sorted_valid, (0, head - P))
     xs = (lags_h.reshape(R, C), valid_h.reshape(R, C))
+    # Unrolling amortizes the scan's per-iteration bookkeeping — the round
+    # body is ~90 us of tiny ops (tools/probe_round5d.py), so loop
+    # overhead is a real fraction of it.  Purely a lowering choice:
+    # results are bit-identical.
+    unroll = min(4, max(R, 1))
     if totals_rank_bits > 0:
         ids0 = jnp.arange(C, dtype=jnp.int32)
         (totals_s, ids_s), round_choice = lax.scan(
@@ -130,12 +135,14 @@ def _rounds_scan(
             ),
             (totals0, ids0),
             xs,
+            unroll=unroll,
         )
         # Restore consumer order for the totals (one C-sized sort).
         _, totals = lax.sort((ids_s, totals_s), num_keys=1)
     else:
         totals, round_choice = lax.scan(
-            functools.partial(_rounds_body, C=C), totals0, xs
+            functools.partial(_rounds_body, C=C), totals0, xs,
+            unroll=unroll,
         )
     flat = round_choice.reshape(head)[: min(head, P)]
     if head < P:
@@ -148,8 +155,9 @@ def _rounds_scan(
 def _unsort_choice(perm, sorted_choice, P: int, C: int):
     """Sorted-order choices back to input row order plus per-consumer
     counts (-1 padding rows excluded) — both scatter-free (sort-based, see
-    :mod:`.sortops`): P-sized scatters cost ~8-15 ms each on the target
-    TPU and sat directly on the north-star latency path here."""
+    :mod:`.sortops`; a P-sized sort is ~0.4 ms measured,
+    tools/probe_round5d.py, vs XLA:TPU's serialized dynamic-index
+    scatters)."""
     choice = unsort(perm, sorted_choice)
     counts = bincount_sorted(sorted_choice, C)
     return choice, counts

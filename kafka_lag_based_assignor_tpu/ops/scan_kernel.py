@@ -89,9 +89,9 @@ def sort_partitions_with(
     pack_shift: int = 0,
 ):
     """:func:`sort_partitions` with the lags and validity co-sorted in the
-    same ``lax.sort`` call — payloads ride the sort, saving the two
-    post-sort P-sized gathers ``lags[perm]`` / ``valid[perm]`` (~2 ms each
-    at north-star scale on the target TPU, tools/probe_ops.py).
+    same ``lax.sort`` call — payloads ride the sort instead of two
+    post-sort P-sized gathers ``lags[perm]`` / ``valid[perm]`` (the co-sort
+    itself is ~0.4 ms at north-star scale, tools/probe_round5d.py).
 
     Returns (perm int32[P], sorted_lags, sorted_valid) — identical values
     to ``(p := sort_partitions(...), lags[p], valid[p])``.
@@ -189,8 +189,9 @@ def assign_topic_scan(
         step, init, (sorted_lags, sorted_valid)
     )
 
-    # Back to input row order — sort-based permutation inversion (a
-    # P-sized scatter costs ~15 ms on the target TPU; a sort ~0.2 ms).
+    # Back to input row order — sort-based permutation inversion
+    # (P-sized sorts are ~0.4 ms measured, tools/probe_round5d.py; XLA:TPU
+    # serializes dynamic-index scatters).
     from .sortops import unsort
 
     choice = unsort(perm, sorted_choice)

@@ -1,11 +1,14 @@
 """Sort-based, scatter-free array primitives for the TPU kernels.
 
-Measured on the target TPU (v5e, tools/probe_ops.py): a P-sized ``lax.sort``
-costs ~0.2 ms at P=131072, while a P-sized scatter costs 8-15 ms, a P-sized
-gather ~2 ms, and ``jnp.searchsorted``'s default sequential method ~18 ms.
-XLA:TPU lowers scatters with dynamic indices to slow serialized updates;
-its bitonic sorter is near-free by comparison.  Every P-sized scatter on a
-latency-critical path is therefore re-expressed as a sort:
+Measurement status (tools/probe_round5c/d.py — fetch-synchronized; the
+earlier probe_ops.py numbers were dispatch times, because
+``block_until_ready`` returns at dispatch on this platform): a P-sized
+``lax.sort`` costs ~0.4 ms at P=131072, which is cheap enough that
+sort-based formulations set the floor for every primitive here.  XLA:TPU
+lowers scatters with dynamic indices to serialized updates (the classic
+hazard these primitives exist to avoid); re-expressing every P-sized
+scatter on a latency-critical path as a sort keeps the cost model simple
+and measured:
 
 * permutation inversion (``unsort``) — co-sort the permutation with its
   payloads instead of ``out.at[perm].set(vals)``;
@@ -42,7 +45,7 @@ def _cpu_backend() -> bool:
 
 def sort_with(keys: jax.Array, *payloads: jax.Array):
     """Stable co-sort: payloads ride along a single-key sort (saves the
-    post-sort gathers ``payload[perm]``, ~2 ms each at P=131k).
+    post-sort gathers ``payload[perm]``).
 
     Returns (sorted_keys, *sorted_payloads).
     """

@@ -1,8 +1,10 @@
 """Unit/property tests for the sort-based scatter-free primitives.
 
 These back the latency-critical kernels (rounds/scan/refine) on the TPU
-target where P-sized scatters cost 8-15 ms; correctness here is what makes
-the scatter->sort rewrites safe (tools/probe_ops.py has the measurements).
+target, where XLA serializes dynamic-index scatters while a P-sized sort
+is ~0.4 ms (fetch-synchronized measurement, tools/probe_round5d.py — the
+earlier probe_ops.py numbers were dispatch-time artifacts); correctness
+here is what makes the scatter->sort rewrites safe.
 """
 
 import numpy as np

@@ -1,0 +1,109 @@
+"""Post-rewrite device validation: new full-solve and refine-round costs
+(fetch-synchronized, see probe_round5c.py) plus an end-to-end bench-style
+interleaved floor/solve measurement."""
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+sys.path.insert(0, "/root/repo")
+
+import functools  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+from kafka_lag_based_assignor_tpu.ops.batched import (  # noqa: E402
+    _stream_device,
+    assign_stream,
+    stream_payload,
+    totals_rank_bits_for,
+)
+from kafka_lag_based_assignor_tpu.ops.packing import pad_bucket  # noqa: E402
+from kafka_lag_based_assignor_tpu.ops.refine import (  # noqa: E402
+    refine_assignment,
+)
+
+print("devices:", jax.devices(), flush=True)
+
+P, C, N_HI = 100_000, 1000, 8
+B = pad_bucket(P)
+rng = np.random.default_rng(0)
+ranks = rng.permutation(P) + 1
+lags1 = (1000.0 * (P / ranks) ** (1 / 1.1)).astype(np.int64)
+payload, shift = stream_payload(lags1)
+rb = totals_rank_bits_for(payload, C)
+print(f"shift={shift} rank_bits={rb} dtype={payload.dtype}", flush=True)
+batch = jax.device_put(
+    np.stack([np.roll(payload, 17 * i) for i in range(N_HI)])
+)
+
+
+def fetch_med(f, iters=10):
+    f()
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        f()
+        ts.append((time.perf_counter() - t0) * 1000.0)
+    return float(np.median(ts))
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def solve_many(b, n):
+    f = lambda v: _stream_device(  # noqa: E731
+        v, num_consumers=C, pack_shift=shift, totals_rank_bits=rb
+    ).astype(jnp.int32).sum()
+    return lax.map(f, b[:n]).sum()
+
+
+t1 = fetch_med(lambda: int(solve_many(batch, n=1)))
+t8 = fetch_med(lambda: int(solve_many(batch, n=N_HI)))
+print(
+    f"full_solve_v2   t[1]={t1:7.2f} t[8]={t8:7.2f} "
+    f"-> {(t8 - t1) / (N_HI - 1):6.3f} ms/solve",
+    flush=True,
+)
+
+lags_p = np.zeros(B, np.int64)
+lags_p[:P] = lags1
+valid_np = np.zeros(B, bool)
+valid_np[:P] = True
+choice_np = np.full(B, -1, np.int32)
+choice_np[:P] = rng.permutation(P) % C
+d_lags = jax.device_put(lags_p)
+d_valid = jax.device_put(valid_np)
+d_choice = jax.device_put(choice_np)
+
+
+def refine_n(iters):
+    r, _, _ = refine_assignment(
+        d_lags, d_valid, d_choice, num_consumers=C, iters=iters,
+        max_pairs=C // 2, patience=10**6,
+    )
+    return int(np.asarray(r[:1])[0])
+
+
+t1 = fetch_med(lambda: refine_n(1))
+t65 = fetch_med(lambda: refine_n(65))
+print(
+    f"refine_round_v2 t[1]={t1:7.2f} t[65]={t65:7.2f} "
+    f"-> {(t65 - t1) / 64:6.3f} ms/round",
+    flush=True,
+)
+
+# End-to-end interleaved floor vs solve (the bench's headline method).
+import bench as bench_mod  # noqa: E402
+
+floor_once = bench_mod.make_transport_floor(lags1, C)
+flr, _ = bench_mod.interleaved_floor(
+    lambda: np.asarray(assign_stream(lags1, num_consumers=C)), floor_once
+)
+print({k: round(v, 2) for k, v in flr.items()}, flush=True)
