@@ -23,21 +23,26 @@ from .scan_kernel import assign_topic_scan, pack_shift_for
 
 
 @functools.partial(
-    jax.jit, static_argnames=("num_consumers", "pack_shift")
+    jax.jit,
+    static_argnames=("num_consumers", "pack_shift", "totals_rank_bits"),
 )
 def assign_batched_rounds(
-    lags, partition_ids, valid, num_consumers: int, pack_shift: int = 0
+    lags, partition_ids, valid, num_consumers: int, pack_shift: int = 0,
+    totals_rank_bits: int = 0,
 ):
     """Rounds kernel over a topic batch.
 
     Args: lags int64[T, P], partition_ids int32[T, P], valid bool[T, P];
-    ``pack_shift`` (static) as in :func:`..ops.scan_kernel.sort_partitions`.
+    ``pack_shift`` (static) as in :func:`..ops.scan_kernel.sort_partitions`;
+    ``totals_rank_bits`` (static) selects the packed round body (see
+    :func:`totals_rank_bits_for`; the caller guarantees the bound).
     Returns (choice int32[T, P], counts int32[T, C], totals[T, C]).
     """
     fn = functools.partial(
         assign_topic_rounds,
         num_consumers=num_consumers,
         pack_shift=pack_shift,
+        totals_rank_bits=totals_rank_bits,
     )
     return jax.vmap(fn)(lags, partition_ids, valid)
 

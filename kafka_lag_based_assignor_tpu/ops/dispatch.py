@@ -24,7 +24,11 @@ import jax
 
 from ..models.greedy import consumers_per_topic
 from ..types import AssignmentMap, TopicPartition, TopicPartitionLag
-from .batched import assign_batched_rounds, assign_batched_scan
+from .batched import (
+    assign_batched_rounds,
+    assign_batched_scan,
+    totals_rank_bits_for,
+)
 from .packing import TopicGroup, build_groups, pad_bucket
 from .rounds_kernel import assign_global_rounds
 from .scan_kernel import pack_shift_for
@@ -112,21 +116,29 @@ def assign_group_device(group: TopicGroup, kernel: str = "rounds"):
     ensure_x64()
     kernel_fn = _BATCHED_KERNELS[kernel]
     if kernel in ("rounds", "global"):
-        # Packed single-key sort when the group's value ranges allow —
+        # Packed single-key sorts when the group's value ranges allow —
         # checked host-side on the numpy inputs (padding rows included:
-        # their values only widen the bound).
+        # their values only widen the bound).  The totals bound for the
+        # packed round body is per-topic row sums for "rounds" but the
+        # whole group's sum for "global" (its totals carry across topics).
         max_lag = int(group.lags.max()) if group.lags.size else 0
         max_pid = (
             int(group.partition_ids.max()) if group.partition_ids.size else 0
         )
         shift = pack_shift_for(max_lag, max_pid)
+        bound_view = (
+            group.lags.reshape(1, -1) if kernel == "global" else group.lags
+        )
+        rb = totals_rank_bits_for(bound_view, group.num_consumers)
         observe_pack_shift(
-            (kernel, group.lags.shape, group.num_consumers), shift
+            (kernel, group.lags.shape, group.num_consumers),
+            shift * 100 + rb,
         )
         return kernel_fn(
             group.lags, group.partition_ids, group.valid,
             num_consumers=group.num_consumers,
             pack_shift=shift,
+            totals_rank_bits=rb,
         )
     return kernel_fn(
         group.lags, group.partition_ids, group.valid,

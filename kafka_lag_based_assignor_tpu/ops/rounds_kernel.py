@@ -106,8 +106,10 @@ def _rounds_scan(
 
     ``totals_rank_bits`` (static) > 0 selects the packed scatter-free
     round body (:func:`_rounds_body_packed`); the caller guarantees
-    ``(max possible total) << totals_rank_bits`` fits the lag dtype and
-    that ``totals0`` is all zeros.  0 = the general two-key body.
+    ``(max possible total) << totals_rank_bits`` fits the lag dtype —
+    including any non-zero ``totals0`` (the first round's sort orders the
+    carry regardless of its initial order, so a running cross-topic
+    start is fine).  0 = the general two-key body.
 
     Returns (totals[C], sorted_choice int32[P] in sorted order).
     """
@@ -237,7 +239,8 @@ def assign_presorted_rounds(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("num_consumers", "pack_shift")
+    jax.jit,
+    static_argnames=("num_consumers", "pack_shift", "totals_rank_bits"),
 )
 def assign_global_rounds(
     lags: jax.Array,
@@ -245,6 +248,7 @@ def assign_global_rounds(
     valid: jax.Array,
     num_consumers: int,
     pack_shift: int = 0,
+    totals_rank_bits: int = 0,
 ):
     """Cross-topic global-balance quality mode (beyond-reference feature).
 
@@ -279,7 +283,9 @@ def assign_global_rounds(
 
     def topic_step(totals, xs):
         sl_t, sv_t, perm = xs
-        totals, sorted_choice = _rounds_scan(sl_t, sv_t, totals, C)
+        totals, sorted_choice = _rounds_scan(
+            sl_t, sv_t, totals, C, totals_rank_bits=totals_rank_bits
+        )
         choice, counts = _unsort_choice(perm, sorted_choice, P, C)
         return totals, (choice, counts)
 

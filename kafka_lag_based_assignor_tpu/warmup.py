@@ -84,7 +84,11 @@ def warmup(
     compiled.  Failures are logged and skipped — warm-up must never take a
     deployment down.
     """
-    from .ops.batched import assign_batched_rounds, assign_batched_scan
+    from .ops.batched import (
+        assign_batched_rounds,
+        assign_batched_scan,
+        totals_rank_bits_for,
+    )
     from .ops.dispatch import ensure_x64
     from .ops.rounds_kernel import assign_global_rounds
     from .ops.scan_kernel import pack_shift_for
@@ -162,22 +166,26 @@ def warmup(
                 pids = np.broadcast_to(pids1d, (T, P)).copy()
                 valid = np.ones((T, P), dtype=bool)
                 # Production dispatch (ops/dispatch.assign_group_device)
-                # derives pack_shift from the group's max lag/pid — warm the
-                # SAME static-arg variant, or the warmed executable is never
-                # hit.  Dense pids 0..P-1 give the same shift as production
-                # dense groups; realistic lags stay under the packing bound,
-                # so pack_shift_for returns the same value for both.
+                # derives pack_shift AND totals_rank_bits from the group's
+                # value ranges — warm the SAME static-arg variants, or the
+                # warmed executable is never hit.  Dense pids 0..P-1 give
+                # the same shift as production dense groups; realistic
+                # lags stay under the packing/overflow bounds, so both
+                # helpers return the same values for both (rank bits
+                # depend only on C unless the lag sum nears 2^61).
                 shift = pack_shift_for(int(lags.max()), int(pids.max()))
+                rb = totals_rank_bits_for(lags, C)
+                rb_g = totals_rank_bits_for(lags.reshape(1, -1), C)
                 if "rounds" in solvers:
                     jobs.append(
                         (
                             "rounds",
                             T,
                             lambda lags=lags, pids=pids, valid=valid,
-                            shift=shift: (
+                            shift=shift, rb=rb: (
                                 assign_batched_rounds(
                                     lags, pids, valid, num_consumers=C,
-                                    pack_shift=shift,
+                                    pack_shift=shift, totals_rank_bits=rb,
                                 )
                             ),
                         )
@@ -200,10 +208,10 @@ def warmup(
                             "global",
                             T,
                             lambda lags=lags, pids=pids, valid=valid,
-                            shift=shift: (
+                            shift=shift, rb_g=rb_g: (
                                 assign_global_rounds(
                                     lags, pids, valid, num_consumers=C,
-                                    pack_shift=shift,
+                                    pack_shift=shift, totals_rank_bits=rb_g,
                                 )
                             ),
                         )

@@ -152,6 +152,32 @@ def test_packed_round_body_parity(seed):
         assert np.array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.parametrize("seed", range(3))
+def test_global_packed_round_body_parity(seed):
+    """The packed body must also be bit-exact for the cross-topic global
+    kernel, whose round scans start from non-zero carried totals."""
+    from kafka_lag_based_assignor_tpu.ops.batched import (
+        totals_rank_bits_for,
+    )
+    from kafka_lag_based_assignor_tpu.ops.rounds_kernel import (
+        assign_global_rounds,
+    )
+
+    rng = np.random.default_rng(seed)
+    T, P, C = 5, 96, 7
+    lags = rng.integers(0, 10**9, size=(T, P)).astype(np.int64)
+    pids = np.tile(np.arange(P, dtype=np.int32), (T, 1))
+    valid = rng.random((T, P)) < 0.9
+    lags[~valid] = 0
+    rb = totals_rank_bits_for(lags.reshape(1, -1), C)
+    base = assign_global_rounds(lags, pids, valid, num_consumers=C)
+    fast = assign_global_rounds(
+        lags, pids, valid, num_consumers=C, totals_rank_bits=rb
+    )
+    for a, b in zip(base, fast):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_totals_rank_bits_overflow_guard():
     """Lag sums that could overflow the packed key must disable packing."""
     from kafka_lag_based_assignor_tpu.ops.batched import (

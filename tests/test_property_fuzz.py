@@ -8,11 +8,13 @@ Reference semantics under test: SURVEY §2.4 items 1-4 (selection order,
 total determinism, per-topic independence, all members present).
 """
 
+import numpy as np
 from hypothesis import given, settings, strategies as st
 
 from kafka_lag_based_assignor_tpu import TopicPartitionLag, assign_greedy
 from kafka_lag_based_assignor_tpu.models.greedy import assign_greedy_global
 from kafka_lag_based_assignor_tpu.ops.dispatch import assign_device
+from kafka_lag_based_assignor_tpu.ops.refine import refine_assignment
 
 # Lags spanning ties, zeros, and near-int64 extremes (SURVEY §7: no packed
 # key could hold this range — the two-stage argmin must).  The defined
@@ -104,3 +106,73 @@ def test_invariants_all_solvers(instance):
             for m, tps in result.items():
                 if m not in subscribers:
                     assert all(tp.topic != topic for tp in tps)
+
+
+@st.composite
+def refine_instances(draw):
+    """Padded refine inputs: ragged P, small C, adversarial lag mixes
+    (ties, zeros, extremes), arbitrary count-balanced starts."""
+    C = draw(st.integers(2, 9))
+    P = draw(st.integers(C, 96))
+    pad = draw(st.integers(0, 32))
+    seed = draw(st.integers(0, 2**31 - 1))
+    rng = np.random.default_rng(seed)
+    style = draw(st.sampled_from(["uniform", "ties", "hot", "extreme"]))
+    if style == "uniform":
+        vals = rng.integers(0, 10**9, P)
+    elif style == "ties":
+        vals = rng.integers(0, 4, P) * 10**6
+    elif style == "hot":
+        vals = np.where(rng.random(P) < 0.1,
+                        rng.integers(10**10, 10**12, P), 1)
+    else:
+        # 2^57 keeps worst-case per-consumer totals (~48 rows at C=2)
+        # inside int64, so the invariant asserts compare real loads, not
+        # wrapped ones.
+        vals = np.full(P, 2**57)
+        vals[: P // 2] = rng.integers(0, 100, P // 2)
+    lags = np.zeros(P + pad, np.int64)
+    lags[:P] = vals
+    valid = np.zeros(P + pad, bool)
+    valid[:P] = True
+    choice = np.full(P + pad, -1, np.int32)
+    choice[:P] = rng.permutation(P) % C
+    iters = draw(st.integers(0, 24))
+    max_pairs = draw(st.one_of(st.none(), st.integers(1, C // 2 or 1)))
+    return lags, valid, choice, C, iters, max_pairs
+
+
+@settings(max_examples=40, deadline=None)
+@given(refine_instances())
+def test_refine_fuzz_invariants(instance):
+    """Hypothesis-searched refine invariants: peak load monotone
+    non-increasing, count spread preserved, accumulators consistent with
+    the returned choice, invalid rows untouched, work conserved, churn
+    within the documented bound."""
+    lags, valid, choice0, C, iters, max_pairs = instance
+    K = max(1, min(C // 2, max_pairs if max_pairs is not None else C // 2))
+    t0 = np.zeros(C, np.int64)
+    c0 = np.zeros(C, np.int64)
+    sel = valid & (choice0 >= 0)
+    np.add.at(t0, choice0[sel], lags[sel])
+    np.add.at(c0, choice0[sel], 1)
+
+    choice, counts, totals = refine_assignment(
+        lags, valid, choice0, num_consumers=C, iters=iters,
+        max_pairs=max_pairs,
+    )
+    choice = np.asarray(choice)
+    t1 = np.zeros(C, np.int64)
+    c1 = np.zeros(C, np.int64)
+    sel1 = valid & (choice >= 0)
+    np.add.at(t1, choice[sel1], lags[sel1])
+    np.add.at(c1, choice[sel1], 1)
+
+    np.testing.assert_array_equal(np.asarray(totals), t1)
+    np.testing.assert_array_equal(np.asarray(counts).astype(np.int64), c1)
+    assert t1.max() <= t0.max()
+    assert c1.max() - c1.min() <= max(c0.max() - c0.min(), 1)
+    assert (choice[~valid] == -1).all()
+    assert (choice[valid] >= 0).all() and (choice[valid] < C).all()
+    assert t1.sum() == t0.sum() and c1.sum() == c0.sum()
+    assert int((choice != choice0).sum()) <= 2 * iters * K
