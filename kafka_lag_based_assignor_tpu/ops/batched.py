@@ -179,7 +179,7 @@ def assign_stream_batch(lags, num_consumers: int):
     payload, shift = stream_payload(lags, partition_axis=1)
     rb = totals_rank_bits_for(payload, num_consumers)
     observe_pack_shift(
-        ("stream_batch", payload.shape, num_consumers), shift * 100 + rb
+        ("stream_batch", payload.shape, num_consumers), (shift, rb)
     )
     return _stream_batch_device(
         payload, num_consumers=num_consumers, pack_shift=shift,
@@ -246,7 +246,7 @@ def assign_stream(lags, num_consumers: int):
         # One observation key per executable-selecting tuple: a change in
         # EITHER static arg (pack shift or rank bits) recompiles.
         observe_pack_shift(
-            ("stream", lags.shape, num_consumers), shift * 100 + rb
+            ("stream", lags.shape, num_consumers), (shift, rb)
         )
         return _stream_device(
             payload, num_consumers=num_consumers, pack_shift=shift,

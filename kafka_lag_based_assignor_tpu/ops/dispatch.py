@@ -46,14 +46,17 @@ LOGGER = logging.getLogger(__name__)
 _LAST_PACK_SHIFT: Dict[Tuple, int] = {}
 
 
-def observe_pack_shift(key: Tuple, shift: int) -> None:
-    """INFO-log pack_shift changes per call signature (recompile signal)."""
+def observe_pack_shift(key: Tuple, shift) -> None:
+    """INFO-log changes in value-derived STATIC kernel args per call
+    signature (a recompile signal).  ``shift`` may be a plain pack shift
+    or a tuple of static args (e.g. ``(pack_shift, totals_rank_bits)``) —
+    logged structurally, any change means a fresh executable."""
     prev = _LAST_PACK_SHIFT.get(key)
     if prev is not None and prev != shift:
         LOGGER.info(
-            "pack_shift for %s changed %d -> %d (input value ranges "
-            "drifted): this solve compiles a fresh executable unless the "
-            "variant was warmed (see warmup.warmup)",
+            "static kernel args for %s changed %s -> %s (input value "
+            "ranges drifted): this solve compiles a fresh executable "
+            "unless the variant was warmed (see warmup.warmup)",
             key, prev, shift,
         )
     _LAST_PACK_SHIFT[key] = shift
@@ -132,7 +135,7 @@ def assign_group_device(group: TopicGroup, kernel: str = "rounds"):
         rb = totals_rank_bits_for(bound_view, group.num_consumers)
         observe_pack_shift(
             (kernel, group.lags.shape, group.num_consumers),
-            shift * 100 + rb,
+            (shift, rb),
         )
         return kernel_fn(
             group.lags, group.partition_ids, group.valid,

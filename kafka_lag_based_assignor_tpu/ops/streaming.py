@@ -279,7 +279,7 @@ class StreamingAssignor:
 
             payload, shift = stream_payload(lags)
             rb = totals_rank_bits_for(payload, C)
-            observe_pack_shift(("stream", lags.shape, C), shift * 100 + rb)
+            observe_pack_shift(("stream", lags.shape, C), (shift, rb))
             payload = jax.device_put(payload)  # ONE upload, both kernels
             choice0 = _stream_device(
                 payload, num_consumers=C, pack_shift=shift,

@@ -29,6 +29,34 @@ Also supported: ``{"method": "ping"}`` -> ``{"result": "pong"}`` and
 responses preserve the request ``id``.  Malformed JSON gets an error
 response with ``id: null`` rather than a dropped connection.
 
+Streaming mode (the BASELINE config-5 loop as a wire API): a client that
+rebalances the same topic periodically can keep warm solver state
+server-side instead of paying a from-scratch solve per epoch::
+
+    {"id": 7, "method": "stream_assign",
+     "params": {"stream_id": "orders",            # server-side state key
+                "topic": "t0",
+                "lags": [[0, 100000], [1, 50000]],
+                "members": ["C1", "C0"],          # ranks = sorted order
+                "options": {"refine_iters": 128,  # exchange budget
+                            "guardrail": 1.25,    # or null
+                            "refine_threshold": 1.02}}}   # or null
+
+    -> {"id": 7, "result": {"assignments": {"C0": [["t0", 0]], ...},
+                            "stream": {"cold_start": true, "refined": ...,
+                                       "churn": 0, ...}}}
+
+Epoch-over-epoch the server keeps the previous assignment
+(:class:`..ops.streaming.StreamingAssignor`): still-balanced epochs are
+no-ops (zero churn), drifted ones pay one bounded refine, and membership
+changes remap by member NAME (survivors keep their partitions; see
+``remap_members``).  A changed partition-id set or partition count
+re-solves cold.  ``{"method": "stream_reset", "params": {"stream_id":
+...}}`` drops the state; at most ``MAX_STREAMS`` live streams.  Unlike
+``assign`` (processing order, reference :228-235), streaming assignment
+lists are in ascending partition-id order — the row-stable order warm
+state is keyed on.
+
 Wire limits: a request line may be at most ``MAX_LINE_BYTES`` (16 MiB —
 comfortably above a 100k-partition request, ~2 MB); longer lines are
 answered with an error and drained without buffering.  ``params.options``
@@ -86,6 +114,10 @@ MAX_LINE_BYTES = 16 * 1024 * 1024
 _OPTION_BOUNDS = {"sinkhorn_iters": (1, 4096), "refine_iters": (0, 65536)}
 _OPTION_ROUNDS_UP = {"sinkhorn_iters": True, "refine_iters": False}
 
+# Live warm-state cap for stream_assign: each stream holds two int32[P]
+# vectors (host + device resident) — 64 north-star streams is ~50 MB.
+MAX_STREAMS = 64
+
 
 def _quantize_pow2(value: int, up: bool) -> int:
     if value == 0:
@@ -114,6 +146,81 @@ def _validate_options(options: Any) -> Dict[str, int]:
             )
         out[key] = _quantize_pow2(value, _OPTION_ROUNDS_UP[key])
     return out
+
+
+def _validate_stream_options(options: Any) -> Dict[str, Any]:
+    """Stream options: ``refine_iters`` is compile-relevant (static jit
+    arg downstream) and gets the same pow2-down quantization as the
+    stateless path; ``guardrail`` / ``refine_threshold`` are host-side
+    floats (no compile risk) — >= 1.0 or null to disable."""
+    if not isinstance(options, dict):
+        raise ValueError("params.options must be a JSON object")
+    out: Dict[str, Any] = {}
+    for key, value in options.items():
+        if key == "refine_iters":
+            # THE stateless path's validation + pow2-down quantization —
+            # delegated so the two surfaces cannot diverge.
+            out.update(_validate_options({key: value}))
+        elif key in ("guardrail", "refine_threshold"):
+            if value is None:
+                out[key] = None
+                continue
+            if isinstance(value, bool) or not isinstance(
+                value, (int, float)
+            ):
+                raise ValueError(f"option {key} must be a number or null")
+            if not 1.0 <= float(value) <= 1000.0:
+                raise ValueError(
+                    f"option {key}={value} out of range [1.0, 1000.0]"
+                )
+            out[key] = float(value)
+        else:
+            raise ValueError(
+                f"unknown stream option {key!r}; valid: "
+                "['guardrail', 'refine_iters', 'refine_threshold']"
+            )
+    return out
+
+
+def _snake_fallback(lags, C: int, prev):
+    """Emergency host-side assignment when the device solve fails or
+    times out mid-stream: partitions in descending-lag order deal out
+    boustrophedon (round r even -> slot j, odd -> C-1-j) — vectorized,
+    count spread <= 1, classic sorted-LPT quality.  NOT reference-parity
+    (the streaming surface never was); it keeps the rebalance alive.
+
+    Returns (choice int32[P], StreamingStats-shaped stats)."""
+    import numpy as np
+
+    from .ops.streaming import StreamingStats
+    from .utils.observability import count_constrained_bound
+
+    P = lags.shape[0]
+    ranks = np.empty(P, np.int64)
+    ranks[np.argsort(-lags, kind="stable")] = np.arange(P)
+    r, j = np.divmod(ranks, C)
+    choice = np.where(r % 2 == 0, j, C - 1 - j).astype(np.int32)
+    stats = StreamingStats(cold_start=True)
+    totals = np.bincount(choice, weights=lags.astype(np.float64),
+                         minlength=C)
+    mean = totals.mean()
+    stats.max_mean_imbalance = float(totals.max() / mean) if mean else 1.0
+    stats.imbalance_bound = count_constrained_bound(lags, C)
+    counts = np.bincount(choice, minlength=C)
+    stats.count_spread = int(counts.max() - counts.min())
+    if prev is not None and prev.shape[0] == P:
+        stats.churn = int((choice != prev).sum())
+    return choice, stats
+
+
+class _Stream:
+    """Warm per-stream solver state (see the module docstring)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.engine = None
+        self.members: List[str] = []
+        self.pids = None  # np.int64[P], sorted — the row order contract
 
 
 def _solve(
@@ -252,6 +359,8 @@ class AssignorService:
         ]
         self._warmup_solvers = tuple(warmup_solvers)
         self._counter_lock = threading.Lock()
+        self._streams: Dict[str, _Stream] = {}
+        self._streams_lock = threading.Lock()
         self.requests_served = 0
         self.errors = 0
         self.started_at = time.time()
@@ -314,6 +423,14 @@ class AssignorService:
                     # a client can see any pow2 substitution on the wire.
                     "options": options,
                 }
+            elif method == "stream_assign":
+                result = self._stream_assign(req.get("params") or {})
+            elif method == "stream_reset":
+                params = req.get("params") or {}
+                sid = params.get("stream_id")
+                with self._streams_lock:
+                    dropped = self._streams.pop(sid, None) is not None
+                result = {"dropped": dropped}
             else:
                 raise ValueError(f"unknown method {method!r}")
             with self._counter_lock:
@@ -326,6 +443,142 @@ class AssignorService:
             return json.dumps(
                 {"id": req_id, "error": {"message": str(exc)}}
             ).encode()
+
+    def _stream_assign(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        import numpy as np
+
+        from .ops.streaming import StreamingAssignor
+
+        sid = params.get("stream_id")
+        if not isinstance(sid, str) or not sid:
+            raise ValueError("params.stream_id must be a non-empty string")
+        topic = params.get("topic", "t0")
+        rows = params.get("lags") or []
+        members = params.get("members") or []
+        if not isinstance(members, list) or not members:
+            raise ValueError("params.members must be a non-empty list")
+        members_sorted = sorted(str(m) for m in members)
+        if len(set(members_sorted)) != len(members_sorted):
+            raise ValueError("params.members contains duplicates")
+        C = len(members_sorted)
+        opts = _validate_stream_options(params.get("options") or {})
+
+        if not rows:
+            raise ValueError("params.lags must be a non-empty list")
+        pids = np.fromiter(
+            (int(p) for p, _ in rows), np.int64, count=len(rows)
+        )
+        lags_in = np.fromiter(
+            (int(lag) for _, lag in rows), np.int64, count=len(rows)
+        )
+        order = np.argsort(pids, kind="stable")
+        pids_sorted = pids[order]
+        lags = lags_in[order]
+        if pids_sorted.size and (
+            np.diff(pids_sorted) == 0
+        ).any():
+            raise ValueError("params.lags contains duplicate partition ids")
+
+        with self._streams_lock:
+            st = self._streams.get(sid)
+            if st is None:
+                if len(self._streams) >= MAX_STREAMS:
+                    raise ValueError(
+                        f"too many live streams (max {MAX_STREAMS}); "
+                        "stream_reset unused ones"
+                    )
+                st = self._streams[sid] = _Stream()
+
+        with st.lock:
+            if st.engine is None:
+                st.engine = StreamingAssignor(
+                    num_consumers=C,
+                    refine_iters=opts.get("refine_iters", 128),
+                    imbalance_guardrail=opts.get("guardrail", 1.25),
+                    refine_threshold=opts.get("refine_threshold", 1.02),
+                )
+                st.members = members_sorted
+            elif st.members != members_sorted:
+                # Membership change: remap by NAME so survivors keep their
+                # partitions (the engine's repair pass re-seats only
+                # orphans/overflow next rebalance).
+                new_rank = {m: i for i, m in enumerate(members_sorted)}
+                old_to_new = np.fromiter(
+                    (new_rank.get(m, -1) for m in st.members),
+                    np.int32, count=len(st.members),
+                )
+                st.engine.remap_members(old_to_new, C)
+                st.members = members_sorted
+            # A different partition-id set at the SAME count would silently
+            # misbind warm rows to new pids — force a cold solve (a count
+            # change already does, via the engine's shape check).
+            if st.pids is not None and not np.array_equal(
+                st.pids, pids_sorted
+            ):
+                st.engine.reset()
+            st.pids = pids_sorted
+            # Option changes apply to the LIVE engine (not only at stream
+            # creation) — silently ignoring a changed budget would violate
+            # the churn bound the client thinks it configured.
+            if "refine_iters" in opts:
+                st.engine.refine_iters = opts["refine_iters"]
+            if "guardrail" in opts:
+                st.engine.imbalance_guardrail = opts["guardrail"]
+            if "refine_threshold" in opts:
+                st.engine.refine_threshold = opts["refine_threshold"]
+
+            fallback_used = False
+            prev = st.engine._prev_choice
+            try:
+                solve = st.engine.rebalance
+                if self._watchdog is not None:
+                    choice = self._watchdog.call(solve, lags)
+                else:
+                    choice = solve(lags)
+                s = st.engine.last_stats
+            except Exception:
+                # A watchdog-abandoned worker thread may STILL be running
+                # the engine's rebalance and will mutate its warm state
+                # later with no lock held — the stream must be POISONED
+                # (dropped) so no future epoch touches the orphaned
+                # engine.  The response falls back to a host-side snake
+                # LPT (like the stateless path's host fallback) so the
+                # rebalance survives; the next epoch restarts cold.
+                with self._streams_lock:
+                    self._streams.pop(sid, None)
+                if not self._host_fallback:
+                    raise
+                LOGGER.warning(
+                    "stream %r solve failed; host fallback + state drop",
+                    sid, exc_info=True,
+                )
+                fallback_used = True
+                choice, s = _snake_fallback(lags, C, prev)
+
+        choice_l = np.asarray(choice).tolist()
+        pids_l = pids_sorted.tolist()
+        assignments: Dict[str, List[List[Any]]] = {
+            m: [] for m in members_sorted
+        }
+        for row, consumer in enumerate(choice_l):
+            assignments[members_sorted[consumer]].append(
+                [topic, pids_l[row]]
+            )
+        return {
+            "assignments": assignments,
+            "stream": {
+                "cold_start": s.cold_start,
+                "refined": s.refined,
+                "guardrail_tripped": s.guardrail_tripped,
+                "churn": s.churn,
+                "repaired_rows": s.repaired_rows,
+                "max_mean_imbalance": s.max_mean_imbalance,
+                "imbalance_bound": s.imbalance_bound,
+                "count_spread": s.count_spread,
+                "fallback_used": fallback_used,
+            },
+            "options": opts,
+        }
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -406,6 +659,31 @@ class AssignorServiceClient:
             m: [(t, int(p)) for t, p in tps]
             for m, tps in result["assignments"].items()
         }
+
+    def stream_assign(
+        self,
+        stream_id: str,
+        topic: str,
+        lags: List[Tuple[int, int]],
+        members: List[str],
+        options: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        """One warm-start epoch; returns the raw result dict
+        (``assignments`` + ``stream`` stats)."""
+        params: Dict[str, Any] = {
+            "stream_id": stream_id,
+            "topic": topic,
+            "lags": lags,
+            "members": members,
+        }
+        if options is not None:
+            params["options"] = options
+        return self.request("stream_assign", params)
+
+    def stream_reset(self, stream_id: str) -> bool:
+        return self.request("stream_reset", {"stream_id": stream_id})[
+            "dropped"
+        ]
 
     def close(self) -> None:
         self._file.close()

@@ -261,7 +261,7 @@ def test_pack_shift_flip_logged(caplog):
         assign_device(lag_map(100), subs)
         # 2^60 lags exceed the packing bound -> pack_shift flips to 0.
         assign_device(lag_map(1 << 60), subs)
-    assert any("pack_shift" in r.message for r in caplog.records)
+    assert any("static kernel args" in r.message for r in caplog.records)
 
 
 def test_valid_options_accepted(service):
@@ -304,3 +304,111 @@ def test_concurrent_clients_device_solver(service):
     for r in results:
         sizes = sorted(len(v) for v in r.values())
         assert sizes == [16, 16]
+
+
+class TestStreamAssign:
+    """Warm-state streaming over the wire (stream_assign/stream_reset)."""
+
+    def _epoch(self, c, lags, members=("C0", "C1", "C2", "C3"), **kw):
+        return c.stream_assign(
+            "s1", "t0", [[i, int(v)] for i, v in enumerate(lags)],
+            list(members), **kw,
+        )
+
+    def test_warm_epochs_over_wire(self, service):
+        import numpy as np
+
+        rng = np.random.default_rng(0)
+        lags = rng.integers(0, 10**9, 512)
+        with client_for(service) as c:
+            r1 = self._epoch(c, lags)
+            assert r1["stream"]["cold_start"]
+            total = sum(len(v) for v in r1["assignments"].values())
+            assert total == 512
+            # Same lags again: a no-op epoch (threshold default 1.02).
+            r2 = self._epoch(c, lags)
+            assert not r2["stream"]["cold_start"]
+            assert r2["stream"]["churn"] == 0
+            assert not r2["stream"]["refined"]
+            assert r2["assignments"] == r1["assignments"]
+            # Drifted lags: bounded churn.
+            drifted = (lags * rng.lognormal(0, 0.3, 512)).astype(int)
+            r3 = self._epoch(c, drifted, options={"refine_iters": 16})
+            assert r3["stream"]["churn"] <= 2 * 16 + r3["stream"][
+                "repaired_rows"
+            ] or r3["stream"]["cold_start"]
+
+    def test_membership_change_remaps_by_name(self, service):
+        import numpy as np
+
+        rng = np.random.default_rng(1)
+        lags = rng.integers(0, 10**9, 400)
+        with client_for(service) as c:
+            r1 = self._epoch(c, lags)
+            before = {
+                m: {tuple(tp) for tp in tps}
+                for m, tps in r1["assignments"].items()
+            }
+            # C2 leaves; survivors keep most of their partitions.
+            r2 = self._epoch(c, lags, members=("C0", "C1", "C3"))
+            assert not r2["stream"]["cold_start"]
+            assert r2["stream"]["repaired_rows"] >= len(before["C2"])
+            after = {
+                m: {tuple(tp) for tp in tps}
+                for m, tps in r2["assignments"].items()
+            }
+            assert "C2" not in after
+            for m in ("C0", "C1", "C3"):
+                kept = len(before[m] & after[m])
+                assert kept >= len(before[m]) // 2, (m, kept)
+
+    def test_pid_set_change_forces_cold(self, service):
+        with client_for(service) as c:
+            r1 = c.stream_assign(
+                "s1", "t0", [[i, 100] for i in range(64)], ["C0", "C1"]
+            )
+            assert r1["stream"]["cold_start"]
+            r2 = c.stream_assign(
+                "s1", "t0", [[i + 1000, 100] for i in range(64)],
+                ["C0", "C1"],
+            )
+            assert r2["stream"]["cold_start"]
+
+    def test_stream_reset_drops_state(self, service):
+        with client_for(service) as c:
+            c.stream_assign("s1", "t0", [[0, 1], [1, 2]], ["C0"])
+            assert c.stream_reset("s1")
+            assert not c.stream_reset("s1")
+            r = c.stream_assign("s1", "t0", [[0, 1], [1, 2]], ["C0"])
+            assert r["stream"]["cold_start"]
+
+    def test_stream_validation_errors(self, service):
+        with client_for(service) as c:
+            with pytest.raises(RuntimeError, match="stream_id"):
+                c.stream_assign("", "t0", [[0, 1]], ["C0"])
+            with pytest.raises(RuntimeError, match="members"):
+                c.stream_assign("s1", "t0", [[0, 1]], [])
+            with pytest.raises(RuntimeError, match="duplicate partition"):
+                c.stream_assign("s1", "t0", [[0, 1], [0, 2]], ["C0"])
+            with pytest.raises(RuntimeError, match="non-empty"):
+                c.stream_assign("s2", "t0", [], ["C0"])
+            with pytest.raises(RuntimeError, match="unknown stream option"):
+                c.stream_assign(
+                    "s3", "t0", [[0, 1]], ["C0"], options={"bogus": 1}
+                )
+            with pytest.raises(RuntimeError, match="out of range"):
+                c.stream_assign(
+                    "s3", "t0", [[0, 1]], ["C0"],
+                    options={"guardrail": 0.5},
+                )
+
+    def test_stream_cap(self, service):
+        from kafka_lag_based_assignor_tpu import service as service_mod
+
+        with client_for(service) as c:
+            for i in range(service_mod.MAX_STREAMS):
+                c.stream_assign(f"cap{i}", "t0", [[0, 1]], ["C0"])
+            with pytest.raises(RuntimeError, match="too many live streams"):
+                c.stream_assign("overflow", "t0", [[0, 1]], ["C0"])
+            assert c.stream_reset("cap0")
+            c.stream_assign("overflow", "t0", [[0, 1]], ["C0"])
