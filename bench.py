@@ -414,15 +414,14 @@ def config3_vmap():
     member_load = totals.sum(axis=0)
 
     # Cross-topic global-balance quality mode (beyond-reference): same
-    # per-topic count invariant, lag totals carried across topics.
-    from kafka_lag_based_assignor_tpu.ops.rounds_kernel import (
-        assign_global_rounds,
+    # per-topic count invariant, lag totals carried across topics — via
+    # the dense transfer-lean path (lags-only upload).
+    from kafka_lag_based_assignor_tpu.ops.batched import (
+        assign_stream_global,
     )
 
     def global_once():
-        _, _, g_totals = assign_global_rounds(
-            lags, pids, valid, num_consumers=C
-        )
+        _, g_totals = assign_stream_global(lags, num_consumers=C)
         return np.asarray(g_totals)  # the one blocking readback
 
     g_ms, g_totals = timed_solve(global_once, iters=10)

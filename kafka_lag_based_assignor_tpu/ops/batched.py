@@ -187,6 +187,60 @@ def assign_stream_batch(lags, num_consumers: int):
     )
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_consumers", "pack_shift", "totals_rank_bits"),
+)
+def _stream_global_device(
+    lags, num_consumers: int, pack_shift: int = 0,
+    totals_rank_bits: int = 0,
+):
+    """Dense transfer-lean inner for the cross-topic global quality mode
+    (same upload contract as :func:`_stream_batch_device`: the [T, P] lag
+    matrix alone)."""
+    import jax.numpy as jnp
+
+    from .packing import pad_bucket
+    from .rounds_kernel import assign_global_rounds
+
+    T, P = lags.shape
+    P_pad = pad_bucket(P)
+    lags_p = jnp.pad(lags.astype(jnp.int64), ((0, 0), (0, P_pad - P)))
+    pids = jnp.broadcast_to(
+        jnp.arange(P_pad, dtype=jnp.int32), (T, P_pad)
+    )
+    valid = pids < P
+    choice, _, totals = assign_global_rounds(
+        lags_p, pids, valid, num_consumers=num_consumers,
+        pack_shift=pack_shift, totals_rank_bits=totals_rank_bits,
+    )
+    return _narrow_choice(choice[:, :P], num_consumers), totals
+
+
+def assign_stream_global(lags, num_consumers: int):
+    """Transfer-lean dense batch path for the GLOBAL (cross-topic lag
+    balance) quality mode: upload the [T, P] lag matrix only, read back
+    the narrow choice plus the single global [C] totals vector.  Same
+    semantics as :func:`..ops.rounds_kernel.assign_global_rounds` with
+    dense pids / all-true valid.
+
+    Returns (choice[T, P] int16/int32, totals int64[C])."""
+    from .dispatch import ensure_x64, observe_pack_shift
+
+    ensure_x64()
+    payload, shift = stream_payload(lags, partition_axis=1)
+    # The global kernel's totals carry across topics: bound by the WHOLE
+    # batch's sum, not per-topic row sums.
+    rb = totals_rank_bits_for(payload.reshape(1, -1), num_consumers)
+    observe_pack_shift(
+        ("stream_global", payload.shape, num_consumers), (shift, rb)
+    )
+    return _stream_global_device(
+        payload, num_consumers=num_consumers, pack_shift=shift,
+        totals_rank_bits=rb,
+    )
+
+
 def stream_payload(lags: np.ndarray, partition_axis: int = 0):
     """Host half of the accelerator stream paths: the upload dtype choice
     (int32 when the lag range allows — halves the bytes; the kernels widen

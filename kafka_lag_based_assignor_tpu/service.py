@@ -479,23 +479,35 @@ class AssignorService:
         ).any():
             raise ValueError("params.lags contains duplicate partition ids")
 
-        with self._streams_lock:
-            st = self._streams.get(sid)
-            if st is None:
-                if len(self._streams) >= MAX_STREAMS:
-                    raise ValueError(
-                        f"too many live streams (max {MAX_STREAMS}); "
-                        "stream_reset unused ones"
-                    )
-                st = self._streams[sid] = _Stream()
+        while True:
+            with self._streams_lock:
+                st = self._streams.get(sid)
+                if st is None:
+                    if len(self._streams) >= MAX_STREAMS:
+                        raise ValueError(
+                            f"too many live streams (max {MAX_STREAMS}); "
+                            "stream_reset unused ones"
+                        )
+                    st = self._streams[sid] = _Stream()
+            st.lock.acquire()
+            # The stream may have been POISONED (solve failure) or reset
+            # while this request waited on its lock — solving on the
+            # orphaned engine would race the very abandoned thread the
+            # poison quarantines.  Re-validate registration under the
+            # lock; on a mismatch, loop and start over on fresh state.
+            with self._streams_lock:
+                if self._streams.get(sid) is st:
+                    break
+            st.lock.release()
 
-        with st.lock:
+        try:
             if st.engine is None:
+                # Service-level defaults (guardrail on at 1.25, unlike the
+                # library default) — requested options are applied by the
+                # SAME update block every epoch uses, so each default
+                # lives in exactly one place.
                 st.engine = StreamingAssignor(
-                    num_consumers=C,
-                    refine_iters=opts.get("refine_iters", 128),
-                    imbalance_guardrail=opts.get("guardrail", 1.25),
-                    refine_threshold=opts.get("refine_threshold", 1.02),
+                    num_consumers=C, imbalance_guardrail=1.25
                 )
                 st.members = members_sorted
             elif st.members != members_sorted:
@@ -554,6 +566,8 @@ class AssignorService:
                 )
                 fallback_used = True
                 choice, s = _snake_fallback(lags, C, prev)
+        finally:
+            st.lock.release()
 
         choice_l = np.asarray(choice).tolist()
         pids_l = pids_sorted.tolist()

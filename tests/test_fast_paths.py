@@ -178,6 +178,30 @@ def test_global_packed_round_body_parity(seed):
         assert np.array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.parametrize("seed", range(2))
+def test_assign_stream_global_parity(seed):
+    """The dense global fast path must match assign_global_rounds with
+    explicit dense pids / all-true valid, bit-exactly."""
+    from kafka_lag_based_assignor_tpu.ops.batched import (
+        assign_stream_global,
+    )
+    from kafka_lag_based_assignor_tpu.ops.rounds_kernel import (
+        assign_global_rounds,
+    )
+
+    rng = np.random.default_rng(seed)
+    T, P, C = 6, 100, 8
+    lags = rng.integers(0, 10**9, size=(T, P)).astype(np.int64)
+    choice, totals = assign_stream_global(lags, num_consumers=C)
+    pids = np.tile(np.arange(P, dtype=np.int32), (T, 1))
+    valid = np.ones((T, P), dtype=bool)
+    b_choice, _, b_totals = assign_global_rounds(
+        lags, pids, valid, num_consumers=C
+    )
+    assert np.array_equal(np.asarray(choice), np.asarray(b_choice))
+    assert np.array_equal(np.asarray(totals), np.asarray(b_totals))
+
+
 def test_totals_rank_bits_overflow_guard():
     """Lag sums that could overflow the packed key must disable packing."""
     from kafka_lag_based_assignor_tpu.ops.batched import (

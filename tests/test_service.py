@@ -412,3 +412,44 @@ class TestStreamAssign:
                 c.stream_assign("overflow", "t0", [[0, 1]], ["C0"])
             assert c.stream_reset("cap0")
             c.stream_assign("overflow", "t0", [[0, 1]], ["C0"])
+
+    def test_solve_failure_poisons_stream_and_falls_back(
+        self, service, monkeypatch
+    ):
+        """A failing stream solve must answer with the host fallback
+        (count-balanced, fallback_used flagged) and drop the warm state so
+        the next epoch restarts cold on a fresh engine."""
+        import numpy as np
+
+        from kafka_lag_based_assignor_tpu.ops import streaming as streaming_mod
+
+        lags = np.arange(1, 257, dtype=np.int64) * 1000
+        with client_for(service) as c:
+            r1 = self._epoch(c, lags, members=("C0", "C1"))
+            assert r1["stream"]["cold_start"]
+
+            calls = {"n": 0}
+            orig = streaming_mod.StreamingAssignor.rebalance
+
+            def boom(self_eng, arr):
+                calls["n"] += 1
+                raise RuntimeError("simulated device failure")
+
+            monkeypatch.setattr(
+                streaming_mod.StreamingAssignor, "rebalance", boom
+            )
+            r2 = self._epoch(c, lags, members=("C0", "C1"))
+            assert r2["stream"]["fallback_used"]
+            assert r2["stream"]["cold_start"]
+            sizes = sorted(
+                len(v) for v in r2["assignments"].values()
+            )
+            assert sizes == [128, 128]  # snake fallback count-balanced
+            assert calls["n"] == 1
+
+            monkeypatch.setattr(
+                streaming_mod.StreamingAssignor, "rebalance", orig
+            )
+            r3 = self._epoch(c, lags, members=("C0", "C1"))
+            assert r3["stream"]["cold_start"]  # state was dropped
+            assert not r3["stream"]["fallback_used"]
