@@ -269,25 +269,11 @@ def timed_solve(once, iters=20):
     return float(np.median(times)), out
 
 
-def device_assign_ms(lags, pids, valid, C, iters=20):
-    """Steady-state end-to-end ms for one batched device solve: host numpy
-    in, choices materialized to host out (a single device->host readback;
-    per-member totals are derived host-side, cheaper than a second RTT)."""
-    from kafka_lag_based_assignor_tpu.ops.batched import assign_batched_rounds
-
-    def once():
-        choice, _, _ = assign_batched_rounds(
-            lags, pids, valid, num_consumers=C
-        )
-        return np.asarray(choice)  # the one blocking readback
-
-    ms, choice = timed_solve(once, iters)
-
-    totals = np.zeros((lags.shape[0], C), dtype=np.int64)
-    for t in range(lags.shape[0]):
-        sel = valid[t] & (choice[t] >= 0)
-        np.add.at(totals[t], choice[t][sel], lags[t][sel])
-    return ms, choice, totals
+def totals_from_choice(choice: np.ndarray, lags: np.ndarray, C: int):
+    """Per-consumer lag totals for a dense single-topic choice vector."""
+    totals = np.zeros(C, dtype=np.int64)
+    np.add.at(totals, choice.astype(np.int64), lags)
+    return totals
 
 
 def imbalance(member_totals: np.ndarray) -> float:
@@ -355,15 +341,19 @@ def config2_zipf():
     )
     from kafka_lag_based_assignor_tpu.ops.packing import pad_topic_rows
 
+    from kafka_lag_based_assignor_tpu.ops.batched import assign_stream
+
     rng = np.random.default_rng(2)
     P, C = 1000, 16
     lags1d = zipf_lags(rng, P)
-    lags = lags1d[None, :]
-    pids = np.arange(P, dtype=np.int32)[None, :]
-    valid = np.ones((1, P), dtype=bool)
-    ms, _, totals = device_assign_ms(lags, pids, valid, C)
+
+    def once():
+        return np.asarray(assign_stream(lags1d, num_consumers=C))
+
+    ms, choice = timed_solve(once)
+    totals = totals_from_choice(choice, lags1d, C)
     bound = imbalance_bound(lags1d, C)
-    imb = imbalance(totals[0])
+    imb = imbalance(totals)
 
     lags_p, pids_p, valid_p = pad_topic_rows(lags1d)
 
@@ -437,15 +427,19 @@ def config3_vmap():
 
 def config4_skew():
     """10k partitions, 512 consumers, 90% zero-lag / 10% hot."""
+    from kafka_lag_based_assignor_tpu.ops.batched import assign_stream
+
     rng = np.random.default_rng(4)
     P, C = 10_000, 512
     lags = np.zeros(P, dtype=np.int64)
     hot = rng.choice(P, size=P // 10, replace=False)
     lags[hot] = rng.integers(10**5, 10**7, size=hot.size)
-    ms, _, totals = device_assign_ms(
-        lags[None, :], np.arange(P, dtype=np.int32)[None, :],
-        np.ones((1, P), dtype=bool), C,
-    )
+
+    def once():
+        return np.asarray(assign_stream(lags, num_consumers=C))
+
+    ms, choice = timed_solve(once)
+    totals = totals_from_choice(choice, lags, C)
 
     # Sinkhorn quality mode on the same instance (the BASELINE config-4
     # comparison): implicit-plan OT relaxation + exchange refinement.
@@ -465,7 +459,7 @@ def config4_skew():
     s_ms, s_totals = timed_solve(sink_once, iters=5)
 
     bound = imbalance_bound(lags, C)
-    imb = imbalance(totals[0])
+    imb = imbalance(totals)
     s_imb = imbalance(s_totals)
     return {
         "config": "skew_10k_512c",
@@ -504,9 +498,7 @@ def config5_northstar():
         floor_once,
     )
     ms = flr["assign_ms"]
-    totals = np.zeros(C, dtype=np.int64)
-    np.add.at(totals, choice.astype(np.int64), lags0)
-    imb = imbalance(totals)
+    imb = imbalance(totals_from_choice(choice, lags0, C))
     bound = imbalance_bound(lags0, C)
 
     phases = phase_breakdown(lags0, C)

@@ -130,6 +130,24 @@ def _stream_device(
     return _narrow_choice(choice[:P], num_consumers)
 
 
+def _dense_batch_inputs(lags):
+    """THE device-side derivation for dense [T, P] batches: pad the
+    partition axis to the pow2 bucket, dense pids, valid = real-row mask.
+    Shared by the batch and global stream inners so the dense-padding
+    contract lives in one place.  Returns (lags_p, pids, valid, P)."""
+    import jax.numpy as jnp
+
+    from .packing import pad_bucket
+
+    T, P = lags.shape
+    P_pad = pad_bucket(P)
+    lags_p = jnp.pad(lags.astype(jnp.int64), ((0, 0), (0, P_pad - P)))
+    pids = jnp.broadcast_to(
+        jnp.arange(P_pad, dtype=jnp.int32), (T, P_pad)
+    )
+    return lags_p, pids, pids < P, P
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("num_consumers", "pack_shift", "totals_rank_bits"),
@@ -143,17 +161,7 @@ def _stream_batch_device(
     the upload is the [T, P] lag matrix alone.  Pads the partition axis
     device-side to the power-of-two bucket like :func:`_stream_device`
     and shares its trimmed-scan / packed-round-body static args."""
-    import jax.numpy as jnp
-
-    from .packing import pad_bucket
-
-    T, P = lags.shape
-    P_pad = pad_bucket(P)
-    lags_p = jnp.pad(lags.astype(jnp.int64), ((0, 0), (0, P_pad - P)))
-    pids = jnp.broadcast_to(
-        jnp.arange(P_pad, dtype=jnp.int32), (T, P_pad)
-    )
-    valid = pids < P
+    lags_p, pids, valid, P = _dense_batch_inputs(lags)
     fn = functools.partial(
         assign_topic_rounds, num_consumers=num_consumers,
         pack_shift=pack_shift, n_valid=P,
@@ -198,21 +206,13 @@ def _stream_global_device(
     """Dense transfer-lean inner for the cross-topic global quality mode
     (same upload contract as :func:`_stream_batch_device`: the [T, P] lag
     matrix alone)."""
-    import jax.numpy as jnp
-
-    from .packing import pad_bucket
     from .rounds_kernel import assign_global_rounds
 
-    T, P = lags.shape
-    P_pad = pad_bucket(P)
-    lags_p = jnp.pad(lags.astype(jnp.int64), ((0, 0), (0, P_pad - P)))
-    pids = jnp.broadcast_to(
-        jnp.arange(P_pad, dtype=jnp.int32), (T, P_pad)
-    )
-    valid = pids < P
+    lags_p, pids, valid, P = _dense_batch_inputs(lags)
     choice, _, totals = assign_global_rounds(
         lags_p, pids, valid, num_consumers=num_consumers,
         pack_shift=pack_shift, totals_rank_bits=totals_rank_bits,
+        n_valid=P,
     )
     return _narrow_choice(choice[:, :P], num_consumers), totals
 

@@ -240,7 +240,9 @@ def assign_presorted_rounds(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("num_consumers", "pack_shift", "totals_rank_bits"),
+    static_argnames=(
+        "num_consumers", "pack_shift", "totals_rank_bits", "n_valid"
+    ),
 )
 def assign_global_rounds(
     lags: jax.Array,
@@ -249,6 +251,7 @@ def assign_global_rounds(
     num_consumers: int,
     pack_shift: int = 0,
     totals_rank_bits: int = 0,
+    n_valid: int | None = None,
 ):
     """Cross-topic global-balance quality mode (beyond-reference feature).
 
@@ -284,7 +287,8 @@ def assign_global_rounds(
     def topic_step(totals, xs):
         sl_t, sv_t, perm = xs
         totals, sorted_choice = _rounds_scan(
-            sl_t, sv_t, totals, C, totals_rank_bits=totals_rank_bits
+            sl_t, sv_t, totals, C, n_valid=n_valid,
+            totals_rank_bits=totals_rank_bits,
         )
         choice, counts = _unsort_choice(perm, sorted_choice, P, C)
         return totals, (choice, counts)

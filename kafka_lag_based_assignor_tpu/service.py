@@ -400,6 +400,8 @@ class AssignorService:
                         "errors": self.errors,
                         "uptime_s": time.time() - self.started_at,
                     }
+                with self._streams_lock:
+                    result["live_streams"] = len(self._streams)
             elif method == "assign":
                 params = req.get("params") or {}
                 solver = params.get("solver", "rounds")

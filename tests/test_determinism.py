@@ -86,3 +86,27 @@ def test_batched_leading_dim_determinism():
     a = np.asarray(assign_batched_rounds(*batch, num_consumers=8)[0])
     b = np.asarray(assign_batched_rounds(*batch, num_consumers=8)[0])
     np.testing.assert_array_equal(a, b)
+
+
+def test_refine_repeated_runs_bit_identical():
+    """The refine kernel (sort-based selection, quantized keys) must be
+    bit-deterministic across calls — rebalances must be reproducible."""
+    import numpy as np
+
+    from kafka_lag_based_assignor_tpu.ops.refine import refine_assignment
+
+    rng = np.random.default_rng(11)
+    P, C = 2048, 32
+    lags = rng.integers(0, 10**12, P).astype(np.int64)
+    valid = np.ones(P, bool)
+    choice0 = (rng.permutation(P) % C).astype(np.int32)
+    runs = [
+        tuple(
+            np.asarray(a).tobytes()
+            for a in refine_assignment(
+                lags, valid, choice0, num_consumers=C, iters=24
+            )
+        )
+        for _ in range(3)
+    ]
+    assert runs[0] == runs[1] == runs[2]
