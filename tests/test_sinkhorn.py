@@ -192,3 +192,37 @@ def test_more_consumers_than_partitions():
     result = assign_sinkhorn(lag_map, subs)
     sizes = sorted(len(v) for v in result.values())
     assert sizes == [0, 0, 0, 1, 1]
+
+
+def test_duals_converge_on_heavy_skew():
+    """The duals iteration must actually converge the A (mirror-descent)
+    step, not only the B column marginal: a premature stop watching only
+    the column correction exits at iteration ~2 on heavy-skew inputs with
+    a continuous load spread ~4 orders of magnitude worse (measured when
+    a B-only early-exit was attempted and reverted).  Pin the converged
+    plan's fractional load spread."""
+    import numpy as np
+
+    from kafka_lag_based_assignor_tpu.models.sinkhorn import (
+        _dedup_weights,
+        _sinkhorn_duals_jit,
+    )
+    from kafka_lag_based_assignor_tpu.ops.plan_stats import plan_stats
+
+    rng = np.random.default_rng(4)
+    P, C = 1000, 16
+    lags = np.zeros(P, np.int64)
+    hot = rng.choice(P, P // 10, replace=False)
+    lags[hot] = rng.integers(10**5, 10**7, size=hot.size)
+    valid = np.ones(P, bool)
+    ws_u, count_u, wsum_u = _dedup_weights(lags, valid, C)
+    A, B = _sinkhorn_duals_jit(
+        ws_u, count_u, wsum_u, num_consumers=C, iters=24
+    )
+    load, colsum = (
+        np.asarray(x) for x in plan_stats(ws_u, count_u, wsum_u, A, B)
+    )
+    spread = (load.max() - load.min()) / load.mean()
+    assert spread < 1e-4, f"duals load spread {spread:.2e}: undertrained"
+    col_spread = (colsum.max() - colsum.min()) / colsum.mean()
+    assert col_spread < 1e-2, f"count marginal spread {col_spread:.2e}"
