@@ -1,0 +1,18 @@
+#!/bin/bash
+# One-shot revalidation after the tunnel recovers: kernel probes first
+# (fetch-synchronized, tools/probe_round5f.py), then the full benchmark
+# (writes BENCH_DETAILS.json).  Run from the repo root:
+#   bash tools/device_revalidate.sh
+set -u
+cd "$(dirname "$0")/.."
+echo "== device probe =="
+timeout 150 python -c "
+import jax, numpy as np
+x = jax.device_put(np.arange(8, dtype=np.int32))
+assert int(jax.jit(lambda v: (v+1).sum())(x)) == 36
+print('device alive:', jax.devices())" || { echo "device unreachable"; exit 1; }
+echo "== kernel probe (probe_round5f) =="
+timeout 2400 python tools/probe_round5f.py 2>&1 | grep -vE "WARN|INFO|warning"
+echo "== full bench =="
+timeout 3600 python bench.py
+echo "== done; BENCH_DETAILS.json updated =="
