@@ -408,7 +408,15 @@ class StreamingAssignor:
         rows (cheapest churn); then orphans, largest lag first, go to the
         least-loaded open consumer — the count-primary greedy rule over
         only the moving rows, O(moving * C) host work on a few hundred
-        rows, versus a full device re-solve.
+        rows, versus a full device re-solve.  A final correction pass
+        restores ``max - min <= 1`` exactly: with a non-divisible P the
+        cap-based release alone leaves every survivor at ceil while the
+        joiner cannot reach floor (e.g. P=401, C 4->5: cap 81, survivors
+        81,81,81,81, joiner 77 — spread 4, found by the
+        operation-sequence fuzz; a join can also arrive with no cap
+        overflow at all, e.g. counts 2,2,2,2,2,0), and the count
+        invariant is the reference's PRIMARY semantic, so it must hold
+        even when the quality threshold later skips the refine.
 
         Owns its trigger: returns ``(choice unchanged, 0)`` when there is
         nothing to repair.  Returns ``(repaired choice, rows moved)``.
@@ -418,7 +426,11 @@ class StreamingAssignor:
         cap = -(-P // C)  # ceil: no consumer may exceed the new ceiling
         counts = np.bincount(choice[choice >= 0], minlength=C)
         has_orphans = bool((choice < 0).any())
-        if not has_orphans and counts.max() <= cap:
+        if (
+            not has_orphans
+            and counts.max() <= cap
+            and counts.max() - counts.min() <= 1
+        ):
             return choice, 0
         original = choice
         choice = choice.copy()
@@ -432,18 +444,35 @@ class StreamingAssignor:
             choice[release] = -1
             counts[c] = cap
             totals[c] -= lags[release].sum()
+        def least_total_of(cand: np.ndarray) -> int:
+            """THE seating tie-break: least total lag among the candidate
+            mask (shared by orphan seating and spread correction)."""
+            return int(
+                np.argmin(np.where(cand, totals, np.iinfo(np.int64).max))
+            )
+
         # Seat orphans: largest lag first, least (count, total) open seat.
         orphans = np.nonzero(choice < 0)[0]
         for p in orphans[np.argsort(-lags[orphans])]:
             open_mask = counts < cap
             key = np.where(open_mask, counts, np.iinfo(np.int64).max)
-            cand = key == key.min()
-            who = int(
-                np.argmin(np.where(cand, totals, np.iinfo(np.int64).max))
-            )
+            who = least_total_of(key == key.min())
             choice[p] = who
             counts[who] += 1
             totals[who] += lags[p]
+        # Spread correction: move the heaviest-count member's smallest-lag
+        # row to the lightest member until max - min <= 1.  Bounded by
+        # O(C * initial spread) single-row moves.
+        while counts.max() - counts.min() > 1:
+            donor = int(np.argmax(counts))
+            recv = least_total_of(counts == counts.min())
+            rows = np.nonzero(choice == donor)[0]
+            p = rows[np.argmin(lags[rows])]
+            choice[p] = recv
+            counts[donor] -= 1
+            counts[recv] += 1
+            totals[donor] -= lags[p]
+            totals[recv] += lags[p]
         return choice, int((choice != original).sum())
 
     def reset(self) -> None:

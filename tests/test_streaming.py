@@ -323,3 +323,66 @@ class TestMembershipChange:
         assert s.churn == s.repaired_rows
         cnt = np.bincount(after, minlength=3)
         assert cnt.max() - cnt.min() <= 1
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_engine_random_operation_sequences(seed):
+    """Stateful fuzz: random interleavings of drift/rebalance, membership
+    remap (join/leave), reset, and shape changes must always preserve the
+    engine's core invariants — full assignment, count spread <= 1 over
+    live members, churn within documented bounds on pure-drift epochs."""
+    rng = np.random.default_rng(100 + seed)
+    C = int(rng.integers(4, 24))
+    P = int(rng.integers(200, 1200))
+    budget = int(rng.integers(8, 128))
+    engine = StreamingAssignor(
+        num_consumers=C, refine_iters=budget,
+        imbalance_guardrail=float(rng.uniform(1.2, 3.0)),
+    )
+    lags = rng.integers(0, 10**9, P).astype(np.int64)
+    prev = None
+    for _step in range(12):
+        op = rng.choice(["drift", "remap", "reset", "reshape"],
+                        p=[0.6, 0.2, 0.1, 0.1])
+        if op == "drift":
+            lags = np.maximum(
+                (lags * rng.lognormal(0, 0.15, P)).astype(np.int64), 0
+            )
+        elif op == "remap":
+            if rng.random() < 0.5 and C > 2:  # leave
+                gone = int(rng.integers(0, C))
+                mapping = np.full(C, -1, np.int32)
+                keep = [i for i in range(C) if i != gone]
+                mapping[keep] = np.arange(C - 1, dtype=np.int32)
+                engine.remap_members(mapping, C - 1)
+                C -= 1
+            else:  # join
+                engine.remap_members(
+                    np.arange(C, dtype=np.int32), C + 1
+                )
+                C += 1
+            prev = None  # churn bound doesn't apply across remap here
+        elif op == "reset":
+            engine.reset()
+            prev = None
+        else:  # reshape
+            P = int(rng.integers(200, 1200))
+            lags = rng.integers(0, 10**9, P).astype(np.int64)
+            prev = None
+
+        choice = engine.rebalance(lags)
+        s = engine.last_stats
+        assert choice.shape == (P,)
+        assert (choice >= 0).all() and (choice < C).all()
+        counts = np.bincount(choice, minlength=C)
+        assert counts.max() - counts.min() <= 1
+        assert s.count_spread <= 1
+        totals = np.zeros(C, np.int64)
+        np.add.at(totals, choice.astype(np.int64), lags)
+        mean = totals.mean()
+        if mean > 0:
+            assert abs(s.max_mean_imbalance - totals.max() / mean) < 1e-9
+        if prev is not None and not s.cold_start:
+            assert s.churn <= s.repaired_rows + 2 * budget
+            assert s.churn == int((choice != prev).sum())
+        prev = choice
