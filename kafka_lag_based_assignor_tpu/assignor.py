@@ -205,9 +205,16 @@ class LagBasedPartitionAssignor:
             summarize_topics(stats, raw, lags)
             # The decision replay assumes per-topic sequential greedy —
             # only true for the parity solvers; 'global' carries totals
-            # across topics and 'sinkhorn' has no decision sequence.
-            if self._config.solver in PARITY_SOLVERS and LOGGER.isEnabledFor(
-                TRACE
+            # across topics, 'sinkhorn' has no decision sequence, and an
+            # explicit refine budget post-edits the greedy output (the
+            # quality mode intentionally breaks replayability).
+            refined = self._config.solver in (
+                "rounds", "scan"
+            ) and bool(self._config.refine_iters)
+            if (
+                self._config.solver in PARITY_SOLVERS
+                and not refined
+                and LOGGER.isEnabledFor(TRACE)
             ):
                 trace_decisions(raw, lags, logger=LOGGER)
             log_topic_summaries(stats, raw, logger=LOGGER)
@@ -263,7 +270,14 @@ class LagBasedPartitionAssignor:
             return assign_native(lags, topic_subscriptions)
         from .ops.dispatch import assign_device
 
-        return assign_device(lags, topic_subscriptions, kernel=solver)
+        # The one-shot quality option: an EXPLICIT refine budget appends
+        # the exchange refinement to the per-topic parity kernels (None =
+        # strict reference parity; "global" rejects it at config time).
+        refine = options.get("refine_iters")
+        return assign_device(
+            lags, topic_subscriptions, kernel=solver,
+            refine_iters=None if solver == "global" else refine,
+        )
 
     def _get_metadata_consumer(self) -> MetadataConsumer:
         """Lazily create the shared metadata consumer (reference :322-324);

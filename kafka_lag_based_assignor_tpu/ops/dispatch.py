@@ -16,7 +16,7 @@ tie-break reproduces the reference's member-id string compare (:259).
 from __future__ import annotations
 
 import logging
-from typing import Dict, List, Mapping, Sequence, Tuple
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -107,7 +107,9 @@ def _rebuild_topic(
     return out
 
 
-def assign_group_device(group: TopicGroup, kernel: str = "rounds"):
+def assign_group_device(
+    group: TopicGroup, kernel: str = "rounds", refine_iters: int = 0
+):
     """Run one packed topic group through a batched kernel.
 
     Returns (choice int32[T, P_pad], counts [T, C], totals) as **device
@@ -115,9 +117,19 @@ def assign_group_device(group: TopicGroup, kernel: str = "rounds"):
     path doesn't pay device->host syncs for discarded stats.  ``totals`` is
     per-topic [T, C] for the parity kernels ("rounds"/"scan") but a single
     cross-topic [C] vector for "global" (its totals carry across topics).
+
+    ``refine_iters`` (static, 0 = strict parity; "rounds"/"scan" only)
+    chains the per-topic exchange refinement inside the SAME executable —
+    the quality mode costs no extra upload or dispatch.
     """
     ensure_x64()
     kernel_fn = _BATCHED_KERNELS[kernel]
+    if refine_iters and kernel == "global":
+        raise ValueError(
+            "refine_iters is per-topic and would undo the 'global' "
+            "kernel's cross-topic balance; use kernel='rounds' or 'scan'"
+        )
+    refine = {"refine_iters": int(refine_iters)} if refine_iters else {}
     if kernel in ("rounds", "global"):
         # Packed single-key sorts when the group's value ranges allow —
         # checked host-side on the numpy inputs (padding rows included:
@@ -142,10 +154,12 @@ def assign_group_device(group: TopicGroup, kernel: str = "rounds"):
             num_consumers=group.num_consumers,
             pack_shift=shift,
             totals_rank_bits=rb,
+            **refine,
         )
     return kernel_fn(
         group.lags, group.partition_ids, group.valid,
         num_consumers=group.num_consumers,
+        **refine,
     )
 
 
@@ -153,13 +167,29 @@ def assign_device(
     partition_lag_per_topic: Mapping[str, Sequence[TopicPartitionLag]],
     subscriptions: Mapping[str, Sequence[str]],
     kernel: str = "rounds",
+    refine_iters: Optional[int] = None,
 ) -> AssignmentMap:
     """Device-backed equivalent of the reference's static core (:166-188):
     full parity including empty members and missing-lag topics, with one
-    batched kernel launch per subscriber-set group."""
+    batched kernel launch per subscriber-set group.
+
+    ``refine_iters`` (default off, preserving strict reference parity)
+    appends that many rounds of the parallel pairwise-exchange refinement
+    (:func:`..ops.batched.refine_batched`) to each group's solve — the
+    default solver's quality mode, addressing the slack greedy leaves on
+    skewed lags (the reference's own TODO,
+    LagBasedPartitionAssignorTest.java:226).  Only the per-topic parity
+    kernels accept it: the "global" kernel optimizes CROSS-topic balance,
+    which a per-topic refinement would undo."""
     if kernel not in _BATCHED_KERNELS:
         raise ValueError(
             f"unknown kernel {kernel!r}; valid: {sorted(_BATCHED_KERNELS)}"
+        )
+    refine = int(refine_iters) if refine_iters else 0
+    if refine and kernel == "global":
+        raise ValueError(
+            "refine_iters is per-topic and would undo the 'global' "
+            "kernel's cross-topic balance; use kernel='rounds' or 'scan'"
         )
     assignment: AssignmentMap = {m: [] for m in subscriptions}
     by_topic = consumers_per_topic(subscriptions)
@@ -170,7 +200,12 @@ def assign_device(
     # awaited round-trip, overlapping when in flight together —
     # BASELINE.md) this turns G sequential round-trips into ~one.
     dispatched = [
-        (group, assign_group_device(group, kernel=kernel)[0])
+        (
+            group,
+            assign_group_device(
+                group, kernel=kernel, refine_iters=refine
+            )[0],
+        )
         for group in groups
     ]
 

@@ -166,6 +166,15 @@ class StreamingAssignor:
         """Produce choice int32[P] for the current lag vector."""
         ensure_x64()  # int64 lags would silently downcast to int32 otherwise
         lags = np.ascontiguousarray(lags, dtype=np.int64)
+        if lags.size and int(lags.min()) < 0:
+            # Non-negative lags are a documented precondition of every
+            # kernel downstream (packed sort keys, the int32 upload
+            # downcast) AND of the exact_bincount guard below — with mixed
+            # signs, cancellation can keep the f64 total small while
+            # per-consumer partial sums exceed 2^53, making the fast
+            # weighted bincount silently inexact.  The reference's lag
+            # formula clamps at 0, so a negative lag here is a caller bug.
+            raise ValueError("lags must be non-negative")
         P = lags.shape[0]
         stats = StreamingStats()
 

@@ -233,6 +233,17 @@ def _solve(
         ]
         for topic, rows in topics.items()
     }
+    # Same wire contract as _stream_assign: lags are non-negative by
+    # construction (the reference's lag formula clamps at 0), so a
+    # negative value is a client-side computation bug — reject it loudly
+    # at BOTH entry points rather than let the kernels' packed sort keys
+    # see undefined ordering.
+    for rows in lag_map.values():
+        for r in rows:
+            if r.lag < 0:
+                raise ValueError(
+                    "params.topics contains negative lag values"
+                )
     subs = {m: list(ts) for m, ts in subscriptions.items()}
     fallback_used = False
     if solver == "host":
@@ -473,6 +484,14 @@ class AssignorService:
         lags_in = np.fromiter(
             (int(lag) for _, lag in rows), np.int64, count=len(rows)
         )
+        if lags_in.size and int(lags_in.min()) < 0:
+            # Every kernel documents lags >= 0 as a precondition (the packed
+            # sort keys, the int32 downcast, and the quality stats all
+            # assume it), and the reference's lag formula clamps at 0
+            # (LagBasedPartitionAssignor.java:376-404) — so a negative lag
+            # at the wire is a client-side computation bug, rejected loudly
+            # rather than silently producing undefined ordering.
+            raise ValueError("params.lags contains negative lag values")
         order = np.argsort(pids, kind="stable")
         pids_sorted = pids[order]
         lags = lags_in[order]

@@ -30,10 +30,15 @@ FALLBACK_CONFIG = "tpu.assignor.host.fallback"  # bool: greedy host fallback
 PROFILE_CONFIG = "tpu.assignor.profile"  # bool: jax.profiler traces
 SOLVE_TIMEOUT_CONFIG = "tpu.assignor.solve.timeout.ms"  # 0/empty disables
 SINKHORN_ITERS_CONFIG = "tpu.assignor.sinkhorn.iters"  # int > 0
-# int >= 0, or unset/"auto" for the per-rounding-path budget
-# (models/sinkhorn: 24 for the sequential scan rounding, 96 for the
-# parallel rounding, which starts coarser).  An explicit integer is
-# honored exactly on every path.
+# int >= 0, or unset/"auto".  For the "sinkhorn" solver, "auto" selects
+# the per-rounding-path budget (models/sinkhorn: 24 for the sequential
+# scan rounding, 96 for the parallel rounding, which starts coarser) and
+# an explicit integer is honored exactly.  For the parity solvers
+# "rounds"/"scan", an explicit integer > 0 opts into the one-shot quality
+# mode (greedy + that many exchange-refinement rounds — NOT bit-parity
+# with the reference), while unset/"auto"/0 keeps strict parity.
+# Rejected for "global" (per-topic refinement would undo its cross-topic
+# balance); ignored by "native"/"host" (host-only paths).
 REFINE_ITERS_CONFIG = "tpu.assignor.refine.iters"
 # "P:C[:T][,P:C[:T]...]" — shapes to pre-compile at configure() time
 # (consumer startup, NOT on the rebalance critical path): each entry warms
@@ -166,6 +171,12 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
         if raw_refine in (None, "", "auto")
         else _as_int(REFINE_ITERS_CONFIG, raw_refine, 0)
     )
+    if solver == "global" and refine_iters:
+        raise ValueError(
+            f"{REFINE_ITERS_CONFIG} is per-topic and would undo the "
+            f"'global' solver's cross-topic balance; unset it or choose "
+            f"solver 'rounds'/'scan'/'sinkhorn'"
+        )
 
     raw_shapes = consumer_group_props.get(WARMUP_SHAPES_CONFIG, "")
     warmup_shapes = []

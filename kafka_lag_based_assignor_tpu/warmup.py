@@ -71,7 +71,11 @@ def warmup(
         iters=24, refine_iters=None = the per-path auto budget — the
         warm-up goes through the same public solver wrapper that resolves
         the auto rule, so default warm-up compiles exactly the executables
-        a default-config rebalance uses.
+        a default-config rebalance uses.  For the parity solvers
+        ("rounds"/"scan"), an explicit refine_iters warms the REFINED
+        executable variant (the one-shot quality mode is a different
+        static-arg compile than plain parity) — exactly what a
+        ``tpu.assignor.refine.iters`` deployment dispatches.
       stream_refine_iters: the StreamingAssignor exchange budget to warm —
         the "stream" warm-up runs a cold+warm rebalance pair so BOTH the
         cold :func:`..ops.batched.assign_stream` compile and the warm-path
@@ -176,16 +180,27 @@ def warmup(
                 shift = pack_shift_for(int(lags.max()), int(pids.max()))
                 rb = totals_rank_bits_for(lags, C)
                 rb_g = totals_rank_bits_for(lags.reshape(1, -1), C)
+                # The quality mode is a different static-arg executable:
+                # warm the variant production will actually dispatch
+                # (assignor._solve_accelerated passes the configured
+                # refine budget to assign_device for rounds/scan).  Pass
+                # the kwarg only when ON — jit cache keys include WHICH
+                # kwargs were passed (ops/dispatch does the same).
+                parity_refine = (
+                    {"refine_iters": int(refine_iters)}
+                    if refine_iters else {}
+                )
                 if "rounds" in solvers:
                     jobs.append(
                         (
                             "rounds",
                             T,
                             lambda lags=lags, pids=pids, valid=valid,
-                            shift=shift, rb=rb: (
+                            shift=shift, rb=rb, ri=parity_refine: (
                                 assign_batched_rounds(
                                     lags, pids, valid, num_consumers=C,
                                     pack_shift=shift, totals_rank_bits=rb,
+                                    **ri,
                                 )
                             ),
                         )
@@ -195,9 +210,11 @@ def warmup(
                         (
                             "scan",
                             T,
-                            lambda lags=lags, pids=pids, valid=valid: (
+                            lambda lags=lags, pids=pids, valid=valid,
+                            ri=parity_refine: (
                                 assign_batched_scan(
-                                    lags, pids, valid, num_consumers=C
+                                    lags, pids, valid, num_consumers=C,
+                                    **ri,
                                 )
                             ),
                         )

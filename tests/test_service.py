@@ -53,6 +53,17 @@ def test_assign_device_solver(service):
     assert sizes == [4, 4, 4, 4]
 
 
+def test_assign_rejects_negative_lags(service):
+    """Both wire entry points (assign + stream_assign) share the
+    non-negative-lag contract — the reference's lag formula clamps at 0,
+    so a negative value is a client computation bug."""
+    with client_for(service) as c:
+        with pytest.raises(RuntimeError, match="negative"):
+            c.assign(
+                {"t0": [[0, 100], [1, -7]]}, {"C0": ["t0"]}, solver="host"
+            )
+
+
 def test_unknown_method(service):
     with client_for(service) as c:
         with pytest.raises(RuntimeError, match="unknown method"):
@@ -390,6 +401,8 @@ class TestStreamAssign:
                 c.stream_assign("s1", "t0", [[0, 1]], [])
             with pytest.raises(RuntimeError, match="duplicate partition"):
                 c.stream_assign("s1", "t0", [[0, 1], [0, 2]], ["C0"])
+            with pytest.raises(RuntimeError, match="negative"):
+                c.stream_assign("s1", "t0", [[0, 1], [1, -2]], ["C0"])
             with pytest.raises(RuntimeError, match="non-empty"):
                 c.stream_assign("s2", "t0", [], ["C0"])
             with pytest.raises(RuntimeError, match="unknown stream option"):

@@ -101,3 +101,35 @@ def test_stream_warmup_covers_cold_refine_variant():
     eng.rebalance(lags)   # cold (refined)
     eng.rebalance(lags)   # warm
     assert refine_assignment._cache_size() == before
+
+
+def test_warmup_covers_oneshot_refined_variant():
+    """An explicit refine budget (tpu.assignor.refine.iters with the
+    default solver) warms the REFINED executable — a different static-arg
+    compile than plain parity — so the first quality-mode rebalance pays
+    no compile (VERDICT r4 / review finding)."""
+    import numpy as np
+
+    from kafka_lag_based_assignor_tpu.ops.batched import (
+        assign_batched_rounds,
+        totals_rank_bits_for,
+    )
+    from kafka_lag_based_assignor_tpu.ops.scan_kernel import pack_shift_for
+    from kafka_lag_based_assignor_tpu.warmup import warmup
+
+    warmup(
+        max_partitions=32, consumers=[2], solvers=("rounds",),
+        refine_iters=16,
+    )
+    before = assign_batched_rounds._cache_size()
+    rng = np.random.default_rng(0)
+    lags = rng.integers(0, 1000, (1, 32)).astype(np.int64)
+    pids = np.arange(32, dtype=np.int32)[None, :]
+    valid = np.ones((1, 32), dtype=bool)
+    shift = pack_shift_for(int(lags.max()), 31)
+    rb = totals_rank_bits_for(lags, 2)
+    assign_batched_rounds(
+        lags, pids, valid, num_consumers=2, pack_shift=shift,
+        totals_rank_bits=rb, refine_iters=16,
+    )
+    assert assign_batched_rounds._cache_size() == before

@@ -1,0 +1,27 @@
+#!/bin/bash
+# Poll the tunneled accelerator (killable subprocess probes, the only safe
+# way — a wedged tunnel blocks even jax.devices() forever) and run the
+# one-shot revalidation the moment it answers.  Writes a status heartbeat
+# to tools/poll_status.txt and the revalidation log to
+# tools/revalidate_r05.log.  Exits after one successful revalidation.
+set -u
+cd "$(dirname "$0")/.."
+STATUS=tools/poll_status.txt
+LOG=tools/revalidate_r05.log
+for i in $(seq 1 200); do
+  echo "$(date -u +%H:%M:%S) probe $i" >> "$STATUS"
+  if timeout 120 python - <<'EOF' > /dev/null 2>&1
+import jax, numpy as np
+x = jax.device_put(np.arange(8, dtype=np.int32))
+assert int(jax.jit(lambda v: (v + 1).sum())(x)) == 36
+EOF
+  then
+    echo "$(date -u +%H:%M:%S) DEVICE UP - revalidating" >> "$STATUS"
+    bash tools/device_revalidate.sh > "$LOG" 2>&1
+    echo "$(date -u +%H:%M:%S) revalidate done rc=$?" >> "$STATUS"
+    exit 0
+  fi
+  sleep 240
+done
+echo "$(date -u +%H:%M:%S) gave up" >> "$STATUS"
+exit 1
