@@ -272,11 +272,12 @@ class LagBasedPartitionAssignor:
 
         # The one-shot quality option: an EXPLICIT refine budget appends
         # the exchange refinement to the per-topic parity kernels (None =
-        # strict reference parity; "global" rejects it at config time).
-        refine = options.get("refine_iters")
+        # strict reference parity).  global+refine is invalid and raises
+        # in the dispatch layer; every entry point (config parse, the
+        # service wire) validates it before reaching here.
         return assign_device(
             lags, topic_subscriptions, kernel=solver,
-            refine_iters=None if solver == "global" else refine,
+            refine_iters=options.get("refine_iters"),
         )
 
     def _get_metadata_consumer(self) -> MetadataConsumer:

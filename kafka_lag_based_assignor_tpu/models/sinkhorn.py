@@ -132,14 +132,78 @@ def _require_concrete(lags, valid, caller: str) -> None:
             )
 
 
+# Cap on the deduplicated value axis fed to the duals iteration.  With
+# near-distinct lags (U ~ P — e.g. Zipf at the 100k north star) a plain
+# dedup degenerates: each of ~24 duals iterations streams a [U, C] logits
+# plan twice, and the quality mode's latency collapses (measured 17.5 s at
+# 100k x 1k on the CPU backend, BENCH_r04).  Above the cap the tail of the
+# value distribution is LOG-BUCKETED (below: exact top values + log-spaced
+# bins at <=2.8% relative width): each bin is represented by its weighted
+# MEAN value, so both marginal statistics stay exactly mass-preserving
+# (sum count, sum ws are unchanged); only the within-bin variation of the
+# plan rows is approximated — a sub-3% logits perturbation that steers the
+# mirror descent imperceptibly, and whose residual the exchange-refinement
+# pass absorbs (the rounding itself always uses EXACT per-row ws).
+_DEDUP_CAP = 4096
+# How many of the largest unique values stay exact above the cap: the top
+# of the lag distribution carries most of the load mass (Zipf), so it is
+# excluded from bucketing entirely.
+_DEDUP_EXACT_TOP = _DEDUP_CAP // 2
+
+
+def _quantize_tail(uniq: np.ndarray, counts: np.ndarray):
+    """Aggregate (uniq asc, counts) onto <= _DEDUP_CAP representative
+    values: the _DEDUP_EXACT_TOP largest stay exact; the tail maps onto
+    log-spaced bins (plus a dedicated bin for value 0), each represented
+    by its weighted mean.  Returns (vals, counts, vsums) with
+    vsums[i] == sum of (value * count) over the bin — exact, so the
+    aggregate mass the duals iteration sees is unchanged."""
+    split = len(uniq) - _DEDUP_EXACT_TOP
+    head_v, head_c = uniq[split:], counts[split:]
+    tail_v, tail_c = uniq[:split], counts[:split]
+    nbins = _DEDUP_CAP - _DEDUP_EXACT_TOP
+    pos = tail_v > 0
+    lo = float(tail_v[pos].min()) if pos.any() else 1.0
+    hi = float(tail_v.max())
+    if hi <= lo:
+        edges = np.array([lo], dtype=np.float64)
+    else:
+        # nbins-1 interior edges over [lo, hi]; ratio (hi/lo)^(1/(nbins-1))
+        # bounds each bin's relative width (<= 2.8% for a 2^53 range at
+        # the default cap).
+        edges = np.geomspace(lo, hi, num=nbins - 1)
+    # Bin 0 collects value 0 (and anything below the first edge).  All
+    # products run in f64: int64 value*count could wrap for huge lags
+    # (f64 only rounds, which the downstream f32 cast does anyway).
+    idx = np.digitize(tail_v, edges)
+    cnt_b = np.bincount(idx, weights=tail_c.astype(np.float64),
+                        minlength=nbins)
+    vsum_b = np.bincount(
+        idx,
+        weights=tail_v.astype(np.float64) * tail_c.astype(np.float64),
+        minlength=nbins,
+    )
+    nz = cnt_b > 0
+    rep_b = np.zeros_like(vsum_b)
+    rep_b[nz] = vsum_b[nz] / cnt_b[nz]
+    head_vf = head_v.astype(np.float64)
+    head_cf = head_c.astype(np.float64)
+    vals = np.concatenate([rep_b[nz], head_vf])
+    cnts = np.concatenate([cnt_b[nz], head_cf])
+    vsums = np.concatenate([vsum_b[nz], head_vf * head_cf])
+    return vals, cnts, vsums
+
+
 def _dedup_weights(lags: np.ndarray, valid: np.ndarray, C: int):
     """Host-side aggregation onto the unique-lag-value axis.
 
     Partitions with equal scaled lag have identical (noise-free) plan rows,
     so the duals iteration only needs per-unique-value weights
-    (plan_stats module docstring).  Padded to the power-of-two bucket so
-    the jit cache stays bounded as U drifts; padding rows carry
-    count=wsum=0 and contribute exactly nothing.
+    (plan_stats module docstring).  Above ``_DEDUP_CAP`` unique values the
+    tail is log-bucketed (see :func:`_quantize_tail`) so the iteration
+    cost is bounded regardless of how distinct the lags are.  Padded to
+    the power-of-two bucket so the jit cache stays bounded as U drifts;
+    padding rows carry count=wsum=0 and contribute exactly nothing.
 
     Returns (ws_u f32[U_pad], count_u f32[U_pad], wsum_u f32[U_pad]).
     """
@@ -148,14 +212,20 @@ def _dedup_weights(lags: np.ndarray, valid: np.ndarray, C: int):
     vals = lags[valid]
     scale = _scale_np(lags, valid, C)
     uniq, counts = np.unique(vals, return_counts=True)
-    U = max(len(uniq), 1)
+    if len(uniq) > _DEDUP_CAP:
+        vals_r, cnts_r, vsums_r = _quantize_tail(uniq, counts)
+    else:
+        vals_r = uniq.astype(np.float64)
+        cnts_r = counts.astype(np.float64)
+        vsums_r = vals_r * cnts_r
+    U = max(len(vals_r), 1)
     U_pad = pad_bucket(U)
     ws_u = np.zeros(U_pad, np.float32)
     count_u = np.zeros(U_pad, np.float32)
     wsum_u = np.zeros(U_pad, np.float32)
-    ws_u[: len(uniq)] = uniq / scale
-    count_u[: len(uniq)] = counts
-    wsum_u[: len(uniq)] = uniq * counts / scale
+    ws_u[: len(vals_r)] = vals_r / scale
+    count_u[: len(vals_r)] = cnts_r
+    wsum_u[: len(vals_r)] = vsums_r / scale
     return ws_u, count_u, wsum_u
 
 

@@ -185,12 +185,9 @@ def assign_device(
         raise ValueError(
             f"unknown kernel {kernel!r}; valid: {sorted(_BATCHED_KERNELS)}"
         )
+    # global+refine is rejected by assign_group_device on the first group
+    # (the one place the rule lives).
     refine = int(refine_iters) if refine_iters else 0
-    if refine and kernel == "global":
-        raise ValueError(
-            "refine_iters is per-topic and would undo the 'global' "
-            "kernel's cross-topic balance; use kernel='rounds' or 'scan'"
-        )
     assignment: AssignmentMap = {m: [] for m in subscriptions}
     by_topic = consumers_per_topic(subscriptions)
     groups = build_groups(partition_lag_per_topic, by_topic)

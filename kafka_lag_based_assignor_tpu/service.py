@@ -227,23 +227,29 @@ def _solve(
     topics, subscriptions, solver, watchdog=None, host_fallback=True,
     options=None,
 ):
-    lag_map = {
-        topic: [
-            TopicPartitionLag(topic, int(pid), int(lag)) for pid, lag in rows
-        ]
-        for topic, rows in topics.items()
-    }
     # Same wire contract as _stream_assign: lags are non-negative by
     # construction (the reference's lag formula clamps at 0), so a
-    # negative value is a client-side computation bug — reject it loudly
-    # at BOTH entry points rather than let the kernels' packed sort keys
-    # see undefined ordering.
-    for rows in lag_map.values():
-        for r in rows:
-            if r.lag < 0:
-                raise ValueError(
-                    "params.topics contains negative lag values"
-                )
+    # negative value is a client-side computation bug — rejected loudly
+    # at BOTH entry points, in the same single pass that builds the rows.
+    def _row(topic, pid, lag):
+        lag = int(lag)
+        if lag < 0:
+            raise ValueError("params.topics contains negative lag values")
+        return TopicPartitionLag(topic, int(pid), lag)
+
+    lag_map = {
+        topic: [_row(topic, pid, lag) for pid, lag in rows]
+        for topic, rows in topics.items()
+    }
+    if solver == "global" and (options or {}).get("refine_iters"):
+        # Reject at the wire boundary (client error), BEFORE the solver
+        # try/except whose fallback would silently return an unrefined
+        # assignment while echoing the option back as applied — the same
+        # loud rule as config parse and the dispatch layer.
+        raise ValueError(
+            "options.refine_iters is per-topic and not valid with "
+            "solver 'global'"
+        )
     subs = {m: list(ts) for m, ts in subscriptions.items()}
     fallback_used = False
     if solver == "host":

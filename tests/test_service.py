@@ -64,6 +64,21 @@ def test_assign_rejects_negative_lags(service):
             )
 
 
+def test_assign_global_rejects_refine_option(service):
+    """global+refine must be a loud CLIENT error at the wire boundary —
+    not a silent drop with the option echoed back as applied, and not a
+    host fallback (the same rule as config parse and the dispatch
+    layer)."""
+    with client_for(service) as c:
+        with pytest.raises(RuntimeError, match="refine_iters"):
+            c.request("assign", {
+                "topics": {"t0": [[0, 100]]},
+                "subscriptions": {"C0": ["t0"]},
+                "solver": "global",
+                "options": {"refine_iters": 8},
+            })
+
+
 def test_unknown_method(service):
     with client_for(service) as c:
         with pytest.raises(RuntimeError, match="unknown method"):

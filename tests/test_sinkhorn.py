@@ -226,3 +226,83 @@ def test_duals_converge_on_heavy_skew():
     assert spread < 1e-4, f"duals load spread {spread:.2e}: undertrained"
     col_spread = (colsum.max() - colsum.min()) / colsum.mean()
     assert col_spread < 1e-2, f"count marginal spread {col_spread:.2e}"
+
+
+class TestDedupCap:
+    """The duals iteration's value axis is capped (_DEDUP_CAP): above it
+    the tail is log-bucketed with exact mass preservation, so the quality
+    mode's cost is bounded even with fully distinct lags (U ~ P collapsed
+    the mode at the 100k north star, VERDICT r4 item 3)."""
+
+    def test_quantize_tail_mass_preserving_and_bounded(self):
+        from kafka_lag_based_assignor_tpu.models.sinkhorn import (
+            _DEDUP_CAP,
+            _DEDUP_EXACT_TOP,
+            _quantize_tail,
+        )
+
+        rng = np.random.default_rng(0)
+        # Distinct values spanning 6 decades, skewed counts.
+        uniq = np.unique(
+            rng.integers(0, 10**6, size=3 * _DEDUP_CAP).astype(np.int64)
+        )
+        counts = rng.integers(1, 5, size=uniq.size).astype(np.int64)
+        vals, cnts, vsums = _quantize_tail(uniq, counts)
+        assert len(vals) <= _DEDUP_CAP
+        # Exact mass preservation (f64): total count and total value*count.
+        assert cnts.sum() == counts.sum()
+        np.testing.assert_allclose(
+            vsums.sum(), (uniq.astype(np.float64) * counts).sum(),
+            rtol=1e-12,
+        )
+        # Representatives are per-bin weighted means: vsums == vals*cnts.
+        np.testing.assert_allclose(vsums, vals * cnts, rtol=1e-12)
+        # The largest _DEDUP_EXACT_TOP uniques survive exactly.
+        np.testing.assert_array_equal(
+            vals[-_DEDUP_EXACT_TOP:], uniq[-_DEDUP_EXACT_TOP:]
+        )
+        # Monotone non-decreasing (sorted axis preserved).
+        assert (np.diff(vals) >= 0).all()
+
+    def test_dedup_weights_capped_shape(self):
+        from kafka_lag_based_assignor_tpu.models.sinkhorn import (
+            _DEDUP_CAP,
+            _dedup_weights,
+        )
+        from kafka_lag_based_assignor_tpu.ops.packing import pad_bucket
+
+        P = 3 * _DEDUP_CAP
+        lags = np.arange(P, dtype=np.int64) * 7 + 1  # all distinct
+        valid = np.ones(P, dtype=bool)
+        ws_u, count_u, wsum_u = _dedup_weights(lags, valid, 16)
+        assert ws_u.shape[0] <= pad_bucket(_DEDUP_CAP)
+        assert float(count_u.sum()) == P
+        # ws mass preserved: sum ws over rows == sum wsum_u (f32 tolerance).
+        scale = max(float(lags.sum()), 1.0) / 16
+        np.testing.assert_allclose(
+            wsum_u.sum(), (lags / scale).sum(), rtol=1e-5
+        )
+
+    def test_over_cap_instance_quality_not_worse_than_greedy(self):
+        from kafka_lag_based_assignor_tpu.models.sinkhorn import (
+            assign_topic_sinkhorn,
+        )
+        from kafka_lag_based_assignor_tpu.ops.batched import assign_stream
+        from kafka_lag_based_assignor_tpu.ops.packing import pad_topic_rows
+
+        rng = np.random.default_rng(3)
+        P, C = 6000, 16  # > _DEDUP_CAP unique values
+        lags = np.unique(
+            rng.integers(1, 10**7, size=2 * P).astype(np.int64)
+        )[:P]
+        rng.shuffle(lags)
+        lags_p, pids_p, valid_p = pad_topic_rows(lags)
+        _, _, s_tot = assign_topic_sinkhorn(
+            lags_p, pids_p, valid_p, num_consumers=C, iters=8,
+            refine_iters=16,
+        )
+        g = np.asarray(assign_stream(lags, num_consumers=C))
+        g_tot = np.zeros(C, np.int64)
+        np.add.at(g_tot, g.astype(np.int64), lags)
+        # Portfolio guarantee survives quantization.
+        assert int(np.asarray(s_tot).max()) <= int(g_tot.max())
