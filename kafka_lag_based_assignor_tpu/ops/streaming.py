@@ -307,8 +307,23 @@ class StreamingAssignor:
     ) -> np.ndarray:
         """Split the exchange budget into rounds x pairs (rounds * pairs <=
         refine_iters keeps the documented churn bound 2 * refine_iters)
-        and dispatch one bounded refine."""
-        pairs = max(1, min(self.num_consumers // 2, self.refine_iters))
+        and dispatch one bounded refine.
+
+        The split is BALANCED (pairs ~ rounds ~ sqrt(budget)) rather than
+        maximally wide: a single stubborn peak consumer sheds at most ONE
+        partition per round (pairs are disjoint — it sits in one pair),
+        so a wide-shallow split stalls on concentrated drift (measured on
+        the drained-hot-partition scenario: q 1.17 wide vs 1.07 balanced
+        at the same budget/churn), while a deep split still fixes broad
+        drift because each round repairs `pairs` consumers at once.  The
+        extra sequential rounds ride inside one executable, so the wall
+        cost on the target transport stays RTT-dominated."""
+        import math
+
+        pairs = max(
+            1,
+            min(self.num_consumers // 2, math.isqrt(self.refine_iters)),
+        )
         rounds = max(1, self.refine_iters // pairs)
         return self._warm_refine(lags, choice, rounds, pairs)
 
