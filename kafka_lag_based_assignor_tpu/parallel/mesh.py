@@ -57,9 +57,17 @@ def make_mesh(
     return Mesh(dev_array, axis_names=("topics", "members"))
 
 
-def _sharded_step(lags, partition_ids, valid, *, num_consumers: int, members_axis: int):
+def _sharded_step(
+    lags, partition_ids, valid, *, num_consumers: int, members_axis: int,
+    refine_iters: int = 0,
+):
     """Per-shard body under shard_map: local topic block [T_loc, P] solved
     with the vmapped rounds kernel, then cross-shard psum for global stats.
+
+    ``refine_iters`` chains the per-topic exchange refinement onto each
+    local topic — refinement is per-topic like the solve itself, so it
+    shards over the "topics" axis with ZERO additional communication (the
+    stats psum below already reflects the refined totals).
 
     The member-axis devices each reduce only their C/members_axis slice of
     the [T_loc, C] totals before the psum over "topics" — so the global
@@ -67,6 +75,14 @@ def _sharded_step(lags, partition_ids, valid, *, num_consumers: int, members_axi
     materializes all members' accumulators)."""
     fn = functools.partial(assign_topic_rounds, num_consumers=num_consumers)
     choice, counts, totals = jax.vmap(fn)(lags, partition_ids, valid)
+    if refine_iters:
+        from ..ops.refine import refine_assignment
+
+        rfn = functools.partial(
+            refine_assignment, num_consumers=num_consumers,
+            iters=refine_iters,
+        )
+        choice, counts, totals = jax.vmap(rfn)(lags, valid, choice)
     c_local = num_consumers // members_axis
     offset = jax.lax.axis_index("members") * c_local
     local_load = jax.lax.dynamic_slice_in_dim(
@@ -86,11 +102,15 @@ def assign_sharded(
     partition_ids,
     valid,
     num_consumers: int,
+    refine_iters: int = 0,
 ):
     """Solve a topic batch sharded over ``mesh``.
 
     Args: arrays of shape [T, P] with T divisible by the mesh's "topics"
     axis size and ``num_consumers`` divisible by its "members" axis size.
+    ``refine_iters`` (static, 0 = strict parity) chains the per-topic
+    exchange refinement onto each shard-local topic — no additional
+    cross-device communication (see :func:`_sharded_step`).
     Returns (choice [T, P], counts [T, C], totals [T, C], member_load [C],
     member_count [C]) — the per-member global stats are computed and stored
     member-sharded.
@@ -104,20 +124,26 @@ def assign_sharded(
             f"num_consumers={num_consumers} not divisible by members axis "
             f"{members_axis}"
         )
-    step = _jitted_sharded_step(mesh, num_consumers, members_axis)
+    step = _jitted_sharded_step(
+        mesh, num_consumers, members_axis, int(refine_iters)
+    )
     return step(lags, partition_ids, valid)
 
 
 @functools.lru_cache(maxsize=64)
-def _jitted_sharded_step(mesh: Mesh, num_consumers: int, members_axis: int):
-    """Build + jit the shard_map step once per (mesh, C, members-axis) —
-    jax.jit caches per function object, so constructing a fresh wrapper on
-    every call would retrace and recompile each rebalance."""
+def _jitted_sharded_step(
+    mesh: Mesh, num_consumers: int, members_axis: int, refine_iters: int = 0
+):
+    """Build + jit the shard_map step once per (mesh, C, members-axis,
+    refine budget) — jax.jit caches per function object, so constructing a
+    fresh wrapper on every call would retrace and recompile each
+    rebalance."""
     step = jax.shard_map(
         functools.partial(
             _sharded_step,
             num_consumers=num_consumers,
             members_axis=members_axis,
+            refine_iters=refine_iters,
         ),
         mesh=mesh,
         in_specs=(P("topics", None), P("topics", None), P("topics", None)),
@@ -135,6 +161,41 @@ def _jitted_sharded_step(mesh: Mesh, num_consumers: int, members_axis: int):
         check_vma=False,
     )
     return jax.jit(step)
+
+
+def assign_global_replicated(mesh: Mesh, lags, partition_ids, valid,
+                             num_consumers: int):
+    """The cross-topic GLOBAL quality mode on a mesh: an explicit, tested
+    REPLICATION decision rather than a sharding.
+
+    The global kernel carries member totals across topics sequentially
+    (topic t+1's seating depends on totals after topic t —
+    ops/rounds_kernel.assign_global_rounds), so the topic axis cannot be
+    data-parallel without changing semantics; and C-axis sharding would
+    put the per-round C-sized sort/argmin under collectives for no win at
+    realistic C.  Replicating the solve on every device is the honest
+    mapping: each device computes the identical assignment (deterministic
+    kernel — bit-identical replicas), so downstream topic-sharded
+    consumers (e.g. the refine pass or stats) can read their slice with
+    no broadcast step.
+
+    Returns (choice [T, P], counts [T, C], totals [C]) fully replicated.
+    """
+    from ..ops.rounds_kernel import assign_global_rounds
+
+    rep = NamedSharding(mesh, P())
+    fn = jax.jit(
+        functools.partial(
+            assign_global_rounds, num_consumers=num_consumers
+        ),
+        in_shardings=(rep, rep, rep),
+        out_shardings=(rep, rep, rep),
+    )
+    return fn(
+        jax.device_put(lags, rep),
+        jax.device_put(partition_ids, rep),
+        jax.device_put(valid, rep),
+    )
 
 
 def shard_topic_batch(mesh: Mesh, lags, partition_ids, valid):

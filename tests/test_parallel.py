@@ -25,7 +25,9 @@ def test_eight_devices_available():
     assert len(jax.devices()) == 8
 
 
-@pytest.mark.parametrize("topics_axis,members_axis", [(8, 1), (4, 2), (2, 4)])
+@pytest.mark.parametrize(
+    "topics_axis,members_axis", [(8, 1), (4, 2), (2, 4), (1, 8)]
+)
 def test_sharded_matches_single_device(topics_axis, members_axis):
     """Sharded result must be bit-identical to the unsharded batched kernel
     (determinism requirement, SURVEY §5 race-detection row)."""
@@ -139,3 +141,56 @@ def test_determinism_across_runs():
         choice, *_ = assign_sharded(mesh, lags, pids, valid, num_consumers=C)
         outs.append(np.asarray(choice))
     assert all((o == outs[0]).all() for o in outs)
+
+
+def test_sharded_refine_matches_unsharded():
+    """The exchange refinement chained into the sharded step is per-topic
+    (no cross-device communication), so it must be bit-identical to the
+    unsharded refined batch — and the psum'd member stats must reflect the
+    REFINED totals, not the pre-refine ones."""
+    T, P, C = 16, 64, 8
+    lags, pids, valid = make_batch(T, P, C)
+    mesh = make_mesh(jax.devices(), topics_axis=4, members_axis=2)
+    s_lags, s_pids, s_valid = shard_topic_batch(mesh, lags, pids, valid)
+    choice, counts, totals, member_load, member_count = assign_sharded(
+        mesh, s_lags, s_pids, s_valid, num_consumers=C, refine_iters=8
+    )
+    ref_choice, ref_counts, ref_totals = assign_batched_rounds(
+        lags, pids, valid, num_consumers=C, refine_iters=8
+    )
+    np.testing.assert_array_equal(np.asarray(choice), np.asarray(ref_choice))
+    np.testing.assert_array_equal(np.asarray(totals), np.asarray(ref_totals))
+    np.testing.assert_array_equal(
+        np.asarray(member_load), np.asarray(ref_totals).sum(axis=0)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(member_count), np.asarray(ref_counts).sum(axis=0)
+    )
+
+
+def test_global_replicated_matches_single_device():
+    """The cross-topic global mode's mesh story is an explicit REPLICATION
+    decision (its totals carry across topics sequentially, so the topic
+    axis cannot be data-parallel): every replica must be bit-identical to
+    the single-device kernel."""
+    from kafka_lag_based_assignor_tpu.ops.rounds_kernel import (
+        assign_global_rounds,
+    )
+    from kafka_lag_based_assignor_tpu.parallel.mesh import (
+        assign_global_replicated,
+    )
+
+    T, P, C = 8, 64, 8
+    lags, pids, valid = make_batch(T, P, C)
+    mesh = make_mesh(jax.devices(), topics_axis=4, members_axis=2)
+    choice, counts, totals = assign_global_replicated(
+        mesh, lags, pids, valid, num_consumers=C
+    )
+    ref_choice, ref_counts, ref_totals = assign_global_rounds(
+        lags, pids, valid, num_consumers=C
+    )
+    np.testing.assert_array_equal(np.asarray(choice), np.asarray(ref_choice))
+    np.testing.assert_array_equal(np.asarray(counts), np.asarray(ref_counts))
+    np.testing.assert_array_equal(np.asarray(totals), np.asarray(ref_totals))
+    # Truly replicated: every device holds the full result.
+    assert choice.sharding.is_fully_replicated
