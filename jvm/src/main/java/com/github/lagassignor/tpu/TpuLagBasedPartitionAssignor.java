@@ -264,8 +264,21 @@ public class TpuLagBasedPartitionAssignor
     private Map<String, List<TopicPartition>> sidecarAssign(
             Map<String, List<long[]>> topicLags,
             Map<String, List<String>> memberTopics) throws IOException {
+        String request = buildAssignRequest(
+                ++requestId, topicLags, memberTopics, solver);
+        return parseAssignResponse(roundTrip(request));
+    }
+
+    /**
+     * Marshal one {@code assign} request line (byte shape pinned by the
+     * {@code assign_*} entries of tests/fixtures/wire_conformance.jsonl).
+     * Static and socket-free so the Java tests can assert the exact bytes.
+     */
+    static String buildAssignRequest(long id,
+            Map<String, List<long[]>> topicLags,
+            Map<String, List<String>> memberTopics, String solver) {
         StringBuilder sb = new StringBuilder(1 << 16);
-        sb.append("{\"id\": ").append(++requestId)
+        sb.append("{\"id\": ").append(id)
           .append(", \"method\": \"assign\", \"params\": {\"topics\": {");
         boolean firstTopic = true;
         for (Map.Entry<String, List<long[]>> e : topicLags.entrySet()) {
@@ -297,17 +310,28 @@ public class TpuLagBasedPartitionAssignor
         sb.append("}, \"solver\": ");
         Json.writeString(sb, solver);
         sb.append("}}");
+        return sb.toString();
+    }
 
-        String responseLine = roundTrip(sb.toString());
-        Object parsed = Json.parse(responseLine);
-        Map<?, ?> response = (Map<?, ?>) parsed;
+    /** Unmarshal one {@code assign} response line. */
+    static Map<String, List<TopicPartition>> parseAssignResponse(
+            String responseLine) throws IOException {
+        Map<?, ?> response = (Map<?, ?>) Json.parse(responseLine);
+        raiseOnError(response);
+        Map<?, ?> result = (Map<?, ?>) response.get("result");
+        return parseAssignmentsMap((Map<?, ?>) result.get("assignments"));
+    }
+
+    private static void raiseOnError(Map<?, ?> response) throws IOException {
         Object error = response.get("error");
         if (error != null) {
             throw new IOException("sidecar error: "
                     + ((Map<?, ?>) error).get("message"));
         }
-        Map<?, ?> result = (Map<?, ?>) response.get("result");
-        Map<?, ?> assignments = (Map<?, ?>) result.get("assignments");
+    }
+
+    private static Map<String, List<TopicPartition>> parseAssignmentsMap(
+            Map<?, ?> assignments) {
         Map<String, List<TopicPartition>> out = new HashMap<>();
         for (Map.Entry<?, ?> e : assignments.entrySet()) {
             List<TopicPartition> tps = new ArrayList<>();
@@ -319,6 +343,133 @@ public class TpuLagBasedPartitionAssignor
             out.put((String) e.getKey(), tps);
         }
         return out;
+    }
+
+    // ------------------------------------------------------------------
+    // Streaming client (sidecar stream_assign / stream_reset; wire shapes
+    // pinned by the stream_assign_* fixtures).  Groups that rebalance one
+    // topic on a timer call streamAssign each epoch: the sidecar keeps the
+    // previous assignment warm per stream_id, makes still-balanced epochs
+    // no-ops, bounds churn via the exchange refinement, and carries state
+    // across member joins/leaves by NAME.  NOTE: streaming responses list
+    // partitions in ascending partition-id order, not processing order.
+    // ------------------------------------------------------------------
+
+    /** One streaming epoch's result: the assignment plus the engine's
+     *  per-epoch stats (sidecar service.py, stream_assign response). */
+    public static final class StreamResult {
+        public final Map<String, List<TopicPartition>> assignments;
+        public final boolean coldStart;
+        public final boolean refined;
+        public final boolean guardrailTripped;
+        public final long churn;
+
+        StreamResult(Map<String, List<TopicPartition>> assignments,
+                boolean coldStart, boolean refined,
+                boolean guardrailTripped, long churn) {
+            this.assignments = assignments;
+            this.coldStart = coldStart;
+            this.refined = refined;
+            this.guardrailTripped = guardrailTripped;
+            this.churn = churn;
+        }
+    }
+
+    /**
+     * One streaming rebalance epoch for {@code streamId}.
+     *
+     * @param lags    {@code [partition, lag]} rows (lags must be >= 0; the
+     *                sidecar rejects negative values).
+     * @param members group member ids; a changed set triggers the
+     *                sidecar's by-name warm-state remap.
+     * @param options optional per-epoch knobs ({@code refine_iters},
+     *                {@code guardrail}, {@code refine_threshold}); null or
+     *                empty sends none.  The sidecar may quantize values
+     *                and echoes the effective ones.
+     */
+    public StreamResult streamAssign(String streamId, String topic,
+            List<long[]> lags, List<String> members,
+            Map<String, Object> options) throws IOException {
+        String request = buildStreamAssignRequest(
+                ++requestId, streamId, topic, lags, members, options);
+        return parseStreamAssignResponse(roundTrip(request));
+    }
+
+    /** Drop a stream's warm state; returns whether it existed. */
+    public boolean streamReset(String streamId) throws IOException {
+        String line = roundTrip(buildStreamResetRequest(
+                ++requestId, streamId));
+        Map<?, ?> response = (Map<?, ?>) Json.parse(line);
+        raiseOnError(response);
+        Map<?, ?> result = (Map<?, ?>) response.get("result");
+        return Boolean.TRUE.equals(result.get("dropped"));
+    }
+
+    static String buildStreamAssignRequest(long id, String streamId,
+            String topic, List<long[]> lags, List<String> members,
+            Map<String, Object> options) {
+        StringBuilder sb = new StringBuilder(1 << 12);
+        sb.append("{\"id\": ").append(id)
+          .append(", \"method\": \"stream_assign\", ")
+          .append("\"params\": {\"stream_id\": ");
+        Json.writeString(sb, streamId);
+        sb.append(", \"topic\": ");
+        Json.writeString(sb, topic);
+        sb.append(", \"lags\": [");
+        for (int i = 0; i < lags.size(); i++) {
+            long[] row = lags.get(i);
+            if (i > 0) sb.append(", ");
+            sb.append('[').append(row[0]).append(", ").append(row[1])
+              .append(']');
+        }
+        sb.append("], \"members\": [");
+        for (int i = 0; i < members.size(); i++) {
+            if (i > 0) sb.append(", ");
+            Json.writeString(sb, members.get(i));
+        }
+        sb.append(']');
+        if (options != null && !options.isEmpty()) {
+            // TreeMap: deterministic key order, like every other map the
+            // shim marshals.
+            sb.append(", \"options\": {");
+            boolean first = true;
+            for (Map.Entry<String, Object> e
+                    : new TreeMap<>(options).entrySet()) {
+                if (!first) sb.append(", ");
+                first = false;
+                Json.writeString(sb, e.getKey());
+                sb.append(": ");
+                Json.writeValue(sb, e.getValue());
+            }
+            sb.append('}');
+        }
+        sb.append("}}");
+        return sb.toString();
+    }
+
+    static String buildStreamResetRequest(long id, String streamId) {
+        StringBuilder sb = new StringBuilder(128);
+        sb.append("{\"id\": ").append(id)
+          .append(", \"method\": \"stream_reset\", ")
+          .append("\"params\": {\"stream_id\": ");
+        Json.writeString(sb, streamId);
+        sb.append("}}");
+        return sb.toString();
+    }
+
+    static StreamResult parseStreamAssignResponse(String responseLine)
+            throws IOException {
+        Map<?, ?> response = (Map<?, ?>) Json.parse(responseLine);
+        raiseOnError(response);
+        Map<?, ?> result = (Map<?, ?>) response.get("result");
+        Map<String, List<TopicPartition>> out = parseAssignmentsMap(
+                (Map<?, ?>) result.get("assignments"));
+        Map<?, ?> stream = (Map<?, ?>) result.get("stream");
+        return new StreamResult(out,
+                Boolean.TRUE.equals(stream.get("cold_start")),
+                Boolean.TRUE.equals(stream.get("refined")),
+                Boolean.TRUE.equals(stream.get("guardrail_tripped")),
+                ((Number) stream.get("churn")).longValue());
     }
 
     private String roundTrip(String requestLine) throws IOException {
@@ -433,6 +584,26 @@ public class TpuLagBasedPartitionAssignor
                 }
             }
             sb.append('"');
+        }
+
+        /** Write a protocol value: null, String, Boolean, integral or
+         *  floating Number — exactly the option-value set the sidecar
+         *  accepts. */
+        static void writeValue(StringBuilder sb, Object value) {
+            if (value == null) {
+                sb.append("null");
+            } else if (value instanceof String) {
+                writeString(sb, (String) value);
+            } else if (value instanceof Boolean) {
+                sb.append(value);
+            } else if (value instanceof Double || value instanceof Float) {
+                sb.append(((Number) value).doubleValue());
+            } else if (value instanceof Number) {
+                sb.append(((Number) value).longValue());
+            } else {
+                throw new IllegalArgumentException(
+                        "unsupported JSON value type: " + value.getClass());
+            }
         }
 
         static Object parse(String text) {

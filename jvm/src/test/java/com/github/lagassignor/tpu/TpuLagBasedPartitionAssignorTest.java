@@ -1,0 +1,435 @@
+package com.github.lagassignor.tpu;
+
+import static org.junit.Assert.assertEquals;
+import static org.junit.Assert.assertFalse;
+import static org.junit.Assert.assertNull;
+import static org.junit.Assert.assertTrue;
+
+import java.io.BufferedReader;
+import java.io.BufferedWriter;
+import java.io.InputStreamReader;
+import java.io.OutputStreamWriter;
+import java.net.ServerSocket;
+import java.net.Socket;
+import java.nio.charset.StandardCharsets;
+import java.util.ArrayList;
+import java.util.Arrays;
+import java.util.Collections;
+import java.util.HashMap;
+import java.util.List;
+import java.util.Map;
+import java.util.Optional;
+import java.util.TreeMap;
+
+import org.apache.kafka.common.TopicPartition;
+import org.junit.Test;
+
+/**
+ * Java-side tests for the shim, mirroring the coverage of the reference's
+ * test class (reference LagBasedPartitionAssignorTest.java:21-228: the four
+ * lag-formula cases, the golden two-topic assignment, the zero-lag and
+ * heavily-skewed count-invariant cases) against THIS shim's API — plus the
+ * JSON codec and the wire byte shapes, which are pinned cross-language by
+ * tests/fixtures/wire_conformance.jsonl in the parent repo (the Python
+ * service replays the same fixtures in tests/test_service.py).
+ */
+public class TpuLagBasedPartitionAssignorTest {
+
+    // ------------------------------------------------------------------
+    // computePartitionLag — the exact reference formula (:376-404).
+    // ------------------------------------------------------------------
+
+    @Test
+    public void computesLagFromCommittedOffset() {
+        // reference testComputePartitionLag: committed 5555, end 9999.
+        assertEquals(4444L, TpuLagBasedPartitionAssignor.computePartitionLag(
+                Optional.of(5555L), 1111, 9999, "none"));
+    }
+
+    @Test
+    public void clampsNegativeLagWhenEndOffsetLookupFailed() {
+        // reference testComputePartitionLagNoEndOffset: begin=end=0 with a
+        // committed offset would give a negative difference; clamp to 0.
+        assertEquals(0L, TpuLagBasedPartitionAssignor.computePartitionLag(
+                Optional.of(5555L), 0, 0, "none"));
+    }
+
+    @Test
+    public void noCommittedOffsetLatestMeansZeroLag() {
+        // reference testComputePartitionLagNoCommittedOffsetResetModeLatest.
+        assertEquals(0L, TpuLagBasedPartitionAssignor.computePartitionLag(
+                Optional.empty(), 1111, 9999, "latest"));
+    }
+
+    @Test
+    public void noCommittedOffsetEarliestMeansFullBacklog() {
+        // reference ...ResetModeEarliest: lag = end - begin.
+        assertEquals(8888L, TpuLagBasedPartitionAssignor.computePartitionLag(
+                Optional.empty(), 1111, 9999, "earliest"));
+    }
+
+    @Test
+    public void committedOffsetBeatsResetMode() {
+        // The reset mode only matters when no offset is committed.
+        assertEquals(100L, TpuLagBasedPartitionAssignor.computePartitionLag(
+                Optional.of(900L), 0, 1000, "latest"));
+    }
+
+    // ------------------------------------------------------------------
+    // localGreedyAssign — the sidecar-down fallback; identical semantics
+    // to the reference's static assign core (:166-308).
+    // ------------------------------------------------------------------
+
+    private static Map<String, List<long[]>> goldenTopicLags() {
+        Map<String, List<long[]>> topicLags = new TreeMap<>();
+        topicLags.put("topic1", Arrays.asList(
+                new long[] {0, 100000}, new long[] {1, 100000},
+                new long[] {2, 500}, new long[] {3, 1}));
+        topicLags.put("topic2", Arrays.asList(
+                new long[] {0, 900000}, new long[] {1, 100000}));
+        return topicLags;
+    }
+
+    @Test
+    public void goldenTwoTopicAssignment() {
+        // reference testAssign: consumer-1 subscribes both topics,
+        // consumer-2 only topic1; expected map including processing order.
+        Map<String, List<String>> subs = new TreeMap<>();
+        subs.put("consumer-1", Arrays.asList("topic1", "topic2"));
+        subs.put("consumer-2", Collections.singletonList("topic1"));
+
+        Map<String, List<TopicPartition>> expected = new HashMap<>();
+        expected.put("consumer-1", Arrays.asList(
+                new TopicPartition("topic1", 0),
+                new TopicPartition("topic1", 2),
+                new TopicPartition("topic2", 0),
+                new TopicPartition("topic2", 1)));
+        expected.put("consumer-2", Arrays.asList(
+                new TopicPartition("topic1", 1),
+                new TopicPartition("topic1", 3)));
+
+        assertEquals(expected, TpuLagBasedPartitionAssignor
+                .localGreedyAssign(goldenTopicLags(), subs));
+    }
+
+    private static int spread(Map<String, List<TopicPartition>> assignment) {
+        int max = Integer.MIN_VALUE;
+        int min = Integer.MAX_VALUE;
+        for (List<TopicPartition> tps : assignment.values()) {
+            max = Math.max(max, tps.size());
+            min = Math.min(min, tps.size());
+        }
+        return max - min;
+    }
+
+    @Test
+    public void zeroLagsDistributeEvenly() {
+        // reference testAssignWithZeroLags: 7 partitions, 2 consumers.
+        Map<String, List<long[]>> topicLags = new TreeMap<>();
+        List<long[]> rows = new ArrayList<>();
+        for (int p = 0; p < 7; p++) {
+            rows.add(new long[] {p, 0});
+        }
+        topicLags.put("topic1", rows);
+        Map<String, List<String>> subs = new TreeMap<>();
+        subs.put("consumer-1", Collections.singletonList("topic1"));
+        subs.put("consumer-2", Collections.singletonList("topic1"));
+
+        assertTrue("count spread must be <= 1", spread(
+                TpuLagBasedPartitionAssignor.localGreedyAssign(
+                        topicLags, subs)) <= 1);
+    }
+
+    @Test
+    public void heavilySkewedLagsKeepCountInvariant() {
+        // reference testAssignWithHeavilySkewedLags: 10 partitions (not
+        // divisible by 3 consumers), two of them hot.
+        long[] lags = {360, 359, 230, 118, 444, 122, 65, 111, 455000, 424000};
+        Map<String, List<long[]>> topicLags = new TreeMap<>();
+        List<long[]> rows = new ArrayList<>();
+        for (int p = 0; p < lags.length; p++) {
+            rows.add(new long[] {p, lags[p]});
+        }
+        topicLags.put("topic1", rows);
+        Map<String, List<String>> subs = new TreeMap<>();
+        for (int c = 1; c <= 3; c++) {
+            subs.put("consumer-" + c,
+                    Collections.singletonList("topic1"));
+        }
+
+        Map<String, List<TopicPartition>> assignment =
+                TpuLagBasedPartitionAssignor.localGreedyAssign(
+                        topicLags, subs);
+        assertTrue("count spread must be <= 1", spread(assignment) <= 1);
+
+        // The reference's own TODO (its test file, line 226), resolved
+        // here: the consumer carrying the most lag must hold the FEWEST
+        // partitions — count-primary greedy steers the extra partition
+        // (10 = 3*3+1) away from the hot consumers.
+        String hottest = null;
+        long hottestLag = -1;
+        int minCount = Integer.MAX_VALUE;
+        for (Map.Entry<String, List<TopicPartition>> e
+                : assignment.entrySet()) {
+            long total = 0;
+            for (TopicPartition tp : e.getValue()) {
+                total += lags[tp.partition()];
+            }
+            if (total > hottestLag) {
+                hottestLag = total;
+                hottest = e.getKey();
+            }
+            minCount = Math.min(minCount, e.getValue().size());
+        }
+        assertEquals("hottest consumer must hold the fewest partitions",
+                minCount, assignment.get(hottest).size());
+    }
+
+    @Test
+    public void emptyTopicsYieldEmptyLists() {
+        // Members with no solvable topics still appear with empty lists
+        // (reference :171-174 — every member gets an Assignment).
+        Map<String, List<String>> subs = new TreeMap<>();
+        subs.put("consumer-1", Collections.singletonList("missing"));
+        Map<String, List<TopicPartition>> assignment =
+                TpuLagBasedPartitionAssignor.localGreedyAssign(
+                        new TreeMap<>(), subs);
+        assertTrue(assignment.get("consumer-1").isEmpty());
+    }
+
+    // ------------------------------------------------------------------
+    // JSON codec — the dependency-free parser/writer the wire relies on.
+    // ------------------------------------------------------------------
+
+    @Test
+    @SuppressWarnings("unchecked")
+    public void jsonParsesProtocolResponseShapes() {
+        Map<String, Object> parsed = (Map<String, Object>)
+                TpuLagBasedPartitionAssignor.Json.parse(
+                        "{\"id\": 3, \"result\": {\"assignments\": "
+                        + "{\"C0\": [[\"t0\", 0]]}, \"stats\": "
+                        + "{\"wall_ms\": 1.5, \"fallback\": false, "
+                        + "\"note\": null}}}");
+        assertEquals(3L, parsed.get("id"));
+        Map<String, Object> result =
+                (Map<String, Object>) parsed.get("result");
+        Map<String, Object> stats = (Map<String, Object>)
+                result.get("stats");
+        assertEquals(1.5, (Double) stats.get("wall_ms"), 1e-12);
+        assertEquals(Boolean.FALSE, stats.get("fallback"));
+        assertNull(stats.get("note"));
+        List<Object> pair = (List<Object>) ((List<Object>)
+                ((Map<String, Object>) result.get("assignments"))
+                        .get("C0")).get(0);
+        assertEquals("t0", pair.get(0));
+        assertEquals(0L, pair.get(1));
+    }
+
+    @Test
+    public void jsonStringEscapingRoundTrips() {
+        String tricky = "a\"b\\c\nd\tef";
+        StringBuilder sb = new StringBuilder();
+        TpuLagBasedPartitionAssignor.Json.writeString(sb, tricky);
+        assertEquals(tricky,
+                TpuLagBasedPartitionAssignor.Json.parse(sb.toString()));
+    }
+
+    @Test
+    public void jsonParsesLongsBeyondIntRange() {
+        // Kafka offsets are longs; 2^53-scale lags must survive.
+        assertEquals(9007199254740993L,
+                TpuLagBasedPartitionAssignor.Json.parse(
+                        "9007199254740993"));
+    }
+
+    @Test
+    public void jsonWriteValueCoversOptionTypes() {
+        StringBuilder sb = new StringBuilder();
+        TpuLagBasedPartitionAssignor.Json.writeValue(sb, null);
+        sb.append('|');
+        TpuLagBasedPartitionAssignor.Json.writeValue(sb, 128L);
+        sb.append('|');
+        TpuLagBasedPartitionAssignor.Json.writeValue(sb, 1.5);
+        sb.append('|');
+        TpuLagBasedPartitionAssignor.Json.writeValue(sb, Boolean.TRUE);
+        assertEquals("null|128|1.5|true", sb.toString());
+    }
+
+    // ------------------------------------------------------------------
+    // Wire byte shapes — must match tests/fixtures/wire_conformance.jsonl
+    // exactly (the Python service replays those fixtures, so both sides
+    // are pinned to the same bytes).
+    // ------------------------------------------------------------------
+
+    @Test
+    public void assignRequestMatchesPinnedWireShape() {
+        assertEquals(
+                "{\"id\": 1, \"method\": \"assign\", \"params\": "
+                + "{\"topics\": {\"t0\": [[0, 100000], [1, 50000], "
+                + "[2, 60000]]}, \"subscriptions\": {\"C0\": [\"t0\"], "
+                + "\"C1\": [\"t0\"]}, \"solver\": \"rounds\"}}",
+                TpuLagBasedPartitionAssignor.buildAssignRequest(
+                        1,
+                        new TreeMap<>(Collections.singletonMap(
+                                "t0", Arrays.asList(
+                                        new long[] {0, 100000},
+                                        new long[] {1, 50000},
+                                        new long[] {2, 60000}))),
+                        readmeSubscriptions(),
+                        "rounds"));
+    }
+
+    private static Map<String, List<String>> readmeSubscriptions() {
+        Map<String, List<String>> subs = new TreeMap<>();
+        subs.put("C0", Collections.singletonList("t0"));
+        subs.put("C1", Collections.singletonList("t0"));
+        return subs;
+    }
+
+    @Test
+    public void streamAssignRequestMatchesPinnedFixture() {
+        // Byte-for-byte the "stream_assign_cold" fixture request line.
+        assertEquals(
+                "{\"id\": 20, \"method\": \"stream_assign\", \"params\": "
+                + "{\"stream_id\": \"wire-s1\", \"topic\": \"t0\", "
+                + "\"lags\": [[0, 100000], [1, 50000], [2, 60000]], "
+                + "\"members\": [\"C1\", \"C0\"]}}",
+                TpuLagBasedPartitionAssignor.buildStreamAssignRequest(
+                        20, "wire-s1", "t0",
+                        Arrays.asList(new long[] {0, 100000},
+                                new long[] {1, 50000},
+                                new long[] {2, 60000}),
+                        Arrays.asList("C1", "C0"),
+                        null));
+    }
+
+    @Test
+    public void streamAssignRequestWithOptionsMatchesPinnedFixture() {
+        // The "stream_assign_options_echoed" fixture's option set —
+        // TreeMap ordering puts guardrail < refine_iters <
+        // refine_threshold, matching the fixture line.
+        Map<String, Object> options = new TreeMap<>();
+        options.put("refine_iters", 100L);
+        options.put("guardrail", null);
+        options.put("refine_threshold", 1.5);
+        assertEquals(
+                "{\"id\": 21, \"method\": \"stream_assign\", \"params\": "
+                + "{\"stream_id\": \"wire-s2\", \"topic\": \"t0\", "
+                + "\"lags\": [[0, 7], [1, 5]], \"members\": [\"C0\"], "
+                + "\"options\": {\"guardrail\": null, "
+                + "\"refine_iters\": 100, \"refine_threshold\": 1.5}}",
+                TpuLagBasedPartitionAssignor.buildStreamAssignRequest(
+                        21, "wire-s2", "t0",
+                        Arrays.asList(new long[] {0, 7}, new long[] {1, 5}),
+                        Collections.singletonList("C0"),
+                        options));
+    }
+
+    @Test
+    public void streamResetRequestShape() {
+        assertEquals(
+                "{\"id\": 23, \"method\": \"stream_reset\", \"params\": "
+                + "{\"stream_id\": \"never-created\"}}",
+                TpuLagBasedPartitionAssignor.buildStreamResetRequest(
+                        23, "never-created"));
+    }
+
+    @Test
+    public void parsesStreamAssignResponse() throws Exception {
+        TpuLagBasedPartitionAssignor.StreamResult r =
+                TpuLagBasedPartitionAssignor.parseStreamAssignResponse(
+                        "{\"id\": 20, \"result\": {\"assignments\": "
+                        + "{\"C0\": [[\"t0\", 0]], \"C1\": [[\"t0\", 1], "
+                        + "[\"t0\", 2]]}, \"stream\": {\"cold_start\": "
+                        + "true, \"refined\": false, \"guardrail_tripped\":"
+                        + " false, \"churn\": 0, \"repaired_rows\": 0}}}");
+        assertTrue(r.coldStart);
+        assertFalse(r.refined);
+        assertFalse(r.guardrailTripped);
+        assertEquals(0L, r.churn);
+        assertEquals(Collections.singletonList(new TopicPartition("t0", 0)),
+                r.assignments.get("C0"));
+        assertEquals(Arrays.asList(new TopicPartition("t0", 1),
+                new TopicPartition("t0", 2)), r.assignments.get("C1"));
+    }
+
+    @Test(expected = java.io.IOException.class)
+    public void errorResponsesRaise() throws Exception {
+        TpuLagBasedPartitionAssignor.parseAssignResponse(
+                "{\"id\": 9, \"error\": {\"message\": \"boom\"}}");
+    }
+
+    // ------------------------------------------------------------------
+    // Socket round-trip against an in-process fake sidecar: the streaming
+    // client's full path (marshal -> TCP -> unmarshal) without Python.
+    // ------------------------------------------------------------------
+
+    @Test
+    public void streamClientRoundTripsOverSocket() throws Exception {
+        final String canned =
+                "{\"id\": 1, \"result\": {\"assignments\": {\"C0\": "
+                + "[[\"t0\", 0], [\"t0\", 1]]}, \"stream\": "
+                + "{\"cold_start\": true, \"refined\": false, "
+                + "\"guardrail_tripped\": false, \"churn\": 0}}}";
+        final List<String> received =
+                Collections.synchronizedList(new ArrayList<String>());
+        try (ServerSocket server = new ServerSocket(0)) {
+            Thread sidecar = new Thread(() -> {
+                try (Socket sock = server.accept()) {
+                    BufferedReader in = new BufferedReader(
+                            new InputStreamReader(sock.getInputStream(),
+                                    StandardCharsets.UTF_8));
+                    BufferedWriter out = new BufferedWriter(
+                            new OutputStreamWriter(sock.getOutputStream(),
+                                    StandardCharsets.UTF_8));
+                    received.add(in.readLine());
+                    out.write(canned);
+                    out.write('\n');
+                    out.flush();
+                } catch (Exception e) {
+                    throw new RuntimeException(e);
+                }
+            });
+            sidecar.start();
+
+            TpuLagBasedPartitionAssignor assignor =
+                    new TpuLagBasedPartitionAssignor();
+            Map<String, Object> configs = new HashMap<>();
+            configs.put("group.id", "test-group");
+            configs.put(TpuLagBasedPartitionAssignor.SIDECAR_PORT_CONFIG,
+                    Integer.toString(server.getLocalPort()));
+            assignor.configure(configs);
+
+            TpuLagBasedPartitionAssignor.StreamResult r =
+                    assignor.streamAssign("s1", "t0",
+                            Arrays.asList(new long[] {0, 10},
+                                    new long[] {1, 5}),
+                            Collections.singletonList("C0"), null);
+            sidecar.join(5000);
+
+            assertEquals(1, received.size());
+            assertEquals(
+                    "{\"id\": 1, \"method\": \"stream_assign\", "
+                    + "\"params\": {\"stream_id\": \"s1\", \"topic\": "
+                    + "\"t0\", \"lags\": [[0, 10], [1, 5]], \"members\": "
+                    + "[\"C0\"]}}",
+                    received.get(0));
+            assertTrue(r.coldStart);
+            assertEquals(Arrays.asList(new TopicPartition("t0", 0),
+                    new TopicPartition("t0", 1)),
+                    r.assignments.get("C0"));
+        }
+    }
+
+    @Test
+    public void requiresGroupId() {
+        TpuLagBasedPartitionAssignor assignor =
+                new TpuLagBasedPartitionAssignor();
+        try {
+            assignor.configure(new HashMap<String, Object>());
+            throw new AssertionError("configure() must require group.id");
+        } catch (IllegalArgumentException expected) {
+            assertTrue(expected.getMessage().contains("group.id"));
+        }
+    }
+}
