@@ -87,6 +87,7 @@ def _rounds_body_packed(carry, xs, C: int, rank_bits: int):
 def _rounds_scan(
     sorted_lags, sorted_valid, totals0, C: int,
     n_valid: int | None = None, totals_rank_bits: int = 0,
+    scan_unroll: int | None = None,
 ):
     """Scan the round decomposition over one topic's sorted partitions.
 
@@ -127,8 +128,10 @@ def _rounds_scan(
     # Unrolling amortizes the scan's per-iteration bookkeeping — the round
     # body is ~90 us of tiny ops (tools/probe_round5d.py), so loop
     # overhead is a real fraction of it.  Purely a lowering choice:
-    # results are bit-identical.
-    unroll = min(4, max(R, 1))
+    # results are bit-identical.  ``scan_unroll`` (static) overrides the
+    # default factor so the hardware probe (tools/probe_round6.py) can
+    # sweep it; None keeps the measured default.
+    unroll = min(scan_unroll if scan_unroll else 4, max(R, 1))
     if totals_rank_bits > 0:
         ids0 = jnp.arange(C, dtype=jnp.int32)
         (totals_s, ids_s), round_choice = lax.scan(
@@ -168,7 +171,8 @@ def _unsort_choice(perm, sorted_choice, P: int, C: int):
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "num_consumers", "pack_shift", "n_valid", "totals_rank_bits"
+        "num_consumers", "pack_shift", "n_valid", "totals_rank_bits",
+        "scan_unroll",
     ),
 )
 def assign_topic_rounds(
@@ -179,6 +183,7 @@ def assign_topic_rounds(
     pack_shift: int = 0,
     n_valid: int | None = None,
     totals_rank_bits: int = 0,
+    scan_unroll: int | None = None,
 ):
     """Assign one topic's partitions via the round decomposition.
 
@@ -203,6 +208,7 @@ def assign_topic_rounds(
     totals, sorted_choice = _rounds_scan(
         sorted_lags, sorted_valid, totals0, C,
         n_valid=n_valid, totals_rank_bits=totals_rank_bits,
+        scan_unroll=scan_unroll,
     )
     choice, counts = _unsort_choice(perm, sorted_choice, P, C)
     return choice, counts, totals

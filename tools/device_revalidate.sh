@@ -13,6 +13,8 @@ assert int(jax.jit(lambda v: (v+1).sum())(x)) == 36
 print('device alive:', jax.devices())" || { echo "device unreachable"; exit 1; }
 echo "== kernel probe (probe_round5f) =="
 timeout 2400 python tools/probe_round5f.py 2>&1 | grep -vE "WARN|INFO|warning"
+echo "== round-body sweep (probe_round6) =="
+timeout 2400 python tools/probe_round6.py 2>&1 | grep -vE "WARN|INFO|warning"
 echo "== full bench =="
 timeout 3600 python bench.py
 echo "== done; BENCH_DETAILS.json updated =="

@@ -1,0 +1,192 @@
+"""Round-body cost sweep on real hardware (VERDICT r4 item 7).
+
+The north-star solve's device budget is ~100 sequential rounds at ~90 us
+of tiny-op overhead each (tools/probe_round5d.py).  This probe measures,
+with the fetch-synchronized amortized method (the ONLY valid clock on the
+tunneled platform — block_until_ready returns at dispatch):
+
+  1. the production kernel at scan unroll factors 2/4/8/16 (bit-identical
+     lowering variants, static arg `scan_unroll`);
+  2. an EXPERIMENTAL pow2-padded-consumer round body (C=1000 padded to
+     1024 with sentinel keys that sort last and receive zero gain —
+     possibly a friendlier sort-network shape), bit-parity-checked here
+     against the production kernel before timing.
+
+Run after the tunnel recovers; pick the winning unroll as the new default
+(and productize the pow2 body only if it wins).
+"""
+
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+import functools  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+from kafka_lag_based_assignor_tpu.ops.batched import (  # noqa: E402
+    stream_payload,
+    totals_rank_bits_for,
+)
+from kafka_lag_based_assignor_tpu.ops.packing import pad_bucket  # noqa: E402
+from kafka_lag_based_assignor_tpu.ops.rounds_kernel import (  # noqa: E402
+    assign_topic_rounds,
+)
+from kafka_lag_based_assignor_tpu.ops.scan_kernel import (  # noqa: E402
+    sort_partitions_with,
+)
+
+P, C = 100_000, 1000
+N_HI = 8
+
+
+def zipf_lags(rng, n, a=1.1, scale=1000):
+    ranks = rng.permutation(n) + 1
+    return (scale * (n / ranks) ** (1.0 / a)).astype(np.int64)
+
+
+rng = np.random.default_rng(5)
+lags0 = zipf_lags(rng, P)
+payload, shift = stream_payload(lags0)
+rb = totals_rank_bits_for(payload, C)
+B = pad_bucket(P)
+
+
+def solve_variant(v, unroll):
+    lags_p = jnp.pad(v.astype(jnp.int64), (0, B - P))
+    pids = jnp.arange(B, dtype=jnp.int32)
+    valid = pids < P
+    choice, _, _ = assign_topic_rounds(
+        lags_p, pids, valid, num_consumers=C, pack_shift=shift,
+        n_valid=P, totals_rank_bits=rb, scan_unroll=unroll,
+    )
+    return choice[:P].astype(jnp.int32).sum()
+
+
+# --- experimental pow2-padded-consumer packed body ---------------------
+C_PAD = 1024
+RANK_BITS_PAD = 10  # 1024 ids
+SENTINEL = np.int64(int(lags0.sum()) + 1)  # > any achievable total
+
+
+def solve_pow2c(v, unroll):
+    lags_p = jnp.pad(v.astype(jnp.int64), (0, B - P))
+    pids = jnp.arange(B, dtype=jnp.int32)
+    valid = pids < P
+    perm, sorted_lags, sorted_valid = sort_partitions_with(
+        lags_p, pids, valid, shift
+    )
+    L = P
+    R = -(-L // C)
+    head = R * C
+    lags_h = sorted_lags[:head].reshape(R, C)
+    valid_h = sorted_valid[:head].reshape(R, C)
+    # Pad each round's partition row C -> C_PAD with zero-gain invalid
+    # rows, and the consumer carry with sentinel totals: sentinel keys
+    # sort last, so pad consumers can never occupy a real partition's
+    # position.
+    lags_r = jnp.pad(lags_h, ((0, 0), (0, C_PAD - C)))
+    valid_r = jnp.pad(valid_h, ((0, 0), (0, C_PAD - C)))
+    totals0 = jnp.concatenate([
+        jnp.zeros((C,), jnp.int64),
+        jnp.full((C_PAD - C,), SENTINEL, jnp.int64),
+    ])
+    ids0 = jnp.arange(C_PAD, dtype=jnp.int32)
+
+    def body(carry, xs):
+        totals_s, ids_s = carry
+        round_lags, round_valid = xs
+        key = (totals_s << RANK_BITS_PAD) | ids_s.astype(jnp.int64)
+        skey = lax.sort(key)
+        ids_new = (skey & (C_PAD - 1)).astype(jnp.int32)
+        gain = jnp.where(round_valid, round_lags, 0)
+        totals_new = (skey >> RANK_BITS_PAD) + gain
+        choice = jnp.where(round_valid, ids_new, -1)
+        return (totals_new, ids_new), choice
+
+    (_, _), round_choice = lax.scan(
+        body, (totals0, ids0), (lags_r, valid_r), unroll=unroll
+    )
+    sorted_choice = round_choice[:, :C].reshape(head)
+    flat = jnp.concatenate(
+        [sorted_choice, jnp.full((B - head,), -1, jnp.int32)]
+    )
+    from kafka_lag_based_assignor_tpu.ops.sortops import unsort
+
+    choice = unsort(perm, flat)
+    return choice[:P]
+
+
+def amortized_ms(make_fn, unroll, label):
+    batch = jax.device_put(
+        np.stack([np.roll(payload, 7919 * i) for i in range(N_HI)])
+    )
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def many(b, n):
+        return lax.map(lambda v: make_fn(v, unroll), b[:n]).sum()
+
+    def timed(n, iters=8):
+        int(many(batch, n=n))  # warm-up/compile
+        ts = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            int(many(batch, n=n))
+            ts.append((time.perf_counter() - t0) * 1000.0)
+        return float(np.median(ts))
+
+    t_lo, t_hi = timed(1), timed(N_HI)
+    per = max(0.0, (t_hi - t_lo) / (N_HI - 1))
+    print(f"{label}: amortized {per:.2f} ms/solve "
+          f"(t1={t_lo:.1f} t{N_HI}={t_hi:.1f})", flush=True)
+    return per
+
+
+def main():
+    print(f"devices: {jax.devices()}", flush=True)
+
+    # Bit-parity of the experimental body BEFORE timing it.
+    base = np.asarray(
+        jax.jit(
+            lambda v: assign_topic_rounds(
+                jnp.pad(v.astype(jnp.int64), (0, B - P)),
+                jnp.arange(B, dtype=jnp.int32),
+                jnp.arange(B, dtype=jnp.int32) < P,
+                num_consumers=C, pack_shift=shift, n_valid=P,
+                totals_rank_bits=rb,
+            )[0][:P]
+        )(payload)
+    )
+    exp = np.asarray(jax.jit(lambda v: solve_pow2c(v, 4))(payload))
+    assert (base == exp).all(), "pow2-C body is NOT bit-identical"
+    print("pow2-C body: bit-parity OK", flush=True)
+
+    results = {}
+    for unroll in (2, 4, 8, 16):
+        results[f"unroll{unroll}"] = amortized_ms(
+            lambda v, u: solve_variant(v, u), unroll, f"unroll={unroll}"
+        )
+    results["pow2c_u4"] = amortized_ms(
+        lambda v, u: solve_pow2c(v, u).astype(jnp.int32).sum(),
+        4, "pow2-C unroll=4",
+    )
+    results["pow2c_u8"] = amortized_ms(
+        lambda v, u: solve_pow2c(v, u).astype(jnp.int32).sum(),
+        8, "pow2-C unroll=8",
+    )
+    best = min(results, key=results.get)
+    print(f"BEST: {best} at {results[best]:.2f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()
