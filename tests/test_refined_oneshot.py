@@ -205,3 +205,22 @@ def test_streaming_rejects_negative_lags():
     engine = StreamingAssignor(num_consumers=4)
     with pytest.raises(ValueError, match="non-negative"):
         engine.rebalance(np.array([5, -1, 3], dtype=np.int64))
+
+
+@pytest.mark.parametrize("P,C", [(3, 8), (1, 1), (8, 8), (7, 3)])
+def test_stream_refined_degenerate_shapes(P, C):
+    """Fewer partitions than consumers, single row, exact division — the
+    refined path must keep the count invariant and assign every row."""
+    rng = np.random.default_rng(P * 31 + C)
+    lags = rng.integers(0, 10**6, size=P).astype(np.int64)
+    refined = np.asarray(
+        assign_stream_refined(lags, num_consumers=C, refine_iters=8)
+    )
+    assert refined.shape == (P,)
+    assert ((refined >= 0) & (refined < C)).all()
+    counts = np.bincount(refined, minlength=C)
+    assert counts.max() - counts.min() <= 1
+    # Never worse than plain greedy.
+    greedy = np.asarray(assign_stream(lags, num_consumers=C))
+    assert totals_of(refined, lags, C).max() <= \
+        totals_of(greedy, lags, C).max()
