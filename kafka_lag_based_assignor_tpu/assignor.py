@@ -146,7 +146,16 @@ class LagBasedPartitionAssignor:
         if self._config is None:
             raise RuntimeError("configure() must be called before assign()")
 
-        stats = RebalanceStats(solver=self._config.solver)
+        stats = RebalanceStats(
+            solver=self._config.solver,
+            # Only solvers that actually consume the budget record it:
+            # an operator must be able to tell refined from bit-parity.
+            refine_iters=(
+                self._config.refine_iters
+                if self._config.solver in ("rounds", "scan", "sinkhorn")
+                else None
+            ),
+        )
         with stopwatch() as wall:
             with profile_trace(self._config.profile):
                 group_assignment = self._assign_inner(

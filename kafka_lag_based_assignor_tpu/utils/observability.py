@@ -19,7 +19,7 @@ import json
 import logging
 import time
 from dataclasses import asdict, dataclass, field
-from typing import Dict, Iterator, List
+from typing import Dict, Iterator, List, Optional
 
 LOGGER = logging.getLogger("kafka_lag_based_assignor_tpu")
 
@@ -66,6 +66,10 @@ class RebalanceStats:
     num_partitions: int = 0
     num_members: int = 0
     solver: str = ""
+    # One-shot quality-mode budget applied on top of the solver (None =
+    # strict reference parity) — operators reading a rebalance record must
+    # be able to tell whether an assignment is refined or bit-parity.
+    refine_iters: Optional[int] = None
     fallback_used: bool = False
     wall_ms: float = 0.0
     lag_read_ms: float = 0.0
