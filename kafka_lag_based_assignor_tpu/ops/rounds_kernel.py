@@ -84,6 +84,26 @@ def _rounds_body_packed(carry, xs, C: int, rank_bits: int):
     return (totals_new, ids_new), choice
 
 
+def round_rows(sorted_lags, sorted_valid, C: int, n_valid: int | None):
+    """THE round-prefix shaping shared by the XLA scan and the Pallas
+    adapter (their bit-parity contract depends on identical trimming):
+    trim the sorted axis to ceil(L / C) rounds — padding when P < C fills
+    the single partial round — and return (lags_head, valid_head, R,
+    head) with head == R * C elements."""
+    P = sorted_lags.shape[0]
+    L = P if n_valid is None else min(int(n_valid), P)
+    R = -(-L // C) if L else 0
+    head = R * C
+    if head <= P:
+        return sorted_lags[:head], sorted_valid[:head], R, head
+    return (
+        jnp.pad(sorted_lags, (0, head - P)),
+        jnp.pad(sorted_valid, (0, head - P)),
+        R,
+        head,
+    )
+
+
 def _rounds_scan(
     sorted_lags, sorted_valid, totals0, C: int,
     n_valid: int | None = None, totals_rank_bits: int = 0,
@@ -114,16 +134,10 @@ def _rounds_scan(
 
     Returns (totals[C], sorted_choice int32[P] in sorted order).
     """
+    lags_h, valid_h, R, head = round_rows(
+        sorted_lags, sorted_valid, C, n_valid
+    )
     P = sorted_lags.shape[0]
-    L = P if n_valid is None else min(int(n_valid), P)
-    R = -(-L // C) if L else 0
-    head = R * C
-    if head <= P:
-        lags_h = sorted_lags[:head]
-        valid_h = sorted_valid[:head]
-    else:
-        lags_h = jnp.pad(sorted_lags, (0, head - P))
-        valid_h = jnp.pad(sorted_valid, (0, head - P))
     xs = (lags_h.reshape(R, C), valid_h.reshape(R, C))
     # Unrolling amortizes the scan's per-iteration bookkeeping — the round
     # body is ~90 us of tiny ops (tools/probe_round5d.py), so loop

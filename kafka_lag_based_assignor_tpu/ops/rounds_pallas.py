@@ -1,0 +1,276 @@
+"""Pallas TPU round-scan: the whole greedy round decomposition in ONE
+grid-less kernel with an in-VMEM bitonic sort per round.
+
+Why: the XLA lowering of the rounds scan costs ~90 us per round of
+sequencing overhead (tools/probe_round5d.py) — each round's C-sized
+``lax.sort`` lowers to a multi-pass comparator network with HBM traffic
+between passes, and at the north star that's ~100 sequential rounds =
+~9 ms, essentially the whole device budget (BASELINE.md).  Keeping the
+(total, id) state resident in VMEM across ALL rounds and running the
+compare-exchange network on registers removes the per-pass overhead
+entirely: a 1024-wide bitonic sort is 55 stages of roll/select/min-max
+vector ops, i.e. microseconds, not tens of them.
+
+Design (toolchain-shaped like :mod:`.plan_stats` — this image's Mosaic
+AOT path rejects any ``grid``):
+
+* one grid-less invocation; ``lax.fori_loop`` over rounds; the 55-stage
+  bitonic network is a STATIC python loop inside the body (unrolled once
+  in the compiled loop body, looped R times);
+* state: two (8, 128) int32 planes — totals and consumer ids — i.e. the
+  1024-slot consumer axis laid out sublane x lane.  The XOR-partner
+  shuffle of the bitonic network is two ``pltpu.roll``s and a select:
+  lane-axis rolls for distances < 128, sublane-axis rolls for 128+;
+* comparisons are (total, id) lexicographic on the separate planes — no
+  64-bit key packing, so the whole kernel is int32 (Mosaic-friendly);
+* per round: sort ascending, emit the id plane as that round's choice
+  row (-1 at invalid positions), add the round's gains positionally.
+
+Scope gate (:func:`pallas_rounds_supported`): C <= 1024 consumers and
+TOTAL lag sum < 2**30 (int32 totals with headroom; the int64-sum regime
+stays on the XLA path), R * 1024 ints fitting VMEM.  The north-star
+shape (P=100k, C=1000, Zipf lags ~2e8 total) fits.
+
+EXPERIMENTAL this round: bit-parity with the XLA scan is pinned by
+interpret-mode tests (tests/test_rounds_pallas.py); hardware timing goes
+through tools/probe_round6.py when the tunnel allows.  Production
+dispatch stays on the XLA path until the probe proves a win.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+C_PAD = 1024  # consumer slots: one (8, 128) int32 tile plane
+_SUB, _LANE = 8, 128
+# int32 totals sentinel for pad consumers: above any admissible real
+# total (gated < 2**30) and never incremented (pad positions carry -1
+# gains), so pad slots sort strictly last every round.
+_SENTINEL = np.int32(2**31 - 1)
+# Total-lag admission bound: totals stay exactly representable in int32
+# with sentinel headroom.
+TOTALS_BOUND = 1 << 30
+
+
+def _xor_shuffle(x, d: int):
+    """out[i] = x[i ^ d] over the linearized (8, 128) index, d a power of
+    two < 1024.  Two circular rolls + a bit-select: the element whose
+    ``d`` bit is set reads its lower partner (the +d roll) and vice
+    versa; the roll's wraparound lanes are exactly the ones the select
+    never reads."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    if d < _LANE:
+        a = pltpu.roll(x, shift=d, axis=1)            # a[l] = x[l - d]
+        b = pltpu.roll(x, shift=_LANE - d, axis=1)    # b[l] = x[l + d]
+        lane = lax.broadcasted_iota(jnp.int32, (_SUB, _LANE), 1)
+        return jnp.where((lane & d) != 0, a, b)
+    s = d // _LANE
+    a = pltpu.roll(x, shift=s, axis=0)
+    b = pltpu.roll(x, shift=_SUB - s, axis=0)
+    sub = lax.broadcasted_iota(jnp.int32, (_SUB, _LANE), 0)
+    return jnp.where((sub & s) != 0, a, b)
+
+
+def _bitonic_sort(t, ids):
+    """Ascending (total, id) lexicographic bitonic sort of the 1024-slot
+    planes.  Ids are distinct, so the order is total and the network is
+    exact.  55 compare-exchange stages, fully unrolled (static python
+    loops — this function is traced once inside the round body)."""
+    idx = (
+        lax.broadcasted_iota(jnp.int32, (_SUB, _LANE), 0) * _LANE
+        + lax.broadcasted_iota(jnp.int32, (_SUB, _LANE), 1)
+    )
+    k = 2
+    while k <= C_PAD:
+        j = k // 2
+        while j >= 1:
+            pt = _xor_shuffle(t, j)
+            pid = _xor_shuffle(ids, j)
+            gt = (t > pt) | ((t == pt) & (ids > pid))
+            # Element keeps the min of the pair iff it is the lower
+            # index of an ascending run (or the upper of a descending
+            # one): the classic bitonic orientation rule.
+            take_min = ((idx & k) == 0) == ((idx & j) == 0)
+            swap = jnp.where(take_min, gt, ~gt)
+            t = jnp.where(swap, pt, t)
+            ids = jnp.where(swap, pid, ids)
+            j //= 2
+        k *= 2
+    return t, ids
+
+
+def _rounds_kernel(gains_ref, t0_ref, choice_ref, tout_ref, idout_ref):
+    """gains_ref int32[R, 8, 128] (-1 = invalid position), t0_ref
+    int32[8, 128] starting totals (sentinel at pad slots).  Emits per
+    round the sorted id plane (choice) and returns the final (total, id)
+    planes — still in the LAST round's sorted order; the host unsorts."""
+    from jax.experimental import pallas as pl
+
+    R = gains_ref.shape[0]
+    ids0 = (
+        lax.broadcasted_iota(jnp.int32, (_SUB, _LANE), 0) * _LANE
+        + lax.broadcasted_iota(jnp.int32, (_SUB, _LANE), 1)
+    )
+
+    def body(r, carry):
+        t, ids = carry
+        t, ids = _bitonic_sort(t, ids)
+        g = gains_ref[pl.ds(r, 1)][0]
+        valid = g >= 0
+        choice_ref[pl.ds(r, 1)] = jnp.where(valid, ids, -1)[None]
+        t = t + jnp.where(valid, g, 0)
+        return t, ids
+
+    t, ids = lax.fori_loop(
+        jnp.int32(0), jnp.int32(R), body, (t0_ref[:], ids0)
+    )
+    tout_ref[:] = t
+    idout_ref[:] = ids
+
+
+# Conservative VMEM budget (per-core ~16 MB; leave Mosaic headroom).
+_VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+
+
+def pallas_rounds_supported(
+    num_consumers: int, total_lag_bound: int, num_rounds: int
+) -> bool:
+    """Shape/value admission for the Pallas path: consumer axis fits one
+    tile plane, totals stay int32-exact under the sentinel, and the
+    gains + choice arrays fit VMEM."""
+    if num_consumers > C_PAD:
+        return False
+    if total_lag_bound >= TOTALS_BOUND:
+        return False
+    bytes_needed = 2 * num_rounds * C_PAD * 4 + 6 * C_PAD * 4
+    return bytes_needed <= _VMEM_BUDGET_BYTES
+
+
+@functools.partial(jax.jit, static_argnames=("num_consumers", "interpret"))
+def rounds_scan_pallas(
+    round_gains: jax.Array,
+    num_consumers: int,
+    interpret: bool = False,
+):
+    """Run the round decomposition on pre-rounded gains.
+
+    Args:
+      round_gains: int32[R, C] — round r's positional gains (the sorted
+        descending lags of that round's partitions); -1 marks an invalid
+        (padding) position.  The caller produced this exactly as
+        :func:`..ops.rounds_kernel._rounds_scan` reshapes its sorted
+        prefix.
+      num_consumers: static C <= 1024.
+    Returns (totals int32[C] in CONSUMER order, choice int32[R, C]:
+    consumer id seated at each position, -1 at invalid positions) — the
+    same per-round contract as the XLA packed body.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    C = int(num_consumers)
+    R = round_gains.shape[0]
+    gains_p = jnp.pad(
+        round_gains.astype(jnp.int32),
+        ((0, 0), (0, C_PAD - C)),
+        constant_values=-1,
+    ).reshape(R, _SUB, _LANE)
+    t0 = jnp.full((C_PAD,), _SENTINEL, jnp.int32).at[:C].set(0).reshape(
+        _SUB, _LANE
+    )
+
+    choice, tout, idout = pl.pallas_call(
+        _rounds_kernel,
+        in_specs=[
+            pl.BlockSpec(
+                (R, _SUB, _LANE), lambda: (0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (_SUB, _LANE), lambda: (0, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (R, _SUB, _LANE), lambda: (0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (_SUB, _LANE), lambda: (0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (_SUB, _LANE), lambda: (0, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((R, _SUB, _LANE), jnp.int32),
+            jax.ShapeDtypeStruct((_SUB, _LANE), jnp.int32),
+            jax.ShapeDtypeStruct((_SUB, _LANE), jnp.int32),
+        ],
+        interpret=interpret,
+    )(gains_p, t0)
+
+    # Final planes are in the last round's sorted order: one small sort
+    # by id restores consumer order (ids 0..C-1 first, pads after).
+    _, totals_by_id = lax.sort(
+        (idout.reshape(C_PAD), tout.reshape(C_PAD)), num_keys=1
+    )
+    return totals_by_id[:C], choice.reshape(R, C_PAD)[:, :C]
+
+
+def assign_sorted_rounds_pallas(
+    sorted_lags, sorted_valid, num_consumers: int, n_valid: int,
+    total_lag_bound: int,
+    interpret: bool = False,
+):
+    """Adapter matching :func:`..ops.rounds_kernel._rounds_scan`'s
+    sorted-prefix contract: reshape the trimmed prefix into round rows
+    (the SAME shared shaping the XLA scan uses), run the Pallas scan,
+    return (totals int64[C], sorted_choice int32[P]).  Host-side
+    convenience for tests and the hardware probe — production dispatch
+    stays on the XLA path until the probe proves a win.
+
+    ``total_lag_bound`` is the host-known upper bound on the total valid
+    lag (e.g. ``int(lags.sum())`` — the same host-side-guard idiom as
+    :func:`..ops.batched.totals_rank_bits_for`): the admission gate is
+    ENFORCED here, because an out-of-gate instance would not fail loudly
+    — an int32-overflowing lag would silently read as padding.
+    """
+    from .rounds_kernel import round_rows
+
+    C = int(num_consumers)
+    P = sorted_lags.shape[0]
+    L = min(int(n_valid), P)
+    R = -(-L // C) if L else 0
+    if not pallas_rounds_supported(C, int(total_lag_bound), max(R, 1)):
+        raise ValueError(
+            f"instance outside the Pallas round-scan gate "
+            f"(C={C} <= {C_PAD}, total lag bound {total_lag_bound} < "
+            f"{TOTALS_BOUND}, VMEM): use the XLA path"
+        )
+    if R == 0:
+        # Zero valid rows: the XLA scan's empty-scan contract.
+        return (
+            jnp.zeros((C,), jnp.int64),
+            jnp.full((P,), -1, jnp.int32),
+        )
+    lags_h, valid_h, R, head = round_rows(
+        jnp.asarray(sorted_lags), jnp.asarray(sorted_valid), C, n_valid
+    )
+    gains = jnp.where(valid_h, lags_h, -1).astype(jnp.int32).reshape(R, C)
+    totals, choice = rounds_scan_pallas(
+        gains, num_consumers=C, interpret=interpret
+    )
+    flat = choice.reshape(head)[: min(head, P)]
+    if head < P:
+        flat = jnp.concatenate(
+            [flat, jnp.full((P - head,), -1, jnp.int32)]
+        )
+    return totals.astype(jnp.int64), flat

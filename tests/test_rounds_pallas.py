@@ -1,0 +1,102 @@
+"""Interpret-mode parity for the Pallas round-scan kernel.
+
+The Pallas kernel (ops/rounds_pallas.py) must be bit-identical to the
+XLA round scan (`ops/rounds_kernel._rounds_scan`) on every admissible
+instance — same theorem, same per-round contract.  These tests run the
+kernel in the Pallas interpreter on CPU (the same strategy that
+validates the plan-stats kernel); hardware timing is probed separately
+(tools/probe_round6.py).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from kafka_lag_based_assignor_tpu.ops.rounds_kernel import _rounds_scan
+from kafka_lag_based_assignor_tpu.ops.rounds_pallas import (
+    TOTALS_BOUND,
+    assign_sorted_rounds_pallas,
+    pallas_rounds_supported,
+)
+
+
+def sorted_case(seed, P, C, max_lag=10**5, all_valid=False):
+    """A processing-order instance: descending lags, valid prefix."""
+    rng = np.random.default_rng(seed)
+    n_valid = P if all_valid else int(rng.integers(1, P + 1))
+    lags = np.zeros(P, dtype=np.int64)
+    lags[:n_valid] = -np.sort(
+        -rng.integers(0, max_lag, size=n_valid)
+    )
+    valid = np.arange(P) < n_valid
+    return lags, valid, n_valid
+
+
+@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize(
+    "P,C",
+    [(257, 8), (96, 96), (1000, 37), (2048, 1000), (64, 1024)],
+)
+def test_pallas_matches_xla_scan(seed, P, C):
+    lags, valid, n_valid = sorted_case(seed, P, C)
+    assert pallas_rounds_supported(C, int(lags.sum()), -(-P // C))
+    ref_totals, ref_choice = _rounds_scan(
+        jnp.asarray(lags), jnp.asarray(valid),
+        jnp.zeros((C,), jnp.int64), C, n_valid=n_valid,
+    )
+    p_totals, p_choice = assign_sorted_rounds_pallas(
+        lags, valid, num_consumers=C, n_valid=n_valid,
+        total_lag_bound=int(lags.sum()), interpret=True
+    )
+    np.testing.assert_array_equal(
+        np.asarray(p_choice), np.asarray(ref_choice)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(p_totals), np.asarray(ref_totals)
+    )
+
+
+def test_pallas_many_ties():
+    """Equal lags everywhere: the id tiebreak alone orders every round."""
+    P, C = 500, 16
+    lags = np.full(P, 7, dtype=np.int64)
+    valid = np.ones(P, dtype=bool)
+    ref_totals, ref_choice = _rounds_scan(
+        jnp.asarray(lags), jnp.asarray(valid),
+        jnp.zeros((C,), jnp.int64), C, n_valid=P,
+    )
+    p_totals, p_choice = assign_sorted_rounds_pallas(
+        lags, valid, num_consumers=C, n_valid=P,
+        total_lag_bound=int(lags.sum()), interpret=True
+    )
+    np.testing.assert_array_equal(
+        np.asarray(p_choice), np.asarray(ref_choice)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(p_totals), np.asarray(ref_totals)
+    )
+
+
+def test_admission_gate():
+    assert not pallas_rounds_supported(1025, 10, 1)  # C too wide
+    assert not pallas_rounds_supported(8, TOTALS_BOUND, 1)  # totals wide
+    assert not pallas_rounds_supported(1000, 10, 10**6)  # VMEM
+    assert pallas_rounds_supported(1000, 2 * 10**8, 100)  # north star
+
+
+def test_adapter_enforces_gate_and_empty_input():
+    lags = np.array([5, 3], dtype=np.int64)
+    valid = np.ones(2, dtype=bool)
+    with pytest.raises(ValueError, match="gate"):
+        assign_sorted_rounds_pallas(
+            lags, valid, num_consumers=2, n_valid=2,
+            total_lag_bound=TOTALS_BOUND, interpret=True,
+        )
+    # n_valid=0 follows the XLA scan's empty-scan contract, no kernel.
+    totals, choice = assign_sorted_rounds_pallas(
+        lags, np.zeros(2, dtype=bool), num_consumers=2, n_valid=0,
+        total_lag_bound=8, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(choice), [-1, -1])
+    np.testing.assert_array_equal(np.asarray(totals), [0, 0])

@@ -127,6 +127,28 @@ def solve_pow2c(v, unroll):
     return choice[:P]
 
 
+def solve_pallas(v, unroll):
+    """Full solve with the Pallas round-scan kernel replacing the XLA
+    scan: device sort -> in-VMEM bitonic rounds -> unsort (the _stream
+    contract; unroll is ignored — the kernel loops in-VMEM)."""
+    from kafka_lag_based_assignor_tpu.ops.rounds_pallas import (
+        assign_sorted_rounds_pallas,
+    )
+    from kafka_lag_based_assignor_tpu.ops.sortops import unsort
+
+    lags_p = jnp.pad(v.astype(jnp.int64), (0, B - P))
+    pids = jnp.arange(B, dtype=jnp.int32)
+    valid = pids < P
+    perm, sorted_lags, sorted_valid = sort_partitions_with(
+        lags_p, pids, valid, shift
+    )
+    _, flat = assign_sorted_rounds_pallas(
+        sorted_lags, sorted_valid, num_consumers=C, n_valid=P,
+        total_lag_bound=int(lags0.sum()),
+    )
+    return unsort(perm, flat)[:P]
+
+
 def amortized_ms(make_fn, unroll, label):
     batch = jax.device_put(
         np.stack([np.roll(payload, 7919 * i) for i in range(N_HI)])
@@ -184,6 +206,20 @@ def main():
         lambda v, u: solve_pow2c(v, u).astype(jnp.int32).sum(),
         8, "pow2-C unroll=8",
     )
+    # Pallas in-VMEM round scan (experimental): parity-check on the real
+    # lowering first, then time it.  Any Mosaic legalization failure is
+    # reported and skipped — the XLA variants above still report.
+    try:
+        pal = np.asarray(jax.jit(lambda v: solve_pallas(v, 0))(payload))
+        assert (base == pal).all(), "pallas body NOT bit-identical on HW"
+        print("pallas round-scan: bit-parity OK on device", flush=True)
+        results["pallas"] = amortized_ms(
+            lambda v, u: solve_pallas(v, u).astype(jnp.int32).sum(),
+            0, "pallas round-scan",
+        )
+    except Exception as exc:  # noqa: BLE001 — probe must finish
+        print(f"pallas round-scan unavailable: {type(exc).__name__}: "
+              f"{exc}", flush=True)
     best = min(results, key=results.get)
     print(f"BEST: {best} at {results[best]:.2f} ms", flush=True)
 
