@@ -219,6 +219,49 @@ def assign_stream_refined(lags, num_consumers: int, refine_iters: int = 64):
     )
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_consumers", "pack_shift", "refine_iters"),
+)
+def _stream_device_pallas(
+    lags, num_consumers: int, pack_shift: int = 0, refine_iters: int = 0,
+):
+    """Accelerator inner with the Pallas in-VMEM round scan replacing the
+    XLA scan (same transfer contract as :func:`_stream_device`).  Callers
+    MUST have passed the host-side admission gate
+    (:func:`..ops.rounds_pallas.pallas_rounds_supported` on the actual
+    lag sum) AND the probe-once device parity gate
+    (:func:`..ops.rounds_pallas.rounds_pallas_available`) — the core has
+    no in-trace gate."""
+    import jax.numpy as jnp
+
+    from .packing import pad_bucket
+    from .rounds_pallas import sorted_rounds_pallas_core
+    from .scan_kernel import sort_partitions_with
+    from .sortops import unsort
+
+    P = lags.shape[0]
+    P_pad = pad_bucket(P)
+    lags_p = jnp.pad(lags.astype(jnp.int64), (0, P_pad - P))
+    pids = jnp.arange(P_pad, dtype=jnp.int32)
+    valid = pids < P
+    perm, sorted_lags, sorted_valid = sort_partitions_with(
+        lags_p, pids, valid, pack_shift
+    )
+    _, flat = sorted_rounds_pallas_core(
+        sorted_lags, sorted_valid, num_consumers=num_consumers, n_valid=P
+    )
+    choice = unsort(perm, flat)
+    if refine_iters:
+        from .refine import refine_assignment
+
+        choice, _, _ = refine_assignment(
+            lags_p, valid, choice, num_consumers=num_consumers,
+            iters=refine_iters,
+        )
+    return _narrow_choice(choice[:P], num_consumers)
+
+
 def _dense_batch_inputs(lags):
     """THE device-side derivation for dense [T, P] batches: pad the
     partition axis to the pow2 bucket, dense pids, valid = real-row mask.
@@ -398,6 +441,33 @@ def assign_stream(lags, num_consumers: int, refine_iters: int = 0):
         rb = totals_rank_bits_for(payload, num_consumers)
         from .dispatch import observe_pack_shift
 
+        # Pallas in-VMEM round scan when the instance AND the device
+        # qualify: host value gate first (cheap, avoids probing for
+        # ineligible instances), then the probe-once device parity gate
+        # (compiles + bit-compares a representative instance on first
+        # use; any failure permanently falls back to the XLA scan).
+        if num_consumers <= 1024:
+            from .rounds_pallas import (
+                pallas_rounds_supported,
+                rounds_pallas_available,
+            )
+
+            P = lags.shape[0]
+            # f64 sum: an int64 wrap could alias a huge total to a small
+            # positive and sneak past the int32-totals gate.
+            total = int(
+                min(float(np.sum(lags, dtype=np.float64)), 2.0**62)
+            )
+            if pallas_rounds_supported(
+                num_consumers, total, -(-P // num_consumers)
+            ) and rounds_pallas_available():
+                observe_pack_shift(
+                    ("stream_pallas", lags.shape, num_consumers), shift
+                )
+                return _stream_device_pallas(
+                    payload, num_consumers=num_consumers,
+                    pack_shift=shift, **refine,
+                )
         # One observation key per executable-selecting tuple: a change in
         # EITHER static arg (pack shift or rank bits) recompiles.
         observe_pack_shift(

@@ -138,6 +138,69 @@ def _rounds_kernel(gains_ref, t0_ref, choice_ref, tout_ref, idout_ref):
 # Conservative VMEM budget (per-core ~16 MB; leave Mosaic headroom).
 _VMEM_BUDGET_BYTES = 12 * 1024 * 1024
 
+_pallas_rounds_ok: bool | None = None
+
+
+def rounds_pallas_available() -> bool:
+    """Probe-once gate for PRODUCTION dispatch of the Pallas round scan.
+
+    Stricter than a compile check: the probe runs a representative
+    multi-round instance through the real Mosaic lowering and
+    BIT-COMPARES it against the XLA scan — a kernel that compiles but
+    miscompiles (e.g. an unsupported roll silently mislowered) must
+    never reach a rebalance, because round-scan wrongness is a silent
+    assignment corruption, not an error.  Any failure (lowering error,
+    parity mismatch, CPU backend) disables the path for the process;
+    the XLA scan is always the fallback.  Resolve EAGERLY before any
+    jit trace (same contract as plan_stats._pallas_available)."""
+    global _pallas_rounds_ok
+    if _pallas_rounds_ok is None:
+        import jax as _jax
+
+        from .plan_stats import _trace_state_clean
+
+        if not _trace_state_clean():
+            return False  # unknown while tracing: don't probe, don't cache
+        if _jax.default_backend() == "cpu":
+            _pallas_rounds_ok = False
+            return False
+        try:
+            from .rounds_kernel import _rounds_scan
+
+            rng = np.random.default_rng(0)
+            P, C = 4096, 1000
+            lags = jnp.asarray(
+                -np.sort(-rng.integers(0, 10**6, size=P)).astype(np.int64)
+            )
+            valid = jnp.ones((P,), bool)
+            ref_t, ref_c = _rounds_scan(
+                lags, valid, jnp.zeros((C,), jnp.int64), C, n_valid=P
+            )
+            p_t, p_c = assign_sorted_rounds_pallas(
+                lags, valid, num_consumers=C, n_valid=P,
+                total_lag_bound=int(np.asarray(lags).sum()),
+            )
+            _pallas_rounds_ok = bool(
+                (np.asarray(p_c) == np.asarray(ref_c)).all()
+                and (np.asarray(p_t) == np.asarray(ref_t)).all()
+            )
+            if not _pallas_rounds_ok:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "Pallas round-scan compiled but FAILED device parity; "
+                    "staying on the XLA scan"
+                )
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "Pallas round-scan unavailable; using the XLA scan",
+                exc_info=True,
+            )
+            _pallas_rounds_ok = False
+    return _pallas_rounds_ok
+
 
 def pallas_rounds_supported(
     num_consumers: int, total_lag_bound: int, num_rounds: int
@@ -243,8 +306,6 @@ def assign_sorted_rounds_pallas(
     ENFORCED here, because an out-of-gate instance would not fail loudly
     — an int32-overflowing lag would silently read as padding.
     """
-    from .rounds_kernel import round_rows
-
     C = int(num_consumers)
     P = sorted_lags.shape[0]
     L = min(int(n_valid), P)
@@ -261,6 +322,25 @@ def assign_sorted_rounds_pallas(
             jnp.zeros((C,), jnp.int64),
             jnp.full((P,), -1, jnp.int32),
         )
+    return sorted_rounds_pallas_core(
+        sorted_lags, sorted_valid, num_consumers=C, n_valid=n_valid,
+        interpret=interpret,
+    )
+
+
+def sorted_rounds_pallas_core(
+    sorted_lags, sorted_valid, num_consumers: int, n_valid: int,
+    interpret: bool = False,
+):
+    """Traced core of the adapter — NO admission gate, usable inside an
+    outer jit (the gate bound is per-call data, so checking it here would
+    either trace-specialize on it or silently skip it; callers verify
+    :func:`pallas_rounds_supported` host-side first).  Same round-row
+    shaping as the XLA scan (shared helper)."""
+    from .rounds_kernel import round_rows
+
+    C = int(num_consumers)
+    P = sorted_lags.shape[0]
     lags_h, valid_h, R, head = round_rows(
         jnp.asarray(sorted_lags), jnp.asarray(sorted_valid), C, n_valid
     )
