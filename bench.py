@@ -164,32 +164,49 @@ def interleaved_floor(real_once, floor_once, iters: int = 20):
     }, out
 
 
-def device_compute_amortized_ms(lags: np.ndarray, C: int, n_hi: int = 8):
+def device_compute_amortized_ms(
+    lags: np.ndarray, C: int, n_hi: int = 8, kernel: str = "xla"
+):
     """Isolate the solve's pure device compute: run the full kernel n
     times over independent inputs INSIDE one executable (lax.map is a
     sequential scan) ending in a scalar fetch, at n=1 and n=n_hi; the
     difference divided by (n_hi - 1) cancels both the round-trip and the
     dispatch overhead.  (block_until_ready is NOT a valid clock on this
     tunneled platform — it returns at dispatch, measured in
-    tools/probe_round5b.py — so the fetch is the only real sync.)"""
+    tools/probe_round5b.py — so the fetch is the only real sync.)
+
+    ``kernel`` selects the XLA rounds scan or the Pallas in-VMEM round
+    scan (the caller checks the Pallas gates first)."""
     import functools
 
     import jax
     import jax.numpy as jnp
     from jax import lax
 
-    from kafka_lag_based_assignor_tpu.ops.batched import _stream_device
+    from kafka_lag_based_assignor_tpu.ops.batched import (
+        _stream_device,
+        _stream_device_pallas,
+    )
 
     payload, shift, rb = _stream_args(lags, C)
     batch = jax.device_put(
         np.stack([np.roll(payload, 7919 * i) for i in range(n_hi)])
     )
 
+    if kernel == "pallas":
+        def solve(v):
+            return _stream_device_pallas(
+                v, num_consumers=C, pack_shift=shift
+            )
+    else:
+        def solve(v):
+            return _stream_device(
+                v, num_consumers=C, pack_shift=shift, totals_rank_bits=rb
+            )
+
     @functools.partial(jax.jit, static_argnames=("n",))
     def many(b, n):
-        f = lambda v: _stream_device(  # noqa: E731
-            v, num_consumers=C, pack_shift=shift, totals_rank_bits=rb
-        ).astype(jnp.int32).sum()
+        f = lambda v: solve(v).astype(jnp.int32).sum()  # noqa: E731
         return lax.map(f, b[:n]).sum()
 
     def timed(n, iters=8):
@@ -537,6 +554,20 @@ def config5_northstar():
         else "cpu_fallback_compute_amortized_ms"
     )
     phases[amortized_key] = device_compute_amortized_ms(lags0, C)
+    # The headline path may route through the Pallas round scan on
+    # hardware (batched.assign_stream's gates); record ITS amortized
+    # compute too so both kernels have a datapoint.
+    from kafka_lag_based_assignor_tpu.ops.rounds_pallas import (
+        pallas_rounds_supported,
+        rounds_pallas_available,
+    )
+
+    if pallas_rounds_supported(
+        C, int(lags0.sum()), -(-len(lags0) // C)
+    ) and rounds_pallas_available():
+        phases["device_compute_amortized_pallas_ms"] = (
+            device_compute_amortized_ms(lags0, C, kernel="pallas")
+        )
 
     # Reference-algorithm baseline on host (same machine, same input).
     base_totals, base_ms = host_baseline_greedy(lags0, C)
