@@ -100,3 +100,44 @@ def test_adapter_enforces_gate_and_empty_input():
     )
     np.testing.assert_array_equal(np.asarray(choice), [-1, -1])
     np.testing.assert_array_equal(np.asarray(totals), [0, 0])
+
+
+def test_stream_plumbing_parity_interpret():
+    """The full stream composition around the Pallas core — packed
+    processing-order sort, core scan, unsort — must reproduce
+    assign_stream's choices exactly (interpret mode; the compiled-path
+    equivalence is enforced on-device by rounds_pallas_available's
+    bit-compare probe before production dispatch)."""
+    import jax.numpy as jnp2
+
+    from kafka_lag_based_assignor_tpu.ops.batched import (
+        assign_stream,
+        stream_payload,
+    )
+    from kafka_lag_based_assignor_tpu.ops.packing import pad_bucket
+    from kafka_lag_based_assignor_tpu.ops.rounds_pallas import (
+        sorted_rounds_pallas_core,
+    )
+    from kafka_lag_based_assignor_tpu.ops.scan_kernel import (
+        sort_partitions_with,
+    )
+    from kafka_lag_based_assignor_tpu.ops.sortops import unsort
+
+    rng = np.random.default_rng(9)
+    P, C = 3000, 37
+    lags = rng.integers(0, 10**6, size=P).astype(np.int64)
+    lags[rng.random(P) < 0.3] = 0  # ties
+
+    ref = np.asarray(assign_stream(lags, num_consumers=C))
+
+    payload, shift = stream_payload(lags)
+    B = pad_bucket(P)
+    lags_p = jnp2.pad(jnp2.asarray(payload).astype(jnp2.int64), (0, B - P))
+    pids = jnp2.arange(B, dtype=jnp2.int32)
+    valid = pids < P
+    perm, sl, sv = sort_partitions_with(lags_p, pids, valid, shift)
+    _, flat = sorted_rounds_pallas_core(
+        sl, sv, num_consumers=C, n_valid=P, interpret=True
+    )
+    got = np.asarray(unsort(perm, flat))[:P]
+    np.testing.assert_array_equal(got, ref)
