@@ -141,3 +141,51 @@ def test_stream_plumbing_parity_interpret():
     )
     got = np.asarray(unsort(perm, flat))[:P]
     np.testing.assert_array_equal(got, ref)
+
+
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+
+@st.composite
+def pallas_instances(draw):
+    """Admissible Pallas instances: random P/C, tie-heavy or spread lags,
+    random valid prefix — Hypothesis shrinks any parity violation."""
+    C = draw(st.integers(1, 64))
+    P = draw(st.integers(1, 300))
+    style = draw(st.integers(0, 2))
+    rng = np.random.default_rng(draw(st.integers(0, 2**31)))
+    if style == 0:
+        vals = rng.integers(0, 4, size=P)  # tie-heavy
+    elif style == 1:
+        vals = rng.integers(0, 10**6, size=P)
+    else:
+        vals = rng.integers(0, 2**28, size=P)  # near the totals gate
+    n_valid = draw(st.integers(0, P))
+    lags = np.zeros(P, dtype=np.int64)
+    lags[:n_valid] = -np.sort(-vals[:n_valid].astype(np.int64))
+    valid = np.arange(P) < n_valid
+    return lags, valid, n_valid, C
+
+
+@settings(max_examples=30, deadline=None)
+@given(pallas_instances())
+def test_pallas_fuzz_matches_xla(instance):
+    lags, valid, n_valid, C = instance
+    total = int(lags.sum())
+    rounds = max(-(-len(lags) // C), 1)
+    if not pallas_rounds_supported(C, total, rounds):
+        return  # outside the gate (the near-gate style can exceed it)
+    ref_totals, ref_choice = _rounds_scan(
+        jnp.asarray(lags), jnp.asarray(valid),
+        jnp.zeros((C,), jnp.int64), C, n_valid=n_valid,
+    )
+    p_totals, p_choice = assign_sorted_rounds_pallas(
+        lags, valid, num_consumers=C, n_valid=n_valid,
+        total_lag_bound=max(total, 1), interpret=True,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(p_choice), np.asarray(ref_choice)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(p_totals), np.asarray(ref_totals)
+    )
