@@ -747,6 +747,20 @@ def main():
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     log(f"bench devices: {jax.devices()}")
 
+    if not device_fallback:
+        # Resolve the Pallas round-scan gate ONCE, off every timed path:
+        # the probe bit-compares and races the kernel on the device
+        # (several compiles); assign_stream then dispatches accordingly.
+        try:
+            from kafka_lag_based_assignor_tpu.ops.rounds_pallas import (
+                rounds_pallas_available,
+            )
+
+            log(f"pallas round-scan enabled: "
+                f"{rounds_pallas_available(run_probe=True)}")
+        except Exception as exc:  # noqa: BLE001 — bench must not die
+            log(f"pallas probe failed: {type(exc).__name__}: {exc}")
+
     results = {
         "harness": {
             "rtt_floor_ms": rtt_floor_ms(),

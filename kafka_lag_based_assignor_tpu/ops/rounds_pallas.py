@@ -141,56 +141,121 @@ _VMEM_BUDGET_BYTES = 12 * 1024 * 1024
 _pallas_rounds_ok: bool | None = None
 
 
-def rounds_pallas_available() -> bool:
-    """Probe-once gate for PRODUCTION dispatch of the Pallas round scan.
-
-    Stricter than a compile check: the probe runs a representative
-    multi-round instance through the real Mosaic lowering and
-    BIT-COMPARES it against the XLA scan — a kernel that compiles but
+def _probe_parity() -> bool:
+    """Bit-compare the real Mosaic lowering against the XLA scan on a
+    representative multi-round instance — a kernel that compiles but
     miscompiles (e.g. an unsupported roll silently mislowered) must
     never reach a rebalance, because round-scan wrongness is a silent
-    assignment corruption, not an error.  Any failure (lowering error,
-    parity mismatch, CPU backend) disables the path for the process;
-    the XLA scan is always the fallback.  Resolve EAGERLY before any
-    jit trace (same contract as plan_stats._pallas_available)."""
+    assignment corruption, not an error."""
+    from .rounds_kernel import _rounds_scan
+
+    rng = np.random.default_rng(0)
+    P, C = 4096, 1000
+    lags = jnp.asarray(
+        -np.sort(-rng.integers(0, 10**6, size=P)).astype(np.int64)
+    )
+    valid = jnp.ones((P,), bool)
+    ref_t, ref_c = _rounds_scan(
+        lags, valid, jnp.zeros((C,), jnp.int64), C, n_valid=P
+    )
+    p_t, p_c = assign_sorted_rounds_pallas(
+        lags, valid, num_consumers=C, n_valid=P,
+        total_lag_bound=int(np.asarray(lags).sum()),
+    )
+    return bool(
+        (np.asarray(p_c) == np.asarray(ref_c)).all()
+        and (np.asarray(p_t) == np.asarray(ref_t)).all()
+    )
+
+
+def _probe_speed(margin: float = 0.9) -> bool:
+    """Race the two kernels at a round count large enough for the
+    difference to clear the tunnel's RTT noise (n in-executable repeats,
+    scalar fetch — the only valid clock on this platform): enable the
+    Pallas path only when it is at least ``1/margin`` x faster.  A
+    lowering that is correct but SLOW (e.g. rolls lowered as copies)
+    must not regress the headline just because it compiled."""
+    import functools
+    import time
+
+    from jax import lax
+
+    from .rounds_kernel import _rounds_scan
+
+    P, C, n = 65536, 1000, 8
+    rng = np.random.default_rng(1)
+    lags = -np.sort(-rng.integers(0, 10**6, size=P)).astype(np.int64)
+    batch = jax.device_put(
+        np.stack([np.roll(lags, 7919 * i) for i in range(n)])
+    )
+    valid = jnp.ones((P,), bool)
+
+    @functools.partial(jax.jit, static_argnames=("kind",))
+    def many(b, kind: str):
+        def one(v):
+            if kind == "pallas":
+                _, c = sorted_rounds_pallas_core(
+                    v, valid, num_consumers=C, n_valid=P
+                )
+            else:
+                _, c = _rounds_scan(
+                    v, valid, jnp.zeros((C,), jnp.int64), C, n_valid=P
+                )
+            return c.astype(jnp.int32).sum()
+
+        return lax.map(one, b).sum()
+
+    def timed(kind):
+        int(many(batch, kind=kind))  # warm-up/compile
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            int(many(batch, kind=kind))
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts))
+
+    t_xla, t_pal = timed("xla"), timed("pallas")
+    import logging
+
+    logging.getLogger(__name__).info(
+        "pallas round-scan race: xla %.1f ms vs pallas %.1f ms (x%d "
+        "in-executable)", t_xla * 1e3, t_pal * 1e3, n,
+    )
+    return t_pal < t_xla * margin
+
+
+def rounds_pallas_available(run_probe: bool = False) -> bool:
+    """Probe-once gate for PRODUCTION dispatch of the Pallas round scan.
+
+    The probe (parity bit-compare + a speed race vs the XLA scan, both
+    on the real device) costs several executable compiles — minutes
+    through a remote-compile transport — so it NEVER runs implicitly on
+    a rebalance path: callers that can afford it (configure-time warm-up,
+    the benchmark harness, the hardware probe script) pass
+    ``run_probe=True`` once; until then, and on any failure, the answer
+    is False and the XLA scan serves.  Resolve EAGERLY before any jit
+    trace (same contract as plan_stats._pallas_available)."""
     global _pallas_rounds_ok
     if _pallas_rounds_ok is None:
         import jax as _jax
 
         from .plan_stats import _trace_state_clean
 
-        if not _trace_state_clean():
-            return False  # unknown while tracing: don't probe, don't cache
+        if not run_probe or not _trace_state_clean():
+            return False  # unprobed (or mid-trace): stay on the XLA scan
         if _jax.default_backend() == "cpu":
             _pallas_rounds_ok = False
             return False
         try:
-            from .rounds_kernel import _rounds_scan
-
-            rng = np.random.default_rng(0)
-            P, C = 4096, 1000
-            lags = jnp.asarray(
-                -np.sort(-rng.integers(0, 10**6, size=P)).astype(np.int64)
-            )
-            valid = jnp.ones((P,), bool)
-            ref_t, ref_c = _rounds_scan(
-                lags, valid, jnp.zeros((C,), jnp.int64), C, n_valid=P
-            )
-            p_t, p_c = assign_sorted_rounds_pallas(
-                lags, valid, num_consumers=C, n_valid=P,
-                total_lag_bound=int(np.asarray(lags).sum()),
-            )
-            _pallas_rounds_ok = bool(
-                (np.asarray(p_c) == np.asarray(ref_c)).all()
-                and (np.asarray(p_t) == np.asarray(ref_t)).all()
-            )
-            if not _pallas_rounds_ok:
+            ok = _probe_parity()
+            if not ok:
                 import logging
 
                 logging.getLogger(__name__).warning(
-                    "Pallas round-scan compiled but FAILED device parity; "
-                    "staying on the XLA scan"
+                    "Pallas round-scan compiled but FAILED device "
+                    "parity; staying on the XLA scan"
                 )
+            _pallas_rounds_ok = ok and _probe_speed()
         except Exception:
             import logging
 

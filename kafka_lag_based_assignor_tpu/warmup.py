@@ -127,8 +127,14 @@ def warmup(
                     # skip it (the no-op fast path) and leave the warm
                     # executable cold.
                     from .ops.batched import assign_stream
+                    from .ops.rounds_pallas import rounds_pallas_available
                     from .ops.streaming import StreamingAssignor
 
+                    # Resolve the Pallas round-scan gate here (parity +
+                    # speed race on the device, several compiles) so no
+                    # rebalance ever pays it; assign_stream below then
+                    # warms whichever kernel the gate selected.
+                    rounds_pallas_available(run_probe=True)
                     engine = StreamingAssignor(
                         num_consumers=C, refine_iters=stream_refine_iters,
                         refine_threshold=None,
