@@ -349,6 +349,30 @@ def _stream_global_device(
     return _narrow_choice(choice[:, :P], num_consumers), totals
 
 
+@functools.partial(
+    jax.jit, static_argnames=("num_consumers", "pack_shift")
+)
+def _stream_global_device_pallas(
+    lags, num_consumers: int, pack_shift: int = 0
+):
+    """Global-mode inner with the Pallas round scan: per-topic sorts are
+    parallel (vmap), then the ENTIRE cross-topic sequential chain — every
+    topic's rounds with carried totals — runs as one in-VMEM kernel.
+    Same transfer contract as :func:`_stream_global_device`; callers must
+    have passed both Pallas gates."""
+    from .rounds_pallas import global_rounds_pallas_core
+    from .scan_kernel import sort_partitions_with
+
+    lags_p, pids, valid, P = _dense_batch_inputs(lags)
+    perms, sl, sv = jax.vmap(
+        functools.partial(sort_partitions_with, pack_shift=pack_shift)
+    )(lags_p, pids, valid)
+    totals, choice = global_rounds_pallas_core(
+        sl, sv, perms, num_consumers=num_consumers, n_valid=P
+    )
+    return _narrow_choice(choice[:, :P], num_consumers), totals
+
+
 def assign_stream_global(lags, num_consumers: int):
     """Transfer-lean dense batch path for the GLOBAL (cross-topic lag
     balance) quality mode: upload the [T, P] lag matrix only, read back
@@ -364,6 +388,25 @@ def assign_stream_global(lags, num_consumers: int):
     # The global kernel's totals carry across topics: bound by the WHOLE
     # batch's sum, not per-topic row sums.
     rb = totals_rank_bits_for(payload.reshape(1, -1), num_consumers)
+    if num_consumers <= 1024:
+        from .rounds_pallas import (
+            pallas_rounds_supported,
+            rounds_pallas_available,
+        )
+
+        T, P = lags.shape
+        total = int(min(float(np.sum(lags, dtype=np.float64)), 2.0**62))
+        rounds = T * max(-(-P // num_consumers), 1)
+        if pallas_rounds_supported(
+            num_consumers, total, rounds
+        ) and rounds_pallas_available():
+            observe_pack_shift(
+                ("stream_global_pallas", payload.shape, num_consumers),
+                shift,
+            )
+            return _stream_global_device_pallas(
+                payload, num_consumers=num_consumers, pack_shift=shift
+            )
     observe_pack_shift(
         ("stream_global", payload.shape, num_consumers), (shift, rb)
     )

@@ -393,6 +393,52 @@ def assign_sorted_rounds_pallas(
     )
 
 
+def global_rounds_pallas_core(
+    sorted_lags, sorted_valid, perms, num_consumers: int, n_valid: int,
+    interpret: bool = False,
+):
+    """Cross-topic GLOBAL mode through the same kernel: the global solve
+    IS one long round sequence — each topic contributes ceil(P/C) rounds
+    and the totals carry across topics without reset (exactly what the
+    kernel's loop-carried planes do), so concatenating every topic's
+    round rows into one [T*R, C] gains matrix reproduces
+    :func:`..ops.rounds_kernel.assign_global_rounds` bit-exactly while
+    the whole sequential chain stays in VMEM.
+
+    Args: sorted_lags/sorted_valid [T, P] in per-topic processing order,
+    perms int32[T, P] (each topic's unsort permutation), static n_valid
+    (dense row count per topic).  Returns (totals int64[C] consumer
+    order, choice int32[T, P] in input row order).
+    """
+    from .rounds_kernel import round_rows
+    from .sortops import unsort
+
+    C = int(num_consumers)
+    T, P = sorted_lags.shape
+
+    def topic_rows(sl, sv):
+        lags_h, valid_h, R, head = round_rows(sl, sv, C, n_valid)
+        return (
+            jnp.where(valid_h, lags_h, -1).astype(jnp.int32).reshape(R, C)
+        )
+
+    gains = jax.vmap(topic_rows)(sorted_lags, sorted_valid)  # [T, R, C]
+    R = gains.shape[1]
+    totals, choice_rows = rounds_scan_pallas(
+        gains.reshape(T * R, C), num_consumers=C, interpret=interpret
+    )
+    head = R * C
+    flat = choice_rows.reshape(T, head)
+    if head < P:
+        flat = jnp.concatenate(
+            [flat, jnp.full((T, P - head), -1, jnp.int32)], axis=1
+        )
+    else:
+        flat = flat[:, :P]
+    choice = jax.vmap(unsort)(perms, flat)
+    return totals.astype(jnp.int64), choice
+
+
 def sorted_rounds_pallas_core(
     sorted_lags, sorted_valid, num_consumers: int, n_valid: int,
     interpret: bool = False,

@@ -189,3 +189,46 @@ def test_pallas_fuzz_matches_xla(instance):
     np.testing.assert_array_equal(
         np.asarray(p_totals), np.asarray(ref_totals)
     )
+
+
+@pytest.mark.parametrize("T,P,C", [(5, 64, 8), (3, 40, 64), (8, 17, 4)])
+def test_global_pallas_matches_xla(T, P, C):
+    """The global mode IS one long round sequence with carried totals —
+    the concatenated-rounds Pallas composition must be bit-identical to
+    assign_global_rounds (dense batch, including P < C topics)."""
+    import functools as ft
+
+    import jax as jx
+
+    from kafka_lag_based_assignor_tpu.ops.rounds_kernel import (
+        assign_global_rounds,
+    )
+    from kafka_lag_based_assignor_tpu.ops.rounds_pallas import (
+        global_rounds_pallas_core,
+    )
+    from kafka_lag_based_assignor_tpu.ops.scan_kernel import (
+        sort_partitions_with,
+    )
+
+    rng = np.random.default_rng(T * 100 + P)
+    lags = rng.integers(0, 10**6, size=(T, P)).astype(np.int64)
+    lags[rng.random((T, P)) < 0.3] = 0  # ties
+    pids = np.tile(np.arange(P, dtype=np.int32), (T, 1))
+    valid = np.ones((T, P), dtype=bool)
+
+    ref_choice, _, ref_totals = assign_global_rounds(
+        lags, pids, valid, num_consumers=C, n_valid=P
+    )
+
+    perms, sl, sv = jx.vmap(
+        ft.partial(sort_partitions_with, pack_shift=0)
+    )(jnp.asarray(lags), jnp.asarray(pids), jnp.asarray(valid))
+    p_totals, p_choice = global_rounds_pallas_core(
+        sl, sv, perms, num_consumers=C, n_valid=P, interpret=True
+    )
+    np.testing.assert_array_equal(
+        np.asarray(p_choice), np.asarray(ref_choice)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(p_totals), np.asarray(ref_totals)
+    )
