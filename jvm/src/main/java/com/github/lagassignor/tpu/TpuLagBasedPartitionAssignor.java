@@ -89,6 +89,13 @@ public class TpuLagBasedPartitionAssignor
     public static final String SIDECAR_TIMEOUT_MS_CONFIG =
             "tpu.assignor.sidecar.timeout.ms";
     public static final String SOLVER_CONFIG = "tpu.assignor.solver";
+    /** One-shot quality mode: exchange-refinement rounds chained into
+     *  the sidecar solve (same key as the Python plugin; NOT bit-parity
+     *  with the reference, so unset keeps strict parity).  Only
+     *  marshaled when set; rejected by the sidecar for the 'global'
+     *  solver. */
+    public static final String REFINE_ITERS_CONFIG =
+            "tpu.assignor.refine.iters";
 
     private Properties consumerGroupProps;
     private Properties metadataConsumerProps;
@@ -98,6 +105,7 @@ public class TpuLagBasedPartitionAssignor
     private int sidecarPort = 7531;
     private int sidecarTimeoutMs = 120_000;
     private String solver = "rounds";
+    private Long refineIters;  // null = strict parity (no option sent)
     private long requestId = 0;
 
     // ------------------------------------------------------------------
@@ -138,6 +146,9 @@ public class TpuLagBasedPartitionAssignor
                 SIDECAR_TIMEOUT_MS_CONFIG,
                 Integer.toString(sidecarTimeoutMs)));
         solver = consumerGroupProps.getProperty(SOLVER_CONFIG, solver);
+        String refine = consumerGroupProps.getProperty(REFINE_ITERS_CONFIG);
+        refineIters = (refine == null || refine.isEmpty()
+                || "auto".equals(refine)) ? null : Long.valueOf(refine);
         LOG.debug("configured {} assignor: sidecar {}:{} solver {}",
                 PROTOCOL_NAME, sidecarHost, sidecarPort, solver);
     }
@@ -265,7 +276,7 @@ public class TpuLagBasedPartitionAssignor
             Map<String, List<long[]>> topicLags,
             Map<String, List<String>> memberTopics) throws IOException {
         String request = buildAssignRequest(
-                ++requestId, topicLags, memberTopics, solver);
+                ++requestId, topicLags, memberTopics, solver, refineIters);
         return parseAssignResponse(roundTrip(request));
     }
 
@@ -273,10 +284,12 @@ public class TpuLagBasedPartitionAssignor
      * Marshal one {@code assign} request line (byte shape pinned by the
      * {@code assign_*} entries of tests/fixtures/wire_conformance.jsonl).
      * Static and socket-free so the Java tests can assert the exact bytes.
+     * {@code refineIters} null sends no options (strict parity).
      */
     static String buildAssignRequest(long id,
             Map<String, List<long[]>> topicLags,
-            Map<String, List<String>> memberTopics, String solver) {
+            Map<String, List<String>> memberTopics, String solver,
+            Long refineIters) {
         StringBuilder sb = new StringBuilder(1 << 16);
         sb.append("{\"id\": ").append(id)
           .append(", \"method\": \"assign\", \"params\": {\"topics\": {");
@@ -309,6 +322,10 @@ public class TpuLagBasedPartitionAssignor
         }
         sb.append("}, \"solver\": ");
         Json.writeString(sb, solver);
+        if (refineIters != null) {
+            sb.append(", \"options\": {\"refine_iters\": ")
+              .append(refineIters.longValue()).append('}');
+        }
         sb.append("}}");
         return sb.toString();
     }

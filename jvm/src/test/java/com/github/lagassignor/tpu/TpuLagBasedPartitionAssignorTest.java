@@ -276,7 +276,29 @@ public class TpuLagBasedPartitionAssignorTest {
                                         new long[] {1, 50000},
                                         new long[] {2, 60000}))),
                         readmeSubscriptions(),
-                        "rounds"));
+                        "rounds",
+                        null));
+    }
+
+    @Test
+    public void assignRequestWithRefineOptionMatchesPinnedFixture() {
+        // Byte-for-byte the "assign_rounds_refined_option" fixture line.
+        assertEquals(
+                "{\"id\": 24, \"method\": \"assign\", \"params\": "
+                + "{\"topics\": {\"t0\": [[0, 100000], [1, 50000], "
+                + "[2, 60000]]}, \"subscriptions\": {\"C0\": [\"t0\"], "
+                + "\"C1\": [\"t0\"]}, \"solver\": \"rounds\", "
+                + "\"options\": {\"refine_iters\": 16}}}",
+                TpuLagBasedPartitionAssignor.buildAssignRequest(
+                        24,
+                        new TreeMap<>(Collections.singletonMap(
+                                "t0", Arrays.asList(
+                                        new long[] {0, 100000},
+                                        new long[] {1, 50000},
+                                        new long[] {2, 60000}))),
+                        readmeSubscriptions(),
+                        "rounds",
+                        Long.valueOf(16)));
     }
 
     private static Map<String, List<String>> readmeSubscriptions() {
