@@ -77,6 +77,45 @@ class StreamingStats:
 
 
 @functools.partial(
+    jax.jit,
+    static_argnames=(
+        "num_consumers", "pack_shift", "iters", "max_pairs", "bucket",
+        "interpret",
+    ),
+)
+def _pallas_cold_chain(
+    lags, num_consumers: int, pack_shift: int, iters: int, max_pairs,
+    bucket: int, interpret: bool = False,
+):
+    """Cold solve -> refine as ONE dispatch with the Pallas round scan
+    (the in-VMEM variant of :meth:`StreamingAssignor._cold_solve`'s
+    chained path).  Same contract as solve + :func:`_refine_chain`:
+    exact-shape lags in, (narrow choice[P], padded refined int32[bucket]
+    kept device-resident by the caller) out.  Callers must have passed
+    BOTH Pallas gates host-side."""
+    from .rounds_pallas import sorted_rounds_pallas_core
+    from .scan_kernel import sort_partitions_with
+    from .sortops import unsort
+
+    P = lags.shape[0]
+    B = int(bucket)
+    lags_p = jnp.pad(lags.astype(jnp.int64), (0, B - P))
+    pids = jnp.arange(B, dtype=jnp.int32)
+    valid = pids < P
+    perm, sl, sv = sort_partitions_with(lags_p, pids, valid, pack_shift)
+    _, flat = sorted_rounds_pallas_core(
+        sl, sv, num_consumers=num_consumers, n_valid=P,
+        interpret=interpret,
+    )
+    choice = unsort(perm, flat)
+    refined, _, _ = refine_assignment(
+        lags_p, valid, choice, num_consumers=num_consumers,
+        iters=iters, max_pairs=max_pairs,
+    )
+    return _narrow_choice(refined[:P], num_consumers), refined
+
+
+@functools.partial(
     jax.jit, static_argnames=("num_consumers", "iters", "max_pairs", "bucket")
 )
 def _refine_chain(
@@ -288,6 +327,32 @@ class StreamingAssignor:
 
             payload, shift = stream_payload(lags)
             rb = totals_rank_bits_for(payload, C)
+            # Pallas in-VMEM solve + refine in one dispatch when both
+            # gates pass (same condition set as assign_stream; the
+            # probe-once gate never probes here — warm-up/bench resolve
+            # it off the rebalance path).
+            if C <= 1024:
+                from .rounds_pallas import (
+                    pallas_rounds_supported,
+                    rounds_pallas_available,
+                )
+
+                total = int(
+                    min(float(np.sum(lags, dtype=np.float64)), 2.0**62)
+                )
+                if pallas_rounds_supported(
+                    C, total, -(-P // C)
+                ) and rounds_pallas_available():
+                    observe_pack_shift(
+                        ("cold_pallas", lags.shape, C), shift
+                    )
+                    narrow, refined_pad = _pallas_cold_chain(
+                        payload, num_consumers=C, pack_shift=shift,
+                        iters=self.cold_refine_iters, max_pairs=None,
+                        bucket=self._bucket(P),
+                    )
+                    self._choice_dev = refined_pad
+                    return np.asarray(narrow).astype(np.int32)
             observe_pack_shift(("stream", lags.shape, C), (shift, rb))
             payload = jax.device_put(payload)  # ONE upload, both kernels
             choice0 = _stream_device(

@@ -232,3 +232,41 @@ def test_global_pallas_matches_xla(T, P, C):
     np.testing.assert_array_equal(
         np.asarray(p_totals), np.asarray(ref_totals)
     )
+
+
+def test_cold_chain_matches_xla_chain_interpret():
+    """The Pallas cold chain (solve -> refine, one dispatch) must produce
+    exactly what the XLA cold chain produces from the same budgets: both
+    refine from the SAME (bit-parity) greedy start with identical static
+    args, and the refinement is deterministic."""
+    from kafka_lag_based_assignor_tpu.ops.batched import (
+        _stream_device,
+        stream_payload,
+    )
+    from kafka_lag_based_assignor_tpu.ops.packing import pad_bucket
+    from kafka_lag_based_assignor_tpu.ops.streaming import (
+        _pallas_cold_chain,
+        _refine_chain,
+    )
+
+    rng = np.random.default_rng(17)
+    P, C = 2000, 16
+    lags = rng.integers(0, 10**6, size=P).astype(np.int64)
+    payload, shift = stream_payload(lags)
+    B = pad_bucket(P)
+
+    choice0 = _stream_device(
+        payload, num_consumers=C, pack_shift=shift
+    )
+    ref_narrow, ref_pad = _refine_chain(
+        payload, choice0, num_consumers=C, iters=16, max_pairs=None,
+        bucket=B,
+    )
+    p_narrow, p_pad = _pallas_cold_chain(
+        payload, num_consumers=C, pack_shift=shift, iters=16,
+        max_pairs=None, bucket=B, interpret=True,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(p_narrow), np.asarray(ref_narrow)
+    )
+    np.testing.assert_array_equal(np.asarray(p_pad), np.asarray(ref_pad))
