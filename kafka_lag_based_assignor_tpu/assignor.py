@@ -258,6 +258,7 @@ class LagBasedPartitionAssignor:
                 exc_info=True,
             )
             stats.fallback_used = True
+            stats.refine_iters = None  # the host fallback never refines
             return host_fallback_for(solver)(lags, topic_subscriptions)
 
     @staticmethod

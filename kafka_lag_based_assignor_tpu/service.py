@@ -282,6 +282,13 @@ def _solve(
         num_topics=len(lag_map),
         num_partitions=sum(len(v) for v in lag_map.values()),
         num_members=len(subs),
+        # Same operator contract as the in-process plugin: a stats record
+        # must say whether the assignment is refined or bit-parity.
+        refine_iters=(
+            (options or {}).get("refine_iters")
+            if solver in ("rounds", "scan", "sinkhorn") and not fallback_used
+            else None
+        ),
     )
     stats.fallback_used = fallback_used
     lag_by_tp = {
