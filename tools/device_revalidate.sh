@@ -11,10 +11,14 @@ import jax, numpy as np
 x = jax.device_put(np.arange(8, dtype=np.int32))
 assert int(jax.jit(lambda v: (v+1).sum())(x)) == 36
 print('device alive:', jax.devices())" || { echo "device unreachable"; exit 1; }
-echo "== kernel probe (probe_round5f) =="
-timeout 2400 python tools/probe_round5f.py 2>&1 | grep -vE "WARN|INFO|warning"
-echo "== round-body sweep (probe_round6) =="
-timeout 2400 python tools/probe_round6.py 2>&1 | grep -vE "WARN|INFO|warning"
+# Bench FIRST: it is the driver-relevant artifact, and the tunnel has
+# re-wedged mid-session before — secure BENCH_DETAILS while the window
+# is open, then spend remaining time on the engineering probes.
 echo "== full bench =="
 timeout 3600 python bench.py
-echo "== done; BENCH_DETAILS.json updated =="
+echo "== BENCH_DETAILS.json updated =="
+echo "== round-body sweep (probe_round6) =="
+timeout 2400 python tools/probe_round6.py 2>&1 | grep -vE "WARN|INFO|warning"
+echo "== kernel probe (probe_round5f) =="
+timeout 2400 python tools/probe_round5f.py 2>&1 | grep -vE "WARN|INFO|warning"
+echo "== done =="
