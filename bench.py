@@ -165,7 +165,8 @@ def interleaved_floor(real_once, floor_once, iters: int = 20):
 
 
 def device_compute_amortized_ms(
-    lags: np.ndarray, C: int, n_hi: int = 8, kernel: str = "xla"
+    lags: np.ndarray, C: int, n_hi: int = 8, kernel: str = "xla",
+    wide: bool = False,
 ):
     """Isolate the solve's pure device compute: run the full kernel n
     times over independent inputs INSIDE one executable (lax.map is a
@@ -196,7 +197,7 @@ def device_compute_amortized_ms(
     if kernel == "pallas":
         def solve(v):
             return _stream_device_pallas(
-                v, num_consumers=C, pack_shift=shift
+                v, num_consumers=C, pack_shift=shift, wide=wide
             )
     else:
         def solve(v):
@@ -558,15 +559,17 @@ def config5_northstar():
     # hardware (batched.assign_stream's gates); record ITS amortized
     # compute too so both kernels have a datapoint.
     from kafka_lag_based_assignor_tpu.ops.rounds_pallas import (
-        pallas_rounds_supported,
+        pallas_mode_for,
         rounds_pallas_available,
     )
 
-    if pallas_rounds_supported(
-        C, int(lags0.sum()), -(-len(lags0) // C)
-    ) and rounds_pallas_available():
+    pallas_mode = pallas_mode_for(lags0, C, -(-len(lags0) // C))
+    if pallas_mode and rounds_pallas_available(mode=pallas_mode):
         phases["device_compute_amortized_pallas_ms"] = (
-            device_compute_amortized_ms(lags0, C, kernel="pallas")
+            device_compute_amortized_ms(
+                lags0, C, kernel="pallas",
+                wide=(pallas_mode == "wide"),
+            )
         )
 
     # Reference-algorithm baseline on host (same machine, same input).

@@ -221,10 +221,13 @@ def assign_stream_refined(lags, num_consumers: int, refine_iters: int = 64):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("num_consumers", "pack_shift", "refine_iters"),
+    static_argnames=(
+        "num_consumers", "pack_shift", "refine_iters", "wide"
+    ),
 )
 def _stream_device_pallas(
     lags, num_consumers: int, pack_shift: int = 0, refine_iters: int = 0,
+    wide: bool = False,
 ):
     """Accelerator inner with the Pallas in-VMEM round scan replacing the
     XLA scan (same transfer contract as :func:`_stream_device`).  Callers
@@ -249,7 +252,8 @@ def _stream_device_pallas(
         lags_p, pids, valid, pack_shift
     )
     _, flat = sorted_rounds_pallas_core(
-        sorted_lags, sorted_valid, num_consumers=num_consumers, n_valid=P
+        sorted_lags, sorted_valid, num_consumers=num_consumers, n_valid=P,
+        wide=wide,
     )
     choice = unsort(perm, flat)
     if refine_iters:
@@ -350,10 +354,10 @@ def _stream_global_device(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("num_consumers", "pack_shift")
+    jax.jit, static_argnames=("num_consumers", "pack_shift", "wide")
 )
 def _stream_global_device_pallas(
-    lags, num_consumers: int, pack_shift: int = 0
+    lags, num_consumers: int, pack_shift: int = 0, wide: bool = False
 ):
     """Global-mode inner with the Pallas round scan: per-topic sorts are
     parallel (vmap), then the ENTIRE cross-topic sequential chain — every
@@ -368,7 +372,7 @@ def _stream_global_device_pallas(
         functools.partial(sort_partitions_with, pack_shift=pack_shift)
     )(lags_p, pids, valid)
     totals, choice = global_rounds_pallas_core(
-        sl, sv, perms, num_consumers=num_consumers, n_valid=P
+        sl, sv, perms, num_consumers=num_consumers, n_valid=P, wide=wide
     )
     return _narrow_choice(choice[:, :P], num_consumers), totals
 
@@ -390,22 +394,21 @@ def assign_stream_global(lags, num_consumers: int):
     rb = totals_rank_bits_for(payload.reshape(1, -1), num_consumers)
     if num_consumers <= 1024:
         from .rounds_pallas import (
-            pallas_rounds_supported,
+            pallas_mode_for,
             rounds_pallas_available,
         )
 
         T, P = lags.shape
-        total = int(min(float(np.sum(lags, dtype=np.float64)), 2.0**62))
         rounds = T * max(-(-P // num_consumers), 1)
-        if pallas_rounds_supported(
-            num_consumers, total, rounds
-        ) and rounds_pallas_available():
+        mode = pallas_mode_for(lags, num_consumers, rounds)
+        if mode and rounds_pallas_available(mode=mode):
             observe_pack_shift(
                 ("stream_global_pallas", payload.shape, num_consumers),
-                shift,
+                (shift, mode),
             )
             return _stream_global_device_pallas(
-                payload, num_consumers=num_consumers, pack_shift=shift
+                payload, num_consumers=num_consumers, pack_shift=shift,
+                wide=(mode == "wide"),
             )
     observe_pack_shift(
         ("stream_global", payload.shape, num_consumers), (shift, rb)
@@ -491,25 +494,22 @@ def assign_stream(lags, num_consumers: int, refine_iters: int = 0):
         # use; any failure permanently falls back to the XLA scan).
         if num_consumers <= 1024:
             from .rounds_pallas import (
-                pallas_rounds_supported,
+                pallas_mode_for,
                 rounds_pallas_available,
             )
 
             P = lags.shape[0]
-            # f64 sum: an int64 wrap could alias a huge total to a small
-            # positive and sneak past the int32-totals gate.
-            total = int(
-                min(float(np.sum(lags, dtype=np.float64)), 2.0**62)
+            mode = pallas_mode_for(
+                lags, num_consumers, -(-P // num_consumers)
             )
-            if pallas_rounds_supported(
-                num_consumers, total, -(-P // num_consumers)
-            ) and rounds_pallas_available():
+            if mode and rounds_pallas_available(mode=mode):
                 observe_pack_shift(
-                    ("stream_pallas", lags.shape, num_consumers), shift
+                    ("stream_pallas", lags.shape, num_consumers),
+                    (shift, mode),
                 )
                 return _stream_device_pallas(
                     payload, num_consumers=num_consumers,
-                    pack_shift=shift, **refine,
+                    pack_shift=shift, wide=(mode == "wide"), **refine,
                 )
         # One observation key per executable-selecting tuple: a change in
         # EITHER static arg (pack shift or rank bits) recompiles.

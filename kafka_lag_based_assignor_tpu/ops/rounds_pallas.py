@@ -53,9 +53,14 @@ _SUB, _LANE = 8, 128
 # total (gated < 2**30) and never incremented (pad positions carry -1
 # gains), so pad slots sort strictly last every round.
 _SENTINEL = np.int32(2**31 - 1)
-# Total-lag admission bound: totals stay exactly representable in int32
-# with sentinel headroom.
+# Total-lag admission bound for the NARROW (single-int32-plane) kernel:
+# totals stay exactly representable in int32 with sentinel headroom.
 TOTALS_BOUND = 1 << 30
+# WIDE kernel bounds: totals as two int32 planes (63-bit effective with
+# sentinel headroom in the high plane); per-round gains remain a single
+# int32, so individual lags must fit 31 bits.
+WIDE_TOTALS_BOUND = 1 << 62
+MAX_LAG_BOUND = 1 << 31
 
 
 def _xor_shuffle(x, d: int):
@@ -106,6 +111,74 @@ def _bitonic_sort(t, ids):
     return t, ids
 
 
+def _bitonic_sort_wide(hi, lob, ids):
+    """Ascending (total, id) sort for WIDE (int64) totals held as two
+    int32 planes: ``hi`` = bits 32..62, ``lob`` = bits 0..31 BIASED by
+    xor 2^31 so SIGNED plane compares give the unsigned low-word order
+    (x ^ 2^31 == x + 2^31 mod 2^32, so the bias also commutes with the
+    wrap-add in the round body).  Same network as :func:`_bitonic_sort`
+    with a 3-way lexicographic compare and a third shuffled plane."""
+    idx = (
+        lax.broadcasted_iota(jnp.int32, (_SUB, _LANE), 0) * _LANE
+        + lax.broadcasted_iota(jnp.int32, (_SUB, _LANE), 1)
+    )
+    k = 2
+    while k <= C_PAD:
+        j = k // 2
+        while j >= 1:
+            phi = _xor_shuffle(hi, j)
+            plob = _xor_shuffle(lob, j)
+            pid = _xor_shuffle(ids, j)
+            eq_hi = hi == phi
+            gt = (
+                (hi > phi)
+                | (eq_hi & (lob > plob))
+                | (eq_hi & (lob == plob) & (ids > pid))
+            )
+            take_min = ((idx & k) == 0) == ((idx & j) == 0)
+            swap = jnp.where(take_min, gt, ~gt)
+            hi = jnp.where(swap, phi, hi)
+            lob = jnp.where(swap, plob, lob)
+            ids = jnp.where(swap, pid, ids)
+            j //= 2
+        k *= 2
+    return hi, lob, ids
+
+
+def _rounds_kernel_wide(gains_ref, hi0_ref, lob0_ref, choice_ref,
+                        hi_out_ref, lob_out_ref, idout_ref):
+    """Wide-totals round kernel: totals as (hi, biased-lo) int32 plane
+    pairs; per-round gains (int32, < 2^31) wrap-add into the low plane
+    with an unsigned-carry into the high plane."""
+    from jax.experimental import pallas as pl
+
+    R = gains_ref.shape[0]
+    ids0 = (
+        lax.broadcasted_iota(jnp.int32, (_SUB, _LANE), 0) * _LANE
+        + lax.broadcasted_iota(jnp.int32, (_SUB, _LANE), 1)
+    )
+
+    def body(r, carry):
+        hi, lob, ids = carry
+        hi, lob, ids = _bitonic_sort_wide(hi, lob, ids)
+        g = gains_ref[pl.ds(r, 1)][0]
+        valid = g >= 0
+        choice_ref[pl.ds(r, 1)] = jnp.where(valid, ids, -1)[None]
+        gain = jnp.where(valid, g, 0)
+        new_lob = lob + gain  # int32 wrap-add; bias commutes (see sort)
+        # Unsigned overflow of the raw low word == biased-signed compare
+        # of the planes.
+        carry_bit = (new_lob < lob).astype(jnp.int32)
+        return hi + carry_bit, new_lob, ids
+
+    hi, lob, ids = lax.fori_loop(
+        jnp.int32(0), jnp.int32(R), body, (hi0_ref[:], lob0_ref[:], ids0)
+    )
+    hi_out_ref[:] = hi
+    lob_out_ref[:] = lob
+    idout_ref[:] = ids
+
+
 def _rounds_kernel(gains_ref, t0_ref, choice_ref, tout_ref, idout_ref):
     """gains_ref int32[R, 8, 128] (-1 = invalid position), t0_ref
     int32[8, 128] starting totals (sentinel at pad slots).  Emits per
@@ -138,22 +211,34 @@ def _rounds_kernel(gains_ref, t0_ref, choice_ref, tout_ref, idout_ref):
 # Conservative VMEM budget (per-core ~16 MB; leave Mosaic headroom).
 _VMEM_BUDGET_BYTES = 12 * 1024 * 1024
 
-_pallas_rounds_ok: bool | None = None
+_pallas_rounds_ok: dict | None = None  # {"narrow": bool, "wide": bool}
 
 
-def _probe_parity() -> bool:
+def _probe_parity(wide: bool = False) -> bool:
     """Bit-compare the real Mosaic lowering against the XLA scan on a
     representative multi-round instance — a kernel that compiles but
     miscompiles (e.g. an unsupported roll silently mislowered) must
     never reach a rebalance, because round-scan wrongness is a silent
-    assignment corruption, not an error."""
+    assignment corruption, not an error.  ``wide`` probes the two-plane
+    totals variant (big lags force it through the wide gate)."""
     from .rounds_kernel import _rounds_scan
 
     rng = np.random.default_rng(0)
     P, C = 4096, 1000
-    lags = jnp.asarray(
-        -np.sort(-rng.integers(0, 10**6, size=P)).astype(np.int64)
+    # Value ranges chosen so the instance ADMITS to the intended mode
+    # (asserted below): narrow needs total < 2^30, i.e. lags < ~2^17
+    # here; wide needs total >= 2^30 with every lag < 2^31.
+    lo, hi = (2**29, 2**31 - 1) if wide else (0, 2**17)
+    lags_np = -np.sort(-rng.integers(lo, hi, size=P)).astype(np.int64)
+    got_mode = pallas_rounds_mode(
+        C, int(lags_np.sum()), -(-P // C), int(lags_np.max())
     )
+    want_mode = "wide" if wide else "narrow"
+    assert got_mode == want_mode, (
+        f"probe instance admitted as {got_mode!r}, wanted {want_mode!r} "
+        "— the probe would validate the WRONG kernel"
+    )
+    lags = jnp.asarray(lags_np)
     valid = jnp.ones((P,), bool)
     ref_t, ref_c = _rounds_scan(
         lags, valid, jnp.zeros((C,), jnp.int64), C, n_valid=P
@@ -161,6 +246,7 @@ def _probe_parity() -> bool:
     p_t, p_c = assign_sorted_rounds_pallas(
         lags, valid, num_consumers=C, n_valid=P,
         total_lag_bound=int(np.asarray(lags).sum()),
+        max_lag_bound=int(np.asarray(lags).max()),
     )
     return bool(
         (np.asarray(p_c) == np.asarray(ref_c)).all()
@@ -224,7 +310,9 @@ def _probe_speed(margin: float = 0.9) -> bool:
     return t_pal < t_xla * margin
 
 
-def rounds_pallas_available(run_probe: bool = False) -> bool:
+def rounds_pallas_available(
+    run_probe: bool = False, mode: str = "narrow"
+) -> bool:
     """Probe-once gate for PRODUCTION dispatch of the Pallas round scan.
 
     The probe (parity bit-compare + a speed race vs the XLA scan, both
@@ -244,18 +332,28 @@ def rounds_pallas_available(run_probe: bool = False) -> bool:
         if not run_probe or not _trace_state_clean():
             return False  # unprobed (or mid-trace): stay on the XLA scan
         if _jax.default_backend() == "cpu":
-            _pallas_rounds_ok = False
+            _pallas_rounds_ok = {"narrow": False, "wide": False}
             return False
         try:
-            ok = _probe_parity()
-            if not ok:
+            narrow = _probe_parity()
+            if not narrow:
                 import logging
 
                 logging.getLogger(__name__).warning(
                     "Pallas round-scan compiled but FAILED device "
                     "parity; staying on the XLA scan"
                 )
-            _pallas_rounds_ok = ok and _probe_speed()
+            narrow = narrow and _probe_speed()
+            wide = False
+            if narrow:
+                # The wide variant shares the narrow race verdict (same
+                # network, ~1.5x the plane ops) but needs its OWN parity
+                # proof: the carry/bias logic is wide-only code.
+                try:
+                    wide = _probe_parity(wide=True)
+                except Exception:
+                    wide = False
+            _pallas_rounds_ok = {"narrow": narrow, "wide": wide}
         except Exception:
             import logging
 
@@ -263,29 +361,69 @@ def rounds_pallas_available(run_probe: bool = False) -> bool:
                 "Pallas round-scan unavailable; using the XLA scan",
                 exc_info=True,
             )
-            _pallas_rounds_ok = False
-    return _pallas_rounds_ok
+            _pallas_rounds_ok = {"narrow": False, "wide": False}
+    return _pallas_rounds_ok.get(mode, False)
+
+
+def pallas_rounds_mode(
+    num_consumers: int, total_lag_bound: int, num_rounds: int,
+    max_lag_bound: int,
+):
+    """Shape/value admission for the Pallas path.  Returns the kernel
+    variant to use — ``"narrow"`` (totals fit int32), ``"wide"`` (totals
+    as two int32 planes; individual lags must fit 31 bits so the gains
+    stay one plane) — or None when neither admits the instance (the XLA
+    scan serves)."""
+    if num_consumers > C_PAD:
+        return None
+    bytes_needed = 2 * num_rounds * C_PAD * 4 + 8 * C_PAD * 4
+    if bytes_needed > _VMEM_BUDGET_BYTES:
+        return None
+    if total_lag_bound < TOTALS_BOUND:
+        return "narrow"
+    if total_lag_bound < WIDE_TOTALS_BOUND and max_lag_bound < MAX_LAG_BOUND:
+        return "wide"
+    return None
 
 
 def pallas_rounds_supported(
     num_consumers: int, total_lag_bound: int, num_rounds: int
 ) -> bool:
-    """Shape/value admission for the Pallas path: consumer axis fits one
-    tile plane, totals stay int32-exact under the sentinel, and the
-    gains + choice arrays fit VMEM."""
+    """Narrow-kernel admission (back-compat boolean view of
+    :func:`pallas_rounds_mode`)."""
+    return (
+        pallas_rounds_mode(
+            num_consumers, total_lag_bound, num_rounds, total_lag_bound
+        )
+        == "narrow"
+    )
+
+
+def pallas_mode_for(lags, num_consumers: int, num_rounds: int):
+    """THE host-side admission helper for dispatch sites: derive the
+    value bounds from a raw lag array (f64 sum — an int64 wrap could
+    alias a huge total to a small admissible one) and return the kernel
+    mode or None.  One definition, so the clamp and the empty-array
+    guard cannot drift across call sites."""
     if num_consumers > C_PAD:
-        return False
-    if total_lag_bound >= TOTALS_BOUND:
-        return False
-    bytes_needed = 2 * num_rounds * C_PAD * 4 + 6 * C_PAD * 4
-    return bytes_needed <= _VMEM_BUDGET_BYTES
+        return None
+    arr = np.asarray(lags)
+    if arr.size == 0:
+        return "narrow"
+    total = int(min(float(arr.sum(dtype=np.float64)), 2.0**63))
+    return pallas_rounds_mode(
+        num_consumers, total, num_rounds, int(arr.max())
+    )
 
 
-@functools.partial(jax.jit, static_argnames=("num_consumers", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("num_consumers", "interpret", "wide")
+)
 def rounds_scan_pallas(
     round_gains: jax.Array,
     num_consumers: int,
     interpret: bool = False,
+    wide: bool = False,
 ):
     """Run the round decomposition on pre-rounded gains.
 
@@ -296,7 +434,9 @@ def rounds_scan_pallas(
         :func:`..ops.rounds_kernel._rounds_scan` reshapes its sorted
         prefix.
       num_consumers: static C <= 1024.
-    Returns (totals int32[C] in CONSUMER order, choice int32[R, C]:
+      wide: static — two-plane int64 totals (see
+        :func:`pallas_rounds_mode`; gains stay one int32 plane).
+    Returns (totals int64[C] in CONSUMER order, choice int32[R, C]:
     consumer id seated at each position, -1 at invalid positions) — the
     same per-round contract as the XLA packed body.
     """
@@ -310,38 +450,59 @@ def rounds_scan_pallas(
         ((0, 0), (0, C_PAD - C)),
         constant_values=-1,
     ).reshape(R, _SUB, _LANE)
+
+    def spec3():
+        return pl.BlockSpec(
+            (R, _SUB, _LANE), lambda: (0, 0, 0), memory_space=pltpu.VMEM
+        )
+
+    def spec2():
+        return pl.BlockSpec(
+            (_SUB, _LANE), lambda: (0, 0), memory_space=pltpu.VMEM
+        )
+
+    def shape3():
+        return jax.ShapeDtypeStruct((R, _SUB, _LANE), jnp.int32)
+
+    def shape2():
+        return jax.ShapeDtypeStruct((_SUB, _LANE), jnp.int32)
+
+    if wide:
+        # Real slots start at total 0: hi = 0, low word 0 biased by xor
+        # 2^31 == INT32_MIN.  Pad slots: sentinel in the HIGH plane
+        # (above any admissible real hi, never incremented).
+        hi0 = jnp.full((C_PAD,), _SENTINEL, jnp.int32).at[:C].set(
+            0
+        ).reshape(_SUB, _LANE)
+        lob0 = jnp.full(
+            (C_PAD,), jnp.int32(-(2**31)), jnp.int32
+        ).reshape(_SUB, _LANE)
+        choice, hi, lob, idout = pl.pallas_call(
+            _rounds_kernel_wide,
+            in_specs=[spec3(), spec2(), spec2()],
+            out_specs=[spec3(), spec2(), spec2(), spec2()],
+            out_shape=[shape3(), shape2(), shape2(), shape2()],
+            interpret=interpret,
+        )(gains_p, hi0, lob0)
+        # Reconstruct int64 totals: raw low word = biased plane xor 2^31
+        # (as an unsigned 32-bit value).
+        lo_u = (
+            lob.reshape(C_PAD).astype(jnp.int64) & jnp.int64(0xFFFFFFFF)
+        ) ^ jnp.int64(0x80000000)
+        tot64 = (hi.reshape(C_PAD).astype(jnp.int64) << 32) + lo_u
+        _, totals_by_id = lax.sort(
+            (idout.reshape(C_PAD), tot64), num_keys=1
+        )
+        return totals_by_id[:C], choice.reshape(R, C_PAD)[:, :C]
+
     t0 = jnp.full((C_PAD,), _SENTINEL, jnp.int32).at[:C].set(0).reshape(
         _SUB, _LANE
     )
-
     choice, tout, idout = pl.pallas_call(
         _rounds_kernel,
-        in_specs=[
-            pl.BlockSpec(
-                (R, _SUB, _LANE), lambda: (0, 0, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (_SUB, _LANE), lambda: (0, 0), memory_space=pltpu.VMEM
-            ),
-        ],
-        out_specs=[
-            pl.BlockSpec(
-                (R, _SUB, _LANE), lambda: (0, 0, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (_SUB, _LANE), lambda: (0, 0), memory_space=pltpu.VMEM
-            ),
-            pl.BlockSpec(
-                (_SUB, _LANE), lambda: (0, 0), memory_space=pltpu.VMEM
-            ),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((R, _SUB, _LANE), jnp.int32),
-            jax.ShapeDtypeStruct((_SUB, _LANE), jnp.int32),
-            jax.ShapeDtypeStruct((_SUB, _LANE), jnp.int32),
-        ],
+        in_specs=[spec3(), spec2()],
+        out_specs=[spec3(), spec2(), spec2()],
+        out_shape=[shape3(), shape2(), shape2()],
         interpret=interpret,
     )(gains_p, t0)
 
@@ -350,13 +511,15 @@ def rounds_scan_pallas(
     _, totals_by_id = lax.sort(
         (idout.reshape(C_PAD), tout.reshape(C_PAD)), num_keys=1
     )
-    return totals_by_id[:C], choice.reshape(R, C_PAD)[:, :C]
+    return totals_by_id[:C].astype(jnp.int64), \
+        choice.reshape(R, C_PAD)[:, :C]
 
 
 def assign_sorted_rounds_pallas(
     sorted_lags, sorted_valid, num_consumers: int, n_valid: int,
     total_lag_bound: int,
     interpret: bool = False,
+    max_lag_bound: int | None = None,
 ):
     """Adapter matching :func:`..ops.rounds_kernel._rounds_scan`'s
     sorted-prefix contract: reshape the trimmed prefix into round rows
@@ -375,11 +538,15 @@ def assign_sorted_rounds_pallas(
     P = sorted_lags.shape[0]
     L = min(int(n_valid), P)
     R = -(-L // C) if L else 0
-    if not pallas_rounds_supported(C, int(total_lag_bound), max(R, 1)):
+    mode = pallas_rounds_mode(
+        C, int(total_lag_bound), max(R, 1),
+        int(total_lag_bound if max_lag_bound is None else max_lag_bound),
+    )
+    if mode is None:
         raise ValueError(
             f"instance outside the Pallas round-scan gate "
-            f"(C={C} <= {C_PAD}, total lag bound {total_lag_bound} < "
-            f"{TOTALS_BOUND}, VMEM): use the XLA path"
+            f"(C={C} <= {C_PAD}, total lag bound {total_lag_bound}, "
+            f"VMEM): use the XLA path"
         )
     if R == 0:
         # Zero valid rows: the XLA scan's empty-scan contract.
@@ -389,13 +556,13 @@ def assign_sorted_rounds_pallas(
         )
     return sorted_rounds_pallas_core(
         sorted_lags, sorted_valid, num_consumers=C, n_valid=n_valid,
-        interpret=interpret,
+        interpret=interpret, wide=(mode == "wide"),
     )
 
 
 def global_rounds_pallas_core(
     sorted_lags, sorted_valid, perms, num_consumers: int, n_valid: int,
-    interpret: bool = False,
+    interpret: bool = False, wide: bool = False,
 ):
     """Cross-topic GLOBAL mode through the same kernel: the global solve
     IS one long round sequence — each topic contributes ceil(P/C) rounds
@@ -425,7 +592,8 @@ def global_rounds_pallas_core(
     gains = jax.vmap(topic_rows)(sorted_lags, sorted_valid)  # [T, R, C]
     R = gains.shape[1]
     totals, choice_rows = rounds_scan_pallas(
-        gains.reshape(T * R, C), num_consumers=C, interpret=interpret
+        gains.reshape(T * R, C), num_consumers=C, interpret=interpret,
+        wide=wide,
     )
     head = R * C
     flat = choice_rows.reshape(T, head)
@@ -436,17 +604,17 @@ def global_rounds_pallas_core(
     else:
         flat = flat[:, :P]
     choice = jax.vmap(unsort)(perms, flat)
-    return totals.astype(jnp.int64), choice
+    return totals, choice
 
 
 def sorted_rounds_pallas_core(
     sorted_lags, sorted_valid, num_consumers: int, n_valid: int,
-    interpret: bool = False,
+    interpret: bool = False, wide: bool = False,
 ):
     """Traced core of the adapter — NO admission gate, usable inside an
     outer jit (the gate bound is per-call data, so checking it here would
     either trace-specialize on it or silently skip it; callers verify
-    :func:`pallas_rounds_supported` host-side first).  Same round-row
+    :func:`pallas_rounds_mode` host-side first).  Same round-row
     shaping as the XLA scan (shared helper)."""
     from .rounds_kernel import round_rows
 
@@ -457,11 +625,11 @@ def sorted_rounds_pallas_core(
     )
     gains = jnp.where(valid_h, lags_h, -1).astype(jnp.int32).reshape(R, C)
     totals, choice = rounds_scan_pallas(
-        gains, num_consumers=C, interpret=interpret
+        gains, num_consumers=C, interpret=interpret, wide=wide
     )
     flat = choice.reshape(head)[: min(head, P)]
     if head < P:
         flat = jnp.concatenate(
             [flat, jnp.full((P - head,), -1, jnp.int32)]
         )
-    return totals.astype(jnp.int64), flat
+    return totals, flat

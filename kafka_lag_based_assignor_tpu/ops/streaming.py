@@ -80,12 +80,12 @@ class StreamingStats:
     jax.jit,
     static_argnames=(
         "num_consumers", "pack_shift", "iters", "max_pairs", "bucket",
-        "interpret",
+        "interpret", "wide",
     ),
 )
 def _pallas_cold_chain(
     lags, num_consumers: int, pack_shift: int, iters: int, max_pairs,
-    bucket: int, interpret: bool = False,
+    bucket: int, interpret: bool = False, wide: bool = False,
 ):
     """Cold solve -> refine as ONE dispatch with the Pallas round scan
     (the in-VMEM variant of :meth:`StreamingAssignor._cold_solve`'s
@@ -105,7 +105,7 @@ def _pallas_cold_chain(
     perm, sl, sv = sort_partitions_with(lags_p, pids, valid, pack_shift)
     _, flat = sorted_rounds_pallas_core(
         sl, sv, num_consumers=num_consumers, n_valid=P,
-        interpret=interpret,
+        interpret=interpret, wide=wide,
     )
     choice = unsort(perm, flat)
     refined, _, _ = refine_assignment(
@@ -333,23 +333,19 @@ class StreamingAssignor:
             # it off the rebalance path).
             if C <= 1024:
                 from .rounds_pallas import (
-                    pallas_rounds_supported,
+                    pallas_mode_for,
                     rounds_pallas_available,
                 )
 
-                total = int(
-                    min(float(np.sum(lags, dtype=np.float64)), 2.0**62)
-                )
-                if pallas_rounds_supported(
-                    C, total, -(-P // C)
-                ) and rounds_pallas_available():
+                mode = pallas_mode_for(lags, C, -(-P // C))
+                if mode and rounds_pallas_available(mode=mode):
                     observe_pack_shift(
-                        ("cold_pallas", lags.shape, C), shift
+                        ("cold_pallas", lags.shape, C), (shift, mode)
                     )
                     narrow, refined_pad = _pallas_cold_chain(
                         payload, num_consumers=C, pack_shift=shift,
                         iters=self.cold_refine_iters, max_pairs=None,
-                        bucket=self._bucket(P),
+                        bucket=self._bucket(P), wide=(mode == "wide"),
                     )
                     self._choice_dev = refined_pad
                     return np.asarray(narrow).astype(np.int32)

@@ -86,12 +86,16 @@ def test_admission_gate():
 
 
 def test_adapter_enforces_gate_and_empty_input():
+    from kafka_lag_based_assignor_tpu.ops.rounds_pallas import (
+        WIDE_TOTALS_BOUND,
+    )
+
     lags = np.array([5, 3], dtype=np.int64)
     valid = np.ones(2, dtype=bool)
     with pytest.raises(ValueError, match="gate"):
         assign_sorted_rounds_pallas(
             lags, valid, num_consumers=2, n_valid=2,
-            total_lag_bound=TOTALS_BOUND, interpret=True,
+            total_lag_bound=WIDE_TOTALS_BOUND, interpret=True,
         )
     # n_valid=0 follows the XLA scan's empty-scan contract, no kernel.
     totals, choice = assign_sorted_rounds_pallas(
@@ -270,3 +274,106 @@ def test_cold_chain_matches_xla_chain_interpret():
         np.asarray(p_narrow), np.asarray(ref_narrow)
     )
     np.testing.assert_array_equal(np.asarray(p_pad), np.asarray(ref_pad))
+
+
+class TestWideTotals:
+    """The two-plane (int64-totals) kernel variant: bias/carry logic is
+    wide-only code, so it gets its own parity pins."""
+
+    def test_wide_matches_xla_big_lags(self):
+        from kafka_lag_based_assignor_tpu.ops.rounds_pallas import (
+            pallas_rounds_mode,
+        )
+
+        rng = np.random.default_rng(3)
+        P, C = 1500, 16
+        # Totals ~ 1500 * 2^30 >> 2^30: forces the wide gate; each lag
+        # fits 31 bits.
+        n_valid = P
+        lags = -np.sort(
+            -rng.integers(2**29, 2**31 - 1, size=P).astype(np.int64)
+        )
+        valid = np.ones(P, dtype=bool)
+        total = int(lags.sum())
+        assert pallas_rounds_mode(C, total, -(-P // C), int(lags.max())) \
+            == "wide"
+        ref_totals, ref_choice = _rounds_scan(
+            jnp.asarray(lags), jnp.asarray(valid),
+            jnp.zeros((C,), jnp.int64), C, n_valid=n_valid,
+        )
+        p_totals, p_choice = assign_sorted_rounds_pallas(
+            lags, valid, num_consumers=C, n_valid=n_valid,
+            total_lag_bound=total, max_lag_bound=int(lags.max()),
+            interpret=True,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(p_choice), np.asarray(ref_choice)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(p_totals), np.asarray(ref_totals)
+        )
+
+    def test_wide_carry_stress_single_consumer(self):
+        """C=1: one consumer accumulates every lag, so the low plane
+        wraps repeatedly — every carry path executes."""
+        P, C = 64, 1
+        lags = np.full(P, 2**31 - 7, dtype=np.int64)
+        valid = np.ones(P, dtype=bool)
+        total = int(lags.sum())  # ~2^37: low word wraps ~32 times
+        ref_totals, ref_choice = _rounds_scan(
+            jnp.asarray(lags), jnp.asarray(valid),
+            jnp.zeros((C,), jnp.int64), C, n_valid=P,
+        )
+        p_totals, p_choice = assign_sorted_rounds_pallas(
+            lags, valid, num_consumers=C, n_valid=P,
+            total_lag_bound=total, max_lag_bound=int(lags.max()),
+            interpret=True,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(p_choice), np.asarray(ref_choice)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(p_totals), np.asarray(ref_totals)
+        )
+
+    def test_wide_tie_heavy(self):
+        """Equal big lags: low-plane equality paths + id tiebreaks."""
+        rng = np.random.default_rng(8)
+        P, C = 400, 8
+        lags = -np.sort(-(
+            rng.integers(0, 3, size=P).astype(np.int64) + 2**30
+        ))
+        valid = np.ones(P, dtype=bool)
+        total = int(lags.sum())
+        ref_totals, ref_choice = _rounds_scan(
+            jnp.asarray(lags), jnp.asarray(valid),
+            jnp.zeros((C,), jnp.int64), C, n_valid=P,
+        )
+        p_totals, p_choice = assign_sorted_rounds_pallas(
+            lags, valid, num_consumers=C, n_valid=P,
+            total_lag_bound=total, max_lag_bound=int(lags.max()),
+            interpret=True,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(p_choice), np.asarray(ref_choice)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(p_totals), np.asarray(ref_totals)
+        )
+
+    def test_mode_boundaries(self):
+        from kafka_lag_based_assignor_tpu.ops.rounds_pallas import (
+            MAX_LAG_BOUND,
+            WIDE_TOTALS_BOUND,
+            pallas_rounds_mode,
+        )
+
+        assert pallas_rounds_mode(8, TOTALS_BOUND - 1, 4, 100) == "narrow"
+        assert pallas_rounds_mode(8, TOTALS_BOUND, 4, 100) == "wide"
+        assert pallas_rounds_mode(
+            8, WIDE_TOTALS_BOUND, 4, 100
+        ) is None
+        # A single lag past 31 bits cannot ride the one-plane gains.
+        assert pallas_rounds_mode(
+            8, TOTALS_BOUND, 4, MAX_LAG_BOUND
+        ) is None
