@@ -11,6 +11,7 @@ validates the plan-stats kernel); hardware timing is probed separately
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 from kafka_lag_based_assignor_tpu.ops.rounds_kernel import _rounds_scan
@@ -19,6 +20,17 @@ from kafka_lag_based_assignor_tpu.ops.rounds_pallas import (
     assign_sorted_rounds_pallas,
     pallas_rounds_supported,
 )
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _drop_interpreter_executables():
+    """The Pallas interpreter materializes MANY tiny XLA:CPU executables
+    (every interpreter step at every new shape); letting them accumulate
+    has produced flaky LLVM-JIT segfaults in LATER modules' compiles
+    (observed twice at test_streaming's engine fuzz).  Drop them when
+    this module finishes."""
+    yield
+    jax.clear_caches()
 
 
 def sorted_case(seed, P, C, max_lag=10**5, all_valid=False):
@@ -33,7 +45,7 @@ def sorted_case(seed, P, C, max_lag=10**5, all_valid=False):
     return lags, valid, n_valid
 
 
-@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize("seed", range(3))
 @pytest.mark.parametrize(
     "P,C",
     [(257, 8), (96, 96), (1000, 37), (2048, 1000), (64, 1024)],
@@ -171,7 +183,7 @@ def pallas_instances(draw):
     return lags, valid, n_valid, C
 
 
-@settings(max_examples=30, deadline=None)
+@settings(max_examples=15, deadline=None)
 @given(pallas_instances())
 def test_pallas_fuzz_matches_xla(instance):
     lags, valid, n_valid, C = instance
