@@ -26,15 +26,23 @@ AOT path rejects any ``grid``):
 * per round: sort ascending, emit the id plane as that round's choice
   row (-1 at invalid positions), add the round's gains positionally.
 
-Scope gate (:func:`pallas_rounds_supported`): C <= 1024 consumers and
-TOTAL lag sum < 2**30 (int32 totals with headroom; the int64-sum regime
-stays on the XLA path), R * 1024 ints fitting VMEM.  The north-star
-shape (P=100k, C=1000, Zipf lags ~2e8 total) fits.
+Admission (:func:`pallas_rounds_mode`, one shared helper at every
+dispatch site): C <= 1024 consumers, gains + choice fitting VMEM, and
+either total lag < 2**30 (NARROW: one int32 totals plane — the
+north-star shape qualifies) or total < 2**62 with every lag < 2**31
+(WIDE: totals as two int32 planes, biased low word, carry into the high
+plane).  Anything else stays on the XLA scan.
 
-EXPERIMENTAL this round: bit-parity with the XLA scan is pinned by
-interpret-mode tests (tests/test_rounds_pallas.py); hardware timing goes
-through tools/probe_round6.py when the tunnel allows.  Production
-dispatch stays on the XLA path until the probe proves a win.
+Production dispatch (assign_stream / assign_stream_global / the
+streaming cold chain) is DOUBLE-gated: the host admission above plus a
+probe-once device gate (:func:`rounds_pallas_available`) that
+bit-compares each kernel mode against the XLA scan on the real lowering
+AND races it (a correct-but-slow lowering must not regress the
+headline) — the probe is only ever invoked by warm-up/bench
+(run_probe=True), never on a cold rebalance, and any failure falls back
+to the XLA scan.  Bit-parity is pinned by interpret-mode tests
+(tests/test_rounds_pallas.py: fixed shape classes, Hypothesis fuzz,
+carry stress); hardware timing goes through tools/probe_round6.py.
 """
 
 from __future__ import annotations
