@@ -149,9 +149,10 @@ def solve_pallas(v, unroll):
     return unsort(perm, flat)[:P]
 
 
-def amortized_ms(make_fn, unroll, label):
+def amortized_ms(make_fn, unroll, label, src=None):
+    src = payload if src is None else src
     batch = jax.device_put(
-        np.stack([np.roll(payload, 7919 * i) for i in range(N_HI)])
+        np.stack([np.roll(src, 7919 * i) for i in range(N_HI)])
     )
 
     @functools.partial(jax.jit, static_argnames=("n",))
@@ -220,6 +221,56 @@ def main():
     except Exception as exc:  # noqa: BLE001 — probe must finish
         print(f"pallas round-scan unavailable: {type(exc).__name__}: "
               f"{exc}", flush=True)
+    # WIDE (two-plane totals) variant at the same scale: scale the lags
+    # so the total crosses the int32 gate while each lag fits 31 bits,
+    # parity-check the wide lowering, then time it.
+    try:
+        from kafka_lag_based_assignor_tpu.ops.rounds_pallas import (
+            assign_sorted_rounds_pallas,
+            pallas_mode_for,
+        )
+        from kafka_lag_based_assignor_tpu.ops.rounds_kernel import (
+            assign_topic_rounds as _atr,
+        )
+        from kafka_lag_based_assignor_tpu.ops.sortops import unsort
+        from kafka_lag_based_assignor_tpu.ops.scan_kernel import (
+            sort_partitions_with as _spw,
+        )
+
+        wide_lags = (lags0 * 32).astype(np.int64)
+        assert pallas_mode_for(wide_lags, C, -(-P // C)) == "wide"
+        w_total = int(wide_lags.sum())
+
+        def solve_wide(v, _u):
+            lags_p = jnp.pad(v.astype(jnp.int64), (0, B - P))
+            pids = jnp.arange(B, dtype=jnp.int32)
+            valid = pids < P
+            perm, sl, sv = _spw(lags_p, pids, valid, 0)
+            _, flat = assign_sorted_rounds_pallas(
+                sl, sv, num_consumers=C, n_valid=P,
+                total_lag_bound=w_total,
+                max_lag_bound=int(wide_lags.max()),
+            )
+            return unsort(perm, flat)[:P]
+
+        w_base = np.asarray(jax.jit(
+            lambda v: _atr(
+                jnp.pad(v.astype(jnp.int64), (0, B - P)),
+                jnp.arange(B, dtype=jnp.int32),
+                jnp.arange(B, dtype=jnp.int32) < P,
+                num_consumers=C, n_valid=P,
+            )[0][:P]
+        )(wide_lags))
+        w_pal = np.asarray(jax.jit(lambda v: solve_wide(v, 0))(wide_lags))
+        assert (w_base == w_pal).all(), "WIDE body NOT bit-identical"
+        print("pallas WIDE: bit-parity OK on device", flush=True)
+        results["pallas_wide"] = amortized_ms(
+            lambda v, u: solve_wide(v, u).astype(jnp.int32).sum(),
+            0, "pallas WIDE round-scan", src=wide_lags,
+        )
+    except Exception as exc:  # noqa: BLE001 — probe must finish
+        print(f"pallas WIDE unavailable: {type(exc).__name__}: {exc}",
+              flush=True)
     best = min(results, key=results.get)
     print(f"BEST: {best} at {results[best]:.2f} ms", flush=True)
 
