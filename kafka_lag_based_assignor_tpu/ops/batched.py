@@ -22,6 +22,47 @@ from .rounds_kernel import (
 from .scan_kernel import assign_topic_scan, pack_shift_for
 
 
+def _maybe_refine(lags, valid, choice, num_consumers: int, iters: int):
+    """Trace-time helper: chain the exchange refinement onto a solve when
+    a budget is set (0 = strict parity, choice passes through) — the one
+    definition of the in-executable refine chaining used by every stream
+    inner."""
+    if not iters:
+        return choice
+    from .refine import refine_assignment
+
+    choice, _, _ = refine_assignment(
+        lags, valid, choice, num_consumers=num_consumers, iters=iters
+    )
+    return choice
+
+
+def _pallas_solve_padded(
+    lags, bucket: int, num_consumers: int, pack_shift: int,
+    wide: bool, interpret: bool = False,
+):
+    """Traced plumbing shared by the Pallas stream inners: pad the
+    exact-shape lag vector to ``bucket``, sort in processing order, run
+    the in-VMEM round scan, unsort.  Returns (padded lags, validity
+    mask, choice int32[bucket])."""
+    import jax.numpy as jnp
+
+    from .rounds_pallas import sorted_rounds_pallas_core
+    from .scan_kernel import sort_partitions_with
+    from .sortops import unsort
+
+    P = lags.shape[0]
+    lags_p = jnp.pad(lags.astype(jnp.int64), (0, bucket - P))
+    pids = jnp.arange(bucket, dtype=jnp.int32)
+    valid = pids < P
+    perm, sl, sv = sort_partitions_with(lags_p, pids, valid, pack_shift)
+    _, flat = sorted_rounds_pallas_core(
+        sl, sv, num_consumers=num_consumers, n_valid=P,
+        interpret=interpret, wide=wide,
+    )
+    return lags_p, valid, unsort(perm, flat)
+
+
 def _refine_vmapped(lags, valid, choice, num_consumers: int, iters: int):
     """Trace-time helper: the pairwise-exchange refinement (:mod:`.refine`)
     vmapped over the topic axis, for use INSIDE an already-jitted solve so
@@ -110,13 +151,10 @@ def _stream_presorted(lags, perm, num_consumers: int, refine_iters: int = 0):
     choice, _, _ = assign_presorted_rounds(
         lags[perm], perm, num_consumers=num_consumers
     )
-    if refine_iters:
-        from .refine import refine_assignment
-
-        choice, _, _ = refine_assignment(
-            lags, jnp.ones(lags.shape, bool), choice,
-            num_consumers=num_consumers, iters=refine_iters,
-        )
+    choice = _maybe_refine(
+        lags, jnp.ones(lags.shape, bool), choice, num_consumers,
+        refine_iters,
+    )
     return _narrow_choice(choice, num_consumers)
 
 
@@ -178,13 +216,9 @@ def _stream_device(
         pack_shift=pack_shift, n_valid=P,
         totals_rank_bits=totals_rank_bits,
     )
-    if refine_iters:
-        from .refine import refine_assignment
-
-        choice, _, _ = refine_assignment(
-            lags_p, valid, choice, num_consumers=num_consumers,
-            iters=refine_iters,
-        )
+    choice = _maybe_refine(
+        lags_p, valid, choice, num_consumers, refine_iters
+    )
     return _narrow_choice(choice[:P], num_consumers)
 
 
@@ -236,33 +270,15 @@ def _stream_device_pallas(
     lag sum) AND the probe-once device parity gate
     (:func:`..ops.rounds_pallas.rounds_pallas_available`) — the core has
     no in-trace gate."""
-    import jax.numpy as jnp
-
     from .packing import pad_bucket
-    from .rounds_pallas import sorted_rounds_pallas_core
-    from .scan_kernel import sort_partitions_with
-    from .sortops import unsort
 
     P = lags.shape[0]
-    P_pad = pad_bucket(P)
-    lags_p = jnp.pad(lags.astype(jnp.int64), (0, P_pad - P))
-    pids = jnp.arange(P_pad, dtype=jnp.int32)
-    valid = pids < P
-    perm, sorted_lags, sorted_valid = sort_partitions_with(
-        lags_p, pids, valid, pack_shift
+    lags_p, valid, choice = _pallas_solve_padded(
+        lags, pad_bucket(P), num_consumers, pack_shift, wide
     )
-    _, flat = sorted_rounds_pallas_core(
-        sorted_lags, sorted_valid, num_consumers=num_consumers, n_valid=P,
-        wide=wide,
+    choice = _maybe_refine(
+        lags_p, valid, choice, num_consumers, refine_iters
     )
-    choice = unsort(perm, flat)
-    if refine_iters:
-        from .refine import refine_assignment
-
-        choice, _, _ = refine_assignment(
-            lags_p, valid, choice, num_consumers=num_consumers,
-            iters=refine_iters,
-        )
     return _narrow_choice(choice[:P], num_consumers)
 
 
@@ -392,16 +408,12 @@ def assign_stream_global(lags, num_consumers: int):
     # The global kernel's totals carry across topics: bound by the WHOLE
     # batch's sum, not per-topic row sums.
     rb = totals_rank_bits_for(payload.reshape(1, -1), num_consumers)
-    if num_consumers <= 1024:
-        from .rounds_pallas import (
-            pallas_mode_for,
-            rounds_pallas_available,
-        )
+    from .rounds_pallas import pallas_mode_for, rounds_pallas_available
 
-        T, P = lags.shape
-        rounds = T * max(-(-P // num_consumers), 1)
-        mode = pallas_mode_for(lags, num_consumers, rounds)
-        if mode and rounds_pallas_available(mode=mode):
+    T, P = lags.shape
+    rounds = T * max(-(-P // num_consumers), 1)
+    mode = pallas_mode_for(lags, num_consumers, rounds)
+    if mode and rounds_pallas_available(mode=mode):
             observe_pack_shift(
                 ("stream_global_pallas", payload.shape, num_consumers),
                 (shift, mode),
@@ -488,29 +500,27 @@ def assign_stream(lags, num_consumers: int, refine_iters: int = 0):
         from .dispatch import observe_pack_shift
 
         # Pallas in-VMEM round scan when the instance AND the device
-        # qualify: host value gate first (cheap, avoids probing for
-        # ineligible instances), then the probe-once device parity gate
-        # (compiles + bit-compares a representative instance on first
-        # use; any failure permanently falls back to the XLA scan).
-        if num_consumers <= 1024:
-            from .rounds_pallas import (
-                pallas_mode_for,
-                rounds_pallas_available,
-            )
+        # qualify: host value gate first (pallas_mode_for gates C and the
+        # value ranges), then the probe-once device parity+speed gate
+        # (any failure permanently falls back to the XLA scan).
+        from .rounds_pallas import (
+            pallas_mode_for,
+            rounds_pallas_available,
+        )
 
-            P = lags.shape[0]
-            mode = pallas_mode_for(
-                lags, num_consumers, -(-P // num_consumers)
+        P = lags.shape[0]
+        mode = pallas_mode_for(
+            lags, num_consumers, -(-P // num_consumers)
+        )
+        if mode and rounds_pallas_available(mode=mode):
+            observe_pack_shift(
+                ("stream_pallas", lags.shape, num_consumers),
+                (shift, mode),
             )
-            if mode and rounds_pallas_available(mode=mode):
-                observe_pack_shift(
-                    ("stream_pallas", lags.shape, num_consumers),
-                    (shift, mode),
-                )
-                return _stream_device_pallas(
-                    payload, num_consumers=num_consumers,
-                    pack_shift=shift, wide=(mode == "wide"), **refine,
-                )
+            return _stream_device_pallas(
+                payload, num_consumers=num_consumers,
+                pack_shift=shift, wide=(mode == "wide"), **refine,
+            )
         # One observation key per executable-selecting tuple: a change in
         # EITHER static arg (pack shift or rank bits) recompiles.
         observe_pack_shift(

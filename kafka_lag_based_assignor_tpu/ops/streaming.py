@@ -93,21 +93,13 @@ def _pallas_cold_chain(
     exact-shape lags in, (narrow choice[P], padded refined int32[bucket]
     kept device-resident by the caller) out.  Callers must have passed
     BOTH Pallas gates host-side."""
-    from .rounds_pallas import sorted_rounds_pallas_core
-    from .scan_kernel import sort_partitions_with
-    from .sortops import unsort
+    from .batched import _pallas_solve_padded
 
     P = lags.shape[0]
-    B = int(bucket)
-    lags_p = jnp.pad(lags.astype(jnp.int64), (0, B - P))
-    pids = jnp.arange(B, dtype=jnp.int32)
-    valid = pids < P
-    perm, sl, sv = sort_partitions_with(lags_p, pids, valid, pack_shift)
-    _, flat = sorted_rounds_pallas_core(
-        sl, sv, num_consumers=num_consumers, n_valid=P,
-        interpret=interpret, wide=wide,
+    lags_p, valid, choice = _pallas_solve_padded(
+        lags, int(bucket), num_consumers, pack_shift, wide,
+        interpret=interpret,
     )
-    choice = unsort(perm, flat)
     refined, _, _ = refine_assignment(
         lags_p, valid, choice, num_consumers=num_consumers,
         iters=iters, max_pairs=max_pairs,
@@ -331,24 +323,23 @@ class StreamingAssignor:
             # gates pass (same condition set as assign_stream; the
             # probe-once gate never probes here — warm-up/bench resolve
             # it off the rebalance path).
-            if C <= 1024:
-                from .rounds_pallas import (
-                    pallas_mode_for,
-                    rounds_pallas_available,
-                )
+            from .rounds_pallas import (
+                pallas_mode_for,
+                rounds_pallas_available,
+            )
 
-                mode = pallas_mode_for(lags, C, -(-P // C))
-                if mode and rounds_pallas_available(mode=mode):
-                    observe_pack_shift(
-                        ("cold_pallas", lags.shape, C), (shift, mode)
-                    )
-                    narrow, refined_pad = _pallas_cold_chain(
-                        payload, num_consumers=C, pack_shift=shift,
-                        iters=self.cold_refine_iters, max_pairs=None,
-                        bucket=self._bucket(P), wide=(mode == "wide"),
-                    )
-                    self._choice_dev = refined_pad
-                    return np.asarray(narrow).astype(np.int32)
+            mode = pallas_mode_for(lags, C, -(-P // C))
+            if mode and rounds_pallas_available(mode=mode):
+                observe_pack_shift(
+                    ("cold_pallas", lags.shape, C), (shift, mode)
+                )
+                narrow, refined_pad = _pallas_cold_chain(
+                    payload, num_consumers=C, pack_shift=shift,
+                    iters=self.cold_refine_iters, max_pairs=None,
+                    bucket=self._bucket(P), wide=(mode == "wide"),
+                )
+                self._choice_dev = refined_pad
+                return np.asarray(narrow).astype(np.int32)
             observe_pack_shift(("stream", lags.shape, C), (shift, rb))
             payload = jax.device_put(payload)  # ONE upload, both kernels
             choice0 = _stream_device(
