@@ -579,17 +579,25 @@ def config5_northstar():
     # Streaming: rebalance repeatedly under multiplicative drift + churn,
     # reusing the compiled kernel (stable exact shape).  Run both modes:
     # from-scratch each epoch, and the warm-start engine (previous choice
-    # kept, refine dispatched only past the quality threshold).  Runs
-    # BEFORE the sinkhorn single-shot so its numbers are measured in the
-    # same transport window as the headline (the tunnel's latency drifts
-    # over minutes; the sinkhorn first call alone holds it for ~70 s).
+    # kept, fused refine dispatched only past the quality threshold).
+    # Runs BEFORE the sinkhorn single-shot so its numbers are measured in
+    # the same transport window as the headline (the tunnel's latency
+    # drifts over minutes; the sinkhorn first call alone holds it for
+    # ~70 s).
     from kafka_lag_based_assignor_tpu.ops.streaming import StreamingAssignor
+    from kafka_lag_based_assignor_tpu.utils.observability import (
+        compile_count,
+        install_compile_counter,
+        static_drift_count,
+    )
 
+    install_compile_counter()
     lags = lags0.astype(np.float64)
     stream_times = []
     warm_times, warm_churn, warm_ratio = [], [], []
     warm_refine_times, warm_noop_times = [], []
     warm_refine_ratio, warm_noop_ratio = [], []
+    warm_refine_rounds, warm_refine_ex = [], []
     warm_trips, warm_refines = 0, 0
     # Guardrail 1.25x the per-epoch input bound: the bounded-churn warm
     # path re-solves cold if its quality drifts past the allowance
@@ -597,7 +605,7 @@ def config5_northstar():
     engine = StreamingAssignor(
         num_consumers=C, refine_iters=512, imbalance_guardrail=1.25
     )
-    # Pre-compile the warm-path refine executable OUT of the timed loop
+    # Pre-compile the fused warm-path executable OUT of the timed loop
     # with a throwaway always-refine engine (the production engine's
     # threshold may legitimately skip every dispatch, so its first real
     # dispatch — wherever it lands — must not pay the compile).
@@ -607,6 +615,11 @@ def config5_northstar():
     warmer.rebalance(lags0)
     warmer.rebalance(lags0)
     choice = engine.rebalance(lags0)  # cold start (all compiled now)
+    # Steady-state compile regression gate: from here to the end of the
+    # drift loop ZERO fresh XLA compiles may happen — a warm epoch that
+    # recompiles is exactly the r5 regression this field exists to catch.
+    compiles_before = compile_count()
+    drift_before = static_drift_count()
     # Epoch schedule (VERDICT r4 item 6): the first half drifts mildly
     # (lognormal sigma 0.2 — stays under the 1.02 refine threshold, so
     # those epochs exercise the zero-traffic no-op path); in the second
@@ -652,6 +665,8 @@ def config5_northstar():
             if s.refined:
                 warm_refine_times.append(epoch_ms)
                 warm_refine_ratio.append(q)
+                warm_refine_rounds.append(s.refine_rounds)
+                warm_refine_ex.append(s.refine_exchanges)
             else:
                 warm_noop_times.append(epoch_ms)
                 warm_noop_ratio.append(q)
@@ -659,6 +674,8 @@ def config5_northstar():
         warm_ratio.append(q)
         warm_trips += int(s.guardrail_tripped)
         warm_refines += int(s.refined)
+    warm_compile_count = compile_count() - compiles_before
+    warm_static_drift = static_drift_count() - drift_before
 
     # Quality mode at north-star scale (single shot — a quality record,
     # not a latency one): the implicit-plan Sinkhorn + refinement.
@@ -674,12 +691,21 @@ def config5_northstar():
     )
     s_tot = np.asarray(s_tot)
     s_first_ms = (time.perf_counter() - t0) * 1000.0  # includes compile
-    t0 = time.perf_counter()
-    _, _, s_tot2 = assign_topic_sinkhorn(
-        lags_p, pids_p, valid_p, num_consumers=C
-    )
-    s_tot2 = np.asarray(s_tot2)
-    s_ms = (time.perf_counter() - t0) * 1000.0
+    # Amortized per-call cost: the compiled-executable steady state (the
+    # regime a quality-mode deployment actually lives in) — median of
+    # repeat calls after the compile call above.  sinkhorn_assign_ms
+    # stays the prior rounds' single-second-call timing so
+    # round-over-round comparisons remain apples-to-apples.
+    s_amortized = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        _, _, s_tot2 = assign_topic_sinkhorn(
+            lags_p, pids_p, valid_p, num_consumers=C
+        )
+        s_tot2 = np.asarray(s_tot2)
+        s_amortized.append((time.perf_counter() - t0) * 1000.0)
+    s_ms = s_amortized[0]
+    s_amortized_ms = float(np.median(s_amortized))
     s_imb = imbalance(s_tot2)
 
     return {
@@ -693,9 +719,15 @@ def config5_northstar():
         "baseline_imbalance": base_imb,
         "speedup_vs_baseline": base_ms / ms,
         "sinkhorn_assign_ms": s_ms,
+        "sinkhorn_amortized_ms": s_amortized_ms,
         "sinkhorn_first_call_ms": s_first_ms,
         "sinkhorn_max_mean_imbalance": s_imb,
         "sinkhorn_quality_ratio": quality_ratio(s_imb, bound),
+        # Machine-normalized quality-mode cost: amortized sinkhorn over
+        # the same run's cold assign — comparable across hosts of very
+        # different speed (the recorded 8.2 s baseline was ~396x its
+        # run's 20.7 ms assign).
+        "sinkhorn_over_assign": s_amortized_ms / max(ms, 1e-9),
         "streaming_p50_ms": float(np.percentile(stream_times, 50)),
         "streaming_p95_ms": float(np.percentile(stream_times, 95)),
         "warm_p50_ms": float(np.percentile(warm_times, 50)),
@@ -725,6 +757,20 @@ def config5_northstar():
             if warm_noop_ratio else None
         ),
         "warm_guardrail_trips": warm_trips,
+        # Fused-dispatch observability: rounds/exchanges the resident
+        # refine actually ran (exchange-budget accounting bounds churn by
+        # 2x exchanges), and the steady-state compile regression gates —
+        # warm_compile_count MUST be 0 after warm-up (asserted in main).
+        "warm_refine_rounds_p50": (
+            float(np.percentile(warm_refine_rounds, 50))
+            if warm_refine_rounds else None
+        ),
+        "warm_refine_exchanges_p50": (
+            float(np.percentile(warm_refine_ex, 50))
+            if warm_refine_ex else None
+        ),
+        "warm_compile_count": warm_compile_count,
+        "warm_static_drift_count": warm_static_drift,
         "guardrail": 1.25,
         "target_ms": 50.0,
         "quality_target_ratio": 1.05,
@@ -796,6 +842,36 @@ def main():
     if device_fallback:
         line["device_fallback"] = True  # accelerator was unreachable
     print(json.dumps(line))
+
+    # Regression gates (nonzero rc so CI fails LOUDLY, after the one-line
+    # contract output above is already printed):
+    #   * a warm refine epoch costing more than a cold solve is the exact
+    #     r5 inversion this harness exists to prevent;
+    #   * a fresh XLA compile inside the steady-state warm loop means the
+    #     warm-up no longer covers the production executables.
+    failures = []
+    wr = ns.get("warm_refine_p50_ms")
+    # The cold reference is the from-scratch solve measured INSIDE the
+    # same drift loop (streaming_p50_ms: stream_once runs assign_stream
+    # every epoch, temporally interleaved with the warm epochs) — the
+    # headline assign_ms is measured minutes earlier, and this host's
+    # session noise (observed >50% swings between phases) would fail the
+    # gate on drift rather than regression.  Same pairing rationale as
+    # interleaved_floor.
+    cold_ref = ns.get("streaming_p50_ms", ns["assign_ms"])
+    if wr is not None and wr > cold_ref:
+        failures.append(
+            f"warm_refine_p50_ms {wr:.1f} exceeds the same-loop cold "
+            f"solve p50 {cold_ref:.1f} — warm epoch costlier than cold"
+        )
+    if ns.get("warm_compile_count", 0) > 0:
+        failures.append(
+            f"warm_compile_count {ns['warm_compile_count']} != 0 — fresh "
+            "XLA compiles inside the steady-state warm loop"
+        )
+    for msg in failures:
+        log(f"bench: REGRESSION GATE FAILED: {msg}")
+    sys.exit(1 if failures else 0)
 
 
 if __name__ == "__main__":

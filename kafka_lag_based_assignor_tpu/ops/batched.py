@@ -26,13 +26,22 @@ def _maybe_refine(lags, valid, choice, num_consumers: int, iters: int):
     """Trace-time helper: chain the exchange refinement onto a solve when
     a budget is set (0 = strict parity, choice passes through) — the one
     definition of the in-executable refine chaining used by every stream
-    inner."""
+    inner.  Uses the resident-table rounds (:mod:`.refine`'s fused warm
+    core — O(K*M log M) per round instead of two P-sized sorts), which a
+    greedy solve's count-balanced output always admits; selection is
+    bit-identical to the oracle kernel's exact-argmin semantics."""
     if not iters:
         return choice
-    from .refine import refine_assignment
+    from .packing import table_rows
+    from .refine import build_choice_tables, refine_rounds_resident
 
-    choice, _, _ = refine_assignment(
-        lags, valid, choice, num_consumers=num_consumers, iters=iters
+    row_tab, counts, totals = build_choice_tables(
+        lags, valid, choice, num_consumers,
+        table_rows(lags.shape[0], num_consumers),
+    )
+    choice, _, _, _, _, _ = refine_rounds_resident(
+        lags, choice, row_tab, counts, totals,
+        num_consumers=num_consumers, iters=iters,
     )
     return choice
 

@@ -50,9 +50,15 @@ def observe_pack_shift(key: Tuple, shift) -> None:
     """INFO-log changes in value-derived STATIC kernel args per call
     signature (a recompile signal).  ``shift`` may be a plain pack shift
     or a tuple of static args (e.g. ``(pack_shift, totals_rank_bits)``) —
-    logged structurally, any change means a fresh executable."""
+    logged structurally, any change means a fresh executable.  Every
+    observed change also bumps the process-wide drift counter
+    (utils/observability.static_drift_count) so benches and deployments
+    can assert the steady state is drift-free without log scraping."""
     prev = _LAST_PACK_SHIFT.get(key)
     if prev is not None and prev != shift:
+        from ..utils.observability import note_static_drift
+
+        note_static_drift()
         LOGGER.info(
             "static kernel args for %s changed %s -> %s (input value "
             "ranges drifted): this solve compiles a fresh executable "

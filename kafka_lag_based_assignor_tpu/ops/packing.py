@@ -44,6 +44,19 @@ def pad_chunk(n: int, chunk: int = 4096) -> int:
     return max(chunk, -(-n // chunk) * chunk)
 
 
+def table_rows(num_rows: int, num_consumers: int) -> int:
+    """Per-consumer slot budget for the resident refine's [C, M] row
+    table (ops/refine.build_choice_tables): the count invariant
+    ``max - min <= 1`` bounds any consumer at ``ceil(P / C)`` rows, and
+    exchange moves never push a consumer past the current maximum, so
+    ``ceil(P / C) + 1`` slots hold every reachable state with one slot of
+    headroom.  One definition, so the fused warm-path executables and the
+    standalone resident refine agree on the (P-bucket, C) -> M geometry
+    (a mismatched M is a different executable signature)."""
+    C = max(int(num_consumers), 1)
+    return -(-int(num_rows) // C) + 1
+
+
 def pad_topic_rows(lags, partition_ids=None):
     """Pad one topic's columns to its power-of-two bucket.
 

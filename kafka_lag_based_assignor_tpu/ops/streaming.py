@@ -18,21 +18,31 @@ the optional warm start for the streaming-rebalance benchmark):
   ``refine_threshold`` of the input-driven bound, the epoch is a
   **no-op**: zero churn, zero device traffic — a rebalance that would
   move nothing should cost nothing (the reference re-solves O(P*C) every
-  time regardless).  Otherwise dispatch one round-trip of the parallel
-  pairwise-exchange refinement (:mod:`.refine`) under the new lags.  The
-  count invariant is preserved by construction, imbalance is
-  re-tightened, and only the exchanges' partitions move — ``refine_iters``
-  is a total *exchange budget*, split into rounds of up to ``C // 2``
-  concurrent disjoint exchanges, so churn is bounded by 2 x refine_iters
-  instead of O(P).
+  time regardless).  Otherwise ONE fused device dispatch
+  (:func:`_warm_fused_resident`) does the whole epoch's quality work:
+  re-derive the per-consumer totals under the new lags from the
+  device-resident row table (the fused equivalent of the bincount), test
+  them against the quality target, and run the multi-round resident
+  exchange-refinement loop (:func:`..ops.refine.refine_rounds_resident`
+  — a ``lax.while_loop`` whose condition early-exits on target-met /
+  stagnant-peak / budget-spent) entirely on device.  The count invariant
+  is preserved by construction, imbalance is re-tightened, and only the
+  exchanges' partitions move — ``refine_iters`` is a total *exchange
+  budget* accounted per APPLIED exchange, so churn is bounded by
+  2 x refine_iters instead of O(P) while a concentrated drift can spend
+  the whole budget on one stubborn peak across many cheap rounds.
 
-  The refine dispatch itself is transfer-lean: the previous choice vector
-  lives **device-resident** between refines (it is the engine's own
-  state — re-uploading it every epoch would double the payload), lags
-  upload as int32 when their range allows (as the cold path does), and
-  the validity mask is derived on device from the static shape, so the
-  round trip carries only the new lag vector in and the narrow choice
-  out.
+  The fused dispatch is transfer-lean AND compute-lean: the previous
+  choice vector, the [C, M] row table, and the counts live
+  **device-resident** between dispatches as DONATED buffers (they are
+  the engine's own state — re-uploading or rebuilding them every epoch
+  would dominate the dispatch), lags upload as int32 when their range
+  allows (as the cold path does), and the validity mask is derived on
+  device from the static shape, so the round trip carries only the new
+  lag vector in and the narrow choice out.  Executables are cached per
+  (P-bucket, C, budget) signature — warm them via :mod:`..warmup`'s
+  stream job so the steady-state loop compiles NOTHING (asserted by the
+  bench's ``warm_compile_count`` gate).
 
 * **membership change** — :meth:`StreamingAssignor.remap_members` carries
   the warm state across a join/leave (the usual rebalance trigger, where
@@ -60,8 +70,8 @@ import jax.numpy as jnp
 from ..utils.observability import count_constrained_bound
 from .batched import _narrow_choice, _stream_device, assign_stream, stream_payload
 from .dispatch import ensure_x64, observe_pack_shift
-from .packing import pad_bucket, pad_chunk
-from .refine import refine_assignment
+from .packing import pad_bucket, pad_chunk, table_rows
+from .refine import build_choice_tables, refine_rounds_resident
 
 
 @dataclass
@@ -74,6 +84,41 @@ class StreamingStats:
     max_mean_imbalance: float = 1.0
     imbalance_bound: float = 1.0  # input-driven lower bound max_lag/mean
     count_spread: int = 0
+    refine_rounds: int = 0  # resident-refine rounds the fused dispatch ran
+    refine_exchanges: int = 0  # exchanges it applied (churn <= 2x this)
+
+
+def _pad_choice(choice, B: int):
+    """Trace-time helper: padded int32[B] view of a choice vector that is
+    either already the padded device-resident buffer or an exact-shape
+    host start."""
+    if choice.shape[0] == B and choice.dtype == jnp.int32:
+        return choice
+    P = choice.shape[0]
+    return jnp.pad(choice.astype(jnp.int32), (0, B - P), constant_values=-1)
+
+
+def _refine_core(
+    lags_p, choice_p, row_tab, counts, totals, limit, P: int,
+    num_consumers: int, iters: int, max_pairs, exchange_budget: int,
+    bulk: bool = False,
+):
+    """Shared tail of every fused refine executable: the resident round
+    loop plus the narrowed host-facing output.  Returns
+    (narrow choice[P], choice int32[B], row_tab, counts, totals int64[C],
+    rounds int32, exchanges int32) — everything after the first element
+    stays device-resident with the caller.  ``bulk`` selects the warm
+    engine's anti-ranked bulk-swap rounds (see
+    :func:`..ops.refine.refine_rounds_resident`) with a 4-way partner
+    fan per heavy consumer; cold chains keep the parity selection."""
+    choice_p, row_tab, counts, totals, rounds, ex = refine_rounds_resident(
+        lags_p, choice_p, row_tab, counts, totals,
+        num_consumers=num_consumers, iters=iters, max_pairs=max_pairs,
+        exchange_budget=exchange_budget, quality_limit=limit,
+        bulk_transfer=bulk, fan=8 if bulk else 1,
+    )
+    narrow = _narrow_choice(choice_p[:P], num_consumers)
+    return narrow, choice_p, row_tab, counts, totals, rounds, ex
 
 
 @functools.partial(
@@ -87,24 +132,26 @@ def _pallas_cold_chain(
     lags, num_consumers: int, pack_shift: int, iters: int, max_pairs,
     bucket: int, interpret: bool = False, wide: bool = False,
 ):
-    """Cold solve -> refine as ONE dispatch with the Pallas round scan
-    (the in-VMEM variant of :meth:`StreamingAssignor._cold_solve`'s
-    chained path).  Same contract as solve + :func:`_refine_chain`:
-    exact-shape lags in, (narrow choice[P], padded refined int32[bucket]
-    kept device-resident by the caller) out.  Callers must have passed
-    BOTH Pallas gates host-side."""
+    """Cold solve -> table build -> resident refine as ONE dispatch with
+    the Pallas round scan (the in-VMEM variant of
+    :meth:`StreamingAssignor._cold_solve`'s chained path).  Same contract
+    as :func:`_refine_chain` with the greedy solve fused in front; the
+    emitted (choice, table, counts) triple seeds the engine's resident
+    warm state.  Callers must have passed BOTH Pallas gates host-side."""
     from .batched import _pallas_solve_padded
 
     P = lags.shape[0]
+    B = int(bucket)
     lags_p, valid, choice = _pallas_solve_padded(
-        lags, int(bucket), num_consumers, pack_shift, wide,
-        interpret=interpret,
+        lags, B, num_consumers, pack_shift, wide, interpret=interpret,
     )
-    refined, _, _ = refine_assignment(
-        lags_p, valid, choice, num_consumers=num_consumers,
-        iters=iters, max_pairs=max_pairs,
+    row_tab, counts, totals = build_choice_tables(
+        lags_p, valid, choice, num_consumers, table_rows(B, num_consumers)
     )
-    return _narrow_choice(refined[:P], num_consumers), refined
+    return _refine_core(
+        lags_p, choice, row_tab, counts, totals, -1.0, P,
+        num_consumers, iters, max_pairs, 0,
+    )
 
 
 @functools.partial(
@@ -113,34 +160,98 @@ def _pallas_cold_chain(
 def _refine_chain(
     lags, choice, num_consumers: int, iters: int, max_pairs, bucket: int
 ):
-    """One-dispatch refine over an exact-shape lag upload.
+    """One-dispatch cold-path refine over an exact-shape lag upload.
 
     ``lags`` is the exact [P] vector (int32 when the host downcast it,
-    widened back here); ``choice`` is EITHER the device-resident padded
-    int32[bucket] kept from the previous refine (no upload at all) or an
-    exact-shape [P] start (the cold chain feeds assign_stream's narrow
-    output without a host round-trip).  Padding and the validity mask are
+    widened back here); ``choice`` is an exact-shape [P] start (the cold
+    chain feeds assign_stream's narrow output without a host round-trip)
+    or a padded int32[bucket] buffer.  Padding and the validity mask are
     derived on device from the static shapes, so neither is transferred.
+    The per-consumer row table is built in-executable (one padded-size
+    sort) and returned device-resident, seeding the fused warm path.
 
     Returns (narrow choice[P] — the one output the host materializes —
-    and the padded refined int32[bucket], which the caller keeps
-    device-resident for the next epoch).
+    choice int32[bucket], row_tab, counts, totals, rounds, exchanges).
     """
     P = lags.shape[0]
     B = int(bucket)
     lags_p = jnp.pad(lags.astype(jnp.int64), (0, B - P))
-    if choice.shape[0] == B and choice.dtype == jnp.int32:
-        choice_p = choice
-    else:
-        choice_p = jnp.pad(
-            choice.astype(jnp.int32), (0, B - P), constant_values=-1
-        )
+    choice_p = _pad_choice(choice, B)
     valid = jnp.arange(B, dtype=jnp.int32) < P
-    refined, _, _ = refine_assignment(
-        lags_p, valid, choice_p, num_consumers=num_consumers,
-        iters=iters, max_pairs=max_pairs,
+    row_tab, counts, totals = build_choice_tables(
+        lags_p, valid, choice_p, num_consumers, table_rows(B, num_consumers)
     )
-    return _narrow_choice(refined[:P], num_consumers), refined
+    return _refine_core(
+        lags_p, choice_p, row_tab, counts, totals, -1.0, P,
+        num_consumers, iters, max_pairs, 0,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "num_consumers", "iters", "max_pairs", "exchange_budget", "bucket"
+    ),
+)
+def _warm_fused_build(
+    lags, choice, limit, num_consumers: int, iters: int, max_pairs,
+    exchange_budget: int, bucket: int,
+):
+    """Fused warm dispatch, table-BUILDING variant: used when the
+    resident state is stale (membership repair, host-side edits) — pays
+    one padded-size sort to rebuild the [C, M] table, then runs the same
+    fused quality-gated refine as the resident variant."""
+    P = lags.shape[0]
+    B = int(bucket)
+    lags_p = jnp.pad(lags.astype(jnp.int64), (0, B - P))
+    choice_p = _pad_choice(choice, B)
+    valid = jnp.arange(B, dtype=jnp.int32) < P
+    row_tab, counts, totals = build_choice_tables(
+        lags_p, valid, choice_p, num_consumers, table_rows(B, num_consumers)
+    )
+    return _refine_core(
+        lags_p, choice_p, row_tab, counts, totals, limit, P,
+        num_consumers, iters, max_pairs, exchange_budget, bulk=True,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "num_consumers", "iters", "max_pairs", "exchange_budget"
+    ),
+    donate_argnums=(1, 2, 3),
+)
+def _warm_fused_resident(
+    lags, choice, row_tab, counts, limit, num_consumers: int, iters: int,
+    max_pairs, exchange_budget: int,
+):
+    """THE fused warm-epoch executable: quality evaluation, target test,
+    and the full multi-round exchange loop in ONE dispatch over
+    device-RESIDENT state.
+
+    Only the exact-shape lag vector crosses host->device; the previous
+    choice, the per-consumer row table, and the counts are the donated
+    loop-carried buffers from the last dispatch (warm state never
+    round-trips to host between rounds, per the FlashSinkhorn fusion
+    playbook).  The per-consumer totals under the NEW lags are
+    re-derived from the resident table by one gather+sum — the fused
+    equivalent of the host-side quality bincount — and the while-loop
+    condition tests them against ``limit`` BEFORE the first round, so a
+    dispatch whose kept assignment already meets the target performs
+    zero rounds.  Returns the same tuple as :func:`_refine_chain`."""
+    P = lags.shape[0]
+    B = choice.shape[0]
+    M = row_tab.shape[1]
+    lags_p = jnp.pad(lags.astype(jnp.int64), (0, B - P))
+    slot_ok = jnp.arange(M, dtype=jnp.int32)[None, :] < counts[:, None]
+    totals = jnp.where(
+        slot_ok, lags_p[jnp.clip(row_tab, 0, B - 1)], 0
+    ).sum(axis=1)
+    return _refine_core(
+        lags_p, choice, row_tab, counts, totals, limit, P,
+        num_consumers, iters, max_pairs, exchange_budget, bulk=True,
+    )
 
 
 class StreamingAssignor:
@@ -187,10 +298,13 @@ class StreamingAssignor:
         self.imbalance_guardrail = imbalance_guardrail
         self.refine_threshold = refine_threshold
         self._prev_choice: Optional[np.ndarray] = None
-        # Padded int32[bucket] copy of the previous choice, kept on device
-        # between refines so a warm dispatch doesn't re-upload the
-        # engine's own state.  None = stale (host-side edits happened).
-        self._choice_dev = None
+        # Device-RESIDENT warm state between dispatches: (padded int32
+        # choice[bucket], per-consumer row table int32[C, M], counts
+        # int32[C]).  The fused warm executable takes these as DONATED
+        # buffers and returns their successors, so the engine's own state
+        # never round-trips to host.  None = stale (host-side edits:
+        # repair, remap, reset, shape change).
+        self._resident = None
         self.last_stats = StreamingStats()
 
     def rebalance(self, lags: np.ndarray) -> np.ndarray:
@@ -235,12 +349,14 @@ class StreamingAssignor:
             prev_for_churn = prev  # churn counts repair moves too
             choice, stats.repaired_rows = self._repair_choice(prev, lags)
             if stats.repaired_rows:
-                self._choice_dev = None  # device copy is stale now
+                self._resident = None  # device state is stale now
 
             # Evaluate the KEPT assignment under the new lags (host-side,
             # one weighted bincount) and dispatch the refinement only when
             # it is actually needed: a still-balanced epoch is a no-op —
-            # zero churn, zero device traffic.
+            # zero churn, zero device traffic.  (The fused executable
+            # re-evaluates on device and early-exits at the same target,
+            # so the host gate only decides WHETHER to dispatch at all.)
             self._fill_quality_stats(stats, choice, lags, bound,
                                      exact_bincount)
             needs_refine = self.refine_iters > 0 and (
@@ -249,10 +365,8 @@ class StreamingAssignor:
                 > self.refine_threshold * max(stats.imbalance_bound, 1.0)
             )
             if needs_refine:
-                choice = self._dispatch_warm_refine(lags, choice)
+                choice = self._dispatch_warm_refine(lags, choice, stats)
                 stats.refined = True
-                self._fill_quality_stats(stats, choice, lags, bound,
-                                         exact_bincount)
 
         # Quality guardrail: a warm epoch whose imbalance drifted past the
         # allowance re-solves cold (the churn bound intentionally yields).
@@ -269,10 +383,8 @@ class StreamingAssignor:
                 and not stats.refined
                 and self.refine_iters > 0
             ):
-                choice = self._dispatch_warm_refine(lags, choice)
+                choice = self._dispatch_warm_refine(lags, choice, stats)
                 stats.refined = True
-                self._fill_quality_stats(stats, choice, lags, bound,
-                                         exact_bincount)
             if stats.max_mean_imbalance > allowance:
                 stats.guardrail_tripped = True
                 stats.cold_start = True
@@ -304,7 +416,7 @@ class StreamingAssignor:
         by both kernels."""
         C = self.num_consumers
         if self.cold_refine_iters <= 0 or C < 2:
-            self._choice_dev = None
+            self._resident = None
             return np.asarray(
                 assign_stream(lags, num_consumers=C)
             ).astype(np.int32)
@@ -333,12 +445,12 @@ class StreamingAssignor:
                 observe_pack_shift(
                     ("cold_pallas", lags.shape, C), (shift, mode)
                 )
-                narrow, refined_pad = _pallas_cold_chain(
+                narrow, *resident = _pallas_cold_chain(
                     payload, num_consumers=C, pack_shift=shift,
                     iters=self.cold_refine_iters, max_pairs=None,
                     bucket=self._bucket(P), wide=(mode == "wide"),
                 )
-                self._choice_dev = refined_pad
+                self._resident = tuple(resident[:3])
                 return np.asarray(narrow).astype(np.int32)
             observe_pack_shift(("stream", lags.shape, C), (shift, rb))
             payload = jax.device_put(payload)  # ONE upload, both kernels
@@ -346,75 +458,112 @@ class StreamingAssignor:
                 payload, num_consumers=C, pack_shift=shift,
                 totals_rank_bits=rb,
             )
-        narrow, refined_pad = _refine_chain(
+        narrow, *resident = _refine_chain(
             payload, choice0, num_consumers=C,
             iters=self.cold_refine_iters, max_pairs=None,
             bucket=self._bucket(P),
         )
-        self._choice_dev = refined_pad
+        self._resident = tuple(resident[:3])
         return np.asarray(narrow).astype(np.int32)
+
+    def _quality_limit(self, bound: float, total_lag: float) -> float:
+        """Device-side early-exit target for the fused refine: peak
+        consumer total at the TIGHTER of refine_threshold / guardrail
+        (the same count-constrained normalization the host gate uses).
+        Negative disables (refine until budget/patience)."""
+        ratios = [
+            r for r in (self.refine_threshold, self.imbalance_guardrail)
+            if r is not None
+        ]
+        if not ratios:
+            return -1.0
+        mean_load = total_lag / max(self.num_consumers, 1)
+        return min(ratios) * max(bound, 1.0) * mean_load
 
     def _dispatch_warm_refine(
-        self, lags: np.ndarray, choice: np.ndarray
+        self, lags: np.ndarray, choice: np.ndarray, stats: StreamingStats
     ) -> np.ndarray:
-        """Split the exchange budget into rounds x pairs (rounds * pairs <=
-        refine_iters keeps the documented churn bound 2 * refine_iters)
-        and dispatch one bounded refine.
+        """ONE fused device dispatch for the whole warm epoch's quality
+        work: re-evaluate the kept assignment's totals under the new lags
+        (device-side, from the resident table), test them against the
+        quality target, and run the multi-round exchange loop with its
+        three early exits (target met / peak stagnant for ``patience``
+        rounds / exchange budget spent).  ``refine_iters`` is accounted
+        as APPLIED exchanges — churn stays bounded by 2 * refine_iters —
+        so a concentrated-drift epoch can spend its whole budget on one
+        stubborn peak across many cheap rounds instead of charging
+        rounds x pairs up front (the r5 regression: 23 charged rounds
+        exhausted a 512 budget at quality 1.12).
 
-        The split is BALANCED (pairs ~ rounds ~ sqrt(budget)) rather than
-        maximally wide: a single stubborn peak consumer sheds at most ONE
-        partition per round (pairs are disjoint — it sits in one pair),
-        so a wide-shallow split stalls on concentrated drift (measured on
-        the drained-hot-partition scenario: q 1.17 wide vs 1.07 balanced
-        at the same budget/churn), while a deep split still fixes broad
-        drift because each round repairs `pairs` consumers at once.  The
-        extra sequential rounds ride inside one executable, so the wall
-        cost on the target transport stays RTT-dominated."""
-        import math
-
-        pairs = max(
-            1,
-            min(self.num_consumers // 2, math.isqrt(self.refine_iters)),
-        )
-        rounds = max(1, self.refine_iters // pairs)
-        return self._warm_refine(lags, choice, rounds, pairs)
-
-    def _warm_refine(
-        self,
-        lags: np.ndarray,
-        choice: np.ndarray,
-        iters: int,
-        max_pairs: Optional[int],
-    ) -> np.ndarray:
-        """One transfer-lean refine dispatch: exact-shape lags up (int32
-        when the range allows), narrow choice back; the start assignment
-        is the device-resident padded copy when it is current (the usual
-        warm case — no choice upload at all)."""
+        Transfer contract: exact-shape lags up (int32 when the range
+        allows), narrow choice back; the previous choice, row table, and
+        counts live device-resident between dispatches as DONATED
+        buffers (zero re-upload of engine state).  Fills ``stats`` from
+        the executable's own totals/counts outputs — the fused
+        replacement for the post-refine host bincount."""
+        C = self.num_consumers
         P = lags.shape[0]
         B = self._bucket(P)
-        choice_in = self._choice_dev
-        if (
-            choice_in is None
-            or choice_in.shape[0] != B
-            or int(choice_in.dtype.itemsize) != 4
-        ):
-            choice_in = np.pad(
-                choice.astype(np.int32), (0, B - P), constant_values=-1
-            )
+        budget = self.refine_iters
+        # Bulk rounds: 16 pairs = the top 2 over-target consumers, each
+        # fanned across 8 light partners per round (the [K, M] slice
+        # work stays tiny while a stubborn peak drains 8 partners' worth
+        # of swaps per round); the pair-major (heaviest-first) budget
+        # quota still spends churn on the worst offenders first.  The
+        # old ~sqrt(budget) split existed for one-exchange-per-pair
+        # rounds, where width traded against rotation depth.
+        pairs = min(self.num_consumers // 2, 16)
+        limit = self._quality_limit(
+            stats.imbalance_bound, float(lags.sum(dtype=np.float64))
+        )
         payload, _ = stream_payload(lags)
-        # A lag-range drift across the int32 boundary changes the payload
-        # dtype and retraces _refine_chain — log it like every other
-        # recompile-on-drift path (the "shift" here is the upload width).
-        observe_pack_shift(
-            ("warm_refine", lags.shape, self.num_consumers),
-            int(payload.dtype.itemsize) * 8,
-        )
-        narrow, refined_pad = _refine_chain(
-            payload, choice_in, num_consumers=self.num_consumers,
-            iters=iters, max_pairs=max_pairs, bucket=B,
-        )
-        self._choice_dev = refined_pad
+        resident = self._resident
+        if (
+            resident is not None
+            and resident[0].shape[0] == B
+            and resident[1].shape == (C, table_rows(B, C))
+        ):
+            # A lag-range drift across the int32 boundary changes the
+            # payload dtype and retraces the fused executable — log it
+            # like every other recompile-on-drift path (the "shift" here
+            # is the upload width).
+            observe_pack_shift(
+                ("warm_fused", lags.shape, C),
+                int(payload.dtype.itemsize) * 8,
+            )
+            out = _warm_fused_resident(
+                payload, resident[0], resident[1], resident[2], limit,
+                num_consumers=C, iters=budget, max_pairs=pairs,
+                exchange_budget=budget,
+            )
+        else:
+            observe_pack_shift(
+                ("warm_fused_build", lags.shape, C),
+                int(payload.dtype.itemsize) * 8,
+            )
+            out = _warm_fused_build(
+                payload, choice.astype(np.int32), limit,
+                num_consumers=C, iters=budget, max_pairs=pairs,
+                exchange_budget=budget, bucket=B,
+            )
+        narrow, choice_p, row_tab, counts, totals, rounds, ex = out
+        self._resident = (choice_p, row_tab, counts)
+        self._fill_stats_from_device(stats, totals, counts, rounds, ex)
         return np.asarray(narrow).astype(np.int32)
+
+    def _fill_stats_from_device(
+        self, stats: StreamingStats, totals, counts, rounds, ex
+    ) -> None:
+        """Quality stats from the fused executable's own accumulators —
+        exact int64, so no 2^53 fallback is needed (the device totals ARE
+        the scatter-add the host bincount approximates)."""
+        totals = np.asarray(totals)
+        counts = np.asarray(counts)
+        mean = totals.mean()
+        stats.max_mean_imbalance = float(totals.max() / mean) if mean else 1.0
+        stats.count_spread = int(counts.max() - counts.min())
+        stats.refine_rounds = int(rounds)
+        stats.refine_exchanges = int(ex)
 
     def _fill_quality_stats(
         self,
@@ -472,7 +621,7 @@ class StreamingAssignor:
             remapped = np.full(prev.shape[0], -1, dtype=np.int32)
             remapped[valid] = old_to_new[prev[valid]]
             self._prev_choice = remapped
-        self._choice_dev = None  # device copy predates the remap
+        self._resident = None  # device state predates the remap
         self.num_consumers = int(new_num_consumers)
 
     def _repair_choice(self, choice: np.ndarray, lags: np.ndarray):
@@ -554,4 +703,4 @@ class StreamingAssignor:
     def reset(self) -> None:
         """Drop warm state (force the next rebalance to solve cold)."""
         self._prev_choice = None
-        self._choice_dev = None
+        self._resident = None

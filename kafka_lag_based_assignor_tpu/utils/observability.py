@@ -30,6 +30,65 @@ TRACE = 5
 logging.addLevelName(TRACE, "TRACE")
 
 
+# --- Compile observability ---------------------------------------------
+#
+# A fresh XLA compile on the latency-critical rebalance path is THE
+# silent performance cliff of this system (tens of seconds through a
+# remote-compile transport; the r5 warm-path regression hid exactly
+# there).  Two counters make it observable and assertable:
+#
+# * ``compile_count()`` — fresh backend compiles seen process-wide, fed
+#   by jax.monitoring's backend-compile duration event.  A cached
+#   executable fires no event, so the steady-state warm loop can assert
+#   a ZERO delta (bench.py's ``warm_compile_count``; the regression test
+#   in tests/test_streaming.py).
+# * ``static_drift_count()`` — value-derived STATIC kernel args observed
+#   changing per call signature (ops/dispatch.observe_pack_shift), i.e.
+#   recompiles caused by input value ranges drifting across a packing
+#   bound rather than by new shapes.
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_compile_count = [0]
+_compile_listener_installed = [False]
+_static_drift_count = [0]
+
+
+def install_compile_counter() -> None:
+    """Idempotently register the jax.monitoring listener behind
+    :func:`compile_count`.  Call once at process setup (warm-up, bench,
+    service start) BEFORE the executables of interest are built; compiles
+    that happen earlier are simply not counted."""
+    if _compile_listener_installed[0]:
+        return
+    from jax import monitoring
+
+    def _on_duration(name, *_args, **_kw):
+        if name == _COMPILE_EVENT:
+            _compile_count[0] += 1
+
+    monitoring.register_event_duration_secs_listener(_on_duration)
+    _compile_listener_installed[0] = True
+
+
+def compile_count() -> int:
+    """Fresh XLA backend compiles observed since
+    :func:`install_compile_counter` (0 if never installed).  Snapshot it
+    around a steady-state loop and assert the delta is zero."""
+    return _compile_count[0]
+
+
+def note_static_drift() -> None:
+    """Record one observed static-kernel-arg drift (called by
+    ops/dispatch.observe_pack_shift when a call signature's value-derived
+    static args change — each such change compiles a fresh executable
+    unless the variant was warmed)."""
+    _static_drift_count[0] += 1
+
+
+def static_drift_count() -> int:
+    return _static_drift_count[0]
+
+
 def count_constrained_bound(lags, num_consumers: int) -> float:
     """Input-driven lower bound on max/mean lag imbalance for ANY valid
     assignment — THE normalizer for the north-star quality metric.

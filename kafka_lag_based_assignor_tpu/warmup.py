@@ -77,12 +77,16 @@ def warmup(
         static-arg compile than plain parity) — exactly what a
         ``tpu.assignor.refine.iters`` deployment dispatches.
       stream_refine_iters: the StreamingAssignor exchange budget to warm —
-        the "stream" warm-up runs a cold+warm rebalance pair so BOTH the
-        cold :func:`..ops.batched.assign_stream` compile and the warm-path
-        :func:`..ops.refine.refine_assignment` compile (at the padded
-        bucket shape) happen here, not on the first warm rebalance's
-        critical path.  Must match the production ``refine_iters`` passed
-        to :class:`..ops.streaming.StreamingAssignor`.
+        the "stream" warm-up drives a cold + warm + repair-invalidated
+        rebalance sequence so the cold :func:`..ops.batched.assign_stream`
+        compile, the cold table-build+refine chain, AND both fused warm
+        executables (:func:`..ops.streaming._warm_fused_resident` /
+        ``_warm_fused_build``, at the padded bucket shape) happen here,
+        not on any rebalance's critical path.  Must match the production
+        ``refine_iters`` passed to
+        :class:`..ops.streaming.StreamingAssignor` (iters, pairs, and
+        exchange budget are static args — a different budget is a
+        different executable).
 
     Returns a list of (solver, T, P_bucket, C, seconds) for each shape
     compiled.  Failures are logged and skipped — warm-up must never take a
@@ -96,8 +100,13 @@ def warmup(
     from .ops.dispatch import ensure_x64
     from .ops.rounds_kernel import assign_global_rounds
     from .ops.scan_kernel import pack_shift_for
+    from .utils.observability import install_compile_counter
 
     ensure_x64()
+    # Compiles from here on are observable: deployments (and the bench)
+    # snapshot utils/observability.compile_count() after warm-up and
+    # assert the steady-state loop's delta is ZERO.
+    install_compile_counter()
     p_buckets = (
         bucket_range(max_partitions)
         if all_partition_buckets
@@ -117,15 +126,15 @@ def warmup(
                 def stream_job(lags1d=lags1d, C=C):
                     # Cold + warm pair through the production engine: the
                     # cold call compiles assign_stream AND the cold-chain
-                    # refine executable (its iters/max_pairs static args
-                    # differ from the warm path's, so it is a separate
-                    # compile); the warm call compiles the warm-path
-                    # refine variant at the padded bucket shape with the
-                    # production exchange budget.  refine_threshold=None
-                    # forces the warm dispatch — with the default
-                    # threshold a warm-up epoch on unchanged lags would
-                    # skip it (the no-op fast path) and leave the warm
-                    # executable cold.
+                    # table-build + resident-refine executable (its
+                    # iters/max_pairs static args differ from the warm
+                    # path's, so it is a separate compile); the warm call
+                    # compiles the fused warm RESIDENT executable at the
+                    # padded bucket shape with the production exchange
+                    # budget.  refine_threshold=None forces the warm
+                    # dispatch — with the default threshold a warm-up
+                    # epoch on unchanged lags would skip it (the no-op
+                    # fast path) and leave the warm executable cold.
                     from .ops.batched import assign_stream
                     from .ops.rounds_pallas import rounds_pallas_available
                     from .ops.streaming import StreamingAssignor
@@ -141,11 +150,18 @@ def warmup(
                     )
                     engine.rebalance(lags1d)
                     out = engine.rebalance(lags1d)
+                    # The table-BUILDING fused variant serves epochs whose
+                    # resident state is stale (membership repair, remap):
+                    # an identity remap invalidates the device state
+                    # without moving a row, so the next warm dispatch
+                    # compiles exactly that executable.
+                    engine.remap_members(np.arange(C, dtype=np.int32), C)
+                    engine.rebalance(lags1d)
                     # assign_stream downcasts the upload to int32 when the
                     # lag range allows; ALSO warm the wide-lag (int64)
-                    # variants of both the stream kernel and the warm
-                    # refine so a later rebalance whose lags exceed int32
-                    # doesn't hit a fresh compile mid-rebalance.
+                    # variants of both the stream kernel and the fused
+                    # warm refine so a later rebalance whose lags exceed
+                    # int32 doesn't hit a fresh compile mid-rebalance.
                     wide = lags1d + (np.int64(1) << 32)
                     assign_stream(wide, num_consumers=C)
                     engine.rebalance(wide)

@@ -234,3 +234,49 @@ def test_count_constrained_bound_edge_cases():
     assert count_constrained_bound(lags, 10) == 1.0
     # Single consumer: everything on it; bound == 1.
     assert count_constrained_bound(np.arange(1, 6, dtype=np.int64), 1) == 1.0
+
+
+def test_compile_counter_counts_fresh_compiles_only():
+    """The compile counter must tick on a FRESH executable build and stay
+    flat on cache hits — the property the bench's warm_compile_count gate
+    and the steady-state warm-loop regression test rely on."""
+    import jax
+    import numpy as np
+
+    from kafka_lag_based_assignor_tpu.utils.observability import (
+        compile_count,
+        install_compile_counter,
+    )
+
+    install_compile_counter()
+    install_compile_counter()  # idempotent: no double counting
+
+    @jax.jit
+    def f(x):
+        return (x * 3 + 1).sum()
+
+    before = compile_count()
+    f(np.arange(7))                  # fresh compile
+    mid = compile_count()
+    assert mid == before + 1
+    f(np.arange(7) + 5)              # cache hit: same shape/dtype
+    assert compile_count() == mid
+    f(np.arange(9))                  # new shape: fresh compile again
+    assert compile_count() == mid + 1
+
+
+def test_static_drift_counter():
+    """observe_pack_shift bumps the process-wide drift counter exactly
+    when a call signature's value-derived static args change."""
+    from kafka_lag_based_assignor_tpu.ops.dispatch import observe_pack_shift
+    from kafka_lag_based_assignor_tpu.utils.observability import (
+        static_drift_count,
+    )
+
+    key = ("test_drift", (64,), 4)
+    observe_pack_shift(key, 7)           # first sighting: no drift
+    base = static_drift_count()
+    observe_pack_shift(key, 7)           # unchanged: no drift
+    assert static_drift_count() == base
+    observe_pack_shift(key, 9)           # changed: one drift
+    assert static_drift_count() == base + 1

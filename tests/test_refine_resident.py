@@ -1,0 +1,204 @@
+"""Resident-table refine: bit-parity vs the oracle kernel + the fused
+warm-path semantics.
+
+The fused warm executable's core (``refine_rounds_resident``) must pick
+EXACTLY the exchanges :func:`refine_assignment` picks — same quantized
+scores, same nearest-neighbour swap restriction, same tie-breaks — so the
+differential fuzz here compares the two bit-for-bit across shapes, tie
+profiles, and budgets.  The opt-in extensions (quality-limit early exit,
+applied-exchange budget accounting) are pinned separately.
+"""
+
+import numpy as np
+import pytest
+
+from kafka_lag_based_assignor_tpu.ops.refine import (
+    refine_assignment,
+    refine_assignment_resident,
+)
+
+
+def recompute(lags, valid, choice, C):
+    totals = np.zeros(C, dtype=np.int64)
+    counts = np.zeros(C, dtype=np.int64)
+    sel = valid & (choice >= 0)
+    np.add.at(totals, choice[sel], lags[sel])
+    np.add.at(counts, choice[sel], 1)
+    return totals, counts
+
+
+def fuzz_instance(seed):
+    """One differential-fuzz draw: random C/P/padding, three lag
+    profiles (uniform-random, hot tail, heavy ties), balanced start."""
+    rng = np.random.default_rng(seed)
+    C = int(rng.integers(2, 40))
+    P = int(rng.integers(C, 2500))
+    pad = int(rng.integers(0, 128))
+    lags = np.zeros(P + pad, dtype=np.int64)
+    lags[:P] = rng.integers(0, 10**9, P)
+    if seed % 3 == 0:  # hot tail forces a nonzero quantization shift
+        lags[: max(P // 10, 1)] = rng.integers(
+            10**11, 10**12, max(P // 10, 1)
+        )
+    if seed % 4 == 1:  # heavy ties exercise every tie-break rule
+        lags[:P] = rng.integers(0, 5, P)
+    valid = np.zeros(P + pad, bool)
+    valid[:P] = True
+    choice = np.full(P + pad, -1, np.int32)
+    choice[:P] = rng.permutation(P) % C
+    iters = int(rng.integers(1, 40))
+    max_pairs = None if rng.random() < 0.5 else int(rng.integers(1, C))
+    return lags, valid, choice, C, iters, max_pairs
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_bit_parity_with_oracle_kernel(seed):
+    """Differential fuzz: the fused resident executable and the
+    per-round oracle chain return identical (choice, counts, totals)."""
+    lags, valid, choice, C, iters, max_pairs = fuzz_instance(seed)
+    a = refine_assignment(
+        lags, valid, choice, num_consumers=C, iters=iters,
+        max_pairs=max_pairs,
+    )
+    b = refine_assignment_resident(
+        lags, valid, choice, num_consumers=C, iters=iters,
+        max_pairs=max_pairs,
+    )
+    for x, y, name in zip(a, b, ("choice", "counts", "totals")):
+        np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y), err_msg=f"{name} diverged"
+        )
+
+
+def test_build_tables_roundtrip():
+    """The [C, M] table partitions exactly the assigned rows, with
+    counts/totals matching a host recompute."""
+    import jax.numpy as jnp
+
+    from kafka_lag_based_assignor_tpu.ops.packing import table_rows
+    from kafka_lag_based_assignor_tpu.ops.refine import build_choice_tables
+
+    rng = np.random.default_rng(7)
+    P, pad, C = 700, 68, 9
+    lags = np.zeros(P + pad, np.int64)
+    lags[:P] = rng.integers(0, 10**6, P)
+    valid = np.zeros(P + pad, bool)
+    valid[:P] = True
+    choice = np.full(P + pad, -1, np.int32)
+    choice[:P] = rng.permutation(P) % C
+    M = table_rows(P + pad, C)
+    row_tab, counts, totals = build_choice_tables(
+        jnp.asarray(lags), jnp.asarray(valid), jnp.asarray(choice), C, M
+    )
+    row_tab, counts, totals = (
+        np.asarray(row_tab), np.asarray(counts), np.asarray(totals)
+    )
+    ref_totals, ref_counts = recompute(lags, valid, choice, C)
+    np.testing.assert_array_equal(counts, ref_counts)
+    np.testing.assert_array_equal(totals, ref_totals)
+    seen = []
+    for c in range(C):
+        rows = row_tab[c, : counts[c]]
+        assert (choice[rows] == c).all()
+        assert (row_tab[c, counts[c]:] == P + pad).all()  # sentinel
+        seen.extend(rows.tolist())
+    assert sorted(seen) == sorted(np.nonzero(choice >= 0)[0].tolist())
+
+
+def test_quality_limit_early_exit():
+    """With a peak-total limit the loop stops as soon as the target is
+    met — fewer exchanges, bounded churn, target satisfied — while the
+    unlimited run keeps grinding toward the optimum."""
+    rng = np.random.default_rng(11)
+    P, C = 1024, 8
+    lags = rng.integers(10**6, 10**9, P).astype(np.int64)
+    valid = np.ones(P, bool)
+    choice = (np.arange(P) % C).astype(np.int32)
+    t0, _ = recompute(lags, valid, choice, C)
+    limit = 1.10 * t0.sum() / C  # 10% above perfect balance
+    c_lim, _, tot_lim = refine_assignment_resident(
+        lags, valid, choice, num_consumers=C, iters=64,
+        quality_limit=float(limit),
+    )
+    c_full, _, tot_full = refine_assignment_resident(
+        lags, valid, choice, num_consumers=C, iters=64,
+    )
+    tot_lim, tot_full = np.asarray(tot_lim), np.asarray(tot_full)
+    assert tot_lim.max() <= limit  # target met...
+    assert tot_full.max() <= tot_lim.max()  # ...but not over-refined
+    churn_lim = int((np.asarray(c_lim) != choice).sum())
+    churn_full = int((np.asarray(c_full) != choice).sum())
+    assert churn_lim < churn_full  # early exit moved strictly less
+
+
+def test_quality_limit_already_met_is_noop():
+    """A start already inside the limit must run ZERO rounds (the fused
+    warm dispatch's round-0 skip)."""
+    P, C = 256, 4
+    lags = np.full(P, 100, np.int64)
+    valid = np.ones(P, bool)
+    choice = (np.arange(P) % C).astype(np.int32)
+    limit = 1.02 * (P * 100) / C
+    out, _, _ = refine_assignment_resident(
+        lags, valid, choice, num_consumers=C, iters=64,
+        quality_limit=float(limit),
+    )
+    np.testing.assert_array_equal(np.asarray(out), choice)
+
+
+@pytest.mark.parametrize("budget", [4, 16, 64])
+def test_exchange_budget_bounds_churn(budget):
+    """Applied-exchange accounting: churn <= 2 * budget even when the
+    round cap would allow far more movement."""
+    rng = np.random.default_rng(13)
+    P, C = 2048, 16
+    lags = rng.integers(0, 10**9, P).astype(np.int64)
+    valid = np.ones(P, bool)
+    choice = rng.permutation(P).astype(np.int32) % C
+    out, counts, totals = refine_assignment_resident(
+        lags, valid, choice, num_consumers=C, iters=budget,
+        max_pairs=4, exchange_budget=budget, patience=10**6,
+    )
+    churn = int((np.asarray(out) != choice).sum())
+    assert churn <= 2 * budget
+    # Invariants survive the budgeted run.
+    t1, c1 = recompute(lags, valid, np.asarray(out), C)
+    np.testing.assert_array_equal(np.asarray(totals), t1)
+    np.testing.assert_array_equal(np.asarray(counts), c1)
+    t0, _ = recompute(lags, valid, choice, C)
+    assert t1.max() <= t0.max()
+
+
+def test_exchange_budget_outruns_round_split_on_concentrated_drift():
+    """The r5 regression scenario in miniature: one consumer's rows heat
+    up; the old rounds x pairs charge exhausts the budget while the peak
+    still needs shedding, the applied-exchange accounting keeps going
+    (many cheap rounds, same churn bound) and lands materially closer to
+    balance."""
+    rng = np.random.default_rng(17)
+    P, C, budget = 4096, 64, 256
+    lags = rng.integers(10**5, 10**6, P).astype(np.int64)
+    valid = np.ones(P, bool)
+    choice = (np.arange(P) % C).astype(np.int32)
+    lags = np.where(choice == 7, lags * 3, lags)  # concentrated drift
+    import math
+
+    pairs = max(1, min(C // 2, math.isqrt(budget)))
+    # Old semantics: rounds = budget // pairs, everything charged up
+    # front, no target — near-balanced pairs' cosmetic exchanges burn
+    # budget the hot consumer needed.
+    old, _, old_tot = refine_assignment(
+        lags, valid, choice, num_consumers=C,
+        iters=max(1, budget // pairs), max_pairs=pairs,
+    )
+    # New semantics, configured exactly as the streaming engine does:
+    # applied-exchange budget + the quality target as the device limit.
+    limit = 1.05 * float(lags.sum()) / C
+    new, _, new_tot = refine_assignment_resident(
+        lags, valid, choice, num_consumers=C, iters=budget,
+        max_pairs=pairs, exchange_budget=budget, quality_limit=limit,
+    )
+    old_tot, new_tot = np.asarray(old_tot), np.asarray(new_tot)
+    assert new_tot.max() <= limit  # target met within the budget
+    assert new_tot.max() < old_tot.max()
+    assert int((np.asarray(new) != choice).sum()) <= 2 * budget

@@ -274,11 +274,11 @@ def test_cold_chain_matches_xla_chain_interpret():
     choice0 = _stream_device(
         payload, num_consumers=C, pack_shift=shift
     )
-    ref_narrow, ref_pad = _refine_chain(
+    ref_narrow, ref_pad, *ref_state = _refine_chain(
         payload, choice0, num_consumers=C, iters=16, max_pairs=None,
         bucket=B,
     )
-    p_narrow, p_pad = _pallas_cold_chain(
+    p_narrow, p_pad, *p_state = _pallas_cold_chain(
         payload, num_consumers=C, pack_shift=shift, iters=16,
         max_pairs=None, bucket=B, interpret=True,
     )
@@ -286,6 +286,10 @@ def test_cold_chain_matches_xla_chain_interpret():
         np.asarray(p_narrow), np.asarray(ref_narrow)
     )
     np.testing.assert_array_equal(np.asarray(p_pad), np.asarray(ref_pad))
+    # The emitted resident warm state (row table / counts) must agree
+    # too — it seeds the fused warm path after a cold solve.
+    for a, b in zip(ref_state[:2], p_state[:2]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
 class TestWideTotals:

@@ -325,6 +325,94 @@ class TestMembershipChange:
         assert cnt.max() - cnt.min() <= 1
 
 
+def test_steady_state_warm_loop_compiles_nothing():
+    """Compile-count regression (the r5 warm-path tax): once an engine
+    has run a cold epoch and one warm refine dispatch at a shape, further
+    warm epochs at that shape — no-ops AND refine dispatches alike —
+    must compile ZERO fresh XLA executables."""
+    from kafka_lag_based_assignor_tpu.utils.observability import (
+        compile_count,
+        install_compile_counter,
+    )
+
+    install_compile_counter()
+    rng = np.random.default_rng(21)
+    P, C = 1024, 8
+    # Lags safely inside int32 so the payload dtype cannot flip mid-loop.
+    lags = rng.integers(10**3, 10**6, P).astype(np.int64)
+    engine = StreamingAssignor(
+        num_consumers=C, refine_iters=64, refine_threshold=1.02,
+        imbalance_guardrail=None,
+    )
+    choice = engine.rebalance(lags)          # cold (compiles)
+    hot = np.where(choice == 0, lags * 3, lags).astype(np.int64)
+    engine.rebalance(hot)                    # warm refine (compiles fused)
+    assert engine.last_stats.refined
+    before = compile_count()
+    for _ in range(4):
+        drifted = np.maximum(
+            (lags * rng.lognormal(0, 0.01, P)), 1
+        ).astype(np.int64)
+        engine.rebalance(drifted)            # no-op epochs
+        hot = np.where(choice == 1, drifted * 3, drifted).astype(np.int64)
+        engine.rebalance(hot)                # refine epochs
+        assert engine.last_stats.refined
+    assert compile_count() == before, (
+        "steady-state warm loop compiled a fresh executable"
+    )
+
+
+def test_resident_state_matches_fresh_build_every_epoch():
+    """The device-resident (choice, table, counts) state carried across
+    fused dispatches must be indistinguishable from rebuilding it from
+    the previous epoch's choice: two engines — one whose resident state
+    is dropped before every epoch — must emit bit-identical choices
+    under the same drift sequence."""
+    rng = np.random.default_rng(22)
+    P, C = 2048, 16
+    kw = dict(num_consumers=C, refine_iters=128, refine_threshold=1.01)
+    a = StreamingAssignor(**kw)
+    b = StreamingAssignor(**kw)
+    lags = rng.integers(10**6, 10**9, P).astype(np.int64)
+    ca = a.rebalance(lags)
+    cb = b.rebalance(lags)
+    np.testing.assert_array_equal(ca, cb)
+    for i in range(6):
+        lags = np.maximum(
+            (lags * rng.lognormal(0, 0.1, P)), 1
+        ).astype(np.int64)
+        if i % 2:  # concentrated drift to force refine dispatches
+            lags = np.where(ca == i % C, lags * 2, lags)
+        b._resident = None  # white-box: force the table-build variant
+        ca = a.rebalance(lags)
+        cb = b.rebalance(lags)
+        np.testing.assert_array_equal(ca, cb)
+    assert a.last_stats.refined  # the comparison exercised the dispatch
+
+
+def test_fused_refine_meets_quality_target_with_bounded_churn():
+    """The fused dispatch's device-side early exit must stop AT the
+    configured target: quality lands at or under refine_threshold x
+    bound while churn stays within 2 x the applied exchanges (which the
+    stats now report)."""
+    rng = np.random.default_rng(23)
+    P, C = 4096, 32
+    engine = StreamingAssignor(
+        num_consumers=C, refine_iters=512, refine_threshold=1.02
+    )
+    lags = rng.integers(10**6, 10**8, P).astype(np.int64)
+    prev = engine.rebalance(lags)
+    drifted = np.where(prev == 5, lags * 3, lags).astype(np.int64)
+    engine.rebalance(drifted)
+    s = engine.last_stats
+    assert s.refined and not s.cold_start
+    assert s.max_mean_imbalance <= 1.02 * max(s.imbalance_bound, 1.0) + 1e-9
+    assert s.refine_exchanges <= 512
+    assert s.churn <= 2 * s.refine_exchanges
+    # Target-directed spending: nowhere near the whole budget was needed.
+    assert s.refine_exchanges < 512
+
+
 @pytest.mark.parametrize("seed", range(4))
 def test_engine_random_operation_sequences(seed):
     """Stateful fuzz: random interleavings of drift/rebalance, membership

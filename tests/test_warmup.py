@@ -81,26 +81,45 @@ def test_warmup_scan_solver_compiles():
     assert assign_batched_scan._cache_size() == before
 
 
-def test_stream_warmup_covers_cold_refine_variant():
-    """The stream warm-up's cold call compiles the cold-solve refine
-    executable too, so a production guardrail trip never pays a fresh
-    compile (its static args differ from the warm path's)."""
+def test_stream_warmup_covers_cold_and_fused_warm_variants():
+    """The stream warm-up covers the whole executable family a
+    production engine dispatches at the warmed shape: the cold
+    table-build+refine chain (guardrail trips re-solve through it) AND
+    both fused warm variants (resident and table-building) — so no
+    rebalance at the warmed shape ever pays a fresh compile."""
     import numpy as np
 
-    from kafka_lag_based_assignor_tpu.ops.refine import refine_assignment
-    from kafka_lag_based_assignor_tpu.ops.streaming import StreamingAssignor
+    from kafka_lag_based_assignor_tpu.ops.streaming import (
+        StreamingAssignor,
+        _refine_chain,
+        _warm_fused_build,
+        _warm_fused_resident,
+    )
     from kafka_lag_based_assignor_tpu.warmup import warmup
 
     warmup(max_partitions=64, consumers=[4], solvers=("stream",))
-    before = refine_assignment._cache_size()
-    # Fresh engine at the warmed shape: cold start (refined) then a
-    # guardrail-trip-style cold solve must both hit the cache.
+    before = (
+        _refine_chain._cache_size(),
+        _warm_fused_resident._cache_size(),
+        _warm_fused_build._cache_size(),
+    )
+    # Fresh engine at the warmed shape: cold start (refined), a warm
+    # fused dispatch, a repair-invalidated (build-variant) dispatch, and
+    # a guardrail-trip-style cold solve must ALL hit the cache.
     eng = StreamingAssignor(num_consumers=4, refine_iters=128,
-                            imbalance_guardrail=1.25)
+                            imbalance_guardrail=1.25,
+                            refine_threshold=None)
     lags = np.arange(64, dtype=np.int64) * 100
     eng.rebalance(lags)   # cold (refined)
-    eng.rebalance(lags)   # warm
-    assert refine_assignment._cache_size() == before
+    eng.rebalance(lags)   # warm fused (resident variant)
+    eng.remap_members(np.arange(4, dtype=np.int32), 4)
+    eng.rebalance(lags)   # warm fused (table-build variant)
+    after = (
+        _refine_chain._cache_size(),
+        _warm_fused_resident._cache_size(),
+        _warm_fused_build._cache_size(),
+    )
+    assert after == before
 
 
 def test_warmup_covers_oneshot_refined_variant():
