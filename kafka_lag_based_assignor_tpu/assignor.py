@@ -28,7 +28,7 @@ from __future__ import annotations
 import logging
 from typing import Any, Callable, Mapping, Optional
 
-from .lag import MetadataConsumer, read_topic_partition_lags
+from .lag import LagRetryPolicy, MetadataConsumer, read_topic_partition_lags
 from .models.greedy import assign_greedy, host_fallback_for
 from .types import (
     Assignment,
@@ -37,6 +37,7 @@ from .types import (
     GroupSubscription,
     TopicPartition,
 )
+from .utils import faults
 from .utils.config import PARITY_SOLVERS, AssignorConfig, parse_config
 from .utils.watchdog import Watchdog
 from .utils.observability import (
@@ -69,6 +70,7 @@ class LagBasedPartitionAssignor:
         self._metadata_consumer: Optional[MetadataConsumer] = None
         self._metadata_consumer_factory = metadata_consumer_factory
         self._watchdog: Optional[Watchdog] = None
+        self._lag_retry: Optional[LagRetryPolicy] = None
         self.last_stats: Optional[RebalanceStats] = None
 
     # -- Configurable SPI --------------------------------------------------
@@ -76,7 +78,21 @@ class LagBasedPartitionAssignor:
     def configure(self, configs: Mapping[str, Any]) -> None:
         """Reference :97-130 — fails fast if ``group.id`` is absent."""
         self._config = parse_config(configs)
-        self._watchdog = Watchdog(self._config.solve_timeout_s)
+        self._watchdog = Watchdog(
+            self._config.solve_timeout_s,
+            cooldown_s=self._config.breaker_cooldown_s,
+            failure_threshold=self._config.breaker_failures,
+        )
+        # Opt-in bounded lag-RPC retry; 0 retries = the reference's
+        # broker-exception-aborts-the-rebalance semantics, untouched.
+        self._lag_retry = (
+            LagRetryPolicy(
+                attempts=self._config.lag_retries + 1,
+                backoff_s=self._config.lag_retry_backoff_s,
+            )
+            if self._config.lag_retries > 0
+            else None
+        )
         LOGGER.debug(
             "Configured LagBasedPartitionAssignor with values:\n"
             "\tgroup.id = %s\n\tclient.id = %s\n\tsolver = %s",
@@ -182,13 +198,15 @@ class LagBasedPartitionAssignor:
             all_subscribed.update(topics)
 
         # Lag acquisition — exceptions propagate and fail the rebalance,
-        # matching the reference's absence of try/catch (:339-342).
+        # matching the reference's absence of try/catch (:339-342), unless
+        # the deployment opted into the bounded retry policy.
         with stopwatch() as lag_ms:
             lags = read_topic_partition_lags(
                 self._get_metadata_consumer(),
                 metadata,
                 all_subscribed,
                 self._config.auto_offset_reset,
+                retry=self._lag_retry,
             )
         stats.lag_read_ms = lag_ms[0]
 
@@ -244,12 +262,16 @@ class LagBasedPartitionAssignor:
             # Device/native solves run under the watchdog: a wedged
             # accelerator transport can HANG rather than raise, and a
             # rebalance must never block past its deadline (SURVEY §5,
-            # failure-detection row).
-            return self._watchdog.call(
+            # failure-detection row).  The breaker key is the SOLVER so a
+            # wedged sinkhorn compile cannot banish the rounds kernel.
+            result = self._watchdog.call(
                 self._solve_accelerated, solver, lags, topic_subscriptions,
-                options,
+                options, key=solver,
             )
+            stats.breaker_state = self._watchdog.state(solver)
+            return result
         except Exception:
+            stats.breaker_state = self._watchdog.state(solver)
             if not self._config.host_fallback:
                 raise
             LOGGER.warning(
@@ -263,6 +285,7 @@ class LagBasedPartitionAssignor:
 
     @staticmethod
     def _solve_accelerated(solver, lags, topic_subscriptions, options=None):
+        faults.fire("device.solve")
         options = options or {}
         if solver == "sinkhorn":
             from .models.sinkhorn import assign_sinkhorn

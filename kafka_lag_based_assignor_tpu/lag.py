@@ -18,7 +18,19 @@ is testable with a fake — the reference left this layer untested (SURVEY §4).
 from __future__ import annotations
 
 import logging
-from typing import Dict, Iterable, List, Mapping, Optional, Protocol, Sequence, Set
+import time
+from dataclasses import dataclass, field
+from typing import (
+    Callable,
+    Dict,
+    Iterable,
+    List,
+    Mapping,
+    Optional,
+    Protocol,
+    Sequence,
+    Set,
+)
 
 from .types import (
     Cluster,
@@ -27,8 +39,53 @@ from .types import (
     TopicPartition,
     TopicPartitionLag,
 )
+from .utils import faults
 
 LOGGER = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class LagRetryPolicy:
+    """Opt-in bounded retry for the three lag batch RPCs.
+
+    The DEFAULT (no policy) preserves reference abort semantics exactly:
+    a broker exception propagates and fails the rebalance (SURVEY
+    §2.4.9).  With a policy, each RPC is attempted up to ``attempts``
+    times with deterministic exponential backoff
+    (``backoff_s * multiplier**i`` — no jitter, so a drill replays the
+    same schedule) before the final exception propagates.  ``sleep`` is
+    injectable so tests assert the backoff sequence without real sleeps.
+    """
+
+    attempts: int = 3
+    backoff_s: float = 0.05
+    multiplier: float = 2.0
+    sleep: Callable[[float], None] = field(default=time.sleep, repr=False)
+
+    def __post_init__(self):
+        if self.attempts < 1:
+            raise ValueError(f"attempts={self.attempts} must be >= 1")
+
+
+def _call_with_retry(
+    fn: Callable[[], Mapping], what: str, retry: Optional[LagRetryPolicy]
+):
+    """Run one batch RPC under the (optional) retry policy."""
+    if retry is None or retry.attempts <= 1:
+        return fn()
+    for attempt in range(retry.attempts):
+        try:
+            return fn()
+        except Exception:
+            if attempt == retry.attempts - 1:
+                raise
+            delay = retry.backoff_s * retry.multiplier**attempt
+            LOGGER.warning(
+                "lag RPC %s failed (attempt %d/%d); retrying in %.3fs",
+                what, attempt + 1, retry.attempts, delay, exc_info=True,
+            )
+            retry.sleep(delay)
+    raise AssertionError("unreachable")  # the loop returns or raises
 
 
 def compute_partition_lag(
@@ -81,6 +138,7 @@ def read_topic_partition_lags(
     cluster: Cluster,
     all_subscribed_topics: Iterable[str],
     auto_offset_reset_mode: str = "latest",
+    retry: Optional[LagRetryPolicy] = None,
 ) -> LagMap:
     """Fetch current consumer-group lag for every partition of every topic.
 
@@ -90,6 +148,11 @@ def read_topic_partition_lags(
     * missing begin/end offsets for a partition default to 0 (:350-351);
     * ``committed`` may omit partitions or map them to None — both mean "no
       committed offset" (:349).
+
+    ``retry`` (default None = reference abort semantics) bounds transient
+    broker failures per RPC — see :class:`LagRetryPolicy`.  The fault
+    points ``lag.begin`` / ``lag.end`` / ``lag.committed`` sit INSIDE the
+    retried callables so injection drills exercise the retry path.
     """
     topic_partition_lags: Dict[str, List[TopicPartitionLag]] = {}
     for topic in all_subscribed_topics:
@@ -107,9 +170,21 @@ def read_topic_partition_lags(
         rows: List[TopicPartitionLag] = []
 
         # The three batch RPCs — the only network boundary in the plugin.
-        begin_offsets = metadata_consumer.beginning_offsets(topic_partitions)
-        end_offsets = metadata_consumer.end_offsets(topic_partitions)
-        committed = metadata_consumer.committed(set(topic_partitions))
+        def _begin():
+            faults.fire("lag.begin")
+            return metadata_consumer.beginning_offsets(topic_partitions)
+
+        def _end():
+            faults.fire("lag.end")
+            return metadata_consumer.end_offsets(topic_partitions)
+
+        def _committed():
+            faults.fire("lag.committed")
+            return metadata_consumer.committed(set(topic_partitions))
+
+        begin_offsets = _call_with_retry(_begin, "beginning_offsets", retry)
+        end_offsets = _call_with_retry(_end, "end_offsets", retry)
+        committed = _call_with_retry(_committed, "committed", retry)
 
         for tp in topic_partitions:
             lag = compute_partition_lag(

@@ -24,6 +24,7 @@ import jax
 
 from ..models.greedy import consumers_per_topic
 from ..types import AssignmentMap, TopicPartition, TopicPartitionLag
+from ..utils import faults
 from .batched import (
     assign_batched_rounds,
     assign_batched_scan,
@@ -129,6 +130,10 @@ def assign_group_device(
     the quality mode costs no extra upload or dispatch.
     """
     ensure_x64()
+    # The fault point for a half-dead XLA compile: this is where an
+    # unwarmed (shape, static-args) combination would block in the
+    # compiler, so drills inject their hang/raise here.
+    faults.fire("device.compile")
     kernel_fn = _BATCHED_KERNELS[kernel]
     if refine_iters and kernel == "global":
         raise ValueError(

@@ -269,6 +269,10 @@ def _trace_state_clean() -> bool:
 
         return trace_state_clean()
     except Exception:  # API moved — assume tracing to stay safe
+        LOGGER.debug(
+            "trace_state_clean probe unavailable; assuming an active trace",
+            exc_info=True,
+        )
         return False
 
 

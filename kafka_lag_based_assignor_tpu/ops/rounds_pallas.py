@@ -48,6 +48,7 @@ carry stress); hardware timing goes through tools/probe_round6.py.
 from __future__ import annotations
 
 import functools
+import logging
 import threading
 
 import numpy as np
@@ -55,6 +56,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+LOGGER = logging.getLogger(__name__)
 
 C_PAD = 1024  # consumer slots: one (8, 128) int32 tile plane
 _SUB, _LANE = 8, 128
@@ -326,9 +329,7 @@ def _probe_speed(margin: float = 0.9) -> bool:
         return float(np.median(ts))
 
     t_xla, t_pal = timed("xla"), timed("pallas")
-    import logging
-
-    logging.getLogger(__name__).info(
+    LOGGER.info(
         "pallas round-scan race: xla %.1f ms vs pallas %.1f ms (x%d "
         "in-executable)", t_xla * 1e3, t_pal * 1e3, n,
     )
@@ -365,9 +366,7 @@ def rounds_pallas_available(
             try:
                 narrow = _probe_parity()
                 if not narrow:
-                    import logging
-
-                    logging.getLogger(__name__).warning(
+                    LOGGER.warning(
                         "Pallas round-scan compiled but FAILED device "
                         "parity; staying on the XLA scan"
                     )
@@ -381,12 +380,15 @@ def rounds_pallas_available(
                     try:
                         wide = _probe_parity(wide=True)
                     except Exception:
+                        LOGGER.warning(
+                            "Pallas wide-variant parity probe failed; "
+                            "narrow-only",
+                            exc_info=True,
+                        )
                         wide = False
                 _pallas_rounds_ok = {"narrow": narrow, "wide": wide}
             except Exception:
-                import logging
-
-                logging.getLogger(__name__).warning(
+                LOGGER.warning(
                     "Pallas round-scan unavailable; using the XLA scan",
                     exc_info=True,
                 )

@@ -67,6 +67,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..utils import faults
 from ..utils.observability import count_constrained_bound
 from .batched import _narrow_choice, _stream_device, assign_stream, stream_payload
 from .dispatch import ensure_x64, observe_pack_shift
@@ -309,6 +310,7 @@ class StreamingAssignor:
 
     def rebalance(self, lags: np.ndarray) -> np.ndarray:
         """Produce choice int32[P] for the current lag vector."""
+        faults.fire("stream.refine")  # fault point: poisoned warm stream
         ensure_x64()  # int64 lags would silently downcast to int32 otherwise
         lags = np.ascontiguousarray(lags, dtype=np.int64)
         if lags.size and int(lags.min()) < 0:
@@ -699,6 +701,16 @@ class StreamingAssignor:
             totals[donor] -= lags[p]
             totals[recv] += lags[p]
         return choice, int((choice != original).sum())
+
+    def seed_choice(self, choice: np.ndarray) -> None:
+        """Warm-restart seed: adopt a host-side choice vector as the
+        previous assignment (the degraded-mode ladder's recovery path —
+        a poisoned stream restarts from the last answer the clients
+        actually received instead of paying a full cold solve).  The
+        device-resident state is left stale; the next refine dispatch
+        rebuilds its tables from this host vector."""
+        self._prev_choice = np.ascontiguousarray(choice, dtype=np.int32)
+        self._resident = None
 
     def reset(self) -> None:
         """Drop warm state (force the next rebalance to solve cold)."""

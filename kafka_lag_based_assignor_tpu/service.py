@@ -57,6 +57,20 @@ re-solves cold.  ``{"method": "stream_reset", "params": {"stream_id":
 lists are in ascending partition-id order — the row-stable order warm
 state is keyed on.
 
+Failure model (DEPLOYMENT.md "Failure modes"): every request carries a
+deadline budget of ``solve_timeout_s`` TOTAL and descends a degraded-mode
+ladder within it — device solve -> host greedy for ``assign``;
+warm-resident -> cold device (fresh engine) -> host snake for
+``stream_assign``, with the rung taken reported as
+``stream.degraded_rung`` (``none`` | ``kept_previous`` | ``cold_device``
+| ``host_snake``) and a poisoned stream's next epoch warm-restarting
+from the last answered choice (``stream.warm_restart``).  Device calls
+run under per-solver circuit breakers (utils/watchdog): a breaker that
+is open fails fast — a stream then keeps serving its previous
+assignment unchanged (``kept_previous``, warm state intact) — and
+``{"method": "stats"}`` exports per-breaker state/trip counters plus
+``fallbacks``/``poisoned_snapshots``.
+
 Wire limits: a request line may be at most ``MAX_LINE_BYTES`` (16 MiB —
 comfortably above a 100k-partition request, ~2 MB); longer lines are
 answered with an error and drained without buffering.  ``params.options``
@@ -84,9 +98,10 @@ from typing import Any, Dict, List, Optional, Tuple
 from .assignor import LagBasedPartitionAssignor
 from .models.greedy import assign_greedy, host_fallback_for
 from .types import TopicPartitionLag
+from .utils import faults
 from .utils.config import VALID_SOLVERS
 from .utils.observability import RebalanceStats, summarize_assignment
-from .utils.watchdog import Watchdog
+from .utils.watchdog import SolveRejected, Watchdog
 
 LOGGER = logging.getLogger(__name__)
 
@@ -117,6 +132,24 @@ _OPTION_ROUNDS_UP = {"sinkhorn_iters": True, "refine_iters": False}
 # Live warm-state cap for stream_assign: each stream holds two int32[P]
 # vectors (host + device resident) — 64 north-star streams is ~50 MB.
 MAX_STREAMS = 64
+
+
+class _DeadlineBudget:
+    """Per-request deadline: the degraded-mode ladder's rungs share ONE
+    budget (``solve_timeout_s`` total), so a request answers within the
+    configured deadline rather than paying a full timeout per attempt —
+    the remaining budget shrinks down the ladder."""
+
+    def __init__(self, total_s: Optional[float]):
+        self.total_s = total_s
+        self._start = time.monotonic()
+
+    def remaining(self) -> Optional[float]:
+        """Seconds left (may be <= 0: the watchdog then fails fast
+        without charging the breaker); None = no deadline configured."""
+        if self.total_s is None:
+            return None
+        return self.total_s - (time.monotonic() - self._start)
 
 
 def _quantize_pow2(value: int, up: bool) -> int:
@@ -182,6 +215,27 @@ def _validate_stream_options(options: Any) -> Dict[str, Any]:
     return out
 
 
+def _host_choice_stats(choice, lags, C: int, prev, cold_start: bool):
+    """StreamingStats for an arbitrary host-side choice vector (the
+    snake and kept-previous degraded rungs share this evaluation)."""
+    import numpy as np
+
+    from .ops.streaming import StreamingStats
+    from .utils.observability import count_constrained_bound
+
+    stats = StreamingStats(cold_start=cold_start)
+    totals = np.bincount(choice, weights=lags.astype(np.float64),
+                         minlength=C)
+    mean = totals.mean()
+    stats.max_mean_imbalance = float(totals.max() / mean) if mean else 1.0
+    stats.imbalance_bound = count_constrained_bound(lags, C)
+    counts = np.bincount(choice, minlength=C)
+    stats.count_spread = int(counts.max() - counts.min())
+    if prev is not None and prev.shape[0] == choice.shape[0]:
+        stats.churn = int((choice != prev).sum())
+    return stats
+
+
 def _snake_fallback(lags, C: int, prev):
     """Emergency host-side assignment when the device solve fails or
     times out mid-stream: partitions in descending-lag order deal out
@@ -192,25 +246,26 @@ def _snake_fallback(lags, C: int, prev):
     Returns (choice int32[P], StreamingStats-shaped stats)."""
     import numpy as np
 
-    from .ops.streaming import StreamingStats
-    from .utils.observability import count_constrained_bound
-
     P = lags.shape[0]
     ranks = np.empty(P, np.int64)
     ranks[np.argsort(-lags, kind="stable")] = np.arange(P)
     r, j = np.divmod(ranks, C)
     choice = np.where(r % 2 == 0, j, C - 1 - j).astype(np.int32)
-    stats = StreamingStats(cold_start=True)
-    totals = np.bincount(choice, weights=lags.astype(np.float64),
-                         minlength=C)
-    mean = totals.mean()
-    stats.max_mean_imbalance = float(totals.max() / mean) if mean else 1.0
-    stats.imbalance_bound = count_constrained_bound(lags, C)
-    counts = np.bincount(choice, minlength=C)
-    stats.count_spread = int(counts.max() - counts.min())
-    if prev is not None and prev.shape[0] == P:
-        stats.churn = int((choice != prev).sum())
-    return choice, stats
+    return choice, _host_choice_stats(choice, lags, C, prev, cold_start=True)
+
+
+def _keepable(prev, P: int, C: int) -> bool:
+    """True when the previous choice is directly servable for this epoch:
+    complete (no orphaned rows from a membership remap awaiting repair),
+    in range, and count-balanced for the current member set."""
+    import numpy as np
+
+    if prev is None or prev.shape[0] != P or P == 0:
+        return False
+    if int(prev.min()) < 0 or int(prev.max()) >= C:
+        return False
+    counts = np.bincount(prev, minlength=C)
+    return int(counts.max() - counts.min()) <= 1
 
 
 class _Stream:
@@ -223,9 +278,22 @@ class _Stream:
         self.pids = None  # np.int64[P], sorted — the row order contract
 
 
+def _apply_stream_opts(engine, opts: Dict[str, Any]) -> None:
+    """Apply validated stream options to a LIVE engine — the one update
+    block every epoch (and every ladder rung) uses, so silently ignoring
+    a changed budget cannot violate the churn bound the client thinks it
+    configured."""
+    if "refine_iters" in opts:
+        engine.refine_iters = opts["refine_iters"]
+    if "guardrail" in opts:
+        engine.imbalance_guardrail = opts["guardrail"]
+    if "refine_threshold" in opts:
+        engine.refine_threshold = opts["refine_threshold"]
+
+
 def _solve(
     topics, subscriptions, solver, watchdog=None, host_fallback=True,
-    options=None,
+    options=None, deadline=None,
 ):
     # Same wire contract as _stream_assign: lags are non-negative by
     # construction (the reference's lag formula clamps at 0), so a
@@ -252,6 +320,7 @@ def _solve(
         )
     subs = {m: list(ts) for m, ts in subscriptions.items()}
     fallback_used = False
+    breaker_state = None
     if solver == "host":
         raw = assign_greedy(lag_map, subs)
     else:
@@ -259,14 +328,25 @@ def _solve(
         # (assignor._solve): device solves run under the watchdog — a
         # wedged accelerator transport can HANG rather than raise, and a
         # service request must never block a rebalance past its deadline —
-        # with the host greedy as the fallback.
+        # with the host greedy as the degraded rung.  The breaker key is
+        # the SOLVER (one circuit per failure domain) and the deadline is
+        # the request's remaining budget, not a fresh per-attempt window.
         solve = LagBasedPartitionAssignor._solve_accelerated
         try:
             if watchdog is not None:
-                raw = watchdog.call(solve, solver, lag_map, subs, options)
+                raw = watchdog.call(
+                    solve, solver, lag_map, subs, options, key=solver,
+                    timeout_s=(
+                        deadline.remaining() if deadline is not None
+                        else watchdog.timeout_s
+                    ),
+                )
+                breaker_state = watchdog.state(solver)
             else:
                 raw = solve(solver, lag_map, subs, options)
         except Exception:
+            if watchdog is not None:
+                breaker_state = watchdog.state(solver)
             if not host_fallback:
                 raise
             LOGGER.warning(
@@ -291,6 +371,7 @@ def _solve(
         ),
     )
     stats.fallback_used = fallback_used
+    stats.breaker_state = breaker_state
     lag_by_tp = {
         (r.topic, r.partition): r.lag for rows in lag_map.values() for r in rows
     }
@@ -314,6 +395,14 @@ class _Handler(socketserver.StreamRequestHandler):
             # instead of an unbounded buffer.
             line = self.rfile.readline(MAX_LINE_BYTES + 1)
             if not line:
+                break
+            try:
+                # Fault point: a torn/failed socket read surfaces as a
+                # dropped connection — the client's reconnect-once policy
+                # (AssignorServiceClient.request) is the recovery path.
+                faults.fire("wire.read")
+            except faults.FaultError:
+                LOGGER.warning("injected wire.read fault; dropping connection")
                 break
             if len(line) > MAX_LINE_BYTES and not line.endswith(b"\n"):
                 response = app.reject_oversized()
@@ -367,6 +456,10 @@ class AssignorService:
         warmup_solvers: Tuple[str, ...] = (
             "rounds", "stream", "global", "sinkhorn",
         ),
+        # Circuit-breaker policy (utils/watchdog): per-solver breakers,
+        # consecutive-exception trips, single half-open probe.
+        breaker_cooldown_s: float = 300.0,
+        breaker_failures: int = 3,
     ):
         self._tcp = socketserver.ThreadingTCPServer(
             (host, port), _Handler, bind_and_activate=True
@@ -374,7 +467,11 @@ class AssignorService:
         self._tcp.daemon_threads = True
         self._tcp.app = self  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
-        self._watchdog = Watchdog(solve_timeout_s)
+        self._watchdog = Watchdog(
+            solve_timeout_s,
+            cooldown_s=breaker_cooldown_s,
+            failure_threshold=breaker_failures,
+        )
         self._host_fallback = host_fallback
         # Normalize (P, C) -> (P, C, topics=1).
         self._warmup_shapes = [
@@ -385,8 +482,14 @@ class AssignorService:
         self._counter_lock = threading.Lock()
         self._streams: Dict[str, _Stream] = {}
         self._streams_lock = threading.Lock()
+        # Last-answered choice per POISONED stream (host-side snapshot):
+        # the next epoch warm-restarts from what the clients are actually
+        # running instead of paying a full cold solve.  Bounded alongside
+        # the stream cap; consumed (popped) on use or stream_reset.
+        self._snapshots: Dict[str, Tuple] = {}
         self.requests_served = 0
         self.errors = 0
+        self.fallbacks = 0  # responses answered by a host-side fallback
         self.started_at = time.time()
 
     @property
@@ -422,10 +525,15 @@ class AssignorService:
                     result = {
                         "requests_served": self.requests_served,
                         "errors": self.errors,
+                        "fallbacks": self.fallbacks,
                         "uptime_s": time.time() - self.started_at,
                     }
                 with self._streams_lock:
                     result["live_streams"] = len(self._streams)
+                    result["poisoned_snapshots"] = len(self._snapshots)
+                # Per-solver circuit-breaker states + trip counters — the
+                # operator's view of which failure domains are sidelined.
+                result["breakers"] = self._watchdog.stats()
             elif method == "assign":
                 params = req.get("params") or {}
                 solver = params.get("solver", "rounds")
@@ -441,7 +549,11 @@ class AssignorService:
                     watchdog=self._watchdog,
                     host_fallback=self._host_fallback,
                     options=options,
+                    deadline=_DeadlineBudget(self._watchdog.timeout_s),
                 )
+                if stats.fallback_used:
+                    with self._counter_lock:
+                        self.fallbacks += 1
                 result = {
                     "assignments": assignments,
                     "stats": json.loads(stats.to_json()),
@@ -450,12 +562,19 @@ class AssignorService:
                     "options": options,
                 }
             elif method == "stream_assign":
-                result = self._stream_assign(req.get("params") or {})
+                result = self._stream_assign(
+                    req.get("params") or {},
+                    _DeadlineBudget(self._watchdog.timeout_s),
+                )
+                if result["stream"]["fallback_used"]:
+                    with self._counter_lock:
+                        self.fallbacks += 1
             elif method == "stream_reset":
                 params = req.get("params") or {}
                 sid = params.get("stream_id")
                 with self._streams_lock:
                     dropped = self._streams.pop(sid, None) is not None
+                    self._snapshots.pop(sid, None)
                 result = {"dropped": dropped}
             else:
                 raise ValueError(f"unknown method {method!r}")
@@ -470,10 +589,15 @@ class AssignorService:
                 {"id": req_id, "error": {"message": str(exc)}}
             ).encode()
 
-    def _stream_assign(self, params: Dict[str, Any]) -> Dict[str, Any]:
+    def _stream_assign(
+        self, params: Dict[str, Any], budget: Optional[_DeadlineBudget] = None
+    ) -> Dict[str, Any]:
         import numpy as np
 
         from .ops.streaming import StreamingAssignor
+
+        if budget is None:
+            budget = _DeadlineBudget(self._watchdog.timeout_s)
 
         sid = params.get("stream_id")
         if not isinstance(sid, str) or not sid:
@@ -535,6 +659,7 @@ class AssignorService:
             st.lock.release()
 
         try:
+            warm_restart = False
             if st.engine is None:
                 # Service-level defaults (guardrail on at 1.25, unlike the
                 # library default) — requested options are applied by the
@@ -544,6 +669,21 @@ class AssignorService:
                     num_consumers=C, imbalance_guardrail=1.25
                 )
                 st.members = members_sorted
+                # Poisoned-stream recovery: if the last epoch for this sid
+                # died on the snake rung, warm-restart from the snapshot of
+                # what the clients were actually handed (repair + bounded
+                # refine) instead of paying a full cold solve.  A stale
+                # snapshot (membership or pid set moved on) is discarded.
+                with self._streams_lock:
+                    snap = self._snapshots.pop(sid, None)
+                if snap is not None:
+                    snap_members, snap_pids, snap_choice = snap
+                    if snap_members == members_sorted and np.array_equal(
+                        snap_pids, pids_sorted
+                    ):
+                        st.engine.seed_choice(snap_choice)
+                        st.pids = snap_pids
+                        warm_restart = True
             elif st.members != members_sorted:
                 # Membership change: remap by NAME so survivors keep their
                 # partitions (the engine's repair pass re-seats only
@@ -563,43 +703,69 @@ class AssignorService:
             ):
                 st.engine.reset()
             st.pids = pids_sorted
-            # Option changes apply to the LIVE engine (not only at stream
-            # creation) — silently ignoring a changed budget would violate
-            # the churn bound the client thinks it configured.
-            if "refine_iters" in opts:
-                st.engine.refine_iters = opts["refine_iters"]
-            if "guardrail" in opts:
-                st.engine.imbalance_guardrail = opts["guardrail"]
-            if "refine_threshold" in opts:
-                st.engine.refine_threshold = opts["refine_threshold"]
+            _apply_stream_opts(st.engine, opts)
 
             fallback_used = False
+            degraded_rung = "none"
             prev = st.engine._prev_choice
             try:
-                solve = st.engine.rebalance
-                if self._watchdog is not None:
-                    choice = self._watchdog.call(solve, lags)
-                else:
-                    choice = solve(lags)
+                # Ladder rung 1: the warm-resident engine, under the
+                # stream breaker with the request's REMAINING budget.
+                choice = self._watchdog.call(
+                    st.engine.rebalance, lags, key="stream",
+                    timeout_s=budget.remaining(),
+                )
                 s = st.engine.last_stats
+            except SolveRejected:
+                # FAIL-FAST rejection (breaker open / probe in flight /
+                # budget spent): nothing ever ran, so the warm engine is
+                # untouched and still valid — an open shared breaker must
+                # NOT destroy every stream's warm state.  Degrade
+                # host-side for this request only: keep serving the
+                # previous assignment (zero churn) when it is directly
+                # servable, else deal the snake and SEED the engine with
+                # it so the stream state matches what the clients now run.
+                if not self._host_fallback:
+                    raise
+                LOGGER.warning(
+                    "stream %r solve rejected without running; keeping "
+                    "warm state and answering host-side",
+                    sid, exc_info=True,
+                )
+                fallback_used = True
+                if _keepable(prev, lags.shape[0], C):
+                    choice = prev
+                    s = _host_choice_stats(
+                        prev, lags, C, prev, cold_start=False
+                    )
+                    degraded_rung = "kept_previous"
+                else:
+                    choice, s = _snake_fallback(lags, C, prev)
+                    st.engine.seed_choice(np.asarray(choice))
+                    degraded_rung = "host_snake"
             except Exception:
                 # A watchdog-abandoned worker thread may STILL be running
                 # the engine's rebalance and will mutate its warm state
                 # later with no lock held — the stream must be POISONED
                 # (dropped) so no future epoch touches the orphaned
-                # engine.  The response falls back to a host-side snake
-                # LPT (like the stateless path's host fallback) so the
-                # rebalance survives; the next epoch restarts cold.
+                # engine.  The response then descends the degraded-mode
+                # ladder (cold device -> host snake) within what is left
+                # of the SAME deadline budget.
                 with self._streams_lock:
                     self._streams.pop(sid, None)
                 if not self._host_fallback:
                     raise
                 LOGGER.warning(
-                    "stream %r solve failed; host fallback + state drop",
+                    "stream %r warm solve failed; poisoning state and "
+                    "descending the degraded-mode ladder",
                     sid, exc_info=True,
                 )
-                fallback_used = True
-                choice, s = _snake_fallback(lags, C, prev)
+                choice, s, degraded_rung, fallback_used = (
+                    self._stream_degraded(
+                        sid, lags, C, opts, prev, budget,
+                        members_sorted, pids_sorted,
+                    )
+                )
         finally:
             st.lock.release()
 
@@ -624,9 +790,64 @@ class AssignorService:
                 "imbalance_bound": s.imbalance_bound,
                 "count_spread": s.count_spread,
                 "fallback_used": fallback_used,
+                # Which ladder rung answered: none (warm engine) |
+                # kept_previous (rejected without running; prior choice
+                # served) | cold_device | host_snake — plus whether this
+                # epoch warm-restarted from a poisoned-stream snapshot.
+                "degraded_rung": degraded_rung,
+                "warm_restart": warm_restart,
             },
             "options": opts,
         }
+
+    def _stream_degraded(
+        self, sid, lags, C, opts, prev, budget, members_sorted, pids_sorted
+    ):
+        """Rungs 2-3 of the degraded-mode ladder, after the warm engine
+        was poisoned: a COLD solve on a FRESH engine (never the orphaned
+        one — its abandoned worker may still mutate it) within the
+        remaining deadline budget, then the host-side snake LPT.  Returns
+        ``(choice, stats, degraded_rung, fallback_used)``."""
+        import numpy as np
+
+        from .ops.streaming import StreamingAssignor
+
+        fresh = StreamingAssignor(num_consumers=C, imbalance_guardrail=1.25)
+        _apply_stream_opts(fresh, opts)
+        try:
+            choice = self._watchdog.call(
+                fresh.rebalance, lags, key="stream",
+                timeout_s=budget.remaining(),
+            )
+        except Exception:
+            # Rung 3: the snake answers from the host within microseconds
+            # of remaining budget, and the choice the clients now run is
+            # SNAPSHOTTED so the next epoch can warm-restart from it.
+            LOGGER.warning(
+                "stream %r cold retry failed; answering with host snake",
+                sid, exc_info=True,
+            )
+            choice, s = _snake_fallback(lags, C, prev)
+            with self._streams_lock:
+                if len(self._snapshots) >= MAX_STREAMS:
+                    self._snapshots.pop(next(iter(self._snapshots)))
+                self._snapshots[sid] = (
+                    list(members_sorted),
+                    pids_sorted.copy(),
+                    np.asarray(choice, dtype=np.int32),
+                )
+            return choice, s, "host_snake", True
+        # The cold rung recovered: install the fresh engine as the
+        # stream's new warm state (unless a concurrent request already
+        # re-registered the sid — never clobber live state).
+        with self._streams_lock:
+            if sid not in self._streams and len(self._streams) < MAX_STREAMS:
+                nst = _Stream()
+                nst.engine = fresh
+                nst.members = list(members_sorted)
+                nst.pids = pids_sorted
+                self._streams[sid] = nst
+        return choice, fresh.last_stats, "cold_device", False
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -664,11 +885,47 @@ class AssignorService:
 class AssignorServiceClient:
     """Blocking line-protocol client (what the JVM plugin side implements)."""
 
+    # Methods the reconnect-once policy must NOT auto-resend: they mutate
+    # server-side warm state, so a request that timed out mid-response may
+    # already have been applied.  (assign/ping/stats are stateless;
+    # stream_reset re-applied is a no-op.)
+    NON_IDEMPOTENT_METHODS = frozenset({"stream_assign"})
+
     def __init__(self, host: str, port: int, timeout_s: float = 60.0):
-        self._sock = socket.create_connection((host, port), timeout=timeout_s)
-        self._file = self._sock.makefile("rwb")
+        self._host = host
+        self._port = port
+        self._timeout_s = timeout_s
         self._next_id = 0
         self._lock = threading.Lock()
+        # Reconnect-once events, visible to the embedding shim: a timeout
+        # or connection drop mid-request leaves the socket in an undefined
+        # state (a late half-response would desynchronize every subsequent
+        # request), so the socket is closed and rebuilt, never reused.
+        self.reconnects = 0
+        self._connect()
+
+    def _connect(self) -> None:
+        self._sock = socket.create_connection(
+            (self._host, self._port), timeout=self._timeout_s
+        )
+        self._file = self._sock.makefile("rwb")
+
+    def _close_quietly(self) -> None:
+        # Each close gets its own guard: a flush error closing the dead
+        # file must not leak the underlying socket fd.
+        for close in (self._file.close, self._sock.close):
+            try:
+                close()
+            except OSError:
+                pass  # already torn down — the rebuild is the point
+
+    def _round_trip(self, payload: bytes) -> bytes:
+        self._file.write(payload)
+        self._file.flush()
+        line = self._file.readline()
+        if not line:
+            raise ConnectionError("service closed the connection")
+        return line
 
     def request(self, method: str, params: Optional[Dict] = None) -> Any:
         with self._lock:
@@ -676,11 +933,40 @@ class AssignorServiceClient:
             req = {"id": self._next_id, "method": method}
             if params is not None:
                 req["params"] = params
-            self._file.write(json.dumps(req).encode() + b"\n")
-            self._file.flush()
-            line = self._file.readline()
-        if not line:
-            raise ConnectionError("service closed the connection")
+            payload = json.dumps(req).encode() + b"\n"
+            if self._file.closed:
+                # A previous request's reconnect died inside _connect()
+                # (e.g. sidecar restarting): rebuild before sending so one
+                # failed recovery cannot brick the client forever.  Does
+                # not consume THIS request's single retry.
+                self._connect()
+                self.reconnects += 1
+            try:
+                line = self._round_trip(payload)
+            except OSError as exc:
+                # socket.timeout / ConnectionError / peer drop: the socket
+                # is in an undefined state — close and reconnect ONCE.
+                # Only IDEMPOTENT methods are resent: a stream_assign may
+                # already have executed server-side (a timeout mid-solve),
+                # and re-executing it would advance the warm state twice
+                # behind the client's back.  For those the caller gets a
+                # ConnectionError and decides (the JVM shim falls back to
+                # its built-in greedy).  A second failure propagates.
+                LOGGER.warning(
+                    "request failed (%s: %s); reconnecting once",
+                    type(exc).__name__, exc,
+                )
+                self._close_quietly()
+                self._connect()
+                self.reconnects += 1
+                if method in self.NON_IDEMPOTENT_METHODS:
+                    raise ConnectionError(
+                        f"connection failed mid-{method}; the request may "
+                        "or may not have been applied server-side — not "
+                        "resending a non-idempotent method (the connection "
+                        "has been rebuilt for subsequent requests)"
+                    ) from exc
+                line = self._round_trip(payload)
         resp = json.loads(line)
         if "error" in resp:
             raise RuntimeError(resp["error"]["message"])

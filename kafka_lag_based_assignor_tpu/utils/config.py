@@ -29,6 +29,16 @@ SOLVER_CONFIG = (
 FALLBACK_CONFIG = "tpu.assignor.host.fallback"  # bool: greedy host fallback
 PROFILE_CONFIG = "tpu.assignor.profile"  # bool: jax.profiler traces
 SOLVE_TIMEOUT_CONFIG = "tpu.assignor.solve.timeout.ms"  # 0/empty disables
+# Circuit-breaker knobs (utils/watchdog): how long a tripped solver stays
+# sidelined before the single half-open probe, and how many CONSECUTIVE
+# exceptions (not only timeouts) trip the breaker.
+BREAKER_COOLDOWN_CONFIG = "tpu.assignor.breaker.cooldown.ms"
+BREAKER_FAILURES_CONFIG = "tpu.assignor.breaker.failures"  # int >= 1
+# Opt-in bounded retry for the three lag batch RPCs (lag.py): number of
+# RETRIES per RPC (0 = reference abort semantics, the default) and the
+# deterministic exponential-backoff base delay.
+LAG_RETRIES_CONFIG = "tpu.assignor.lag.retries"  # int >= 0
+LAG_RETRY_BACKOFF_CONFIG = "tpu.assignor.lag.retry.backoff.ms"
 SINKHORN_ITERS_CONFIG = "tpu.assignor.sinkhorn.iters"  # int > 0
 # int >= 0, or unset/"auto".  For the "sinkhorn" solver, "auto" selects
 # the per-rounding-path budget (models/sinkhorn: 24 for the sequential
@@ -106,6 +116,15 @@ class AssignorConfig:
     # persistent cache); a trip only sidelines the accelerator for the
     # watchdog cooldown, not forever.
     solve_timeout_s: Optional[float] = 120.0
+    # Circuit-breaker policy: a tripped solver fails fast (host fallback)
+    # for the cooldown, then exactly one probe is admitted half-open;
+    # breaker_failures consecutive exceptions trip it like a timeout does.
+    breaker_cooldown_s: float = 300.0
+    breaker_failures: int = 3
+    # Lag-RPC retry policy: 0 retries preserves the reference's
+    # broker-exception-aborts-the-rebalance semantics exactly.
+    lag_retries: int = 0
+    lag_retry_backoff_s: float = 0.05
     # Quality-mode iteration budgets (sinkhorn solver / exchange
     # refinement); refine_iters None = per-path auto budget.
     sinkhorn_iters: int = 24
@@ -195,6 +214,16 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
         )
     solve_timeout_s = timeout_ms / 1000.0 if timeout_ms > 0 else None
 
+    def _as_ms(key: str, default_ms: float) -> float:
+        raw = consumer_group_props.get(key, default_ms)
+        try:
+            value = float(raw)
+        except (TypeError, ValueError):
+            raise ValueError(f"{key}={raw!r} is not a number")
+        if value < 0:
+            raise ValueError(f"{key}={value} must be >= 0")
+        return value / 1000.0
+
     return AssignorConfig(
         group_id=str(group_id),
         auto_offset_reset=str(
@@ -204,6 +233,10 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
         host_fallback=_as_bool(consumer_group_props.get(FALLBACK_CONFIG, True)),
         profile=_as_bool(consumer_group_props.get(PROFILE_CONFIG, False)),
         solve_timeout_s=solve_timeout_s,
+        breaker_cooldown_s=_as_ms(BREAKER_COOLDOWN_CONFIG, 300_000.0),
+        breaker_failures=_as_int(BREAKER_FAILURES_CONFIG, 3, 1),
+        lag_retries=_as_int(LAG_RETRIES_CONFIG, 0, 0),
+        lag_retry_backoff_s=_as_ms(LAG_RETRY_BACKOFF_CONFIG, 50.0),
         sinkhorn_iters=sinkhorn_iters,
         refine_iters=refine_iters,
         warmup_shapes=warmup_shapes,

@@ -17,6 +17,7 @@ from __future__ import annotations
 import contextlib
 import json
 import logging
+import threading
 import time
 from dataclasses import asdict, dataclass, field
 from typing import Dict, Iterator, List, Optional
@@ -89,6 +90,37 @@ def static_drift_count() -> int:
     return _static_drift_count[0]
 
 
+# --- Breaker observability ---------------------------------------------
+#
+# Process-wide trip counters per circuit-breaker key (utils/watchdog) —
+# the aggregate behind every Watchdog instance, so a deployment can
+# assert "no breaker tripped during this soak" without reaching into
+# individual watchdogs (the per-instance state lives in Watchdog.stats()
+# and the service `stats` method).
+
+_breaker_trips: Dict[str, int] = {}
+_breaker_trips_lock = threading.Lock()
+
+
+def note_breaker_trip(key: str) -> None:
+    """Record one breaker trip (called by utils/watchdog on every
+    closed/half-open -> open transition)."""
+    with _breaker_trips_lock:
+        _breaker_trips[key] = _breaker_trips.get(key, 0) + 1
+
+
+def breaker_trip_counts() -> Dict[str, int]:
+    """Per-key trips since process start (empty if none ever tripped)."""
+    return dict(_breaker_trips)
+
+
+def breaker_trip_count(key: Optional[str] = None) -> int:
+    """Total trips, or one key's trips."""
+    if key is not None:
+        return _breaker_trips.get(key, 0)
+    return sum(_breaker_trips.values())
+
+
 def count_constrained_bound(lags, num_consumers: int) -> float:
     """Input-driven lower bound on max/mean lag imbalance for ANY valid
     assignment — THE normalizer for the north-star quality metric.
@@ -130,6 +162,11 @@ class RebalanceStats:
     # be able to tell whether an assignment is refined or bit-parity.
     refine_iters: Optional[int] = None
     fallback_used: bool = False
+    # The configured solver's circuit-breaker state at response time
+    # (utils/watchdog: closed | open | half_open; None = no watchdog) —
+    # an operator reading a fallback_used record must be able to tell a
+    # one-off failure (closed) from a sidelined device (open).
+    breaker_state: Optional[str] = None
     wall_ms: float = 0.0
     lag_read_ms: float = 0.0
     solve_ms: float = 0.0

@@ -1,4 +1,4 @@
-"""Timeout-based failure detection for accelerator calls.
+"""Failure detection for accelerator calls: per-solver circuit breakers.
 
 The reference's failure model is exception propagation (broker RPCs abort
 the rebalance, SURVEY §2.4.9) — but an accelerator behind a
@@ -8,8 +8,36 @@ must never block on the accelerator past its rebalance timeout, so device
 solves run under a watchdog: the call executes in a daemon worker thread
 and, on timeout, the caller falls back to the host path while the stuck
 call is abandoned (threads blocked in a wedged RPC cannot be force-killed
-from Python; abandoning is the correct containment — the daemon thread dies
-with the process and later calls go straight to the fallback).
+from Python; abandoning is the correct containment — the daemon thread
+dies with the process and later calls go straight to the fallback).
+
+Failure domains are tracked PER KEY (one circuit breaker per solver /
+subsystem), because a wedged Sinkhorn compile says nothing about the
+rounds kernel's health: one slow solver must not banish every solver for
+the full cooldown.  Each breaker is a standard three-state circuit:
+
+* **closed** — calls run under the deadline.  A timeout trips the
+  breaker immediately; ``failure_threshold`` CONSECUTIVE exceptions trip
+  it too (a repeatedly-raising device is as dead as a hanging one — the
+  reference-style raise path was previously never counted).
+* **open** — calls fail fast with :class:`SolveTimeout` (host fallback)
+  for ``cooldown_s``; no fresh worker threads pile up behind the wedge.
+* **half-open** — after the cooldown, exactly ONE caller is admitted as
+  the probe; concurrent callers keep failing fast until the probe
+  resolves.  (The previous design cleared the trip under the lock and
+  let every blocked waiter spawn a probe thread against the possibly
+  still-wedged device — a thundering herd of abandoned threads.)  Probe
+  success closes the breaker; probe failure re-opens it for a fresh
+  cooldown.
+
+``clock`` is injectable so cooldown/half-open transitions are unit
+testable without real sleeps.  Worker threads capture ``BaseException``
+but re-raise only ``Exception`` through the normal path: a true
+``BaseException`` (e.g. ``KeyboardInterrupt`` delivered on the worker)
+is logged critically and re-raised deliberately on the caller side, so
+``except Exception`` boundaries (the service's wire handler) let it
+propagate instead of swallowing a shutdown signal into an error
+response.
 """
 
 from __future__ import annotations
@@ -19,89 +47,296 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional, TypeVar
 
+from .observability import note_breaker_trip
+
 LOGGER = logging.getLogger(__name__)
 
 T = TypeVar("T")
 
+_UNSET = object()
+
+STATE_CLOSED = "closed"
+STATE_OPEN = "open"
+STATE_HALF_OPEN = "half_open"
+
 
 class SolveTimeout(Exception):
-    """Raised when a watched call exceeds its deadline."""
+    """Raised when a watched call exceeds its deadline, its breaker is
+    open, or its deadline budget is already exhausted."""
+
+
+class SolveRejected(SolveTimeout):
+    """Fail-fast subtype: the call was rejected WITHOUT running (breaker
+    open, probe already in flight, or budget exhausted) — the device was
+    never touched, so callers holding warm state tied to the callable
+    (the streaming engines) know that state is still intact."""
+
+
+class _Breaker:
+    """One failure domain's state (guarded by the owning Watchdog's lock)."""
+
+    __slots__ = (
+        "state", "tripped_at", "consecutive_failures", "trips",
+        "probe_in_flight",
+    )
+
+    def __init__(self):
+        self.state = STATE_CLOSED
+        self.tripped_at: Optional[float] = None
+        self.consecutive_failures = 0
+        self.trips = 0
+        self.probe_in_flight = False
 
 
 class Watchdog:
-    """Runs callables with a deadline on abandonable daemon threads.
+    """Runs callables with a deadline on abandonable daemon threads,
+    with one circuit breaker per ``key`` (see module docstring).
 
     Deliberately NOT a ThreadPoolExecutor: the executor's atexit hook JOINS
     its workers, so a process that abandoned a hung solve would block at
     shutdown for the full hang.  A bare daemon thread dies with the process.
-
-    A timeout marks the watchdog *tripped* so subsequent solves skip the
-    accelerator immediately (fast host fallback) instead of queueing fresh
-    threads behind a wedged transport.  The trip is NOT permanent: after
-    ``cooldown_s`` the next solve probes the accelerator again, so one
-    transient stall (e.g. a slow first-rebalance XLA compile) cannot
-    banish a healthy device forever.  ``reset()`` clears the trip
-    immediately (operator action).
     """
 
-    def __init__(self, timeout_s: Optional[float], cooldown_s: float = 300.0):
+    def __init__(
+        self,
+        timeout_s: Optional[float],
+        cooldown_s: float = 300.0,
+        failure_threshold: int = 3,
+        clock: Callable[[], float] = time.monotonic,
+    ):
         self.timeout_s = timeout_s
         self.cooldown_s = cooldown_s
-        self._tripped_at: Optional[float] = None
+        self.failure_threshold = int(failure_threshold)
+        self._clock = clock
+        self._breakers: Dict[str, _Breaker] = {}
         self._lock = threading.Lock()
+
+    # -- state inspection --------------------------------------------------
+
+    def _breaker(self, key: str) -> _Breaker:
+        """Caller must hold ``self._lock``."""
+        br = self._breakers.get(key)
+        if br is None:
+            br = self._breakers[key] = _Breaker()
+        return br
+
+    def _effective_state(self, br: _Breaker) -> str:
+        """THE cooldown-expiry rule, in one place (caller holds the
+        lock): an OPEN breaker whose cooldown has elapsed reports
+        half-open — the next call will be the probe."""
+        if br.state == STATE_OPEN and (
+            br.tripped_at is None
+            or self._clock() - br.tripped_at >= self.cooldown_s
+        ):
+            return STATE_HALF_OPEN
+        return br.state
 
     @property
     def tripped(self) -> bool:
+        """True while ANY breaker is open within its cooldown."""
         with self._lock:
-            return self._tripped_at is not None and (
-                time.monotonic() - self._tripped_at < self.cooldown_s
+            return any(
+                self._effective_state(br) == STATE_OPEN
+                for br in self._breakers.values()
             )
+
+    def state(self, key: str = "device") -> str:
+        """The breaker's current state name (cooldown expiry applied)."""
+        with self._lock:
+            br = self._breakers.get(key)
+            if br is None:
+                return STATE_CLOSED
+            return self._effective_state(br)
+
+    def stats(self) -> Dict[str, Dict[str, Any]]:
+        """Per-key breaker snapshot for the service ``stats`` surface."""
+        with self._lock:
+            return {
+                key: {
+                    "state": self._effective_state(br),
+                    "trips": br.trips,
+                    "consecutive_failures": br.consecutive_failures,
+                }
+                for key, br in self._breakers.items()
+            }
 
     def reset(self) -> None:
-        """Allow the accelerator another chance (e.g. operator action)."""
+        """Close every breaker immediately (operator action)."""
         with self._lock:
-            self._tripped_at = None
+            for br in self._breakers.values():
+                br.state = STATE_CLOSED
+                br.tripped_at = None
+                br.consecutive_failures = 0
+                br.probe_in_flight = False
 
-    def call(self, fn: Callable[..., T], *args: Any, **kwargs: Any) -> T:
-        """Run ``fn`` under the deadline.
+    # -- transitions (hold the lock) --------------------------------------
 
-        Raises SolveTimeout if the deadline passes or the watchdog tripped
-        within the cooldown window.  With ``timeout_s`` None the call runs
-        inline (watchdog disabled).
-        """
-        if self.timeout_s is None:
-            return fn(*args, **kwargs)
+    def _trip(self, br: _Breaker, key: str) -> None:
+        if br.state == STATE_OPEN:
+            # A straggler admitted before the trip fails after it: one
+            # incident, one trip — don't inflate the counter or refresh
+            # tripped_at (that would silently extend the cooldown).
+            return
+        br.state = STATE_OPEN
+        br.tripped_at = self._clock()
+        br.trips += 1
+        br.probe_in_flight = False
+        note_breaker_trip(key)
+
+    def _admit(self, key: str) -> bool:
+        """Admission control; returns True when this call is the half-open
+        probe.  Raises SolveTimeout to fail fast (open breaker, or probe
+        already in flight)."""
         with self._lock:
-            if self._tripped_at is not None:
-                if time.monotonic() - self._tripped_at < self.cooldown_s:
-                    raise SolveTimeout(
-                        "watchdog tripped; accelerator considered down for "
+            br = self._breaker(key)
+            if br.state == STATE_OPEN:
+                if (
+                    br.tripped_at is not None
+                    and self._clock() - br.tripped_at < self.cooldown_s
+                ):
+                    raise SolveRejected(
+                        f"breaker {key!r} open; failing fast for up to "
                         f"{self.cooldown_s}s (or until reset())"
                     )
-                self._tripped_at = None  # cooldown over — probe again
+                br.state = STATE_HALF_OPEN
+                br.probe_in_flight = False
+            if br.state == STATE_HALF_OPEN:
+                if br.probe_in_flight:
+                    # THE thundering-herd fix: one probe, everyone else
+                    # fails fast to the host path.
+                    raise SolveRejected(
+                        f"breaker {key!r} half-open; probe already in flight"
+                    )
+                br.probe_in_flight = True
+                return True
+            return False
 
-        outcome: Dict[str, Any] = {}
-        done = threading.Event()
+    def _on_success(self, key: str) -> None:
+        with self._lock:
+            br = self._breaker(key)
+            br.state = STATE_CLOSED
+            br.tripped_at = None
+            br.consecutive_failures = 0
+            br.probe_in_flight = False
 
-        def run() -> None:
-            try:
-                outcome["value"] = fn(*args, **kwargs)
-            except BaseException as exc:  # noqa: BLE001 — re-raised below
-                outcome["exc"] = exc
-            finally:
-                done.set()
+    def _on_timeout(self, key: str, probing: bool, truncated: bool) -> None:
+        with self._lock:
+            br = self._breaker(key)
+            br.consecutive_failures += 1
+            if truncated and not probing:
+                # The deadline was a request's RESIDUAL budget, shorter
+                # than the configured timeout: the device was never given
+                # its fair window, so missing it is the request's fault —
+                # recorded as a failure, but not a trip that would
+                # sideline the device for every other request.  (A
+                # half-open probe still re-opens: it ran and was
+                # abandoned, recovered or not.)
+                return
+            self._trip(br, key)
 
-        worker = threading.Thread(target=run, name="klba-solve", daemon=True)
-        worker.start()
-        if not done.wait(self.timeout_s):
-            with self._lock:
-                self._tripped_at = time.monotonic()
-            LOGGER.warning(
-                "device solve exceeded %.1fs; abandoning call and marking "
-                "accelerator down",
-                self.timeout_s,
+    def _on_exception(self, key: str, probing: bool) -> None:
+        with self._lock:
+            br = self._breaker(key)
+            br.consecutive_failures += 1
+            if probing:
+                # A failed probe re-opens immediately — the device did not
+                # recover; don't let waiters rediscover that one by one.
+                self._trip(br, key)
+            elif br.consecutive_failures >= self.failure_threshold:
+                LOGGER.warning(
+                    "breaker %r tripped after %d consecutive exceptions",
+                    key, br.consecutive_failures,
+                )
+                self._trip(br, key)
+
+    # -- the watched call --------------------------------------------------
+
+    def call(
+        self,
+        fn: Callable[..., T],
+        *args: Any,
+        key: str = "device",
+        timeout_s: Any = _UNSET,
+        **kwargs: Any,
+    ) -> T:
+        """Run ``fn`` under the deadline with ``key``'s breaker.
+
+        ``timeout_s`` overrides the configured deadline for THIS call
+        (the service's per-request deadline budget shrinks it down the
+        degraded-mode ladder); a non-positive override fails fast WITHOUT
+        charging the breaker — an exhausted budget is the request's
+        fault, not the device's.  With an effective deadline of None the
+        call runs inline (watchdog disabled).
+        """
+        effective = self.timeout_s if timeout_s is _UNSET else timeout_s
+        if effective is None:
+            return fn(*args, **kwargs)
+        if effective <= 0:
+            raise SolveRejected(
+                f"deadline budget exhausted before calling {key!r}"
             )
-            raise SolveTimeout(f"device solve exceeded {self.timeout_s}s")
-        if "exc" in outcome:
-            raise outcome["exc"]
-        return outcome["value"]
+        probing = self._admit(key)
+        settled = False  # an _on_* transition (or explicit release) ran
+        try:
+            outcome: Dict[str, Any] = {}
+            done = threading.Event()
+
+            def run() -> None:
+                try:
+                    outcome["value"] = fn(*args, **kwargs)
+                except BaseException as exc:  # noqa: BLE001 — re-raised below
+                    outcome["exc"] = exc
+                finally:
+                    done.set()
+
+            worker = threading.Thread(
+                target=run, name="klba-solve", daemon=True
+            )
+            worker.start()
+            if not done.wait(effective):
+                # "Truncated" = the ladder handed the device a residual
+                # budget well below the configured window.  The 0.9
+                # factor absorbs the request-validation time between
+                # budget creation and rung 1 (microseconds-to-ms), so a
+                # first-rung hang still trips at ~the full deadline.
+                truncated = (
+                    self.timeout_s is not None
+                    and effective < self.timeout_s * 0.9
+                )
+                self._on_timeout(key, probing, truncated)
+                settled = True
+                LOGGER.warning(
+                    "%r call exceeded %.1fs (%s); abandoning it",
+                    key, effective,
+                    "residual budget — breaker not tripped" if truncated
+                    else f"breaker open for {self.cooldown_s:.0f}s",
+                )
+                raise SolveTimeout(f"{key!r} call exceeded {effective}s")
+            exc = outcome.get("exc")
+            if exc is None:
+                self._on_success(key)
+                settled = True
+                return outcome["value"]
+            if isinstance(exc, Exception):
+                self._on_exception(key, probing)
+                settled = True
+                raise exc
+            # True BaseException (KeyboardInterrupt, SystemExit) captured
+            # on the worker: re-raise it DELIBERATELY on the caller thread
+            # so it propagates past `except Exception` boundaries instead
+            # of dying silently with the worker — but never count it
+            # against the device's breaker.
+            LOGGER.critical(
+                "%r worker raised %s; propagating on the caller thread",
+                key, type(exc).__name__,
+            )
+            raise exc
+        finally:
+            if probing and not settled:
+                # The probe aborted before any state transition (e.g.
+                # worker.start() failed under thread exhaustion, or a
+                # BaseException) — release the half-open slot so the
+                # breaker cannot wedge in 'probe already in flight'
+                # fail-fast forever.
+                with self._lock:
+                    self._breaker(key).probe_in_flight = False

@@ -35,6 +35,48 @@ def test_lint_rules_fire():
     assert {"L002", "L003", "L004", "L005", "L006", "L008", "L009", "L010"} <= codes
 
 
+def test_lint_silent_except_exception_in_package():
+    """L011: a module-boundary `except Exception` must not swallow the
+    traceback — re-raise, log with exc_info, or carry an explicit
+    waiver.  Scoped to package code (tests/tools may swallow freely)."""
+    pkg = Path("kafka_lag_based_assignor_tpu/boundary.py")
+    silent = (
+        "def f():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"
+        "        return None\n"
+    )
+    assert any(
+        f.code == "L011" for f in lint.lint_source(pkg, silent)
+    )
+    # Outside the package the same code is not flagged.
+    assert not any(
+        f.code == "L011" for f in lint.lint_source(Path("tests/x.py"), silent)
+    )
+    reraise = silent.replace("        return None\n", "        raise\n")
+    assert not any(f.code == "L011" for f in lint.lint_source(pkg, reraise))
+    logged = (
+        "def f():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"
+        "        log.warning('failed', exc_info=True)\n"
+        "        flag = True\n"
+    )
+    assert not any(f.code == "L011" for f in lint.lint_source(pkg, logged))
+    waived = silent.replace(
+        "    except Exception:\n",
+        "    except Exception:  # noqa: L011\n",
+    )
+    assert not any(f.code == "L011" for f in lint.lint_source(pkg, waived))
+    # A tuple containing Exception counts too.
+    tup = silent.replace(
+        "except Exception:", "except (ValueError, Exception):"
+    )
+    assert any(f.code == "L011" for f in lint.lint_source(pkg, tup))
+
+
 def test_lint_no_false_positives_on_format_specs():
     src = 'x = 3\nprint(f"{x:02d}")\n'
     assert lint.lint_source(Path("ok.py"), src) == []

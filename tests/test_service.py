@@ -109,6 +109,19 @@ def test_stats_counts_requests(service):
     assert stats["requests_served"] >= 2
 
 
+def test_stats_exports_failure_domain_counters(service):
+    """The operator surface for the failure model: per-solver breaker
+    states/trips, host-fallback answers, and poisoned-stream snapshots."""
+    with client_for(service) as c:
+        c.assign({"t": [[0, 5]]}, {"m": ["t"]}, solver="rounds")
+        stats = c.request("stats")
+    assert stats["fallbacks"] == 0
+    assert stats["poisoned_snapshots"] == 0
+    assert stats["breakers"]["rounds"] == {
+        "state": "closed", "trips": 0, "consecutive_failures": 0,
+    }
+
+
 def test_concurrent_clients(service):
     topics = {"t0": [[p, p] for p in range(10)]}
     results = []
@@ -444,9 +457,10 @@ class TestStreamAssign:
     def test_solve_failure_poisons_stream_and_falls_back(
         self, service, monkeypatch
     ):
-        """A failing stream solve must answer with the host fallback
-        (count-balanced, fallback_used flagged) and drop the warm state so
-        the next epoch restarts cold on a fresh engine."""
+        """Every device rung failing must still answer with the host snake
+        (count-balanced, fallback_used flagged, rung visible), drop the
+        poisoned warm state, and snapshot the answered choice so the next
+        epoch WARM-RESTARTS from it instead of paying a full cold solve."""
         import numpy as np
 
         from kafka_lag_based_assignor_tpu.ops import streaming as streaming_mod
@@ -469,15 +483,60 @@ class TestStreamAssign:
             r2 = self._epoch(c, lags, members=("C0", "C1"))
             assert r2["stream"]["fallback_used"]
             assert r2["stream"]["cold_start"]
+            assert r2["stream"]["degraded_rung"] == "host_snake"
             sizes = sorted(
                 len(v) for v in r2["assignments"].values()
             )
             assert sizes == [128, 128]  # snake fallback count-balanced
-            assert calls["n"] == 1
+            # The ladder tried the warm engine AND a fresh-engine cold
+            # retry before descending to the host snake.
+            assert calls["n"] == 2
 
             monkeypatch.setattr(
                 streaming_mod.StreamingAssignor, "rebalance", orig
             )
             r3 = self._epoch(c, lags, members=("C0", "C1"))
-            assert r3["stream"]["cold_start"]  # state was dropped
+            # Poisoned-stream recovery: warm restart from the snapshot of
+            # the snake answer the clients are running — not a cold solve.
+            assert r3["stream"]["warm_restart"]
+            assert not r3["stream"]["cold_start"]
             assert not r3["stream"]["fallback_used"]
+            assert r3["stream"]["degraded_rung"] == "none"
+
+    def test_warm_fault_recovers_on_cold_device_rung(
+        self, service, monkeypatch
+    ):
+        """A fault that poisons ONLY the warm engine is absorbed one rung
+        down: a fresh engine solves cold within the same request and is
+        installed as the stream's new warm state."""
+        import numpy as np
+
+        from kafka_lag_based_assignor_tpu.ops import streaming as streaming_mod
+
+        lags = np.arange(1, 65, dtype=np.int64) * 1000
+        with client_for(service) as c:
+            self._epoch(c, lags, members=("C0", "C1"))
+            orig = streaming_mod.StreamingAssignor.rebalance
+            calls = {"n": 0}
+
+            def flaky(self_eng, arr):
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    raise RuntimeError("poisoned warm engine")
+                return orig(self_eng, arr)
+
+            monkeypatch.setattr(
+                streaming_mod.StreamingAssignor, "rebalance", flaky
+            )
+            r = self._epoch(c, lags, members=("C0", "C1"))
+            assert r["stream"]["degraded_rung"] == "cold_device"
+            assert not r["stream"]["fallback_used"]
+            sizes = sorted(len(v) for v in r["assignments"].values())
+            assert sizes == [32, 32]
+            # The fresh engine was installed: next epoch is warm again.
+            monkeypatch.setattr(
+                streaming_mod.StreamingAssignor, "rebalance", orig
+            )
+            r2 = self._epoch(c, lags, members=("C0", "C1"))
+            assert not r2["stream"]["cold_start"]
+            assert r2["stream"]["degraded_rung"] == "none"

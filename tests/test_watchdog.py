@@ -1,7 +1,10 @@
 """Watchdog failure-detection tests: a HUNG accelerator (not just a raising
 one) must never block a rebalance — observed in practice when the device
-transport wedges."""
+transport wedges.  Since the circuit-breaker upgrade the state machine is
+per solver key: closed -> open (timeout, or consecutive exceptions) ->
+half-open (exactly ONE probe after the cooldown) -> closed/open."""
 
+import threading
 import time
 
 import pytest
@@ -9,7 +12,24 @@ import pytest
 from kafka_lag_based_assignor_tpu.assignor import LagBasedPartitionAssignor
 from kafka_lag_based_assignor_tpu.testing import FakeBroker
 from kafka_lag_based_assignor_tpu.types import GroupSubscription, Subscription
-from kafka_lag_based_assignor_tpu.utils.watchdog import SolveTimeout, Watchdog
+from kafka_lag_based_assignor_tpu.utils.watchdog import (
+    SolveRejected,
+    SolveTimeout,
+    Watchdog,
+)
+
+
+class FakeClock:
+    """Deterministic monotonic clock for cooldown/half-open tests."""
+
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
 
 
 def test_fast_call_passes_through():
@@ -23,6 +43,7 @@ def test_timeout_raises_and_trips():
     with pytest.raises(SolveTimeout):
         wd.call(time.sleep, 10)
     assert wd.tripped
+    assert wd.state() == "open"
     # Subsequent calls short-circuit without waiting.
     t0 = time.perf_counter()
     with pytest.raises(SolveTimeout):
@@ -41,21 +62,201 @@ def test_reset_restores_service():
 def test_cooldown_auto_retries():
     """A trip is temporary: after the cooldown the next call probes again —
     one transient stall must not banish a healthy accelerator forever."""
-    wd = Watchdog(timeout_s=0.05, cooldown_s=0.1)
+    clock = FakeClock()
+    wd = Watchdog(timeout_s=0.05, cooldown_s=10.0, clock=clock)
     with pytest.raises(SolveTimeout):
         wd.call(time.sleep, 10)
     assert wd.tripped
-    time.sleep(0.15)
+    clock.advance(10.1)
     assert not wd.tripped
+    assert wd.state() == "half_open"
     assert wd.call(lambda: "recovered") == "recovered"
+    assert wd.state() == "closed"
+
+
+def test_half_open_admits_exactly_one_probe():
+    """THE thundering-herd fix: after the cooldown, ONE caller probes the
+    possibly-wedged device; concurrent callers fail fast instead of each
+    spawning a probe thread."""
+    clock = FakeClock()
+    wd = Watchdog(timeout_s=5.0, cooldown_s=10.0, clock=clock,
+                  failure_threshold=1)
+    with pytest.raises(ZeroDivisionError):
+        wd.call(lambda: 1 / 0)  # threshold 1: trips immediately
+    assert wd.stats()["device"]["state"] == "open"
+    clock.advance(10.1)
+
+    probe_entered = threading.Event()
+    release_probe = threading.Event()
+    executed = []
+
+    def probe():
+        executed.append(threading.current_thread().name)
+        probe_entered.set()
+        release_probe.wait(5)
+        return "ok"
+
+    results = {}
+
+    def caller(name):
+        try:
+            results[name] = wd.call(probe)
+        except SolveTimeout as exc:
+            results[name] = exc
+
+    t1 = threading.Thread(target=caller, args=("first",))
+    t1.start()
+    assert probe_entered.wait(5)
+    # While the single probe is in flight, every other caller fails fast
+    # WITHOUT invoking the device.
+    for name in ("second", "third"):
+        t0 = time.perf_counter()
+        caller(name)
+        assert time.perf_counter() - t0 < 0.5
+        assert isinstance(results[name], SolveTimeout)
+        assert "probe" in str(results[name])
+    release_probe.set()
+    t1.join(5)
+    assert results["first"] == "ok"
+    assert len(executed) == 1  # the device saw ONE probe, not a herd
+    assert wd.state() == "closed"
+
+
+def test_probe_failure_reopens_immediately():
+    clock = FakeClock()
+    wd = Watchdog(timeout_s=0.05, cooldown_s=10.0, clock=clock,
+                  failure_threshold=99)
+    with pytest.raises(SolveTimeout):
+        wd.call(time.sleep, 10)  # full configured window: trips
+    clock.advance(10.1)
+    assert wd.state() == "half_open"
+    # The probe raises ONE exception — far below failure_threshold — yet
+    # the breaker re-opens: a failed probe is proof the device is down.
+    with pytest.raises(ZeroDivisionError):
+        wd.call(lambda: 1 / 0)
+    assert wd.state() == "open"
+    calls = []
+    with pytest.raises(SolveTimeout):
+        wd.call(lambda: calls.append(1))
+    assert not calls  # fast-fail, device untouched
+    assert wd.stats()["device"]["trips"] == 2
+
+
+def test_consecutive_exceptions_trip():
+    """A repeatedly-RAISING device is as dead as a hanging one: the
+    threshold trips the breaker without any timeout."""
+    wd = Watchdog(timeout_s=5.0, failure_threshold=3)
+    for _ in range(3):
+        with pytest.raises(ZeroDivisionError):
+            wd.call(lambda: 1 / 0)
+    assert wd.state() == "open"
+    with pytest.raises(SolveTimeout):
+        wd.call(lambda: "never runs")
+    # A success in between resets the count.
+    wd.reset()
+    for _ in range(2):
+        with pytest.raises(ZeroDivisionError):
+            wd.call(lambda: 1 / 0)
+    assert wd.call(lambda: "ok") == "ok"
+    assert wd.stats()["device"]["consecutive_failures"] == 0
+    assert wd.state() == "closed"
+
+
+def test_per_key_breakers_are_independent():
+    wd = Watchdog(timeout_s=0.05)
+    with pytest.raises(SolveTimeout):
+        wd.call(time.sleep, 10, key="sinkhorn")
+    assert wd.state("sinkhorn") == "open"
+    assert wd.state("rounds") == "closed"
+    assert wd.call(lambda: 7, key="rounds") == 7
+    stats = wd.stats()
+    assert stats["sinkhorn"]["trips"] == 1
+    assert stats["rounds"]["trips"] == 0
+
+
+def test_fail_fast_raises_the_rejected_subtype():
+    """Callers (the stream ladder) distinguish 'the device never ran'
+    (SolveRejected — warm state intact) from a real timeout/failure:
+    open-breaker, probe-in-flight, and spent-budget rejections all carry
+    the subtype; a genuine timeout does not."""
+    wd = Watchdog(timeout_s=0.05, cooldown_s=30.0)
+    try:
+        wd.call(time.sleep, 10)
+        raise AssertionError("expected SolveTimeout")
+    except SolveTimeout as exc:
+        assert not isinstance(exc, SolveRejected)  # it RAN and hung
+    with pytest.raises(SolveRejected):
+        wd.call(lambda: 1)  # open breaker: never ran
+    with pytest.raises(SolveRejected, match="budget"):
+        wd.call(lambda: 1, key="other", timeout_s=-1.0)
+
+
+def test_straggler_failure_does_not_retrip_open_breaker():
+    """Concurrent calls admitted before a trip that fail AFTER it are the
+    same incident: the trip counter must not inflate and tripped_at must
+    not refresh (which would silently extend the cooldown)."""
+    clock = FakeClock()
+    wd = Watchdog(timeout_s=5.0, cooldown_s=10.0, failure_threshold=1,
+                  clock=clock)
+    with pytest.raises(ZeroDivisionError):
+        wd.call(lambda: 1 / 0)  # threshold 1: trips immediately
+    assert wd.stats()["device"]["trips"] == 1
+    clock.advance(9.0)
+    # Straggler failure lands while open (admitted pre-trip in a real
+    # race; delivered directly here).
+    wd._on_exception("device", probing=False)
+    assert wd.stats()["device"]["trips"] == 1  # same incident
+    clock.advance(1.1)  # original cooldown expires on schedule
+    assert wd.state() == "half_open"
+
+
+def test_truncated_budget_timeout_does_not_trip():
+    """A timeout against a request's RESIDUAL budget (well below the
+    configured window) is the request's fault: recorded as a failure but
+    not a trip — one ladder descent must not sideline the device for
+    every other request.  A full-window timeout still trips."""
+    wd = Watchdog(timeout_s=30.0, cooldown_s=30.0)
+    with pytest.raises(SolveTimeout):
+        wd.call(time.sleep, 10, timeout_s=0.05)  # residual budget
+    assert wd.state() == "closed"
+    assert wd.stats()["device"]["trips"] == 0
+    assert wd.stats()["device"]["consecutive_failures"] == 1
+    wd2 = Watchdog(timeout_s=0.05, cooldown_s=30.0)
+    with pytest.raises(SolveTimeout):
+        wd2.call(time.sleep, 10)  # the configured window: a real wedge
+    assert wd2.state() == "open"
+
+
+def test_budget_exhaustion_fails_fast_without_charging_breaker():
+    """A non-positive per-call deadline (the service's spent budget) fails
+    fast but is NOT the device's fault — the breaker stays closed."""
+    wd = Watchdog(timeout_s=5.0)
+    with pytest.raises(SolveTimeout, match="budget"):
+        wd.call(lambda: "never", timeout_s=0.0)
+    assert wd.state() == "closed"
+    assert wd.stats() == {}  # no breaker was even created
+
+
+def test_trip_counters_exported_to_observability():
+    from kafka_lag_based_assignor_tpu.utils.observability import (
+        breaker_trip_count,
+    )
+
+    key = "obs-test-key"
+    before = breaker_trip_count(key)
+    wd = Watchdog(timeout_s=0.05)
+    with pytest.raises(SolveTimeout):
+        wd.call(time.sleep, 10, key=key)
+    assert breaker_trip_count(key) == before + 1
 
 
 def test_assignor_reset_accelerator():
     broker = FakeBroker().with_partition("t", 0, end=100, committed=0)
     a = LagBasedPartitionAssignor(metadata_consumer_factory=lambda p: broker)
     a.configure({"group.id": "g", "tpu.assignor.solve.timeout.ms": "100"})
-    a._watchdog.call  # built at configure time
-    a._watchdog._tripped_at = time.monotonic()
+    with pytest.raises(SolveTimeout):
+        a._watchdog.call(time.sleep, 10, key="rounds")
+    assert a._watchdog.tripped
     a.reset_accelerator()
     assert not a._watchdog.tripped
 
@@ -70,6 +271,22 @@ def test_exception_propagates_not_tripped():
     with pytest.raises(ZeroDivisionError):
         wd.call(lambda: 1 / 0)
     assert not wd.tripped
+
+
+def test_base_exception_propagates_without_charging_breaker():
+    """A true BaseException captured on the worker (e.g. a
+    KeyboardInterrupt delivered there) must re-raise on the CALLER
+    thread — deliberately past `except Exception` boundaries — and must
+    not count against the device's breaker."""
+
+    def interrupted():
+        raise KeyboardInterrupt
+
+    wd = Watchdog(timeout_s=5.0, failure_threshold=1)
+    with pytest.raises(KeyboardInterrupt):
+        wd.call(interrupted)
+    assert wd.state() == "closed"
+    assert wd.call(lambda: "still serving") == "still serving"
 
 
 def test_hung_solver_falls_back_to_host(monkeypatch):
@@ -89,6 +306,7 @@ def test_hung_solver_falls_back_to_host(monkeypatch):
     result = a.assign(broker.cluster(), subs)
     assert time.perf_counter() - t0 < 5
     assert a.last_stats.fallback_used
+    assert a.last_stats.breaker_state == "open"
     assert len(result.group_assignment["m"].partitions) == 1
 
 
@@ -96,3 +314,25 @@ def test_timeout_config_validation():
     a = LagBasedPartitionAssignor()
     with pytest.raises(ValueError, match="not a number"):
         a.configure({"group.id": "g", "tpu.assignor.solve.timeout.ms": "soon"})
+
+
+def test_breaker_config_knobs():
+    broker = FakeBroker().with_partition("t", 0, end=100, committed=0)
+    a = LagBasedPartitionAssignor(metadata_consumer_factory=lambda p: broker)
+    a.configure({
+        "group.id": "g",
+        "tpu.assignor.breaker.cooldown.ms": "1500",
+        "tpu.assignor.breaker.failures": "5",
+    })
+    assert a._watchdog.cooldown_s == 1.5
+    assert a._watchdog.failure_threshold == 5
+    with pytest.raises(ValueError, match="not a number"):
+        a.configure({
+            "group.id": "g",
+            "tpu.assignor.breaker.cooldown.ms": "soonish",
+        })
+    with pytest.raises(ValueError, match="must be >= 1"):
+        a.configure({
+            "group.id": "g",
+            "tpu.assignor.breaker.failures": "0",
+        })

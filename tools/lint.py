@@ -18,6 +18,11 @@ Rules:
   L008  trailing whitespace
   L009  duplicate top-level definition name
   L010  f-string without placeholders
+  L011  silent ``except Exception`` in package code: the handler must
+        re-raise, log with ``exc_info`` (or ``logger.exception``), or be
+        explicitly waived with ``# noqa: L011`` — a module-boundary
+        catch-all that swallows the traceback hides exactly the failures
+        the degraded-mode ladder is supposed to surface
 """
 
 from __future__ import annotations
@@ -80,9 +85,38 @@ def _used_names(tree: ast.AST) -> set:
     return used
 
 
+def _catches_exception(handler: ast.ExceptHandler) -> bool:
+    """True when the handler type names bare ``Exception`` (directly or
+    in a tuple)."""
+    node = handler.type
+    types = node.elts if isinstance(node, ast.Tuple) else [node]
+    return any(
+        isinstance(t, ast.Name) and t.id == "Exception" for t in types
+    )
+
+
+def _handler_is_loud(handler: ast.ExceptHandler) -> bool:
+    """True when the body re-raises or logs the traceback: a ``raise``
+    statement, any call with an ``exc_info`` keyword, or a
+    ``logger.exception(...)`` call."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            if any(kw.arg == "exc_info" for kw in node.keywords):
+                return True
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "exception"
+            ):
+                return True
+    return False
+
+
 def lint_source(path: Path, source: str) -> List[Finding]:
     findings: List[Finding] = []
     rel = str(path)
+    lines = source.splitlines()
 
     try:
         tree = ast.parse(source, filename=rel)
@@ -90,6 +124,9 @@ def lint_source(path: Path, source: str) -> List[Finding]:
         return [Finding(rel, exc.lineno or 0, "L001", f"syntax error: {exc.msg}")]
 
     is_init = path.name == "__init__.py"
+    # L011 applies to the package (the module boundaries the failure
+    # model depends on), not to tests/tools/bench scaffolding.
+    is_package = "kafka_lag_based_assignor_tpu" in path.parts
 
     # A format spec (the ":02d" in f"{j:02d}") parses as a nested JoinedStr
     # of constants — not a placeholder-less f-string.
@@ -120,6 +157,22 @@ def lint_source(path: Path, source: str) -> List[Finding]:
                     )
         elif isinstance(node, ast.ExceptHandler) and node.type is None:
             findings.append(Finding(rel, node.lineno, "L005", "bare except"))
+        elif (
+            isinstance(node, ast.ExceptHandler)
+            and is_package
+            and _catches_exception(node)
+            and not _handler_is_loud(node)
+            and "noqa: L011" not in lines[node.lineno - 1]
+        ):
+            findings.append(
+                Finding(
+                    rel,
+                    node.lineno,
+                    "L011",
+                    "silent `except Exception`: re-raise, log with "
+                    "exc_info, or waive with `# noqa: L011`",
+                )
+            )
         elif isinstance(node, ast.Compare):
             for op, comparator in zip(node.ops, node.comparators):
                 if isinstance(op, (ast.Eq, ast.NotEq)) and (
