@@ -817,9 +817,22 @@ def main():
         }
     }
     log(json.dumps(results["harness"]))
+    # Registry snapshots bracket each config: the per-config BENCH record
+    # embeds p50/p99 of every histogram series that moved (span
+    # latencies, churn, solve durations) — the same registry the service
+    # exports over the wire, so bench numbers and production telemetry
+    # share one definition.
+    from kafka_lag_based_assignor_tpu.utils import metrics as klba_metrics
+
     for fn in (config1_readme, config2_zipf, config3_vmap, config4_skew,
                config5_northstar):
+        before = klba_metrics.REGISTRY.snapshot()
         r = fn()
+        deltas = klba_metrics.histogram_deltas(
+            before, klba_metrics.REGISTRY.snapshot()
+        )
+        if deltas:
+            r["registry_histograms"] = deltas
         results[r["config"]] = r
         log(json.dumps(r))
 

@@ -37,7 +37,7 @@ from .types import (
     GroupSubscription,
     TopicPartition,
 )
-from .utils import faults
+from .utils import faults, metrics
 from .utils.config import PARITY_SOLVERS, AssignorConfig, parse_config
 from .utils.watchdog import Watchdog
 from .utils.observability import (
@@ -180,6 +180,34 @@ class LagBasedPartitionAssignor:
         stats.wall_ms = wall[0]
         log_rebalance(stats)
         self.last_stats = stats
+        # Registry + flight-recorder export (utils/metrics): the
+        # structured record, queryable after the socket closes.
+        metrics.REGISTRY.histogram(
+            "klba_rebalance_wall_ms", {"solver": stats.solver}
+        ).observe(stats.wall_ms)
+        metrics.FLIGHT.record(
+            "rebalance",
+            {
+                "solver": stats.solver,
+                "num_topics": stats.num_topics,
+                "num_partitions": stats.num_partitions,
+                "num_members": stats.num_members,
+                "wall_ms": stats.wall_ms,
+                "lag_read_ms": stats.lag_read_ms,
+                "solve_ms": stats.solve_ms,
+                "total_lag": stats.total_lag,
+                "quality_ratio": stats.quality_ratio,
+                "fallback_used": stats.fallback_used,
+                "breaker_state": stats.breaker_state,
+                "refine_iters": stats.refine_iters,
+            },
+        )
+        if stats.fallback_used:
+            # The in-process ladder descended past its first rung — the
+            # same incident class the wire service dumps on.
+            metrics.FLIGHT.auto_dump(
+                "ladder", {"method": "assign", "rung": "host_greedy"}
+            )
         return group_assignment
 
     def _assign_inner(
@@ -211,7 +239,8 @@ class LagBasedPartitionAssignor:
         stats.lag_read_ms = lag_ms[0]
 
         with stopwatch() as solve_ms:
-            raw = self._solve(lags, topic_subscriptions, stats)
+            with metrics.span("assign.solve"):
+                raw = self._solve(lags, topic_subscriptions, stats)
         stats.solve_ms = solve_ms[0]
 
         stats.num_topics = len(lags)
@@ -281,6 +310,10 @@ class LagBasedPartitionAssignor:
             )
             stats.fallback_used = True
             stats.refine_iters = None  # the host fallback never refines
+            metrics.REGISTRY.counter(
+                "klba_ladder_rung_total",
+                {"method": "assign", "rung": "host_greedy"},
+            ).inc()
             return host_fallback_for(solver)(lags, topic_subscriptions)
 
     @staticmethod

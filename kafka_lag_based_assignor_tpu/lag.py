@@ -39,7 +39,7 @@ from .types import (
     TopicPartition,
     TopicPartitionLag,
 )
-from .utils import faults
+from .utils import faults, metrics
 
 LOGGER = logging.getLogger(__name__)
 
@@ -79,6 +79,9 @@ def _call_with_retry(
         except Exception:
             if attempt == retry.attempts - 1:
                 raise
+            metrics.REGISTRY.counter(
+                "klba_lag_retries_total", {"rpc": what}
+            ).inc()
             delay = retry.backoff_s * retry.multiplier**attempt
             LOGGER.warning(
                 "lag RPC %s failed (attempt %d/%d); retrying in %.3fs",
@@ -155,6 +158,18 @@ def read_topic_partition_lags(
     retried callables so injection drills exercise the retry path.
     """
     topic_partition_lags: Dict[str, List[TopicPartitionLag]] = {}
+    with metrics.span("lag.read"):
+        _read_all(
+            topic_partition_lags, metadata_consumer, cluster,
+            all_subscribed_topics, auto_offset_reset_mode, retry,
+        )
+    return topic_partition_lags
+
+
+def _read_all(
+    topic_partition_lags, metadata_consumer, cluster,
+    all_subscribed_topics, auto_offset_reset_mode, retry,
+):
     for topic in all_subscribed_topics:
         partition_info = cluster.partitions_for_topic(topic)
         if not partition_info:
@@ -195,5 +210,3 @@ def read_topic_partition_lags(
             )
             rows.append(TopicPartitionLag(tp.topic, tp.partition, lag))
         topic_partition_lags[topic] = rows
-
-    return topic_partition_lags

@@ -279,10 +279,10 @@ def _probe_speed(margin: float = 0.9) -> bool:
     lowering that is correct but SLOW (e.g. rolls lowered as copies)
     must not regress the headline just because it compiled."""
     import functools
-    import time
 
     from jax import lax
 
+    from ..utils.observability import stopwatch
     from .rounds_kernel import _rounds_scan
 
     P, C, n = 65536, 1000, 8
@@ -323,9 +323,9 @@ def _probe_speed(margin: float = 0.9) -> bool:
         int(many(batch, kind=kind))  # warm-up/compile
         ts = []
         for _ in range(5):
-            t0 = time.perf_counter()
-            int(many(batch, kind=kind))
-            ts.append(time.perf_counter() - t0)
+            with stopwatch() as t:
+                int(many(batch, kind=kind))
+            ts.append(t[0] / 1000.0)
         return float(np.median(ts))
 
     t_xla, t_pal = timed("xla"), timed("pallas")

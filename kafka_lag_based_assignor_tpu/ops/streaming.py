@@ -67,7 +67,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..utils import faults
+from ..utils import faults, metrics
 from ..utils.observability import count_constrained_bound
 from .batched import _narrow_choice, _stream_device, assign_stream, stream_payload
 from .dispatch import ensure_x64, observe_pack_shift
@@ -87,6 +87,14 @@ class StreamingStats:
     count_spread: int = 0
     refine_rounds: int = 0  # resident-refine rounds the fused dispatch ran
     refine_exchanges: int = 0  # exchanges it applied (churn <= 2x this)
+
+    @property
+    def quality_ratio(self) -> float:
+        """Achieved imbalance normalized to the input-driven bound —
+        THE definition (shared by the engine's telemetry, the wire
+        response, and the flight records; same normalization as
+        RebalanceStats.quality_ratio and the bench)."""
+        return self.max_mean_imbalance / max(self.imbalance_bound, 1.0)
 
 
 def _pad_choice(choice, B: int):
@@ -284,6 +292,13 @@ class StreamingAssignor:
         # sits well inside the framework's 1.05 quality target while
         # making steady-drift epochs ~free; None always refines.
         refine_threshold: Optional[float] = 1.02,
+        # Opt-in per-epoch jax.profiler StepTraceAnnotation (alongside
+        # utils/observability.profile_trace): a Perfetto trace of the
+        # warm loop then shows per-epoch step boundaries instead of one
+        # undifferentiated blob.  Off by default — the annotation object
+        # costs a little even with no profiler attached, and the warm
+        # no-op epoch is a ~1.5 ms budget.
+        step_trace: bool = False,
     ):
         self.num_consumers = int(num_consumers)
         self.refine_iters = int(refine_iters)
@@ -298,6 +313,22 @@ class StreamingAssignor:
             )
         self.imbalance_guardrail = imbalance_guardrail
         self.refine_threshold = refine_threshold
+        self.step_trace = bool(step_trace)
+        self._epoch_num = 0
+        # Pre-bound registry series (utils/metrics): the warm no-op epoch
+        # is the hot path (<1% overhead budget, asserted in tests), so
+        # the per-epoch records must be plain pre-resolved observes, not
+        # name lookups.
+        self._m_churn = metrics.REGISTRY.histogram("klba_stream_churn")
+        self._m_quality_milli = metrics.REGISTRY.histogram(
+            "klba_stream_quality_ratio_milli"
+        )
+        self._m_quality_last = metrics.REGISTRY.gauge(
+            "klba_stream_quality_ratio"
+        )
+        self._m_guardrail = metrics.REGISTRY.counter(
+            "klba_stream_guardrail_trips_total"
+        )
         self._prev_choice: Optional[np.ndarray] = None
         # Device-RESIDENT warm state between dispatches: (padded int32
         # choice[bucket], per-consumer row table int32[C, M], counts
@@ -311,6 +342,48 @@ class StreamingAssignor:
     def rebalance(self, lags: np.ndarray) -> np.ndarray:
         """Produce choice int32[P] for the current lag vector."""
         faults.fire("stream.refine")  # fault point: poisoned warm stream
+        self._epoch_num += 1
+        with metrics.span("stream.epoch"):
+            if self.step_trace:
+                with jax.profiler.StepTraceAnnotation(
+                    "klba_stream_epoch", step_num=self._epoch_num
+                ):
+                    choice = self._rebalance_inner(lags)
+            else:
+                choice = self._rebalance_inner(lags)
+        s = self.last_stats
+        ratio = s.quality_ratio
+        self._m_churn.observe(s.churn)
+        self._m_quality_milli.observe(int(ratio * 1000))
+        self._m_quality_last.set(ratio)
+        metrics.FLIGHT.record(
+            "stream_epoch",
+            {
+                "epoch": self._epoch_num,
+                "P": int(lags.shape[0]),
+                "C": self.num_consumers,
+                "cold_start": s.cold_start,
+                "refined": s.refined,
+                "guardrail_tripped": s.guardrail_tripped,
+                "churn": s.churn,
+                "repaired_rows": s.repaired_rows,
+                "quality_ratio": ratio,
+                "max_mean_imbalance": s.max_mean_imbalance,
+                "imbalance_bound": s.imbalance_bound,
+                "count_spread": s.count_spread,
+                "refine_rounds": s.refine_rounds,
+                "refine_exchanges": s.refine_exchanges,
+            },
+        )
+        if s.guardrail_tripped:
+            self._m_guardrail.inc()
+            metrics.FLIGHT.auto_dump(
+                "guardrail", {"epoch": self._epoch_num,
+                              "quality_ratio": ratio}
+            )
+        return choice
+
+    def _rebalance_inner(self, lags: np.ndarray) -> np.ndarray:
         ensure_x64()  # int64 lags would silently downcast to int32 otherwise
         lags = np.ascontiguousarray(lags, dtype=np.int64)
         if lags.size and int(lags.min()) < 0:
@@ -416,6 +489,10 @@ class StreamingAssignor:
         high-latency transport a host round-trip between the two would
         double the cold cost.  The lag payload is uploaded once and shared
         by both kernels."""
+        with metrics.span("stream.cold_solve"):
+            return self._cold_solve_inner(lags)
+
+    def _cold_solve_inner(self, lags: np.ndarray) -> np.ndarray:
         C = self.num_consumers
         if self.cold_refine_iters <= 0 or C < 2:
             self._resident = None
@@ -455,7 +532,9 @@ class StreamingAssignor:
                 self._resident = tuple(resident[:3])
                 return np.asarray(narrow).astype(np.int32)
             observe_pack_shift(("stream", lags.shape, C), (shift, rb))
-            payload = jax.device_put(payload)  # ONE upload, both kernels
+            with metrics.span("stream.h2d"):
+                # ONE upload, shared by both kernels.
+                payload = jax.device_put(payload)
             choice0 = _stream_device(
                 payload, num_consumers=C, pack_shift=shift,
                 totals_rank_bits=rb,
@@ -503,6 +582,12 @@ class StreamingAssignor:
         buffers (zero re-upload of engine state).  Fills ``stats`` from
         the executable's own totals/counts outputs — the fused
         replacement for the post-refine host bincount."""
+        with metrics.span("stream.refine"):
+            return self._dispatch_warm_refine_inner(lags, choice, stats)
+
+    def _dispatch_warm_refine_inner(
+        self, lags: np.ndarray, choice: np.ndarray, stats: StreamingStats
+    ) -> np.ndarray:
         C = self.num_consumers
         P = lags.shape[0]
         B = self._bucket(P)

@@ -24,10 +24,20 @@ Response::
                          "stats": {...}}}
     {"id": 1, "error": {"message": "..."}}
 
-Also supported: ``{"method": "ping"}`` -> ``{"result": "pong"}`` and
-``{"method": "stats"}`` -> counters since start.  One request per line;
-responses preserve the request ``id``.  Malformed JSON gets an error
-response with ``id: null`` rather than a dropped connection.
+Also supported: ``{"method": "ping"}`` -> ``{"result": "pong"}``,
+``{"method": "stats"}`` -> counters since start, and
+``{"method": "metrics"}`` -> the unified registry (utils/metrics) both
+as structured JSON and as the Prometheus text exposition, plus
+flight-recorder status (see DEPLOYMENT.md "Observability" and
+tools/dump_metrics.py).  One request per line; responses preserve the
+request ``id``.  Malformed JSON gets an error response with ``id:
+null`` rather than a dropped connection.
+
+Every response envelope additionally carries a server-minted
+``request_id`` (``req-<pid>-<n>``): the same id tags package log lines
+emitted while the request was being served and any flight-recorder dump
+it triggered, so one wire exchange is correlatable across the response,
+the logs, and a post-incident dump.  Clients may ignore it.
 
 Streaming mode (the BASELINE config-5 loop as a wire API): a client that
 rebalances the same topic periodically can keep warm solver state
@@ -93,14 +103,18 @@ import socket
 import socketserver
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .assignor import LagBasedPartitionAssignor
 from .models.greedy import assign_greedy, host_fallback_for
 from .types import TopicPartitionLag
-from .utils import faults
+from .utils import faults, metrics
 from .utils.config import VALID_SOLVERS
-from .utils.observability import RebalanceStats, summarize_assignment
+from .utils.observability import (
+    RebalanceStats,
+    install_compile_counter,
+    summarize_assignment,
+)
 from .utils.watchdog import SolveRejected, Watchdog
 
 LOGGER = logging.getLogger(__name__)
@@ -133,23 +147,42 @@ _OPTION_ROUNDS_UP = {"sinkhorn_iters": True, "refine_iters": False}
 # vectors (host + device resident) — 64 north-star streams is ~50 MB.
 MAX_STREAMS = 64
 
+# Wire methods, as metric label values: anything else is labeled
+# "unknown" so a misbehaving client cannot mint unbounded label
+# cardinality in ``klba_requests_total`` / the span histograms.
+_KNOWN_METHODS = frozenset(
+    {"ping", "stats", "metrics", "assign", "stream_assign", "stream_reset"}
+)
+
 
 class _DeadlineBudget:
     """Per-request deadline: the degraded-mode ladder's rungs share ONE
     budget (``solve_timeout_s`` total), so a request answers within the
     configured deadline rather than paying a full timeout per attempt —
-    the remaining budget shrinks down the ladder."""
+    the remaining budget shrinks down the ladder.  ``clock`` is
+    injectable (L012 discipline) so budget-consumption accounting is
+    testable without real waits."""
 
-    def __init__(self, total_s: Optional[float]):
+    def __init__(
+        self,
+        total_s: Optional[float],
+        clock: Callable[[], float] = time.monotonic,
+    ):
         self.total_s = total_s
-        self._start = time.monotonic()
+        self._clock = clock
+        self._start = clock()
 
     def remaining(self) -> Optional[float]:
         """Seconds left (may be <= 0: the watchdog then fails fast
         without charging the breaker); None = no deadline configured."""
         if self.total_s is None:
             return None
-        return self.total_s - (time.monotonic() - self._start)
+        return self.total_s - (self._clock() - self._start)
+
+    def consumed_ms(self) -> float:
+        """Milliseconds spent since the budget was minted — the
+        deadline-budget-consumption metric, recorded per request."""
+        return (self._clock() - self._start) * 1000.0
 
 
 def _quantize_pow2(value: int, up: bool) -> int:
@@ -460,6 +493,8 @@ class AssignorService:
         # consecutive-exception trips, single half-open probe.
         breaker_cooldown_s: float = 300.0,
         breaker_failures: int = 3,
+        # Uptime/budget clock (L012 discipline: injectable, monotonic).
+        clock: Callable[[], float] = time.monotonic,
     ):
         self._tcp = socketserver.ThreadingTCPServer(
             (host, port), _Handler, bind_and_activate=True
@@ -490,7 +525,8 @@ class AssignorService:
         self.requests_served = 0
         self.errors = 0
         self.fallbacks = 0  # responses answered by a host-side fallback
-        self.started_at = time.time()
+        self._clock = clock
+        self._started = clock()
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -501,11 +537,15 @@ class AssignorService:
     def reject_oversized(self) -> bytes:
         with self._counter_lock:
             self.errors += 1
+        metrics.REGISTRY.counter(
+            "klba_request_errors_total", {"method": "oversized"}
+        ).inc()
         LOGGER.warning("rejected oversized request line (> %d bytes)",
                        MAX_LINE_BYTES)
         return json.dumps(
             {
                 "id": None,
+                "request_id": metrics.mint_request_id(),
                 "error": {
                     "message": f"request line exceeds {MAX_LINE_BYTES} bytes"
                 },
@@ -513,81 +553,198 @@ class AssignorService:
         ).encode()
 
     def handle_line(self, line: bytes) -> bytes:
-        req_id = None
-        try:
-            req = json.loads(line)
-            req_id = req.get("id")
-            method = req.get("method")
-            if method == "ping":
-                result: Any = "pong"
-            elif method == "stats":
+        """One wire request: minted request id (echoed in the response
+        envelope and on request-thread log lines), a ``wire.<method>``
+        span, and deadline-budget-consumption accounting."""
+        with metrics.request_scope() as rid:
+            req_id = None
+            label = "unknown"
+            try:
+                req = json.loads(line)
+                req_id = req.get("id")
+                method = req.get("method")
+                if method in _KNOWN_METHODS:
+                    label = method
+                with metrics.span(f"wire.{label}"):
+                    result, budget = self._dispatch(method, req)
                 with self._counter_lock:
-                    result = {
-                        "requests_served": self.requests_served,
-                        "errors": self.errors,
-                        "fallbacks": self.fallbacks,
-                        "uptime_s": time.time() - self.started_at,
+                    self.requests_served += 1
+                metrics.REGISTRY.counter(
+                    "klba_requests_total", {"method": label}
+                ).inc()
+                if budget is not None and budget.total_s is not None:
+                    metrics.REGISTRY.histogram(
+                        "klba_deadline_budget_consumed_ms",
+                        {"method": label},
+                    ).observe(budget.consumed_ms())
+                return json.dumps(
+                    {"id": req_id, "request_id": rid, "result": result}
+                ).encode()
+            except Exception as exc:  # noqa: BLE001 — wire boundary
+                with self._counter_lock:
+                    self.errors += 1
+                metrics.REGISTRY.counter(
+                    "klba_request_errors_total", {"method": label}
+                ).inc()
+                LOGGER.warning("service request failed", exc_info=True)
+                return json.dumps(
+                    {
+                        "id": req_id,
+                        "request_id": rid,
+                        "error": {"message": str(exc)},
                     }
-                with self._streams_lock:
-                    result["live_streams"] = len(self._streams)
-                    result["poisoned_snapshots"] = len(self._snapshots)
-                # Per-solver circuit-breaker states + trip counters — the
-                # operator's view of which failure domains are sidelined.
-                result["breakers"] = self._watchdog.stats()
-            elif method == "assign":
-                params = req.get("params") or {}
-                solver = params.get("solver", "rounds")
-                if solver not in VALID_SOLVERS:
-                    raise ValueError(
-                        f"unknown solver {solver!r}; valid: {list(VALID_SOLVERS)}"
-                    )
-                options = _validate_options(params.get("options") or {})
-                assignments, stats = _solve(
-                    params.get("topics") or {},
-                    params.get("subscriptions") or {},
-                    solver,
-                    watchdog=self._watchdog,
-                    host_fallback=self._host_fallback,
-                    options=options,
-                    deadline=_DeadlineBudget(self._watchdog.timeout_s),
-                )
-                if stats.fallback_used:
-                    with self._counter_lock:
-                        self.fallbacks += 1
-                result = {
-                    "assignments": assignments,
-                    "stats": json.loads(stats.to_json()),
-                    # Effective (quantized) option values actually used —
-                    # a client can see any pow2 substitution on the wire.
-                    "options": options,
+                ).encode()
+
+    def _dispatch(
+        self, method: Any, req: Dict[str, Any]
+    ) -> Tuple[Any, Optional[_DeadlineBudget]]:
+        """Route one parsed request; returns (result, deadline budget)."""
+        if method == "ping":
+            return "pong", None
+        if method == "stats":
+            with self._counter_lock:
+                result: Dict[str, Any] = {
+                    "requests_served": self.requests_served,
+                    "errors": self.errors,
+                    "fallbacks": self.fallbacks,
+                    "uptime_s": self._clock() - self._started,
                 }
-            elif method == "stream_assign":
-                result = self._stream_assign(
-                    req.get("params") or {},
-                    _DeadlineBudget(self._watchdog.timeout_s),
+            with self._streams_lock:
+                result["live_streams"] = len(self._streams)
+                result["poisoned_snapshots"] = len(self._snapshots)
+            # Per-solver circuit-breaker states + trip counters — the
+            # operator's view of which failure domains are sidelined.
+            result["breakers"] = self._watchdog.stats()
+            return result, None
+        if method == "metrics":
+            # The registry, both ways: structured JSON for programmatic
+            # consumers, Prometheus text exposition for scrapers (see
+            # tools/dump_metrics.py and DEPLOYMENT.md "Observability").
+            # ``params.view`` ("json" | "prometheus" | "flight") trims
+            # the response to one section — a 15 s scrape loop should
+            # not ship the snapshot twice plus the last dump per poll;
+            # either way the registry is walked ONCE.
+            view = (req.get("params") or {}).get("view")
+            if view not in (None, "json", "prometheus", "flight"):
+                raise ValueError(
+                    f"unknown metrics view {view!r}; valid: "
+                    "['flight', 'json', 'prometheus']"
                 )
-                if result["stream"]["fallback_used"]:
-                    with self._counter_lock:
-                        self.fallbacks += 1
-            elif method == "stream_reset":
-                params = req.get("params") or {}
-                sid = params.get("stream_id")
-                with self._streams_lock:
-                    dropped = self._streams.pop(sid, None) is not None
-                    self._snapshots.pop(sid, None)
-                result = {"dropped": dropped}
-            else:
-                raise ValueError(f"unknown method {method!r}")
-            with self._counter_lock:
-                self.requests_served += 1
-            return json.dumps({"id": req_id, "result": result}).encode()
-        except Exception as exc:  # noqa: BLE001 — wire boundary
-            with self._counter_lock:
-                self.errors += 1
-            LOGGER.warning("service request failed", exc_info=True)
-            return json.dumps(
-                {"id": req_id, "error": {"message": str(exc)}}
-            ).encode()
+            result = {}
+            if view in (None, "json", "prometheus"):
+                snap = metrics.REGISTRY.snapshot()
+                if view in (None, "json"):
+                    result["json"] = snap
+                if view in (None, "prometheus"):
+                    result["prometheus"] = metrics.REGISTRY.prometheus(
+                        snap
+                    )
+            if view in (None, "flight"):
+                last = metrics.FLIGHT.last_dump()
+                result["flight"] = {
+                    "records": len(metrics.FLIGHT.records()),
+                    "dumps": metrics.FLIGHT.dump_count(),
+                    "last_dump_reason": last["reason"] if last else None,
+                    # The payload itself: with KLBA_FLIGHT_DIR unset
+                    # (the default) the wire is the ONLY way an
+                    # operator can reach a dump post-incident.
+                    "last_dump": last,
+                }
+            return result, None
+        if method == "assign":
+            params = req.get("params") or {}
+            solver = params.get("solver", "rounds")
+            if solver not in VALID_SOLVERS:
+                raise ValueError(
+                    f"unknown solver {solver!r}; valid: {list(VALID_SOLVERS)}"
+                )
+            options = _validate_options(params.get("options") or {})
+            budget = _DeadlineBudget(
+                self._watchdog.timeout_s, clock=self._clock
+            )
+            assignments, stats = _solve(
+                params.get("topics") or {},
+                params.get("subscriptions") or {},
+                solver,
+                watchdog=self._watchdog,
+                host_fallback=self._host_fallback,
+                options=options,
+                deadline=budget,
+            )
+            rung = "host_greedy" if stats.fallback_used else "none"
+            metrics.REGISTRY.counter(
+                "klba_ladder_rung_total", {"method": "assign", "rung": rung}
+            ).inc()
+            metrics.FLIGHT.record(
+                "wire_assign",
+                {
+                    "solver": solver,
+                    "rung": rung,
+                    "num_partitions": stats.num_partitions,
+                    "num_members": stats.num_members,
+                    "total_lag": stats.total_lag,
+                    "quality_ratio": stats.quality_ratio,
+                    "fallback_used": stats.fallback_used,
+                    "breaker_state": stats.breaker_state,
+                },
+            )
+            if stats.fallback_used:
+                with self._counter_lock:
+                    self.fallbacks += 1
+                metrics.FLIGHT.auto_dump(
+                    "ladder",
+                    {"method": "assign", "rung": rung, "solver": solver},
+                )
+            return {
+                "assignments": assignments,
+                "stats": json.loads(stats.to_json()),
+                # Effective (quantized) option values actually used —
+                # a client can see any pow2 substitution on the wire.
+                "options": options,
+            }, budget
+        if method == "stream_assign":
+            budget = _DeadlineBudget(
+                self._watchdog.timeout_s, clock=self._clock
+            )
+            result = self._stream_assign(req.get("params") or {}, budget)
+            rung = result["stream"]["degraded_rung"]
+            metrics.REGISTRY.counter(
+                "klba_ladder_rung_total",
+                {"method": "stream_assign", "rung": rung},
+            ).inc()
+            if result["stream"]["fallback_used"]:
+                with self._counter_lock:
+                    self.fallbacks += 1
+            s = result["stream"]
+            metrics.FLIGHT.record(
+                "wire_stream",
+                {
+                    "rung": rung,
+                    "cold_start": s["cold_start"],
+                    "refined": s["refined"],
+                    "guardrail_tripped": s["guardrail_tripped"],
+                    "churn": s["churn"],
+                    "quality_ratio": s["quality_ratio"],
+                    "warm_restart": s["warm_restart"],
+                    "fallback_used": s["fallback_used"],
+                },
+            )
+            if rung != "none":
+                # Descended past the first ladder rung: a flight-recorder
+                # incident (at most one dump per request — a breaker trip
+                # in the same request already dumped this ring).
+                metrics.FLIGHT.auto_dump(
+                    "ladder", {"method": "stream_assign", "rung": rung}
+                )
+            return result, budget
+        if method == "stream_reset":
+            params = req.get("params") or {}
+            sid = params.get("stream_id")
+            with self._streams_lock:
+                dropped = self._streams.pop(sid, None) is not None
+                self._snapshots.pop(sid, None)
+            return {"dropped": dropped}, None
+        raise ValueError(f"unknown method {method!r}")
 
     def _stream_assign(
         self, params: Dict[str, Any], budget: Optional[_DeadlineBudget] = None
@@ -788,6 +945,7 @@ class AssignorService:
                 "repaired_rows": s.repaired_rows,
                 "max_mean_imbalance": s.max_mean_imbalance,
                 "imbalance_bound": s.imbalance_bound,
+                "quality_ratio": s.quality_ratio,
                 "count_spread": s.count_spread,
                 "fallback_used": fallback_used,
                 # Which ladder rung answered: none (warm engine) |
@@ -852,6 +1010,11 @@ class AssignorService:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "AssignorService":
+        # Process-wide telemetry hooks, BEFORE the warm-up builds the
+        # executables of interest: the compile counter must see them,
+        # and request-thread log lines carry the minted request id.
+        install_compile_counter()
+        metrics.install_log_request_ids()
         if self._warmup_shapes:
             # Pre-compile before serving: connections arriving meanwhile
             # queue in the TCP backlog and are answered once warm.

@@ -58,6 +58,8 @@ from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Dict, Iterator, List, Mapping, Optional
 
+from . import metrics
+
 LOGGER = logging.getLogger(__name__)
 
 #: Every fault point compiled into production code.  ``plan()`` validates
@@ -186,6 +188,13 @@ class FaultInjector:
                 return
             plan.fired += 1
             mode, delay = plan.mode, plan.delay_s
+        # Registry export (utils/metrics): fault activations as a
+        # queryable series.  Recorded OUTSIDE the injector lock and only
+        # on the fired path — the off path stays the one global load +
+        # None compare in :func:`fire` below.
+        metrics.REGISTRY.counter(
+            "klba_fault_fired_total", {"point": point, "mode": mode}
+        ).inc()
         # Sleeps happen OUTSIDE the lock: a hang drill must wedge only
         # the faulted call, not every other fault point in the process.
         if mode == "latency":

@@ -17,10 +17,11 @@ from __future__ import annotations
 import contextlib
 import json
 import logging
-import threading
 import time
 from dataclasses import asdict, dataclass, field
 from typing import Dict, Iterator, List, Optional
+
+from . import metrics
 
 LOGGER = logging.getLogger("kafka_lag_based_assignor_tpu")
 
@@ -47,11 +48,15 @@ logging.addLevelName(TRACE, "TRACE")
 #   changing per call signature (ops/dispatch.observe_pack_shift), i.e.
 #   recompiles caused by input value ranges drifting across a packing
 #   bound rather than by new shapes.
+#
+# Both live in the unified registry (utils/metrics) as
+# ``klba_compile_total`` / ``klba_static_drift_total``; the functions
+# here are the stable pre-registry API over those series.
 
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
-_compile_count = [0]
 _compile_listener_installed = [False]
-_static_drift_count = [0]
+_COMPILES = metrics.REGISTRY.counter("klba_compile_total")
+_STATIC_DRIFT = metrics.REGISTRY.counter("klba_static_drift_total")
 
 
 def install_compile_counter() -> None:
@@ -65,7 +70,7 @@ def install_compile_counter() -> None:
 
     def _on_duration(name, *_args, **_kw):
         if name == _COMPILE_EVENT:
-            _compile_count[0] += 1
+            _COMPILES.inc()
 
     monitoring.register_event_duration_secs_listener(_on_duration)
     _compile_listener_installed[0] = True
@@ -75,7 +80,7 @@ def compile_count() -> int:
     """Fresh XLA backend compiles observed since
     :func:`install_compile_counter` (0 if never installed).  Snapshot it
     around a steady-state loop and assert the delta is zero."""
-    return _compile_count[0]
+    return _COMPILES.value
 
 
 def note_static_drift() -> None:
@@ -83,11 +88,11 @@ def note_static_drift() -> None:
     ops/dispatch.observe_pack_shift when a call signature's value-derived
     static args change — each such change compiles a fresh executable
     unless the variant was warmed)."""
-    _static_drift_count[0] += 1
+    _STATIC_DRIFT.inc()
 
 
 def static_drift_count() -> int:
-    return _static_drift_count[0]
+    return _STATIC_DRIFT.value
 
 
 # --- Breaker observability ---------------------------------------------
@@ -96,29 +101,41 @@ def static_drift_count() -> int:
 # the aggregate behind every Watchdog instance, so a deployment can
 # assert "no breaker tripped during this soak" without reaching into
 # individual watchdogs (the per-instance state lives in Watchdog.stats()
-# and the service `stats` method).
+# and the service `stats` method).  Backed by the registry's
+# ``klba_breaker_trips_total{key=...}`` series — which also fixes the
+# old torn read: the previous dict snapshot was built WITHOUT the
+# writers' lock; registry children always read under their own lock.
+# A trip is also a flight-recorder trigger (utils/metrics.FLIGHT): the
+# incident's ring of recent epoch records is dumped exactly once.
 
-_breaker_trips: Dict[str, int] = {}
-_breaker_trips_lock = threading.Lock()
+_TRIPS_NAME = "klba_breaker_trips_total"
 
 
 def note_breaker_trip(key: str) -> None:
     """Record one breaker trip (called by utils/watchdog on every
     closed/half-open -> open transition)."""
-    with _breaker_trips_lock:
-        _breaker_trips[key] = _breaker_trips.get(key, 0) + 1
+    metrics.REGISTRY.counter(_TRIPS_NAME, {"key": key}).inc()
+    metrics.FLIGHT.auto_dump("breaker_trip", {"key": key})
 
 
 def breaker_trip_counts() -> Dict[str, int]:
     """Per-key trips since process start (empty if none ever tripped)."""
-    return dict(_breaker_trips)
+    return {
+        c.labels["key"]: c.value
+        for c in metrics.REGISTRY.series(_TRIPS_NAME)
+        if c.value
+    }
 
 
 def breaker_trip_count(key: Optional[str] = None) -> int:
-    """Total trips, or one key's trips."""
-    if key is not None:
-        return _breaker_trips.get(key, 0)
-    return sum(_breaker_trips.values())
+    """Total trips, or one key's trips.  Read-only: querying a key that
+    never tripped must NOT mint a zero-valued series into the registry
+    (a monitoring probe asserting "no trips" would otherwise grow the
+    Prometheus exposition with every key it ever asked about)."""
+    return sum(
+        c.value for c in metrics.REGISTRY.series(_TRIPS_NAME)
+        if key is None or c.labels.get("key") == key
+    )
 
 
 def count_constrained_bound(lags, num_consumers: int) -> float:

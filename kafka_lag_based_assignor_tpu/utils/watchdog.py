@@ -47,9 +47,17 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional, TypeVar
 
+from . import metrics
 from .observability import note_breaker_trip
 
 LOGGER = logging.getLogger(__name__)
+
+# Registry series (utils/metrics): completed-call latency per breaker
+# key, plus timeout / fail-fast-rejection counters — the queryable
+# aggregate behind every Watchdog instance.
+_SOLVE_MS = "klba_solve_duration_ms"
+_TIMEOUTS = "klba_solve_timeouts_total"
+_REJECTED = "klba_solve_rejected_total"
 
 T = TypeVar("T")
 
@@ -171,17 +179,23 @@ class Watchdog:
 
     # -- transitions (hold the lock) --------------------------------------
 
-    def _trip(self, br: _Breaker, key: str) -> None:
+    def _trip(self, br: _Breaker) -> bool:
+        """Returns True when this call opened the breaker.  The caller
+        fires :func:`note_breaker_trip` AFTER releasing the lock — the
+        trip hook dumps the flight recorder (JSON build, optional file
+        write), and holding the process-wide breaker lock through that
+        would stall every other thread's fail-fast admission exactly
+        during an incident."""
         if br.state == STATE_OPEN:
             # A straggler admitted before the trip fails after it: one
             # incident, one trip — don't inflate the counter or refresh
             # tripped_at (that would silently extend the cooldown).
-            return
+            return False
         br.state = STATE_OPEN
         br.tripped_at = self._clock()
         br.trips += 1
         br.probe_in_flight = False
-        note_breaker_trip(key)
+        return True
 
     def _admit(self, key: str) -> bool:
         """Admission control; returns True when this call is the half-open
@@ -232,22 +246,27 @@ class Watchdog:
                 # half-open probe still re-opens: it ran and was
                 # abandoned, recovered or not.)
                 return
-            self._trip(br, key)
+            tripped = self._trip(br)
+        if tripped:
+            note_breaker_trip(key)
 
     def _on_exception(self, key: str, probing: bool) -> None:
+        tripped = False
         with self._lock:
             br = self._breaker(key)
             br.consecutive_failures += 1
             if probing:
                 # A failed probe re-opens immediately — the device did not
                 # recover; don't let waiters rediscover that one by one.
-                self._trip(br, key)
+                tripped = self._trip(br)
             elif br.consecutive_failures >= self.failure_threshold:
                 LOGGER.warning(
                     "breaker %r tripped after %d consecutive exceptions",
                     key, br.consecutive_failures,
                 )
-                self._trip(br, key)
+                tripped = self._trip(br)
+        if tripped:
+            note_breaker_trip(key)
 
     # -- the watched call --------------------------------------------------
 
@@ -272,18 +291,30 @@ class Watchdog:
         if effective is None:
             return fn(*args, **kwargs)
         if effective <= 0:
+            metrics.REGISTRY.counter(_REJECTED, {"key": key}).inc()
             raise SolveRejected(
                 f"deadline budget exhausted before calling {key!r}"
             )
-        probing = self._admit(key)
+        try:
+            probing = self._admit(key)
+        except SolveRejected:
+            metrics.REGISTRY.counter(_REJECTED, {"key": key}).inc()
+            raise
+        started = self._clock()
         settled = False  # an _on_* transition (or explicit release) ran
         try:
             outcome: Dict[str, Any] = {}
             done = threading.Event()
+            # The caller's request scope, carried onto the worker so
+            # solve-side telemetry (flight records, guardrail dump
+            # triggers) keeps the request id and the one-dump-per-
+            # request budget (utils/metrics.adopt_scope).
+            scope = metrics.capture_scope()
 
             def run() -> None:
                 try:
-                    outcome["value"] = fn(*args, **kwargs)
+                    with metrics.adopt_scope(scope):
+                        outcome["value"] = fn(*args, **kwargs)
                 except BaseException as exc:  # noqa: BLE001 — re-raised below
                     outcome["exc"] = exc
                 finally:
@@ -294,6 +325,7 @@ class Watchdog:
             )
             worker.start()
             if not done.wait(effective):
+                metrics.REGISTRY.counter(_TIMEOUTS, {"key": key}).inc()
                 # "Truncated" = the ladder handed the device a residual
                 # budget well below the configured window.  The 0.9
                 # factor absorbs the request-validation time between
@@ -313,6 +345,9 @@ class Watchdog:
                 )
                 raise SolveTimeout(f"{key!r} call exceeded {effective}s")
             exc = outcome.get("exc")
+            metrics.REGISTRY.histogram(_SOLVE_MS, {"key": key}).observe(
+                (self._clock() - started) * 1000.0
+            )
             if exc is None:
                 self._on_success(key)
                 settled = True

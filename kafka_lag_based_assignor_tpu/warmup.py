@@ -22,12 +22,12 @@ and optionally the quality solvers), on synthetic data.
 from __future__ import annotations
 
 import logging
-import time
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from .ops.packing import pad_bucket
+from .utils.observability import stopwatch
 
 LOGGER = logging.getLogger(__name__)
 
@@ -256,18 +256,21 @@ def warmup(
                         )
                     )
             for name, T, job in jobs:
-                t0 = time.perf_counter()
-                try:
-                    import jax
+                ok = True
+                with stopwatch() as t:
+                    try:
+                        import jax
 
-                    jax.block_until_ready(job())
-                except Exception:
-                    LOGGER.warning(
-                        "warmup %s T=%d P=%d C=%d failed (skipped)",
-                        name, T, P, C, exc_info=True,
-                    )
+                        jax.block_until_ready(job())
+                    except Exception:
+                        LOGGER.warning(
+                            "warmup %s T=%d P=%d C=%d failed (skipped)",
+                            name, T, P, C, exc_info=True,
+                        )
+                        ok = False
+                if not ok:
                     continue
-                secs = time.perf_counter() - t0
+                secs = t[0] / 1000.0
                 done.append((name, T, P, C, secs))
                 LOGGER.info(
                     "warmup %s T=%d P=%d C=%d in %.1fs", name, T, P, C, secs

@@ -80,3 +80,42 @@ def test_lint_silent_except_exception_in_package():
 def test_lint_no_false_positives_on_format_specs():
     src = 'x = 3\nprint(f"{x:02d}")\n'
     assert lint.lint_source(Path("ok.py"), src) == []
+
+
+def test_lint_direct_clock_calls_in_package():
+    """L012: package code times things through stopwatch/spans with
+    injectable clocks, never raw time.time()/time.perf_counter() —
+    except the two clock-owning modules (utils/metrics.py,
+    utils/observability.py).  Tests/tools/bench are exempt."""
+    pkg = Path("kafka_lag_based_assignor_tpu/engine.py")
+    direct = "import time\n\ndef f():\n    return time.perf_counter()\n"
+    assert any(f.code == "L012" for f in lint.lint_source(pkg, direct))
+    wall = direct.replace("perf_counter", "time")
+    assert any(f.code == "L012" for f in lint.lint_source(pkg, wall))
+    # `from time import perf_counter` does not evade the rule.
+    bare = "from time import perf_counter\nx = perf_counter()\n"
+    assert any(f.code == "L012" for f in lint.lint_source(pkg, bare))
+    # monotonic (the injectable-clock default) and sleep are allowed, as
+    # is REFERENCING the callable for a clock parameter default.
+    ok = (
+        "import time\n\n"
+        "def f(clock=time.monotonic):\n"
+        "    time.sleep(0)\n"
+        "    return clock()\n"
+    )
+    assert not any(f.code == "L012" for f in lint.lint_source(pkg, ok))
+    # The clock-owning modules and non-package code are exempt; a
+    # noqa waiver silences it anywhere.
+    for exempt in (
+        Path("kafka_lag_based_assignor_tpu/utils/metrics.py"),
+        Path("kafka_lag_based_assignor_tpu/utils/observability.py"),
+        Path("tests/x.py"),
+        Path("bench.py"),
+    ):
+        assert not any(
+            f.code == "L012" for f in lint.lint_source(exempt, direct)
+        )
+    waived = direct.replace(
+        "time.perf_counter()", "time.perf_counter()  # noqa: L012"
+    )
+    assert not any(f.code == "L012" for f in lint.lint_source(pkg, waived))

@@ -1,0 +1,111 @@
+"""Operator CLI for a live sidecar's metrics: ``{"method": "metrics"}``.
+
+Usage::
+
+    python tools/dump_metrics.py [host] [port]            # JSON snapshot
+    python tools/dump_metrics.py [host] [port] --prom     # Prometheus text
+    python tools/dump_metrics.py [host] [port] --flight   # last flight dump
+    python tools/dump_metrics.py [host] [port] --summary  # p50/p99 table
+
+Defaults match the service's (127.0.0.1:7531).  ``--prom`` output is the
+standard text exposition — pipe it wherever a scrape would go.  The
+``--summary`` view prints one line per histogram series (count, p50,
+p99) and every counter — the quick "what is this sidecar doing" look.
+See DEPLOYMENT.md "Observability" for the metric catalog.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        prog="dump_metrics",
+        description="Dump a running assignor sidecar's metrics registry",
+    )
+    parser.add_argument("host", nargs="?", default="127.0.0.1")
+    parser.add_argument("port", nargs="?", type=int, default=7531)
+    mode = parser.add_mutually_exclusive_group()
+    mode.add_argument(
+        "--prom", action="store_true",
+        help="print the Prometheus text exposition",
+    )
+    mode.add_argument(
+        "--flight", action="store_true",
+        help="print the last flight-recorder dump (if any)",
+    )
+    mode.add_argument(
+        "--summary", action="store_true",
+        help="print a one-line-per-series p50/p99 summary",
+    )
+    parser.add_argument(
+        "--timeout", type=float, default=10.0,
+        help="socket timeout in seconds (default 10)",
+    )
+    args = parser.parse_args()
+
+    from kafka_lag_based_assignor_tpu.service import AssignorServiceClient
+
+    # Fetch only the view being printed — a scrape loop should not pull
+    # the JSON snapshot AND the exposition AND the last dump per poll.
+    view = (
+        "prometheus" if args.prom
+        else "flight" if args.flight
+        else "json"
+    )
+    try:
+        with AssignorServiceClient(
+            args.host, args.port, timeout_s=args.timeout
+        ) as client:
+            result = client.request("metrics", {"view": view})
+    except OSError as exc:
+        print(
+            f"cannot reach sidecar at {args.host}:{args.port}: {exc}",
+            file=sys.stderr,
+        )
+        return 1
+
+    if args.prom:
+        sys.stdout.write(result["prometheus"])
+        return 0
+    if args.flight:
+        flight = result["flight"]
+        if flight["dumps"] == 0:
+            print("no flight-recorder dumps (no incident triggers yet)")
+            print(f"ring holds {flight['records']} records")
+            return 0
+        print(
+            f"dumps: {flight['dumps']} "
+            f"(last reason: {flight['last_dump_reason']}); "
+            f"ring holds {flight['records']} records",
+            file=sys.stderr,
+        )
+        print(json.dumps(flight["last_dump"], indent=2, sort_keys=True))
+        return 0
+    if args.summary:
+        for name, entry in sorted(result["json"].items()):
+            for s in entry["series"]:
+                labels = ",".join(
+                    f"{k}={v}" for k, v in sorted(s["labels"].items())
+                )
+                sig = f"{name}{{{labels}}}" if labels else name
+                if entry["type"] == "histogram":
+                    print(
+                        f"{sig} count={s['count']} p50={s['p50']} "
+                        f"p99={s['p99']}"
+                    )
+                else:
+                    print(f"{sig} {s['value']}")
+        return 0
+    print(json.dumps(result["json"], indent=2, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

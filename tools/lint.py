@@ -23,6 +23,12 @@ Rules:
         explicitly waived with ``# noqa: L011`` — a module-boundary
         catch-all that swallows the traceback hides exactly the failures
         the degraded-mode ladder is supposed to surface
+  L012  direct ``time.time()`` / ``time.perf_counter()`` call in package
+        code outside utils/metrics.py and utils/observability.py: use
+        ``stopwatch`` / ``metrics.span`` (or an injectable clock
+        parameter) so durations land in the unified registry and tests
+        can fake the clock — the same discipline the breaker tests rely
+        on.  Waivable with ``# noqa: L012``.
 """
 
 from __future__ import annotations
@@ -113,6 +119,21 @@ def _handler_is_loud(handler: ast.ExceptHandler) -> bool:
     return False
 
 
+def _is_banned_clock_call(node: ast.Call, from_time_names: set) -> bool:
+    """True for ``time.time(...)`` / ``time.perf_counter(...)`` and for
+    bare calls of those names when imported via ``from time import``."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return (
+            func.attr in ("time", "perf_counter")
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "time"
+        )
+    if isinstance(func, ast.Name):
+        return func.id in from_time_names
+    return False
+
+
 def lint_source(path: Path, source: str) -> List[Finding]:
     findings: List[Finding] = []
     rel = str(path)
@@ -124,9 +145,20 @@ def lint_source(path: Path, source: str) -> List[Finding]:
         return [Finding(rel, exc.lineno or 0, "L001", f"syntax error: {exc.msg}")]
 
     is_init = path.name == "__init__.py"
-    # L011 applies to the package (the module boundaries the failure
+    # L011/L012 apply to the package (the module boundaries the failure
     # model depends on), not to tests/tools/bench scaffolding.
     is_package = "kafka_lag_based_assignor_tpu" in path.parts
+    # The two clock-owning modules: stopwatch/span live there, so direct
+    # perf_counter use is their implementation, not a violation.
+    clock_exempt = path.name in ("metrics.py", "observability.py")
+    # Names bound to the banned callables via `from time import ...`.
+    banned_from_time = {
+        alias.asname or alias.name
+        for node in ast.walk(tree)
+        if isinstance(node, ast.ImportFrom) and node.module == "time"
+        for alias in node.names
+        if alias.name in ("time", "perf_counter")
+    }
 
     # A format spec (the ":02d" in f"{j:02d}") parses as a nested JoinedStr
     # of constants — not a placeholder-less f-string.
@@ -171,6 +203,23 @@ def lint_source(path: Path, source: str) -> List[Finding]:
                     "L011",
                     "silent `except Exception`: re-raise, log with "
                     "exc_info, or waive with `# noqa: L011`",
+                )
+            )
+        elif (
+            isinstance(node, ast.Call)
+            and is_package
+            and not clock_exempt
+            and _is_banned_clock_call(node, banned_from_time)
+            and "noqa: L012" not in lines[node.lineno - 1]
+        ):
+            findings.append(
+                Finding(
+                    rel,
+                    node.lineno,
+                    "L012",
+                    "direct time.time()/time.perf_counter() call: use "
+                    "stopwatch/metrics.span or an injectable clock "
+                    "(waive with `# noqa: L012`)",
                 )
             )
         elif isinstance(node, ast.Compare):
