@@ -1,0 +1,429 @@
+"""Cross-stream megabatch coalescer: ONE vmapped resident dispatch for
+N concurrent consumer groups.
+
+The streaming engine (ops/streaming.py) serves one consumer group per
+rebalance, and each warm epoch that needs quality work costs one fused
+device dispatch.  That is the right shape for a lone tenant — but a
+sidecar serving 32 concurrent groups pays 32 serialized device
+round-trips per rebalance wave even though the fused refine core is
+shape-static and the epochs are independent.  On a tunneled/remote
+accelerator the round-trip IS the cost (BASELINE.md: ~1.5 ms warm no-op
+vs ~40+ ms dispatch+readback), so the multi-tenant fix is the
+FlashSinkhorn playbook applied across tenants instead of within one:
+amortize dispatch and H2D over every stream that is ready to go.
+
+Mechanism
+---------
+
+:class:`MegabatchCoalescer` keeps a queue of pending epoch submissions
+(:class:`EpochSubmission`: the exact-shape lag payload plus the stream's
+device-resident ``(choice, row_tab, counts)`` warm state and its static
+refine arguments).  A dedicated flusher thread admits submissions for a
+short window (sub-millisecond by default; ``max_batch`` pending epochs
+in one shape group flush immediately), then groups them by SHAPE BUCKET
+— ``(padded P bucket, C, payload dtype, iters, max_pairs,
+exchange_budget)``, everything that is a static argument of the fused
+executable — and dispatches each multi-row group as ONE
+:func:`_megabatch_fused_resident` call: the per-stream resident buffers
+are stacked on a new leading batch axis INSIDE the executable and
+``jax.vmap`` runs the exact single-stream warm core
+(totals re-derivation, quality-target test, the resident bulk-exchange
+round loop) over every row in one dispatch.  The batch's host-facing
+outputs come back in ONE device->host fetch; the resident successors
+stay on device and are handed back to each engine as rows of the batch
+output.
+
+Submitters park on a :class:`concurrent.futures.Future`
+(:meth:`StreamingAssignor.submit_epoch` blocks on it inside the same
+watchdog deadline that guards an inline dispatch), so the degraded-mode
+ladder, per-solver breakers, and poisoned-stream handling from round 7
+are untouched — they wrap the submit exactly as they wrapped the inline
+call.
+
+Isolation: a poisoned row falls OUT of the batch
+------------------------------------------------
+
+A flush that fails (an injected ``coalesce.flush`` fault, a megabatch
+dispatch error) never fails its batchmates wholesale: every row of the
+failed group re-dispatches the already-warmed SINGLE-stream resident
+executable on its own, and only a row whose own dispatch fails sees an
+exception on its future.  A single-row flush (window expired with one
+submission, or the service's single-stream bypass never reaches here)
+uses that same single-stream executable — zero extra compiles for the
+lone-tenant path.
+
+Executable-cache discipline: one megabatch executable per (shape bucket,
+batch bucket) — the batch axis pads to a power of two (short groups
+repeat their first row; padding results are discarded), so the compile
+count per shape bucket is log2(max_batch), not one per group size.
+
+Telemetry (utils/metrics): ``klba_coalesce_batch_size`` histogram (true
+group size per flush), ``klba_coalesce_flushes_total{path=megabatch|
+single|fallback}``, the ``coalesce.window`` / ``coalesce.dispatch``
+spans, and a ``coalesce_flush`` flight record carrying the request ids
+captured at submit time (``metrics.capture_scope``) so a flushed batch
+is correlatable with every wire request it served.  Per-row fallback
+dispatches adopt the submitting request's scope, keeping solve-side
+telemetry tagged with the right request id.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import threading
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..utils import faults, metrics
+from .batched import _narrow_choice
+from .refine import refine_rounds_resident
+from .streaming import _warm_fused_resident
+
+LOGGER = logging.getLogger(__name__)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "num_consumers", "iters", "max_pairs", "exchange_budget"
+    ),
+)
+def _megabatch_fused_resident(
+    lags, choices, row_tabs, counts, limits, num_consumers: int,
+    iters: int, max_pairs, exchange_budget: int,
+):
+    """THE megabatch executable: N streams' fused warm epochs in ONE
+    dispatch.
+
+    ``lags`` is the host-stacked ``[N, B]`` padded payload (the only
+    host->device transfer); ``choices``/``row_tabs``/``counts`` are
+    length-N tuples of the per-stream DEVICE-resident buffers, stacked
+    here INSIDE the executable so the gather into batch form fuses with
+    the refine instead of costing N small host-side dispatches;
+    ``limits`` is the per-row quality target (dynamic, ``[N]``).  The
+    body vmaps the exact single-stream warm core
+    (:func:`..ops.streaming._warm_fused_resident` minus its pad, which
+    the host already applied): re-derive per-consumer totals under the
+    new lags from the resident table, test against the target, run the
+    resident bulk-exchange round loop.  ``vmap`` of the ``while_loop``
+    runs until every row's exit condition holds, masking finished rows
+    — each row's result is bit-identical to its single-stream dispatch
+    (pinned by tests/test_coalesce.py).
+
+    Returns ``(narrow [N, B], choice int32 [N, B], row_tab [N, C, M],
+    counts [N, C], totals [N, C], rounds [N], exchanges [N])`` — narrow
+    plus the stats rows are the host-facing fetch; the middle three stay
+    device-resident as every stream's successor state."""
+    choice = jnp.stack(choices)
+    row_tab = jnp.stack(row_tabs)
+    cnt = jnp.stack(counts)
+
+    def one(lags_b, choice_b, tab_b, counts_b, limit):
+        B = choice_b.shape[0]
+        M = tab_b.shape[1]
+        lags64 = lags_b.astype(jnp.int64)
+        slot_ok = (
+            jnp.arange(M, dtype=jnp.int32)[None, :] < counts_b[:, None]
+        )
+        totals = jnp.where(
+            slot_ok, lags64[jnp.clip(tab_b, 0, B - 1)], 0
+        ).sum(axis=1)
+        choice_b, tab_b, counts_b, totals, rounds, ex = (
+            refine_rounds_resident(
+                lags64, choice_b, tab_b, counts_b, totals,
+                num_consumers=num_consumers, iters=iters,
+                max_pairs=max_pairs, exchange_budget=exchange_budget,
+                quality_limit=limit, bulk_transfer=True, fan=8,
+            )
+        )
+        narrow = _narrow_choice(choice_b, num_consumers)
+        return narrow, choice_b, tab_b, counts_b, totals, rounds, ex
+
+    return jax.vmap(one)(lags, choice, row_tab, cnt, limits)
+
+
+class EpochResult(NamedTuple):
+    """One stream's share of a flush: host-facing outputs materialized,
+    resident successors still on device (rows of the batch buffers)."""
+
+    narrow: np.ndarray  # int16-ish [B] padded choice (slice [:P] yourself)
+    resident: Tuple[Any, Any, Any]  # device (choice, row_tab, counts)
+    totals: np.ndarray  # int64 [C] per-consumer totals under the new lags
+    counts: np.ndarray  # int32 [C]
+    rounds: int
+    exchanges: int
+
+
+@dataclass
+class EpochSubmission:
+    """One stream's pending warm epoch (see the module docstring)."""
+
+    payload: np.ndarray  # exact-shape [P] lags, already dtype-downcast
+    bucket: int  # padded refine shape B (the engine's _bucket(P))
+    choice: Any  # device-resident int32[B]
+    row_tab: Any  # device-resident int32[C, M]
+    counts: Any  # device-resident int32[C]
+    limit: float  # device-side quality target (negative disables)
+    num_consumers: int
+    iters: int
+    max_pairs: int
+    exchange_budget: int
+    scope: Any = None  # metrics.capture_scope() token of the submitter
+    future: Future = field(default_factory=Future)
+    enqueued_at: float = 0.0
+
+    @property
+    def shape_key(self) -> Tuple:
+        """Everything that selects a distinct fused executable: only
+        submissions agreeing on ALL of it can share a megabatch."""
+        return (
+            self.bucket, self.num_consumers, self.payload.dtype.str,
+            self.iters, self.max_pairs, self.exchange_budget,
+        )
+
+
+class MegabatchCoalescer:
+    """Admission-window device-dispatch coalescer (module docstring).
+
+    ``window_s`` is the admission window measured from the OLDEST
+    pending submission; ``max_batch`` pending epochs in one shape group
+    flush immediately.  The flusher is a lazily started daemon thread —
+    a coalescer that never sees a submission costs nothing.  A wedged
+    device inside a flush blocks only the flusher (submitters' watchdog
+    deadlines still fire and their requests descend the degraded-mode
+    ladder on fresh engines, exactly like an abandoned inline solve).
+    """
+
+    def __init__(self, window_s: float = 0.0005, max_batch: int = 32):
+        if window_s < 0:
+            raise ValueError(f"window_s={window_s} must be >= 0")
+        if max_batch < 1:
+            raise ValueError(f"max_batch={max_batch} must be >= 1")
+        self.window_s = float(window_s)
+        self.max_batch = int(max_batch)
+        self._cond = threading.Condition()
+        self._pending: List[EpochSubmission] = []
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self._clock = metrics.REGISTRY.clock
+        # Pre-bound series: flushes run on the hot multi-tenant path.
+        self._m_batch = metrics.REGISTRY.histogram(
+            "klba_coalesce_batch_size"
+        )
+        self._m_path = {
+            p: metrics.REGISTRY.counter(
+                "klba_coalesce_flushes_total", {"path": p}
+            )
+            for p in ("megabatch", "single", "fallback")
+        }
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, sub: EpochSubmission) -> Future:
+        """Enqueue one epoch; returns the future its flush resolves.
+        Raises RuntimeError after :meth:`close` (the caller's ladder
+        then degrades exactly as for any failed dispatch)."""
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("megabatch coalescer is closed")
+            sub.enqueued_at = self._clock()
+            self._pending.append(sub)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, name="klba-coalesce", daemon=True
+                )
+                self._thread.start()
+            self._cond.notify_all()
+        return sub.future
+
+    def pending(self) -> int:
+        with self._cond:
+            return len(self._pending)
+
+    def close(self) -> None:
+        """Stop admitting; the flusher drains what is already queued
+        (futures resolve) and exits."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    # -- the flusher -------------------------------------------------------
+
+    def _largest_group(self) -> int:
+        """Max same-shape-bucket pending count (caller holds the lock)."""
+        tally: Dict[Tuple, int] = {}
+        best = 0
+        for s in self._pending:
+            n = tally.get(s.shape_key, 0) + 1
+            tally[s.shape_key] = n
+            if n > best:
+                best = n
+        return best
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending and not self._closed:
+                    self._cond.wait()
+                if not self._pending:
+                    return  # closed and drained
+                if not self._closed and self.window_s > 0:
+                    # Admission window from the OLDEST submission; a
+                    # full shape group short-circuits it.
+                    with metrics.span("coalesce.window"):
+                        deadline = (
+                            self._pending[0].enqueued_at + self.window_s
+                        )
+                        while not self._closed:
+                            if self._largest_group() >= self.max_batch:
+                                break
+                            remaining = deadline - self._clock()
+                            if remaining <= 0:
+                                break
+                            self._cond.wait(remaining)
+                batch, self._pending = self._pending, []
+            try:
+                self._flush(batch)
+            except Exception as exc:  # noqa: BLE001 — delivered to waiters
+                LOGGER.warning("coalescer flush crashed", exc_info=True)
+                for s in batch:
+                    if not s.future.done():
+                        s.future.set_exception(exc)
+
+    def _flush(self, batch: List[EpochSubmission]) -> None:
+        groups: Dict[Tuple, List[EpochSubmission]] = {}
+        for s in batch:
+            groups.setdefault(s.shape_key, []).append(s)
+        for group in groups.values():
+            # Enforce the batch cap HERE, not only at the window break:
+            # a group that outgrew max_batch while the flusher was busy
+            # (or because a whole 64-stream fleet rebalanced at once)
+            # flushes as max_batch-sized chunks — never padding past the
+            # cap into a fresh, bigger executable on the serving path.
+            for i in range(0, len(group), self.max_batch):
+                self._flush_group(group[i: i + self.max_batch])
+
+    def _flush_group(self, rows: List[EpochSubmission]) -> None:
+        self._m_batch.observe(len(rows))
+        path = "single"
+        try:
+            faults.fire("coalesce.flush")
+            if len(rows) > 1:
+                self._dispatch_megabatch(rows)
+                self._m_path["megabatch"].inc()
+                return
+        except Exception:  # noqa: BLE001 — isolated below, per row
+            # Poisoned-ROW isolation: the batch is not poisoned by
+            # one bad row (or a flush-level fault) — every row
+            # re-dispatches the single-stream executable on its own
+            # and only a row whose OWN dispatch fails sees an error.
+            LOGGER.warning(
+                "coalesced flush of %d epoch(s) failed; isolating "
+                "rows via single-stream dispatch",
+                len(rows), exc_info=True,
+            )
+            path = "fallback"
+        self._m_path[path].inc()
+        for s in rows:
+            if not s.future.done():
+                self._resolve_single(s)
+
+    def _dispatch_megabatch(self, rows: List[EpochSubmission]) -> None:
+        s0 = rows[0]
+        B, C = s0.bucket, s0.num_consumers
+        N = len(rows)
+        # Batch-axis bucket: pad to a power of two so the executable
+        # count per shape bucket stays log2(max_batch).  Padding rows
+        # repeat row 0's buffers; their results are dropped.
+        n_pad = 1 << (N - 1).bit_length()
+        lags = np.zeros((n_pad, B), dtype=s0.payload.dtype)
+        limits = np.full(n_pad, s0.limit, dtype=np.float64)
+        for i, s in enumerate(rows):
+            lags[i, : s.payload.shape[0]] = s.payload
+            limits[i] = s.limit
+        padded = rows + [s0] * (n_pad - N)
+        with metrics.span("coalesce.dispatch"):
+            out = _megabatch_fused_resident(
+                lags,
+                tuple(s.choice for s in padded),
+                tuple(s.row_tab for s in padded),
+                tuple(s.counts for s in padded),
+                limits,
+                num_consumers=C, iters=s0.iters,
+                max_pairs=s0.max_pairs,
+                exchange_budget=s0.exchange_budget,
+            )
+            narrow, choice_b, tab_b, counts_b, totals, rounds, ex = out
+            # ONE bulk device->host fetch covers every row's host-facing
+            # outputs (the serialized per-stream round-trips this module
+            # exists to amortize); the resident successors stay on
+            # device as rows of the batch buffers.
+            narrow = np.asarray(narrow)
+            totals_np = np.asarray(totals)
+            counts_np = np.asarray(counts_b)
+            rounds_np = np.asarray(rounds)
+            ex_np = np.asarray(ex)
+        metrics.FLIGHT.record(
+            "coalesce_flush",
+            {
+                "streams": N,
+                "padded_rows": n_pad,
+                "bucket": B,
+                "consumers": C,
+                "request_ids": [
+                    s.scope.request_id for s in rows
+                    if s.scope is not None
+                ],
+            },
+        )
+        for i, s in enumerate(rows):
+            s.future.set_result(
+                EpochResult(
+                    narrow=narrow[i],
+                    resident=(choice_b[i], tab_b[i], counts_b[i]),
+                    totals=totals_np[i],
+                    counts=counts_np[i],
+                    rounds=int(rounds_np[i]),
+                    exchanges=int(ex_np[i]),
+                )
+            )
+
+    def _resolve_single(self, s: EpochSubmission) -> None:
+        """One epoch on the SINGLE-stream resident executable — the
+        single-row flush and the per-row isolation fallback (both reuse
+        the exact executable the inline path warmed, so neither costs a
+        fresh compile).  Never raises: the outcome — result or the
+        row's own exception — lands on the future.  Adopts the
+        submitter's request scope so solve-side telemetry keeps its
+        request id."""
+        with metrics.adopt_scope(s.scope):
+            try:
+                out = _warm_fused_resident(
+                    s.payload, s.choice, s.row_tab, s.counts, s.limit,
+                    num_consumers=s.num_consumers, iters=s.iters,
+                    max_pairs=s.max_pairs,
+                    exchange_budget=s.exchange_budget,
+                )
+                narrow, choice_p, row_tab, counts, totals, rounds, ex = out
+                s.future.set_result(
+                    EpochResult(
+                        narrow=np.asarray(narrow),
+                        resident=(choice_p, row_tab, counts),
+                        totals=np.asarray(totals),
+                        counts=np.asarray(counts),
+                        rounds=int(rounds),
+                        exchanges=int(ex),
+                    )
+                )
+            except Exception as exc:  # noqa: BLE001 — the row's own error
+                LOGGER.warning(
+                    "coalesced single-row dispatch failed", exc_info=True
+                )
+                s.future.set_exception(exc)

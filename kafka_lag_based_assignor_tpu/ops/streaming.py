@@ -299,6 +299,13 @@ class StreamingAssignor:
         # costs a little even with no profiler attached, and the warm
         # no-op epoch is a ~1.5 ms budget.
         step_trace: bool = False,
+        # Optional PER-STREAM flight-recorder ring: every epoch record
+        # written to the process-wide aggregate ring (metrics.FLIGHT)
+        # is also copied here, so one noisy stream's incident can be
+        # dumped without the other tenants' records crowding it out
+        # (the sidecar attaches one small ring per live stream and
+        # serves it via the stream_flight wire method).
+        flight: Optional[metrics.FlightRecorder] = None,
     ):
         self.num_consumers = int(num_consumers)
         self.refine_iters = int(refine_iters)
@@ -314,6 +321,11 @@ class StreamingAssignor:
         self.imbalance_guardrail = imbalance_guardrail
         self.refine_threshold = refine_threshold
         self.step_trace = bool(step_trace)
+        self.flight = flight
+        # Set transiently by submit_epoch: when non-None, the resident
+        # warm dispatch routes through the megabatch coalescer
+        # (ops/coalesce) instead of dispatching inline.
+        self._coalescer = None
         self._epoch_num = 0
         # Pre-bound registry series (utils/metrics): the warm no-op epoch
         # is the hot path (<1% overhead budget, asserted in tests), so
@@ -356,25 +368,27 @@ class StreamingAssignor:
         self._m_churn.observe(s.churn)
         self._m_quality_milli.observe(int(ratio * 1000))
         self._m_quality_last.set(ratio)
-        metrics.FLIGHT.record(
-            "stream_epoch",
-            {
-                "epoch": self._epoch_num,
-                "P": int(lags.shape[0]),
-                "C": self.num_consumers,
-                "cold_start": s.cold_start,
-                "refined": s.refined,
-                "guardrail_tripped": s.guardrail_tripped,
-                "churn": s.churn,
-                "repaired_rows": s.repaired_rows,
-                "quality_ratio": ratio,
-                "max_mean_imbalance": s.max_mean_imbalance,
-                "imbalance_bound": s.imbalance_bound,
-                "count_spread": s.count_spread,
-                "refine_rounds": s.refine_rounds,
-                "refine_exchanges": s.refine_exchanges,
-            },
-        )
+        rec = {
+            "epoch": self._epoch_num,
+            "P": int(lags.shape[0]),
+            "C": self.num_consumers,
+            "cold_start": s.cold_start,
+            "refined": s.refined,
+            "guardrail_tripped": s.guardrail_tripped,
+            "churn": s.churn,
+            "repaired_rows": s.repaired_rows,
+            "quality_ratio": ratio,
+            "max_mean_imbalance": s.max_mean_imbalance,
+            "imbalance_bound": s.imbalance_bound,
+            "count_spread": s.count_spread,
+            "refine_rounds": s.refine_rounds,
+            "refine_exchanges": s.refine_exchanges,
+        }
+        if self.flight is not None:
+            # A recorder takes ownership of its record (annotates it in
+            # place), so the per-stream ring gets its own shallow copy.
+            self.flight.record("stream_epoch", dict(rec))
+        metrics.FLIGHT.record("stream_epoch", rec)
         if s.guardrail_tripped:
             self._m_guardrail.inc()
             metrics.FLIGHT.auto_dump(
@@ -382,6 +396,29 @@ class StreamingAssignor:
                               "quality_ratio": ratio}
             )
         return choice
+
+    def submit_epoch(self, lags: np.ndarray, coalescer) -> np.ndarray:
+        """One rebalance epoch whose fused warm dispatch — if the epoch
+        needs one — is routed through ``coalescer``
+        (:class:`..ops.coalesce.MegabatchCoalescer`): instead of
+        dispatching inline, the epoch parks on a future and the
+        coalescer megabatches it with every concurrent stream's epoch
+        in the same shape bucket into ONE vmapped resident dispatch.
+
+        Everything else about the epoch is :meth:`rebalance` verbatim —
+        the host-side quality gate still skips still-balanced epochs
+        with zero device traffic, cold solves and stale-resident
+        (table-build) dispatches stay inline (they are rare,
+        shape-changing events a megabatch cannot absorb), and a flush
+        failure surfaces on THIS stream only (the coalescer isolates
+        rows; see ops/coalesce).  Intended caller: the sidecar's
+        stream_assign path when more than one stream is live; a lone
+        tenant keeps the inline :meth:`rebalance` fast path."""
+        self._coalescer = coalescer
+        try:
+            return self.rebalance(lags)
+        finally:
+            self._coalescer = None
 
     def _rebalance_inner(self, lags: np.ndarray) -> np.ndarray:
         ensure_x64()  # int64 lags would silently downcast to int32 otherwise
@@ -618,6 +655,29 @@ class StreamingAssignor:
                 ("warm_fused", lags.shape, C),
                 int(payload.dtype.itemsize) * 8,
             )
+            if self._coalescer is not None:
+                # Megabatched epoch (submit_epoch): park on the
+                # coalescer's future — the flush stacks this epoch with
+                # its same-bucket batchmates into ONE vmapped fused
+                # dispatch, and the resident successors come back as
+                # rows of the batch output (still device-resident).
+                from .coalesce import EpochSubmission
+
+                r = self._coalescer.submit(
+                    EpochSubmission(
+                        payload=payload, bucket=B,
+                        choice=resident[0], row_tab=resident[1],
+                        counts=resident[2], limit=limit,
+                        num_consumers=C, iters=budget, max_pairs=pairs,
+                        exchange_budget=budget,
+                        scope=metrics.capture_scope(),
+                    )
+                ).result()
+                self._resident = r.resident
+                self._fill_stats_from_device(
+                    stats, r.totals, r.counts, r.rounds, r.exchanges
+                )
+                return r.narrow[:P].astype(np.int32)
             out = _warm_fused_resident(
                 payload, resident[0], resident[1], resident[2], limit,
                 num_consumers=C, iters=budget, max_pairs=pairs,

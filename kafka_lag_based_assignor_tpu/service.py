@@ -67,6 +67,23 @@ re-solves cold.  ``{"method": "stream_reset", "params": {"stream_id":
 lists are in ascending partition-id order — the row-stable order warm
 state is keyed on.
 
+Multi-tenant dispatch coalescing: when MORE than one stream is live,
+warm refine epochs route through the megabatch coalescer
+(:class:`..ops.coalesce.MegabatchCoalescer`) — concurrent epochs in the
+same shape bucket are stacked and served by ONE vmapped fused device
+dispatch instead of N serialized round-trips (knobs:
+``coalesce_window_ms`` / ``coalesce_max_batch``, config keys
+``tpu.assignor.coalesce.window.ms`` / ``tpu.assignor.coalesce.max_batch``;
+``max_batch <= 1`` disables).  A lone stream always takes the inline
+fast path, so single-tenant latency is unchanged.  Each live stream
+also keeps its OWN small flight-recorder ring (the process-wide
+256-record ring stays the aggregate); ``{"method": "stream_flight",
+"params": {"stream_id": ..., "clear": false}}`` dumps (and optionally
+clears) one stream's ring on demand.  ``metrics_port=`` /
+``--metrics-port`` additionally serves the Prometheus text exposition
+over plain HTTP (``GET /metrics``, utils/metrics_http) so a stock
+Prometheus can scrape without a shim.
+
 Failure model (DEPLOYMENT.md "Failure modes"): every request carries a
 deadline budget of ``solve_timeout_s`` TOTAL and descends a degraded-mode
 ladder within it — device solve -> host greedy for ``assign``;
@@ -147,12 +164,26 @@ _OPTION_ROUNDS_UP = {"sinkhorn_iters": True, "refine_iters": False}
 # vectors (host + device resident) — 64 north-star streams is ~50 MB.
 MAX_STREAMS = 64
 
+# Per-stream flight-recorder ring size: one noisy stream's incident no
+# longer shares the global 256-record ring with every other tenant.
+# Bounded alongside MAX_STREAMS (64 x 64 stats-only records).
+STREAM_FLIGHT_CAPACITY = 64
+
 # Wire methods, as metric label values: anything else is labeled
 # "unknown" so a misbehaving client cannot mint unbounded label
 # cardinality in ``klba_requests_total`` / the span histograms.
 _KNOWN_METHODS = frozenset(
-    {"ping", "stats", "metrics", "assign", "stream_assign", "stream_reset"}
+    {
+        "ping", "stats", "metrics", "assign", "stream_assign",
+        "stream_reset", "stream_flight",
+    }
 )
+
+
+def _counter_total(name: str) -> int:
+    """Sum of every series registered under ``name`` — the registry-view
+    primitive behind the service ``stats`` counters."""
+    return sum(c.value for c in metrics.REGISTRY.series(name))
 
 
 class _DeadlineBudget:
@@ -309,6 +340,16 @@ class _Stream:
         self.engine = None
         self.members: List[str] = []
         self.pids = None  # np.int64[P], sorted — the row order contract
+        self.flight = None  # per-stream FlightRecorder ring
+
+
+def _stream_ring() -> metrics.FlightRecorder:
+    """One stream's private flight ring: small, in-memory only (disk
+    dumps stay the aggregate recorder's job — dump_dir='' overrides the
+    KLBA_FLIGHT_DIR env default)."""
+    return metrics.FlightRecorder(
+        capacity=STREAM_FLIGHT_CAPACITY, dump_dir=""
+    )
 
 
 def _apply_stream_opts(engine, opts: Dict[str, Any]) -> None:
@@ -493,6 +534,17 @@ class AssignorService:
         # consecutive-exception trips, single half-open probe.
         breaker_cooldown_s: float = 300.0,
         breaker_failures: int = 3,
+        # Megabatch coalescer (ops/coalesce): admission window for
+        # cross-stream warm-epoch batching and the per-shape-bucket
+        # batch cap.  max_batch <= 1 disables coalescing entirely;
+        # either way a LONE live stream bypasses the coalescer (inline
+        # fast path — single-tenant p50 unchanged).
+        coalesce_window_ms: float = 0.5,
+        coalesce_max_batch: int = 32,
+        # Opt-in plain-HTTP /metrics listener (utils/metrics_http):
+        # port to bind on the service host (0 = ephemeral, for tests);
+        # None disables.
+        metrics_port: Optional[int] = None,
         # Uptime/budget clock (L012 discipline: injectable, monotonic).
         clock: Callable[[], float] = time.monotonic,
     ):
@@ -514,7 +566,6 @@ class AssignorService:
             for s in (warmup_shapes or [])
         ]
         self._warmup_solvers = tuple(warmup_solvers)
-        self._counter_lock = threading.Lock()
         self._streams: Dict[str, _Stream] = {}
         self._streams_lock = threading.Lock()
         # Last-answered choice per POISONED stream (host-side snapshot):
@@ -522,11 +573,100 @@ class AssignorService:
         # running instead of paying a full cold solve.  Bounded alongside
         # the stream cap; consumed (popped) on use or stream_reset.
         self._snapshots: Dict[str, Tuple] = {}
-        self.requests_served = 0
-        self.errors = 0
-        self.fallbacks = 0  # responses answered by a host-side fallback
+        if coalesce_max_batch > 1:
+            from .ops.coalesce import MegabatchCoalescer
+
+            self._coalescer = MegabatchCoalescer(
+                window_s=max(float(coalesce_window_ms), 0.0) / 1000.0,
+                max_batch=int(coalesce_max_batch),
+            )
+        else:
+            self._coalescer = None
+        self._metrics_port = metrics_port
+        self._metrics_http = None
+        # The request/error/fallback counters live in the registry
+        # (klba_requests_total / klba_request_errors_total /
+        # klba_fallbacks_total — the same series a scraper reads); the
+        # wire ``stats`` shape is a DELTA VIEW over them, baselined at
+        # construction so per-instance semantics survive the registry
+        # being process-wide (tests spin up many services per process).
+        self._stats_base = {
+            "requests_served": _counter_total("klba_requests_total"),
+            "errors": _counter_total("klba_request_errors_total"),
+            "fallbacks": _counter_total("klba_fallbacks_total"),
+        }
         self._clock = clock
         self._started = clock()
+
+    @property
+    def requests_served(self) -> int:
+        """Registry view: wire requests answered since THIS service was
+        constructed (ROADMAP "registry-backed stats").
+
+        Known tradeoff of the fold: the registry is process-wide, so
+        with TWO services alive CONCURRENTLY in one process each
+        instance's delta also counts the other's traffic (per-instance
+        label sets would mint unbounded series cardinality across test
+        processes, which the registry deliberately forbids).  The
+        deployment topologies run one sidecar per process; sequential
+        instances (tests) are exact via the construction baseline.
+        Reads are lock-free counter sums — the requests/errors/
+        fallbacks triple in one ``stats`` response may be mutually torn
+        by in-flight requests, like any monitoring-counter scrape."""
+        return (
+            _counter_total("klba_requests_total")
+            - self._stats_base["requests_served"]
+        )
+
+    @property
+    def errors(self) -> int:
+        return (
+            _counter_total("klba_request_errors_total")
+            - self._stats_base["errors"]
+        )
+
+    @property
+    def fallbacks(self) -> int:
+        """Responses answered by a host-side fallback rung."""
+        return (
+            _counter_total("klba_fallbacks_total")
+            - self._stats_base["fallbacks"]
+        )
+
+    @classmethod
+    def from_config(
+        cls,
+        configs,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        **overrides,
+    ) -> "AssignorService":
+        """Build a sidecar from a Kafka-style consumer config map — THE
+        consumer of the service-relevant ``tpu.assignor.*`` keys
+        (utils/config.parse_config): ``solve.timeout.ms``,
+        ``host.fallback``, ``breaker.cooldown.ms`` / ``breaker.failures``,
+        ``coalesce.window.ms`` / ``coalesce.max_batch``, and
+        ``metrics.port``.  An embedder that already holds the consumer
+        config (which always carries the required ``group.id``) gets a
+        service whose knobs agree with the plugin's, one parse for both
+        surfaces.  Explicit ``overrides`` kwargs win over config values
+        (e.g. ``warmup_shapes``, or a test pinning ``metrics_port=0``).
+        """
+        from .utils.config import parse_config
+
+        cfg = parse_config(configs)
+        kwargs = {
+            "solve_timeout_s": cfg.solve_timeout_s,
+            "host_fallback": cfg.host_fallback,
+            "breaker_cooldown_s": cfg.breaker_cooldown_s,
+            "breaker_failures": cfg.breaker_failures,
+            "coalesce_window_ms": cfg.coalesce_window_s * 1000.0,
+            "coalesce_max_batch": cfg.coalesce_max_batch,
+            "metrics_port": cfg.metrics_port,
+            "warmup_shapes": cfg.warmup_shapes or None,
+        }
+        kwargs.update(overrides)
+        return cls(host, port, **kwargs)
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -535,8 +675,6 @@ class AssignorService:
     # -- request processing ------------------------------------------------
 
     def reject_oversized(self) -> bytes:
-        with self._counter_lock:
-            self.errors += 1
         metrics.REGISTRY.counter(
             "klba_request_errors_total", {"method": "oversized"}
         ).inc()
@@ -567,8 +705,6 @@ class AssignorService:
                     label = method
                 with metrics.span(f"wire.{label}"):
                     result, budget = self._dispatch(method, req)
-                with self._counter_lock:
-                    self.requests_served += 1
                 metrics.REGISTRY.counter(
                     "klba_requests_total", {"method": label}
                 ).inc()
@@ -581,8 +717,6 @@ class AssignorService:
                     {"id": req_id, "request_id": rid, "result": result}
                 ).encode()
             except Exception as exc:  # noqa: BLE001 — wire boundary
-                with self._counter_lock:
-                    self.errors += 1
                 metrics.REGISTRY.counter(
                     "klba_request_errors_total", {"method": label}
                 ).inc()
@@ -602,13 +736,14 @@ class AssignorService:
         if method == "ping":
             return "pong", None
         if method == "stats":
-            with self._counter_lock:
-                result: Dict[str, Any] = {
-                    "requests_served": self.requests_served,
-                    "errors": self.errors,
-                    "fallbacks": self.fallbacks,
-                    "uptime_s": self._clock() - self._started,
-                }
+            # The wire shape is a VIEW over the registry series (see the
+            # properties above) — no shadow counters to keep in sync.
+            result: Dict[str, Any] = {
+                "requests_served": self.requests_served,
+                "errors": self.errors,
+                "fallbacks": self.fallbacks,
+                "uptime_s": self._clock() - self._started,
+            }
             with self._streams_lock:
                 result["live_streams"] = len(self._streams)
                 result["poisoned_snapshots"] = len(self._snapshots)
@@ -689,8 +824,9 @@ class AssignorService:
                 },
             )
             if stats.fallback_used:
-                with self._counter_lock:
-                    self.fallbacks += 1
+                metrics.REGISTRY.counter(
+                    "klba_fallbacks_total", {"method": "assign"}
+                ).inc()
                 metrics.FLIGHT.auto_dump(
                     "ladder",
                     {"method": "assign", "rung": rung, "solver": solver},
@@ -713,8 +849,9 @@ class AssignorService:
                 {"method": "stream_assign", "rung": rung},
             ).inc()
             if result["stream"]["fallback_used"]:
-                with self._counter_lock:
-                    self.fallbacks += 1
+                metrics.REGISTRY.counter(
+                    "klba_fallbacks_total", {"method": "stream_assign"}
+                ).inc()
             s = result["stream"]
             metrics.FLIGHT.record(
                 "wire_stream",
@@ -744,6 +881,27 @@ class AssignorService:
                 dropped = self._streams.pop(sid, None) is not None
                 self._snapshots.pop(sid, None)
             return {"dropped": dropped}, None
+        if method == "stream_flight":
+            # One stream's private flight ring, dumped (and optionally
+            # cleared) on demand — the global 256-record ring stays the
+            # aggregate; this answers "what happened to THIS tenant"
+            # without the other streams' records crowding the window.
+            params = req.get("params") or {}
+            sid = params.get("stream_id")
+            with self._streams_lock:
+                st = self._streams.get(sid)
+                ring = st.flight if st is not None else None
+            if ring is None:
+                raise ValueError(f"unknown stream {sid!r}")
+            records = ring.snapshot()  # redacted copies, oldest first
+            cleared = bool(params.get("clear", False))
+            if cleared:
+                ring.clear()
+            return {
+                "stream_id": sid,
+                "records": records,
+                "cleared": cleared,
+            }, None
         raise ValueError(f"unknown method {method!r}")
 
     def _stream_assign(
@@ -821,9 +979,12 @@ class AssignorService:
                 # Service-level defaults (guardrail on at 1.25, unlike the
                 # library default) — requested options are applied by the
                 # SAME update block every epoch uses, so each default
-                # lives in exactly one place.
+                # lives in exactly one place.  Each stream gets its own
+                # small flight ring alongside the engine.
+                st.flight = _stream_ring()
                 st.engine = StreamingAssignor(
-                    num_consumers=C, imbalance_guardrail=1.25
+                    num_consumers=C, imbalance_guardrail=1.25,
+                    flight=st.flight,
                 )
                 st.members = members_sorted
                 # Poisoned-stream recovery: if the last epoch for this sid
@@ -865,13 +1026,29 @@ class AssignorService:
             fallback_used = False
             degraded_rung = "none"
             prev = st.engine._prev_choice
+            # Multi-tenant routing: with MORE than one live stream the
+            # warm dispatch goes through the megabatch coalescer (one
+            # vmapped device dispatch serves every concurrent epoch in
+            # the shape bucket); a lone stream keeps the inline fast
+            # path so single-tenant p50 is untouched.
+            coalescer = self._coalescer
+            if coalescer is not None:
+                with self._streams_lock:
+                    if len(self._streams) <= 1:
+                        coalescer = None
             try:
                 # Ladder rung 1: the warm-resident engine, under the
                 # stream breaker with the request's REMAINING budget.
-                choice = self._watchdog.call(
-                    st.engine.rebalance, lags, key="stream",
-                    timeout_s=budget.remaining(),
-                )
+                if coalescer is not None:
+                    choice = self._watchdog.call(
+                        st.engine.submit_epoch, lags, coalescer,
+                        key="stream", timeout_s=budget.remaining(),
+                    )
+                else:
+                    choice = self._watchdog.call(
+                        st.engine.rebalance, lags, key="stream",
+                        timeout_s=budget.remaining(),
+                    )
                 s = st.engine.last_stats
             except SolveRejected:
                 # FAIL-FAST rejection (breaker open / probe in flight /
@@ -970,7 +1147,10 @@ class AssignorService:
 
         from .ops.streaming import StreamingAssignor
 
-        fresh = StreamingAssignor(num_consumers=C, imbalance_guardrail=1.25)
+        ring = _stream_ring()
+        fresh = StreamingAssignor(
+            num_consumers=C, imbalance_guardrail=1.25, flight=ring
+        )
         _apply_stream_opts(fresh, opts)
         try:
             choice = self._watchdog.call(
@@ -1002,6 +1182,7 @@ class AssignorService:
             if sid not in self._streams and len(self._streams) < MAX_STREAMS:
                 nst = _Stream()
                 nst.engine = fresh
+                nst.flight = ring
                 nst.members = list(members_sorted)
                 nst.pids = pids_sorted
                 self._streams[sid] = nst
@@ -1027,6 +1208,12 @@ class AssignorService:
                     topics=[topics],
                     solvers=self._warmup_solvers,
                 )
+        if self._metrics_port is not None:
+            from .utils.metrics_http import MetricsHTTPServer
+
+            self._metrics_http = MetricsHTTPServer(
+                self.address[0], self._metrics_port
+            ).start()
         self._thread = threading.Thread(
             target=self._tcp.serve_forever, name="klba-service", daemon=True
         )
@@ -1034,9 +1221,22 @@ class AssignorService:
         LOGGER.info("assignor service listening on %s:%d", *self.address)
         return self
 
+    @property
+    def metrics_address(self) -> Optional[Tuple[str, int]]:
+        """(host, port) of the HTTP /metrics listener, None if disabled
+        or not yet started."""
+        if self._metrics_http is None:
+            return None
+        return self._metrics_http.address
+
     def stop(self) -> None:
         self._tcp.shutdown()
         self._tcp.server_close()
+        if self._coalescer is not None:
+            self._coalescer.close()
+        if self._metrics_http is not None:
+            self._metrics_http.stop()
+            self._metrics_http = None
 
     def __enter__(self) -> "AssignorService":
         return self.start()
@@ -1229,9 +1429,27 @@ def main() -> None:
         help="pre-compile these (max_partitions:num_consumers[:topics]) "
              "shapes before serving",
     )
+    parser.add_argument(
+        "--metrics-port", type=int, default=None, metavar="PORT",
+        help="serve the Prometheus text exposition over plain HTTP on "
+             "this port (GET /metrics); omit to disable",
+    )
+    parser.add_argument(
+        "--coalesce-window-ms", type=float, default=0.5, metavar="MS",
+        help="megabatch admission window for concurrent stream epochs "
+             "(default 0.5 ms)",
+    )
+    parser.add_argument(
+        "--coalesce-max-batch", type=int, default=32, metavar="N",
+        help="max stream epochs per megabatch flush; <= 1 disables "
+             "cross-stream coalescing (default 32)",
+    )
     opts = parser.parse_args()
     service = AssignorService(
-        opts.host, opts.port, warmup_shapes=opts.warmup
+        opts.host, opts.port, warmup_shapes=opts.warmup,
+        coalesce_window_ms=opts.coalesce_window_ms,
+        coalesce_max_batch=opts.coalesce_max_batch,
+        metrics_port=opts.metrics_port,
     ).start()
     print(f"listening on {service.address[0]}:{service.address[1]}", flush=True)
     try:

@@ -50,6 +50,18 @@ SINKHORN_ITERS_CONFIG = "tpu.assignor.sinkhorn.iters"  # int > 0
 # Rejected for "global" (per-topic refinement would undo its cross-topic
 # balance); ignored by "native"/"host" (host-only paths).
 REFINE_ITERS_CONFIG = "tpu.assignor.refine.iters"
+# Megabatch coalescer knobs (ops/coalesce, served by the sidecar):
+# admission window in ms (how long a warm epoch may wait for same-bucket
+# batchmates before its flush; sub-millisecond keeps the lone-tenant
+# p50 intact) and the per-shape-bucket batch cap (a full group flushes
+# immediately; <= 1 disables cross-stream coalescing entirely).
+COALESCE_WINDOW_CONFIG = "tpu.assignor.coalesce.window.ms"
+COALESCE_MAX_BATCH_CONFIG = "tpu.assignor.coalesce.max_batch"
+# Opt-in plain-HTTP /metrics listener (utils/metrics_http): a port for a
+# stock Prometheus to scrape the registry's text exposition without a
+# sidecar shim.  0/unset disables (the JSON wire `metrics` method is
+# always available).
+METRICS_PORT_CONFIG = "tpu.assignor.metrics.port"
 # "P:C[:T][,P:C[:T]...]" — shapes to pre-compile at configure() time
 # (consumer startup, NOT on the rebalance critical path): each entry warms
 # the kernels for max_partitions P / num_consumers C / a topic batch of T
@@ -129,6 +141,11 @@ class AssignorConfig:
     # refinement); refine_iters None = per-path auto budget.
     sinkhorn_iters: int = 24
     refine_iters: Optional[int] = None
+    # Megabatch coalescer (ops/coalesce): admission window + batch cap.
+    coalesce_window_s: float = 0.0005
+    coalesce_max_batch: int = 32
+    # Plain-HTTP /metrics port (utils/metrics_http); None = disabled.
+    metrics_port: Optional[int] = None
     # (max_partitions, num_consumers) shapes to pre-compile at configure().
     warmup_shapes: list = field(default_factory=list)
     consumer_group_props: Dict[str, Any] = field(default_factory=dict)
@@ -224,6 +241,8 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
             raise ValueError(f"{key}={value} must be >= 0")
         return value / 1000.0
 
+    metrics_port = _as_int(METRICS_PORT_CONFIG, 0, 0)
+
     return AssignorConfig(
         group_id=str(group_id),
         auto_offset_reset=str(
@@ -239,6 +258,9 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
         lag_retry_backoff_s=_as_ms(LAG_RETRY_BACKOFF_CONFIG, 50.0),
         sinkhorn_iters=sinkhorn_iters,
         refine_iters=refine_iters,
+        coalesce_window_s=_as_ms(COALESCE_WINDOW_CONFIG, 0.5),
+        coalesce_max_batch=_as_int(COALESCE_MAX_BATCH_CONFIG, 32, 1),
+        metrics_port=metrics_port if metrics_port > 0 else None,
         warmup_shapes=warmup_shapes,
         consumer_group_props=consumer_group_props,
         metadata_consumer_props=metadata_consumer_props,

@@ -17,6 +17,10 @@ Named fault points (every one threaded through production code):
                     would occur (:func:`..ops.dispatch.assign_group_device`)
 ``stream.refine``   entry of a streaming rebalance epoch
                     (:meth:`..ops.streaming.StreamingAssignor.rebalance`)
+``coalesce.flush``  the megabatch coalescer's per-group flush
+                    (:meth:`..ops.coalesce.MegabatchCoalescer._flush`) —
+                    a failure here exercises the batched-epoch isolation
+                    path (every row re-dispatches single-stream)
 ``lag.begin``       the ListOffsets(beginning) broker RPC (:mod:`..lag`)
 ``lag.end``         the ListOffsets(end) broker RPC
 ``lag.committed``   the OffsetFetch broker RPC
@@ -69,6 +73,7 @@ FAULT_POINTS = frozenset(
         "device.solve",
         "device.compile",
         "stream.refine",
+        "coalesce.flush",
         "lag.begin",
         "lag.end",
         "lag.committed",

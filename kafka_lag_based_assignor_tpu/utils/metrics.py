@@ -697,6 +697,21 @@ class FlightRecorder:
             tail = self._ring[self._idx:] + self._ring[: self._idx]
             return [r for r in tail if r is not None]
 
+    def snapshot(self) -> List[Dict[str, Any]]:
+        """REDACTED copies of the retained records, oldest first — the
+        wire dump unit for the per-stream rings (stats only leave the
+        process, same rule as :meth:`dump`; copies, so the live ring
+        dicts are never handed out)."""
+        return [_redact(dict(r)) for r in self.records()]
+
+    def clear(self) -> None:
+        """Drop the retained records (operator action after a dump).
+        ``seq`` numbering stays monotonic so post-clear records are
+        orderable against an earlier dump."""
+        with self._lock:
+            self._ring = [None] * self.capacity
+            self._idx = 0
+
     def auto_dump(self, reason: str,
                   detail: Optional[Dict[str, Any]] = None) -> bool:
         """Trigger hook (breaker trip / guardrail / ladder descent): at

@@ -1,0 +1,655 @@
+"""Megabatch coalescer tests: window/max-batch flush semantics, bit-exact
+parity of vmapped rows vs inline dispatches, fairness, poisoned-row
+isolation, the steady-state zero-compile gate, and the service-level
+routing (multi-stream coalesce, single-stream bypass, stream_flight,
+registry-backed stats, the HTTP /metrics listener)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kafka_lag_based_assignor_tpu.ops import coalesce as coalesce_mod
+from kafka_lag_based_assignor_tpu.ops.coalesce import MegabatchCoalescer
+from kafka_lag_based_assignor_tpu.ops.streaming import StreamingAssignor
+from kafka_lag_based_assignor_tpu.utils import faults, metrics
+
+
+def _engines(n, C=8, refine_iters=16, **kw):
+    kw.setdefault("refine_threshold", None)  # every warm epoch dispatches
+    return [
+        StreamingAssignor(num_consumers=C, refine_iters=refine_iters, **kw)
+        for _ in range(n)
+    ]
+
+
+def _int32_lags(rng, P):
+    """Fresh lags safely inside int32 so the payload dtype (part of the
+    coalescer's shape-bucket key) cannot flip mid-test."""
+    return rng.integers(10**6, 10**8, P).astype(np.int64)
+
+
+def _submit_all(engines, lags_list, coal, timeout_s=180.0):
+    """Concurrent submit_epoch for every engine; returns choices in
+    engine order (raises the worker's error, if any)."""
+    out = [None] * len(engines)
+    errs = [None] * len(engines)
+
+    def run(i):
+        try:
+            out[i] = engines[i].submit_epoch(lags_list[i], coal)
+        except Exception as exc:  # noqa: BLE001 — re-raised below
+            errs[i] = exc
+
+    threads = [
+        threading.Thread(target=run, args=(i,))
+        for i in range(len(engines))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout_s)
+        assert not t.is_alive(), "coalesced epoch did not complete"
+    for e in errs:
+        if e is not None:
+            raise e
+    return out
+
+
+def _batch_hist_state():
+    return metrics.REGISTRY.histogram("klba_coalesce_batch_size").state()
+
+
+def _hist_delta(before, after):
+    return [a - b for a, b in zip(after["buckets"], before["buckets"])]
+
+
+def test_constructor_validation_and_close():
+    with pytest.raises(ValueError, match="window_s"):
+        MegabatchCoalescer(window_s=-1.0)
+    with pytest.raises(ValueError, match="max_batch"):
+        MegabatchCoalescer(max_batch=0)
+    coal = MegabatchCoalescer()
+    coal.close()
+    from kafka_lag_based_assignor_tpu.ops.coalesce import EpochSubmission
+
+    with pytest.raises(RuntimeError, match="closed"):
+        coal.submit(
+            EpochSubmission(
+                payload=np.zeros(4, np.int32), bucket=8, choice=None,
+                row_tab=None, counts=None, limit=-1.0, num_consumers=2,
+                iters=1, max_pairs=1, exchange_budget=1,
+            )
+        )
+
+
+def test_single_row_window_timeout_flush():
+    """A lone submission resolves via the window-timeout flush of a
+    1-row group — which reuses the SINGLE-stream resident executable,
+    so the result is bit-identical to an inline twin engine."""
+    rng = np.random.default_rng(40)
+    P = 512
+    (a,) = _engines(1)
+    (b,) = _engines(1)
+    coal = MegabatchCoalescer(window_s=0.005, max_batch=32)
+    try:
+        lags = _int32_lags(rng, P)
+        np.testing.assert_array_equal(a.rebalance(lags), b.rebalance(lags))
+        lags2 = _int32_lags(rng, P)
+        inline = a.rebalance(lags2)
+        coalesced = b.submit_epoch(lags2, coal)
+        np.testing.assert_array_equal(inline, coalesced)
+        assert b.last_stats.refined
+        assert a.last_stats.refine_exchanges == b.last_stats.refine_exchanges
+    finally:
+        coal.close()
+
+
+def test_megabatch_rows_match_inline_bit_exact():
+    """THE parity pin: every row of a vmapped megabatch must equal the
+    single-stream dispatch for the same inputs — choices, imbalance,
+    and exchange counts alike — across several drift epochs."""
+    rng = np.random.default_rng(41)
+    G, P = 3, 512
+    inline = _engines(G)
+    co = _engines(G)
+    coal = MegabatchCoalescer(window_s=5.0, max_batch=G)
+    try:
+        lags = [_int32_lags(rng, P) for _ in range(G)]
+        for g in range(G):
+            np.testing.assert_array_equal(
+                inline[g].rebalance(lags[g]), co[g].rebalance(lags[g])
+            )
+        for _epoch in range(3):
+            lags = [_int32_lags(rng, P) for _ in range(G)]
+            want = [inline[g].rebalance(lags[g]) for g in range(G)]
+            got = _submit_all(co, lags, coal)
+            for g in range(G):
+                np.testing.assert_array_equal(want[g], got[g])
+                si, sc = inline[g].last_stats, co[g].last_stats
+                assert si.refine_exchanges == sc.refine_exchanges
+                assert si.refine_rounds == sc.refine_rounds
+                assert (
+                    abs(si.max_mean_imbalance - sc.max_mean_imbalance)
+                    < 1e-12
+                )
+        assert co[0].last_stats.refined  # the comparison exercised it
+    finally:
+        coal.close()
+
+
+def test_megabatch_parity_with_live_quality_limit():
+    """Parity must also hold when the device-side quality TARGET is
+    live (positive limit: target test, receiver-headroom clamp, and
+    target-met early exit all active) — the production service path
+    runs with threshold 1.02 / guardrail 1.25, not the disabled -1.0
+    limit the always-refine engines use."""
+    rng = np.random.default_rng(48)
+    G, P, C = 2, 512, 8
+    kw = dict(refine_threshold=1.02, imbalance_guardrail=1.25)
+    inline = _engines(G, C=C, **kw)
+    co = _engines(G, C=C, **kw)
+    coal = MegabatchCoalescer(window_s=5.0, max_batch=G)
+    try:
+        base = [_int32_lags(rng, P) for _ in range(G)]
+        for g in range(G):
+            np.testing.assert_array_equal(
+                inline[g].rebalance(base[g]), co[g].rebalance(base[g])
+            )
+        for member in range(2):
+            # Member-targeted drift: triple one consumer's rows so the
+            # kept assignment breaks the 1.02 threshold and BOTH twins
+            # dispatch a limit-bounded refine.
+            lags = [
+                np.where(
+                    inline[g]._prev_choice == member, base[g] * 3, base[g]
+                ).astype(np.int64)
+                for g in range(G)
+            ]
+            want = [inline[g].rebalance(lags[g]) for g in range(G)]
+            got = _submit_all(co, lags, coal)
+            for g in range(G):
+                assert inline[g].last_stats.refined
+                assert co[g].last_stats.refined
+                np.testing.assert_array_equal(want[g], got[g])
+                si, sc = inline[g].last_stats, co[g].last_stats
+                assert si.refine_exchanges == sc.refine_exchanges
+                assert si.refine_rounds == sc.refine_rounds
+                # The live target actually bounded the work.
+                assert sc.max_mean_imbalance <= 1.02 * max(
+                    sc.imbalance_bound, 1.0
+                ) + 1e-9
+    finally:
+        coal.close()
+
+
+def test_oversized_group_flushes_in_max_batch_chunks():
+    """A same-bucket group larger than max_batch must flush as capped
+    chunks — never padding past the cap into a bigger executable."""
+    rng = np.random.default_rng(49)
+    G, P = 3, 512
+    inline = _engines(G)
+    co = _engines(G)
+    coal = MegabatchCoalescer(window_s=0.2, max_batch=2)
+    try:
+        lags = [_int32_lags(rng, P) for _ in range(G)]
+        for g in range(G):
+            np.testing.assert_array_equal(
+                inline[g].rebalance(lags[g]), co[g].rebalance(lags[g])
+            )
+        before = _batch_hist_state()
+        lags = [_int32_lags(rng, P) for _ in range(G)]
+        want = [inline[g].rebalance(lags[g]) for g in range(G)]
+        got = _submit_all(co, lags, coal)
+        for g in range(G):
+            np.testing.assert_array_equal(want[g], got[g])
+        after = _batch_hist_state()
+        delta = _hist_delta(before, after)
+        assert sum(delta) >= 2  # the wave split into >= 2 flushes
+        # No observed flush exceeded max_batch=2: buckets past
+        # bucket_index(2) == 1 saw nothing new.
+        assert sum(delta[2:]) == 0, "a flush exceeded max_batch"
+    finally:
+        coal.close()
+
+
+def test_max_batch_flush_fires_before_window():
+    """A full shape group flushes IMMEDIATELY — the (huge) admission
+    window must not be waited out once max_batch epochs are pending."""
+    rng = np.random.default_rng(42)
+    G, P = 2, 512
+    co = _engines(G)
+    coal = MegabatchCoalescer(window_s=5.0, max_batch=G)
+    try:
+        lags = [_int32_lags(rng, P) for _ in range(G)]
+        for g in range(G):
+            co[g].rebalance(lags[g])
+        # Warm round (absorbs the megabatch executable compile).
+        _submit_all(co, [_int32_lags(rng, P) for _ in range(G)], coal)
+        t0 = time.monotonic()
+        _submit_all(co, [_int32_lags(rng, P) for _ in range(G)], coal)
+        assert time.monotonic() - t0 < 2.5, (
+            "full batch waited out the admission window"
+        )
+    finally:
+        coal.close()
+
+
+def test_mixed_shape_buckets_flush_as_separate_groups():
+    """Submissions disagreeing on the executable's static key (here: C)
+    cannot share a megabatch — they flush as separate groups, each row
+    still bit-identical to its inline twin."""
+    rng = np.random.default_rng(43)
+    P = 512
+    (a8,) = _engines(1, C=8)
+    (b8,) = _engines(1, C=8)
+    (a4,) = _engines(1, C=4)
+    (b4,) = _engines(1, C=4)
+    coal = MegabatchCoalescer(window_s=0.05, max_batch=32)
+    try:
+        lags = _int32_lags(rng, P)
+        for eng in (a8, b8, a4, b4):
+            eng.rebalance(lags)
+        lags2 = _int32_lags(rng, P)
+        want8, want4 = a8.rebalance(lags2), a4.rebalance(lags2)
+        got8, got4 = _submit_all([b8, b4], [lags2, lags2], coal)
+        np.testing.assert_array_equal(want8, got8)
+        np.testing.assert_array_equal(want4, got4)
+    finally:
+        coal.close()
+
+
+def test_fairness_under_hot_stream():
+    """A hot stream submitting back-to-back epochs must not starve a
+    slower one: the flush drains ALL pending submissions (FIFO), so the
+    cold stream's epochs ride the hot stream's flushes.  Both loops
+    complete, and at least one multi-row batch formed."""
+    rng = np.random.default_rng(44)
+    P = 512
+    (hot,) = _engines(1)
+    (cold,) = _engines(1)
+    coal = MegabatchCoalescer(window_s=0.02, max_batch=8)
+    done = {"hot": 0, "cold": 0}
+    try:
+        hot.rebalance(_int32_lags(rng, P))
+        cold.rebalance(_int32_lags(rng, P))
+        before = _batch_hist_state()
+        hot_lags = [_int32_lags(rng, P) for _ in range(6)]
+        cold_lags = [_int32_lags(rng, P) for _ in range(3)]
+
+        def hot_loop():
+            for arr in hot_lags:
+                hot.submit_epoch(arr, coal)
+                done["hot"] += 1
+
+        def cold_loop():
+            for arr in cold_lags:
+                cold.submit_epoch(arr, coal)
+                done["cold"] += 1
+
+        threads = [
+            threading.Thread(target=hot_loop),
+            threading.Thread(target=cold_loop),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180.0)
+            assert not t.is_alive(), "a stream starved"
+        assert done == {"hot": 6, "cold": 3}
+        delta = _hist_delta(before, _batch_hist_state())
+        assert sum(delta) >= 1
+        # bucket 0 holds size-1 flushes; any heavier bucket means a
+        # genuine multi-row batch formed while the hot stream was busy.
+        assert sum(delta[1:]) >= 1, "no multi-row batch ever formed"
+    finally:
+        coal.close()
+
+
+def test_flush_fault_isolates_rows_and_falls_back():
+    """An injected ``coalesce.flush`` fault fails the BATCH dispatch,
+    not the epochs: every row re-dispatches single-stream and still
+    returns the bit-exact inline result (the chaos invariant)."""
+    rng = np.random.default_rng(45)
+    G, P = 2, 512
+    inline = _engines(G)
+    co = _engines(G)
+    coal = MegabatchCoalescer(window_s=5.0, max_batch=G)
+    try:
+        lags = [_int32_lags(rng, P) for _ in range(G)]
+        for g in range(G):
+            np.testing.assert_array_equal(
+                inline[g].rebalance(lags[g]), co[g].rebalance(lags[g])
+            )
+        fallback = metrics.REGISTRY.counter(
+            "klba_coalesce_flushes_total", {"path": "fallback"}
+        )
+        before = fallback.value
+        lags = [_int32_lags(rng, P) for _ in range(G)]
+        want = [inline[g].rebalance(lags[g]) for g in range(G)]
+        with faults.injected(
+            faults.FaultInjector().plan("coalesce.flush", times=1)
+        ):
+            got = _submit_all(co, lags, coal)
+        for g in range(G):
+            np.testing.assert_array_equal(want[g], got[g])
+        assert fallback.value == before + 1
+    finally:
+        coal.close()
+
+
+def test_poisoned_row_does_not_poison_batchmates(monkeypatch):
+    """One genuinely poisoned row (its OWN single-stream dispatch keeps
+    failing) surfaces on that row's future alone; its batchmate still
+    gets a correct result through the isolation fallback."""
+    rng = np.random.default_rng(46)
+    G, P = 2, 512
+    inline = _engines(G)
+    co = _engines(G)
+    coal = MegabatchCoalescer(window_s=5.0, max_batch=G)
+    try:
+        lags = [_int32_lags(rng, P) for _ in range(G)]
+        for g in range(G):
+            inline[g].rebalance(lags[g])
+            co[g].rebalance(lags[g])
+        lags = [_int32_lags(rng, P) for _ in range(G)]
+        # Poison row 0: payload[0] marks it; the single-row fallback
+        # dispatch for exactly that payload raises.
+        lags[0][0] = 2**30 + 7
+        want1 = inline[1].rebalance(lags[1])
+        real = coalesce_mod._warm_fused_resident
+
+        def flaky(payload, *args, **kw):
+            if int(payload[0]) == 2**30 + 7:
+                raise RuntimeError("poisoned row")
+            return real(payload, *args, **kw)
+
+        monkeypatch.setattr(
+            coalesce_mod, "_warm_fused_resident", flaky
+        )
+        out = [None, None]
+        errs = [None, None]
+
+        def run(i):
+            try:
+                out[i] = co[i].submit_epoch(lags[i], coal)
+            except Exception as exc:  # noqa: BLE001 — asserted below
+                errs[i] = exc
+
+        with faults.injected(
+            faults.FaultInjector().plan("coalesce.flush", times=1)
+        ):
+            threads = [
+                threading.Thread(target=run, args=(i,)) for i in range(2)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=180.0)
+                assert not t.is_alive()
+        assert isinstance(errs[0], RuntimeError)
+        assert errs[1] is None
+        np.testing.assert_array_equal(want1, out[1])
+    finally:
+        coal.close()
+
+
+def test_steady_state_megabatch_loop_compiles_nothing():
+    """The vmapped warm loop's compile gate: once the megabatch
+    executable for the (shape bucket, batch bucket) exists, further
+    coalesced rounds — same streams, fresh lags — compile ZERO new XLA
+    executables."""
+    from kafka_lag_based_assignor_tpu.utils.observability import (
+        compile_count,
+        install_compile_counter,
+    )
+
+    install_compile_counter()
+    rng = np.random.default_rng(47)
+    G, P = 3, 512
+    co = _engines(G)
+    coal = MegabatchCoalescer(window_s=5.0, max_batch=G)
+    try:
+        for g in range(G):
+            co[g].rebalance(_int32_lags(rng, P))
+        for _ in range(2):  # warm rounds: megabatch compile happens here
+            _submit_all(co, [_int32_lags(rng, P) for _ in range(G)], coal)
+        before = compile_count()
+        for _ in range(3):
+            got = _submit_all(
+                co, [_int32_lags(rng, P) for _ in range(G)], coal
+            )
+            for g in range(G):
+                counts = np.bincount(got[g], minlength=8)
+                assert counts.max() - counts.min() <= 1
+        assert compile_count() == before, (
+            "steady-state megabatch loop compiled a fresh executable"
+        )
+    finally:
+        coal.close()
+
+
+# -- service-level routing ------------------------------------------------
+
+
+@pytest.fixture()
+def service():
+    from kafka_lag_based_assignor_tpu.service import AssignorService
+
+    # Generous window so concurrent wire requests actually batch.
+    with AssignorService(port=0, coalesce_window_ms=50.0) as svc:
+        yield svc
+
+
+def _client(svc):
+    from kafka_lag_based_assignor_tpu.service import AssignorServiceClient
+
+    return AssignorServiceClient(*svc.address)
+
+
+def _rows(arr):
+    return [[i, int(v)] for i, v in enumerate(arr)]
+
+
+def _hot_drift(result, lags, member):
+    """Triple the lags of ``member``'s partitions — reliably past the
+    service's 1.02 refine threshold, inside its 1.25 guardrail once the
+    budgeted refine re-tightens."""
+    out = np.asarray(lags).copy()
+    for _t, p in result["assignments"][member]:
+        out[p] *= 3
+    return out
+
+
+def test_service_single_stream_bypasses_coalescer(service):
+    """A lone live stream must keep the inline fast path: its refine
+    dispatches never touch the coalescer (batch-size histogram is not
+    observed), so single-tenant latency cannot regress."""
+    rng = np.random.default_rng(50)
+    lags = rng.integers(10**6, 10**8, 256).astype(np.int64)
+    with _client(service) as c:
+        r = c.stream_assign("only", "t0", _rows(lags), ["A", "B"],
+                            options={"refine_iters": 16})
+        before = _batch_hist_state()["count"]
+        r = c.stream_assign(
+            "only", "t0", _rows(_hot_drift(r, lags, "A")), ["A", "B"],
+            options={"refine_iters": 16},
+        )
+        assert r["stream"]["refined"]
+        assert r["stream"]["degraded_rung"] == "none"
+        assert _batch_hist_state()["count"] == before
+
+
+def test_service_multi_stream_routes_through_coalescer(service):
+    """With two live streams, concurrent warm epochs route through the
+    coalescer (batch-size histogram observed) and both responses stay
+    valid and unfailed."""
+    rng = np.random.default_rng(51)
+    lags = rng.integers(10**6, 10**8, 256).astype(np.int64)
+    opts = {"refine_iters": 16}
+    with _client(service) as c0, _client(service) as c1:
+        r0 = c0.stream_assign("s0", "t0", _rows(lags), ["A", "B"],
+                              options=opts)
+        r1 = c1.stream_assign("s1", "t0", _rows(lags), ["A", "B"],
+                              options=opts)
+        before = _batch_hist_state()["count"]
+        drift0 = _hot_drift(r0, lags, "A")
+        drift1 = _hot_drift(r1, lags, "B")
+        results = [None, None]
+
+        def run(i, cli, arr):
+            results[i] = cli.stream_assign(
+                f"s{i}", "t0", _rows(arr), ["A", "B"], options=opts
+            )
+
+        threads = [
+            threading.Thread(target=run, args=(0, c0, drift0)),
+            threading.Thread(target=run, args=(1, c1, drift1)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300.0)
+            assert not t.is_alive()
+        for r in results:
+            assert r["stream"]["degraded_rung"] == "none"
+            assert not r["stream"]["fallback_used"]
+            sizes = sorted(len(v) for v in r["assignments"].values())
+            assert sum(sizes) == 256 and sizes[-1] - sizes[0] <= 1
+        assert _batch_hist_state()["count"] > before
+
+
+def test_service_stream_flight_dump_and_clear(service):
+    rng = np.random.default_rng(52)
+    lags = rng.integers(10**3, 10**6, 64).astype(np.int64)
+    with _client(service) as c:
+        c.stream_assign("fl", "t0", _rows(lags), ["A", "B"])
+        c.stream_assign("fl", "t0", _rows(lags), ["A", "B"])
+        dump = c.request("stream_flight", {"stream_id": "fl"})
+        assert dump["stream_id"] == "fl"
+        assert len(dump["records"]) == 2
+        assert all(r["kind"] == "stream_epoch" for r in dump["records"])
+        # Stats-only redaction holds for the per-stream ring too.
+        assert all("assignments" not in r for r in dump["records"])
+        cleared = c.request(
+            "stream_flight", {"stream_id": "fl", "clear": True}
+        )
+        assert cleared["cleared"] is True
+        assert c.request("stream_flight", {"stream_id": "fl"})[
+            "records"
+        ] == []
+        # Another epoch repopulates; seq numbering stays monotonic.
+        c.stream_assign("fl", "t0", _rows(lags), ["A", "B"])
+        again = c.request("stream_flight", {"stream_id": "fl"})
+        assert len(again["records"]) == 1
+        assert again["records"][0]["seq"] == 2
+        with pytest.raises(RuntimeError, match="unknown stream"):
+            c.request("stream_flight", {"stream_id": "nope"})
+
+
+def test_service_stats_is_registry_view(service):
+    """The wire ``stats`` counters are a delta view over the registry
+    series — no shadow instance counters."""
+    with _client(service) as c:
+        c.ping()
+        before = sum(
+            ch.value
+            for ch in metrics.REGISTRY.series("klba_requests_total")
+        )
+        c.ping()
+        after = sum(
+            ch.value
+            for ch in metrics.REGISTRY.series("klba_requests_total")
+        )
+        stats = c.request("stats")
+    assert after == before + 1
+    assert stats["requests_served"] >= 2
+    # The stats request itself is counted once it completes.
+    assert service.requests_served == stats["requests_served"] + 1
+    assert service.errors == stats["errors"]
+    assert service.fallbacks == stats["fallbacks"] == 0
+
+
+def test_metrics_http_listener_serves_exposition():
+    import http.client
+
+    from kafka_lag_based_assignor_tpu.service import AssignorService
+
+    metrics.REGISTRY.counter("klba_requests_total", {"method": "ping"})
+    with AssignorService(port=0, metrics_port=0) as svc:
+        host, port = svc.metrics_address
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        try:
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            body = resp.read().decode()
+            assert resp.status == 200
+            assert resp.getheader("Content-Type").startswith(
+                "text/plain; version=0.0.4"
+            )
+            assert "# TYPE klba_requests_total counter" in body
+            conn.request("GET", "/healthz")
+            ok = conn.getresponse()
+            assert ok.status == 200 and ok.read() == b"ok\n"
+            conn.request("GET", "/bogus")
+            missing = conn.getresponse()
+            assert missing.status == 404
+            missing.read()
+        finally:
+            conn.close()
+    assert svc.metrics_address is None  # stopped with the service
+
+
+def test_coalesce_config_knobs_parse():
+    from kafka_lag_based_assignor_tpu.utils.config import parse_config
+
+    cfg = parse_config({
+        "group.id": "g",
+        "tpu.assignor.coalesce.window.ms": "2.5",
+        "tpu.assignor.coalesce.max_batch": "8",
+        "tpu.assignor.metrics.port": "9109",
+    })
+    assert cfg.coalesce_window_s == pytest.approx(0.0025)
+    assert cfg.coalesce_max_batch == 8
+    assert cfg.metrics_port == 9109
+    dflt = parse_config({"group.id": "g"})
+    assert dflt.coalesce_window_s == pytest.approx(0.0005)
+    assert dflt.coalesce_max_batch == 32
+    assert dflt.metrics_port is None
+    with pytest.raises(ValueError, match="coalesce.max_batch"):
+        parse_config({
+            "group.id": "g", "tpu.assignor.coalesce.max_batch": "0",
+        })
+
+
+def test_service_from_config_consumes_knobs():
+    """The tpu.assignor.* service keys have a real consumer: a sidecar
+    built from the consumer config map picks them up (and explicit
+    overrides win)."""
+    from kafka_lag_based_assignor_tpu.service import AssignorService
+
+    with AssignorService.from_config(
+        {
+            "group.id": "g",
+            "tpu.assignor.solve.timeout.ms": "5000",
+            "tpu.assignor.coalesce.window.ms": "2.0",
+            "tpu.assignor.coalesce.max_batch": "4",
+            "tpu.assignor.metrics.port": "0",  # 0/unset = disabled
+        },
+        port=0,
+    ) as svc:
+        assert svc._watchdog.timeout_s == 5.0
+        assert svc._coalescer is not None
+        assert svc._coalescer.window_s == pytest.approx(0.002)
+        assert svc._coalescer.max_batch == 4
+        assert svc._metrics_port is None
+        assert svc.metrics_address is None
+    # max_batch <= 1 disables coalescing; overrides beat config values.
+    with AssignorService.from_config(
+        {"group.id": "g", "tpu.assignor.coalesce.max_batch": "1"},
+        port=0,
+        solve_timeout_s=1.0,
+    ) as svc2:
+        assert svc2._coalescer is None
+        assert svc2._watchdog.timeout_s == 1.0

@@ -347,6 +347,42 @@ class TestStreamLadder:
                 assert not r3["stream"]["cold_start"]
                 assert r3["stream"]["degraded_rung"] == "none"
 
+    def test_coalesce_flush_fault_absorbed_per_row(self, service):
+        """With two live streams the warm epochs route through the
+        megabatch coalescer; a flush-level fault must be absorbed by the
+        per-row isolation fallback INSIDE the coalescer — valid
+        assignments, no ladder descent, nothing poisoned."""
+        lags = (np.arange(64) + 1) * 100
+        rows = [[i, int(v)] for i, v in enumerate(lags)]
+        with client_for(service) as c:
+            first = {
+                sid: c.stream_assign(sid, "t0", rows, ["A", "B"])
+                for sid in ("co-a", "co-b")
+            }
+            with faults.injected(
+                faults.FaultInjector().plan("coalesce.flush", times=0)
+            ) as inj:
+                for sid in ("co-a", "co-b"):
+                    # Member-targeted drift: triple A's partitions so
+                    # the kept assignment breaks the refine threshold
+                    # and the epoch actually reaches the coalescer.
+                    hot = {
+                        p for _t, p in first[sid]["assignments"]["A"]
+                    }
+                    drift = [
+                        [i, int(v) * (3 if i in hot else 1)]
+                        for i, v in enumerate(lags)
+                    ]
+                    r = c.stream_assign(sid, "t0", drift, ["A", "B"])
+                    assert r["stream"]["refined"]
+                    assert r["stream"]["degraded_rung"] == "none"
+                    assert not r["stream"]["fallback_used"]
+                    assert_valid_assignment(r["assignments"], 64)
+                assert inj.fired("coalesce.flush") >= 2
+            # Nothing was poisoned: both streams continue warm.
+            r = c.stream_assign("co-a", "t0", rows, ["A", "B"])
+            assert not r["stream"]["cold_start"]
+
     def test_snapshot_discarded_on_membership_change(self, service):
         lags = (np.arange(32) + 1) * 10
         with client_for(service) as c:
@@ -510,7 +546,7 @@ def test_chaos_soak_random_schedule_bounded_p99():
 
     rng = random.Random(0xC4A05)
     points = ["device.solve", "device.compile", "stream.refine",
-              "wire.read"]
+              "coalesce.flush", "wire.read"]
     lags0 = (np.arange(128) + 1) * 50
     topics = {"t0": [[p, int(v)] for p, v in enumerate(lags0)]}
     subs = {"A": ["t0"], "B": ["t0"], "C": ["t0"]}
@@ -521,6 +557,13 @@ def test_chaos_soak_random_schedule_bounded_p99():
         port=0, solve_timeout_s=2.0, breaker_cooldown_s=0.5
     ) as svc:
         c = client_for(svc)
+        # A second live stream keeps the soak's stream epochs routed
+        # through the megabatch coalescer (its flush fault point is in
+        # the schedule; a lone stream would bypass it).
+        c.stream_assign(
+            "soak-peer", "t0",
+            [[p, int(v)] for p, v in enumerate(lags0)], ["A", "B"],
+        )
         epoch = 0
         while time.monotonic() < deadline:
             epoch += 1

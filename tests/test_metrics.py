@@ -392,6 +392,25 @@ def test_flight_dump_redacts_payload_keys():
     assert "assignments" in fr.records()[0]
 
 
+def test_flight_snapshot_and_clear():
+    """Per-stream ring primitives: ``snapshot`` hands out REDACTED
+    copies (the live ring dicts are never exposed), ``clear`` empties
+    the ring while keeping seq numbering monotonic."""
+    fr = FlightRecorder(capacity=4, dump_dir="", registry_=Registry())
+    fr.record("t", {"churn": 1, "assignments": {"C0": []}})
+    fr.record("t", {"churn": 2})
+    snap = fr.snapshot()
+    assert [r["churn"] for r in snap] == [1, 2]
+    assert "assignments" not in snap[0]
+    snap[0]["churn"] = 99  # copies: the ring is untouched
+    assert fr.records()[0]["churn"] == 1
+    assert "assignments" in fr.records()[0]  # redaction is view-only
+    fr.clear()
+    assert fr.records() == [] and fr.snapshot() == []
+    fr.record("t", {"churn": 3})
+    assert fr.records()[0]["seq"] == 2  # monotonic across the clear
+
+
 def test_flight_dump_writes_file(tmp_path):
     fr = FlightRecorder(
         capacity=4, dump_dir=str(tmp_path), registry_=Registry()
