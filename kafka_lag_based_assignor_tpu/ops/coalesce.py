@@ -1,5 +1,5 @@
 """Cross-stream megabatch coalescer: ONE vmapped resident dispatch for
-N concurrent consumer groups.
+N concurrent consumer groups, roster-stable and pipeline-overlapped.
 
 The streaming engine (ops/streaming.py) serves one consumer group per
 rebalance, and each warm epoch that needs quality work costs one fused
@@ -17,64 +17,117 @@ Mechanism
 
 :class:`MegabatchCoalescer` keeps a queue of pending epoch submissions
 (:class:`EpochSubmission`: the exact-shape lag payload plus the stream's
-device-resident ``(choice, row_tab, counts)`` warm state and its static
-refine arguments).  A dedicated flusher thread admits submissions for a
-short window (sub-millisecond by default; ``max_batch`` pending epochs
-in one shape group flush immediately), then groups them by SHAPE BUCKET
-— ``(padded P bucket, C, payload dtype, iters, max_pairs,
+device-resident warm state and its static refine arguments).  A
+dedicated flusher thread admits submissions for a short window
+(sub-millisecond by default; a full shape group — or a locked roster's
+full wave — flushes immediately), then groups them by SHAPE BUCKET —
+``(padded P bucket, C, payload dtype, iters, max_pairs,
 exchange_budget)``, everything that is a static argument of the fused
-executable — and dispatches each multi-row group as ONE
-:func:`_megabatch_fused_resident` call: the per-stream resident buffers
-are stacked on a new leading batch axis INSIDE the executable and
-``jax.vmap`` runs the exact single-stream warm core
-(totals re-derivation, quality-target test, the resident bulk-exchange
-round loop) over every row in one dispatch.  The batch's host-facing
-outputs come back in ONE device->host fetch; the resident successors
-stay on device and are handed back to each engine as rows of the batch
-output.
+executable — and dispatches each multi-row group as ONE vmapped fused
+call over the exact single-stream warm core (totals re-derivation,
+quality-target test, the resident bulk-exchange round loop).  The
+batch's host-facing outputs come back in ONE device->host fetch.
+
+Roster-stable fast path
+-----------------------
+
+The first wave a stream set serves together pays the RE-STACK path: the
+per-stream resident ``(choice, row_tab, counts)`` buffers are gathered
+host-side and stacked on a new leading batch axis inside
+:func:`_megabatch_fused_resident`.  After ``lock_waves`` consecutive
+waves from the same stream set (default 1) the roster LOCKS: the
+stacked ``[N, ...]`` successors stay device-resident as ONE
+:class:`_ResidentBatch` owned by the coalescer, each engine's resident
+handle becomes a :class:`ResidentRow` (batch + stable row index) rather
+than concrete per-stream buffers, and every subsequent wave dispatches
+:func:`_megabatch_fused_locked` — the stacked buffers go in as DONATED
+arguments and come back as their own successors, with each stream's
+lags placed into its stable row host-side.  The N-per-flush re-stack
+work (3N small device gathers to slice rows out, N buffer tuples in) is
+gone from the steady state: ``klba_coalesce_restack_total`` stays flat
+while ``klba_coalesce_roster_hits_total`` counts locked flushes.
+
+The lock is invalidated — exactly once per churn event — whenever a
+wave does not match the resident batch: a stream joined or left, a
+stream was poisoned/warm-restarted (its engine then submits a concrete
+tuple or nothing at all), or a stale-resident rebuild replaced a handle
+with fresh buffers.  The churn wave falls back to the re-stack path
+(handles of the now-frozen old batch materialize their rows with one
+gather each — the one-wave cost), and the next stable wave re-locks.
+Padding rows of a batch carry zero lags and a ``0.0`` quality limit, so
+the fused while-loop exits before round one and they pass through
+bit-identically at ~zero compute (short re-stack waves pad by cycling
+the surviving rows' buffers, never a dead stream's).
+
+Double-buffered flush pipeline
+------------------------------
+
+A flush is three stages: **upload** (fill one of two rotating
+preallocated host staging buffers with the wave's lags/limits and start
+the async H2D), **dispatch** (the fused call — async under jax), and
+**readback** (the only blocking stage: ``jax.block_until_ready`` + the
+bulk D2H fetch, then futures resolve).  With ``pipeline=True`` (the
+default) readback runs on its own worker thread, so the flusher returns
+to the admission window immediately — wave k+1's admission and upload
+overlap wave k's D2H.  A staging buffer is reused only after the wave
+that used it completed readback (its ``ready`` event), which also
+proves the device consumed the H2D.  ``pipeline=False`` is the
+strict-serial fallback knob (``tpu.assignor.coalesce.pipeline``).
 
 Submitters park on a :class:`concurrent.futures.Future`
 (:meth:`StreamingAssignor.submit_epoch` blocks on it inside the same
 watchdog deadline that guards an inline dispatch), so the degraded-mode
 ladder, per-solver breakers, and poisoned-stream handling from round 7
-are untouched — they wrap the submit exactly as they wrapped the inline
-call.
+are untouched.  A submission whose parked waiter has already been
+abandoned by its watchdog (``abandoned()`` true — the request deadline
+passed between park and flush) is DROPPED before grouping: its future
+fails with :class:`SubmitterGone` (unparking the orphaned worker) and
+its row never pollutes the wave.
 
 Isolation: a poisoned row falls OUT of the batch
 ------------------------------------------------
 
-A flush that fails (an injected ``coalesce.flush`` fault, a megabatch
-dispatch error) never fails its batchmates wholesale: every row of the
-failed group re-dispatches the already-warmed SINGLE-stream resident
-executable on its own, and only a row whose own dispatch fails sees an
-exception on its future.  A single-row flush (window expired with one
-submission, or the service's single-stream bypass never reaches here)
-uses that same single-stream executable — zero extra compiles for the
-lone-tenant path.
+A flush that fails before dispatch (an injected ``coalesce.flush``
+fault, a megabatch grouping error) never fails its batchmates
+wholesale: every row of the failed group re-dispatches the
+already-warmed SINGLE-stream resident executable on its own, and only
+a row whose own dispatch fails sees an exception on its future.  The
+roster (if any) is invalidated so surviving engines re-stack and
+re-lock.  One caveat the locked path trades for zero-copy donation: a
+dispatch or readback failure AFTER the resident batch was donated
+poisons the batch (its buffers are gone), so those rows surface errors
+and recover through the per-stream degraded-mode ladder instead of an
+in-place re-dispatch — breakers and poisoning stay per-stream either
+way.  A single-row flush uses the single-stream executable directly —
+zero extra compiles for the lone-tenant path.
 
-Executable-cache discipline: one megabatch executable per (shape bucket,
-batch bucket) — the batch axis pads to a power of two (short groups
-repeat their first row; padding results are discarded), so the compile
-count per shape bucket is log2(max_batch), not one per group size.
+Executable-cache discipline: one re-stack executable and one locked
+executable per (shape bucket, batch pow2 bucket) — ``2 * log2
+(max_batch)`` compiles per shape bucket, covered off the serving path
+by :mod:`..warmup`'s megabatch job.
 
-Telemetry (utils/metrics): ``klba_coalesce_batch_size`` histogram (true
-group size per flush), ``klba_coalesce_flushes_total{path=megabatch|
-single|fallback}``, the ``coalesce.window`` / ``coalesce.dispatch``
-spans, and a ``coalesce_flush`` flight record carrying the request ids
-captured at submit time (``metrics.capture_scope``) so a flushed batch
-is correlatable with every wire request it served.  Per-row fallback
-dispatches adopt the submitting request's scope, keeping solve-side
-telemetry tagged with the right request id.
+Telemetry (utils/metrics): ``klba_coalesce_batch_size`` histogram,
+``klba_coalesce_flushes_total{path=megabatch|single|fallback}``,
+``klba_coalesce_roster_hits_total`` / ``klba_coalesce_restack_total`` /
+``klba_coalesce_roster_invalidations_total`` /
+``klba_coalesce_dead_rows_total`` counters, the ``coalesce.window`` /
+``coalesce.upload`` / ``coalesce.dispatch`` / ``coalesce.readback``
+pipeline-stage spans, and a ``coalesce_flush`` flight record carrying
+the wave's request ids (``metrics.capture_scope``).  Per-row fallback
+dispatches adopt the submitting request's scope.  Fault points:
+``coalesce.flush`` (per-group flush) and ``coalesce.gather`` (resident
+row materialization — the roster-churn path).
 """
 
 from __future__ import annotations
 
 import functools
 import logging
+import queue
 import threading
 from concurrent.futures import Future
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, NamedTuple, Tuple
 
 import numpy as np
 
@@ -89,41 +142,27 @@ from .streaming import _warm_fused_resident
 LOGGER = logging.getLogger(__name__)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "num_consumers", "iters", "max_pairs", "exchange_budget"
-    ),
-)
-def _megabatch_fused_resident(
-    lags, choices, row_tabs, counts, limits, num_consumers: int,
-    iters: int, max_pairs, exchange_budget: int,
-):
-    """THE megabatch executable: N streams' fused warm epochs in ONE
-    dispatch.
+class SubmitterGone(RuntimeError):
+    """A parked submission's waiter abandoned its wait (its watchdog
+    deadline passed) before the flush; the row was dropped from the
+    wave and this exception unparks the orphaned worker thread."""
 
-    ``lags`` is the host-stacked ``[N, B]`` padded payload (the only
-    host->device transfer); ``choices``/``row_tabs``/``counts`` are
-    length-N tuples of the per-stream DEVICE-resident buffers, stacked
-    here INSIDE the executable so the gather into batch form fuses with
-    the refine instead of costing N small host-side dispatches;
-    ``limits`` is the per-row quality target (dynamic, ``[N]``).  The
-    body vmaps the exact single-stream warm core
-    (:func:`..ops.streaming._warm_fused_resident` minus its pad, which
-    the host already applied): re-derive per-consumer totals under the
-    new lags from the resident table, test against the target, run the
-    resident bulk-exchange round loop.  ``vmap`` of the ``while_loop``
-    runs until every row's exit condition holds, masking finished rows
-    — each row's result is bit-identical to its single-stream dispatch
-    (pinned by tests/test_coalesce.py).
+
+def _epoch_rows(
+    lags, choice, row_tab, cnt, limits, num_consumers: int, iters: int,
+    max_pairs, exchange_budget: int,
+):
+    """The shared vmapped body of both megabatch executables: the exact
+    single-stream warm core (:func:`..ops.streaming._warm_fused_resident`
+    minus its pad, which the host already applied) over every row.
+    ``vmap`` of the ``while_loop`` runs until every row's exit condition
+    holds, masking finished rows — each row's result is bit-identical to
+    its single-stream dispatch (pinned by tests/test_coalesce.py).
+    Padding rows carry zero lags and a ``0.0`` limit, so their peak (0)
+    meets the target before round one and they pass through unchanged.
 
     Returns ``(narrow [N, B], choice int32 [N, B], row_tab [N, C, M],
-    counts [N, C], totals [N, C], rounds [N], exchanges [N])`` — narrow
-    plus the stats rows are the host-facing fetch; the middle three stay
-    device-resident as every stream's successor state."""
-    choice = jnp.stack(choices)
-    row_tab = jnp.stack(row_tabs)
-    cnt = jnp.stack(counts)
+    counts [N, C], totals [N, C], rounds [N], exchanges [N])``."""
 
     def one(lags_b, choice_b, tab_b, counts_b, limit):
         B = choice_b.shape[0]
@@ -149,16 +188,176 @@ def _megabatch_fused_resident(
     return jax.vmap(one)(lags, choice, row_tab, cnt, limits)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "num_consumers", "iters", "max_pairs", "exchange_budget"
+    ),
+)
+def _megabatch_fused_resident(
+    lags, choices, row_tabs, counts, limits, num_consumers: int,
+    iters: int, max_pairs, exchange_budget: int,
+):
+    """The RE-STACK megabatch executable: N streams' per-stream resident
+    buffers arrive as length-N tuples and are stacked onto the batch
+    axis here, inside the executable.  This is the roster-establishment
+    (and churn-recovery) path; a locked roster's steady state uses
+    :func:`_megabatch_fused_locked` instead and never re-stacks."""
+    choice = jnp.stack(choices)
+    row_tab = jnp.stack(row_tabs)
+    cnt = jnp.stack(counts)
+    return _epoch_rows(
+        lags, choice, row_tab, cnt, limits, num_consumers, iters,
+        max_pairs, exchange_budget,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "num_consumers", "iters", "max_pairs", "exchange_budget"
+    ),
+    donate_argnums=(1, 2, 3),
+)
+def _megabatch_fused_locked(
+    lags, choice, row_tab, counts, limits, num_consumers: int,
+    iters: int, max_pairs, exchange_budget: int,
+):
+    """The LOCKED megabatch executable: the stacked ``[N, ...]`` resident
+    batch goes in as DONATED buffers and comes back as its own
+    successor — no per-stream gathers, no re-stack, the only H2D is the
+    ``[N, B]`` lag staging (each stream's row placed by its stable index
+    host-side) and the ``[N]`` limits."""
+    return _epoch_rows(
+        lags, choice, row_tab, counts, limits, num_consumers, iters,
+        max_pairs, exchange_budget,
+    )
+
+
 class EpochResult(NamedTuple):
     """One stream's share of a flush: host-facing outputs materialized,
-    resident successors still on device (rows of the batch buffers)."""
+    resident successor still on device — a concrete ``(choice, row_tab,
+    counts)`` tuple on the re-stack path, a :class:`ResidentRow` handle
+    (the row's ownership lives with the batch) once the roster locks."""
 
     narrow: np.ndarray  # int16-ish [B] padded choice (slice [:P] yourself)
-    resident: Tuple[Any, Any, Any]  # device (choice, row_tab, counts)
+    resident: Any  # device (choice, row_tab, counts) tuple OR ResidentRow
     totals: np.ndarray  # int64 [C] per-consumer totals under the new lags
     counts: np.ndarray  # int32 [C]
     rounds: int
     exchanges: int
+
+
+class _ResidentBatch:
+    """One locked roster's stacked device-resident warm state.
+
+    ``choice [n_pad, B]`` / ``row_tab [n_pad, C, M]`` / ``counts
+    [n_pad, C]`` are replaced by their successors on every locked flush
+    (the executable donates them); ``lock`` serializes that swap against
+    a :class:`ResidentRow` materializing a row from another thread (a
+    stream leaving the batch for an inline dispatch).  ``valid`` False
+    freezes the arrays — an invalidated batch is never donated again,
+    so late materializations stay safe; ``poisoned`` True means the
+    buffers were donated into a flush that then failed, and
+    materialization must fail loudly instead of returning garbage."""
+
+    __slots__ = (
+        "shape_key", "choice", "row_tab", "counts", "n_real", "valid",
+        "poisoned", "lock",
+    )
+
+    def __init__(self, shape_key, choice, row_tab, counts, n_real: int):
+        self.shape_key = shape_key
+        self.choice = choice
+        self.row_tab = row_tab
+        self.counts = counts
+        self.n_real = int(n_real)
+        self.valid = True
+        self.poisoned = False
+        self.lock = threading.Lock()
+
+    @property
+    def n_pad(self) -> int:
+        return self.choice.shape[0]
+
+
+class ResidentRow:
+    """A stream's resident-state handle while its roster is locked: the
+    batch owns the buffers; this names the stream's stable row.  The
+    streaming engine stores it exactly where it stored the concrete
+    ``(choice, row_tab, counts)`` tuple and hands it back on the next
+    :class:`EpochSubmission`; :meth:`materialize` (one gather per
+    buffer) is paid only when the stream LEAVES the batch — an inline
+    dispatch, a fallback single-row dispatch, or a churn-wave
+    re-stack."""
+
+    __slots__ = ("batch", "row")
+
+    def __init__(self, batch: _ResidentBatch, row: int):
+        self.batch = batch
+        self.row = int(row)
+
+    def matches(self, bucket: int, num_consumers: int, m_rows: int) -> bool:
+        """Shape check, same contract as the engine's concrete-tuple
+        check: does this row fit a (bucket, C, M) warm dispatch?"""
+        b = self.batch
+        return (
+            b.choice.shape[1] == bucket
+            and b.row_tab.shape[1:] == (num_consumers, m_rows)
+        )
+
+    def materialize(self) -> Tuple[Any, Any, Any]:
+        """Concrete per-stream device buffers for this row (three
+        gathers).  Fault point ``coalesce.gather`` fires here — the
+        roster-churn recovery path the chaos drills target."""
+        faults.fire("coalesce.gather")
+        b = self.batch
+        with b.lock:
+            if b.poisoned:
+                raise RuntimeError(
+                    "resident batch was poisoned (donated into a failed "
+                    "flush); the row's warm state is gone"
+                )
+            return (b.choice[self.row], b.row_tab[self.row],
+                    b.counts[self.row])
+
+
+class _Roster:
+    """Per-shape-key roster tracking: the owner set of the last wave,
+    its consecutive-wave streak, the locked batch (None until the
+    streak reaches ``lock_waves``), and a recency tick for eviction."""
+
+    __slots__ = ("owners", "streak", "batch", "last_used")
+
+    def __init__(self, owners: frozenset):
+        self.owners = owners
+        self.streak = 1
+        self.batch: Optional[_ResidentBatch] = None
+        self.last_used = 0
+
+
+# Retention caps: a locked batch pins its stacked [N, ...] device
+# buffers and a staging pair pins two [n_pad, B] host arrays — a fleet
+# whose shape key retires (departed tenants, a payload-dtype flip on
+# lag-range drift) must not strand them forever.  Least-recently-used
+# entries beyond the cap are dropped (the batch is invalidated first,
+# so engine handles stay materializable until their owners re-stack).
+_MAX_ROSTERS = 8
+_MAX_STAGING = 16
+
+
+class _StagingSlot:
+    """One of the two rotating host staging buffers for a (shape key,
+    batch bucket): preallocated lag/limit arrays plus the ``ready``
+    event its wave's readback sets when the buffer may be reused."""
+
+    __slots__ = ("lags", "limits", "ready")
+
+    def __init__(self, n_pad: int, bucket: int, dtype):
+        self.lags = np.zeros((n_pad, bucket), dtype=dtype)
+        self.limits = np.zeros(n_pad, dtype=np.float64)
+        self.ready = threading.Event()
+        self.ready.set()
 
 
 @dataclass
@@ -167,15 +366,18 @@ class EpochSubmission:
 
     payload: np.ndarray  # exact-shape [P] lags, already dtype-downcast
     bucket: int  # padded refine shape B (the engine's _bucket(P))
-    choice: Any  # device-resident int32[B]
-    row_tab: Any  # device-resident int32[C, M]
-    counts: Any  # device-resident int32[C]
+    resident: Any  # (choice, row_tab, counts) tuple OR ResidentRow handle
     limit: float  # device-side quality target (negative disables)
     num_consumers: int
     iters: int
     max_pairs: int
     exchange_budget: int
     scope: Any = None  # metrics.capture_scope() token of the submitter
+    owner: Any = None  # stable stream identity (the engine) for rosters
+    # "Is the parked waiter already abandoned?" — captured from the
+    # submitter's watchdog call (utils/watchdog.capture_abandon_check);
+    # None when no watchdog wraps the park (library use, tests).
+    abandoned: Optional[Callable[[], bool]] = None
     future: Future = field(default_factory=Future)
     enqueued_at: float = 0.0
 
@@ -194,25 +396,49 @@ class MegabatchCoalescer:
 
     ``window_s`` is the admission window measured from the OLDEST
     pending submission; ``max_batch`` pending epochs in one shape group
-    flush immediately.  The flusher is a lazily started daemon thread —
-    a coalescer that never sees a submission costs nothing.  A wedged
-    device inside a flush blocks only the flusher (submitters' watchdog
+    (or a locked roster's full wave) flush immediately.  ``lock_waves``
+    is how many consecutive identical-stream-set waves a shape group
+    must serve before its roster locks (1 = lock on the first megabatch
+    flush; a huge value disables the fast path).  ``pipeline`` False
+    selects strict-serial flushes (readback inline on the flusher).
+    The flusher is a lazily started daemon thread — a coalescer that
+    never sees a submission costs nothing.  A wedged device inside a
+    flush blocks only the flusher/readback pair (submitters' watchdog
     deadlines still fire and their requests descend the degraded-mode
     ladder on fresh engines, exactly like an abandoned inline solve).
     """
 
-    def __init__(self, window_s: float = 0.0005, max_batch: int = 32):
+    def __init__(
+        self,
+        window_s: float = 0.0005,
+        max_batch: int = 32,
+        lock_waves: int = 1,
+        pipeline: bool = True,
+    ):
         if window_s < 0:
             raise ValueError(f"window_s={window_s} must be >= 0")
         if max_batch < 1:
             raise ValueError(f"max_batch={max_batch} must be >= 1")
+        if lock_waves < 1:
+            raise ValueError(f"lock_waves={lock_waves} must be >= 1")
         self.window_s = float(window_s)
         self.max_batch = int(max_batch)
+        self.lock_waves = int(lock_waves)
+        self.pipeline = bool(pipeline)
         self._cond = threading.Condition()
         self._pending: List[EpochSubmission] = []
         self._thread: Optional[threading.Thread] = None
         self._closed = False
         self._clock = metrics.REGISTRY.clock
+        # Roster + staging state: rosters are mutated by the flusher
+        # (and invalidated by a failed readback), so dict access is
+        # guarded by its own leaf lock; staging slots are flusher-only.
+        self._roster_lock = threading.Lock()
+        self._rosters: Dict[Tuple, _Roster] = {}
+        self._staging: Dict[Tuple, list] = {}
+        self._tick = 0  # flush-group counter driving LRU eviction
+        self._rb_q: Optional[queue.Queue] = None
+        self._rb_thread: Optional[threading.Thread] = None
         # Pre-bound series: flushes run on the hot multi-tenant path.
         self._m_batch = metrics.REGISTRY.histogram(
             "klba_coalesce_batch_size"
@@ -223,6 +449,18 @@ class MegabatchCoalescer:
             )
             for p in ("megabatch", "single", "fallback")
         }
+        self._m_hits = metrics.REGISTRY.counter(
+            "klba_coalesce_roster_hits_total"
+        )
+        self._m_restack = metrics.REGISTRY.counter(
+            "klba_coalesce_restack_total"
+        )
+        self._m_invalid = metrics.REGISTRY.counter(
+            "klba_coalesce_roster_invalidations_total"
+        )
+        self._m_dead = metrics.REGISTRY.counter(
+            "klba_coalesce_dead_rows_total"
+        )
 
     # -- submission --------------------------------------------------------
 
@@ -236,6 +474,16 @@ class MegabatchCoalescer:
             sub.enqueued_at = self._clock()
             self._pending.append(sub)
             if self._thread is None:
+                if self.pipeline:
+                    # Depth-2 queue = the double buffer: at most one
+                    # wave in readback while the next uploads; a third
+                    # backpressures the flusher, never unbounded memory.
+                    self._rb_q = queue.Queue(maxsize=2)
+                    self._rb_thread = threading.Thread(
+                        target=self._readback_loop,
+                        name="klba-coalesce-rb", daemon=True,
+                    )
+                    self._rb_thread.start()
                 self._thread = threading.Thread(
                     target=self._run, name="klba-coalesce", daemon=True
                 )
@@ -247,25 +495,52 @@ class MegabatchCoalescer:
         with self._cond:
             return len(self._pending)
 
+    def stats(self) -> Dict[str, Any]:
+        """Roster-tracking snapshot for the service ``stats`` surface.
+        Counter values are process-wide registry reads (the same series
+        a scraper sees), not per-instance deltas."""
+        with self._roster_lock:
+            locked = sum(
+                1 for r in self._rosters.values() if r.batch is not None
+            )
+        return {
+            "locked_rosters": locked,
+            "roster_hits": self._m_hits.value,
+            "restack_flushes": self._m_restack.value,
+            "roster_invalidations": self._m_invalid.value,
+            "dead_rows_dropped": self._m_dead.value,
+        }
+
     def close(self) -> None:
         """Stop admitting; the flusher drains what is already queued
-        (futures resolve) and exits."""
+        (futures resolve) and exits, then the readback worker drains
+        its queue and exits."""
         with self._cond:
             self._closed = True
             self._cond.notify_all()
 
     # -- the flusher -------------------------------------------------------
 
-    def _largest_group(self) -> int:
-        """Max same-shape-bucket pending count (caller holds the lock)."""
+    def _flush_ready(self) -> bool:
+        """Caller holds ``self._cond``: a full shape group — or a locked
+        roster whose whole wave is already pending — short-circuits the
+        admission window (waiting longer cannot grow the batch)."""
         tally: Dict[Tuple, int] = {}
-        best = 0
         for s in self._pending:
-            n = tally.get(s.shape_key, 0) + 1
-            tally[s.shape_key] = n
-            if n > best:
-                best = n
-        return best
+            tally[s.shape_key] = tally.get(s.shape_key, 0) + 1
+        with self._roster_lock:
+            for key, n in tally.items():
+                if n >= self.max_batch:
+                    return True
+                roster = self._rosters.get(key)
+                if (
+                    roster is not None
+                    and roster.batch is not None
+                    and roster.batch.valid
+                    and n >= roster.batch.n_real
+                ):
+                    return True
+        return False
 
     def _run(self) -> None:
         while True:
@@ -273,16 +548,18 @@ class MegabatchCoalescer:
                 while not self._pending and not self._closed:
                     self._cond.wait()
                 if not self._pending:
+                    if self._rb_q is not None:
+                        self._rb_q.put(None)  # drain + stop the worker
                     return  # closed and drained
                 if not self._closed and self.window_s > 0:
                     # Admission window from the OLDEST submission; a
-                    # full shape group short-circuits it.
+                    # full shape group (or roster wave) short-circuits.
                     with metrics.span("coalesce.window"):
                         deadline = (
                             self._pending[0].enqueued_at + self.window_s
                         )
                         while not self._closed:
-                            if self._largest_group() >= self.max_batch:
+                            if self._flush_ready():
                                 break
                             remaining = deadline - self._clock()
                             if remaining <= 0:
@@ -297,9 +574,43 @@ class MegabatchCoalescer:
                     if not s.future.done():
                         s.future.set_exception(exc)
 
+    def _readback_loop(self) -> None:
+        while True:
+            job = self._rb_q.get()
+            if job is None:
+                return
+            try:
+                job()
+            except Exception:  # noqa: BLE001 — jobs resolve own futures
+                LOGGER.warning(
+                    "coalescer readback job crashed", exc_info=True
+                )
+
+    def _enqueue_readback(self, job: Callable[[], None]) -> None:
+        if self._rb_q is None:
+            job()  # strict-serial fallback: readback on the flusher
+        else:
+            self._rb_q.put(job)
+
     def _flush(self, batch: List[EpochSubmission]) -> None:
-        groups: Dict[Tuple, List[EpochSubmission]] = {}
+        # Dead-submitter drop (BEFORE grouping): a stream whose parked
+        # waiter was abandoned by its watchdog between park and flush
+        # must not keep a row in the wave — fail its future (unparking
+        # the orphaned worker) and group only the live rows.
+        live: List[EpochSubmission] = []
         for s in batch:
+            abandoned = s.abandoned
+            if abandoned is not None and abandoned():
+                self._m_dead.inc()
+                if not s.future.done():
+                    s.future.set_exception(SubmitterGone(
+                        "submitter abandoned its wait (deadline passed) "
+                        "before the coalesced flush"
+                    ))
+            else:
+                live.append(s)
+        groups: Dict[Tuple, List[EpochSubmission]] = {}
+        for s in live:
             groups.setdefault(s.shape_key, []).append(s)
         for group in groups.values():
             # Enforce the batch cap HERE, not only at the window break:
@@ -311,13 +622,15 @@ class MegabatchCoalescer:
                 self._flush_group(group[i: i + self.max_batch])
 
     def _flush_group(self, rows: List[EpochSubmission]) -> None:
+        self._tick += 1
         self._m_batch.observe(len(rows))
         path = "single"
         try:
             faults.fire("coalesce.flush")
             if len(rows) > 1:
-                self._dispatch_megabatch(rows)
+                job = self._dispatch_megabatch(rows)
                 self._m_path["megabatch"].inc()
+                self._enqueue_readback(job)
                 return
         except Exception:  # noqa: BLE001 — isolated below, per row
             # Poisoned-ROW isolation: the batch is not poisoned by
@@ -330,83 +643,368 @@ class MegabatchCoalescer:
                 len(rows), exc_info=True,
             )
             path = "fallback"
+            # Whatever roster these rows served is stale now: the rows
+            # leave the batch as concrete tuples via their single
+            # dispatches; re-stack + re-lock on the next stable wave.
+            self._invalidate(rows[0].shape_key, None)
         self._m_path[path].inc()
         for s in rows:
             if not s.future.done():
                 self._resolve_single(s)
 
-    def _dispatch_megabatch(self, rows: List[EpochSubmission]) -> None:
+    # -- roster bookkeeping ------------------------------------------------
+
+    def _invalidate(
+        self, key: Tuple, batch: Optional[_ResidentBatch]
+    ) -> None:
+        """Drop ``key``'s locked batch (if ``batch`` is given, only if
+        it is still THE batch — a stale poison must not kill a
+        successor roster).  The arrays freeze: an invalidated batch is
+        never donated again, so engine handles pointing at it stay
+        materializable."""
+        with self._roster_lock:
+            roster = self._rosters.get(key)
+            if roster is None or roster.batch is None:
+                return
+            if batch is not None and roster.batch is not batch:
+                return
+            roster.batch.valid = False
+            roster.batch = None
+            self._m_invalid.inc()
+
+    def _poison(self, batch: _ResidentBatch) -> None:
+        """A flush that DONATED this batch failed: the buffers are gone.
+        Mark it so materialization fails loudly, and invalidate the
+        roster so the next wave re-stacks from the engines' ladders."""
+        batch.poisoned = True
+        self._invalidate(batch.shape_key, batch)
+
+    def _covers(
+        self, batch: _ResidentBatch, rows: List[EpochSubmission]
+    ) -> bool:
+        """True when this wave IS the locked roster: every submission
+        carries a handle of this batch and together they cover every
+        real row exactly once."""
+        if not batch.valid or len(rows) != batch.n_real:
+            return False
+        seen = set()
+        for s in rows:
+            r = s.resident
+            if not isinstance(r, ResidentRow) or r.batch is not batch:
+                return False
+            seen.add(r.row)
+        return seen == set(range(batch.n_real))
+
+    def _note_wave(
+        self, key: Tuple, rows: List[EpochSubmission]
+    ) -> Tuple[bool, _Roster]:
+        """Streak accounting for a re-stack wave; returns (lock_now,
+        roster).  Anonymous submissions (no owner) key on themselves,
+        so they never accumulate a cross-wave streak by accident."""
+        owners = frozenset(
+            id(s.owner) if s.owner is not None else ("anon", id(s))
+            for s in rows
+        )
+        with self._roster_lock:
+            roster = self._rosters.get(key)
+            if roster is None or roster.owners != owners:
+                roster = self._rosters[key] = _Roster(owners)
+            else:
+                roster.streak += 1
+            roster.last_used = self._tick
+            if len(self._rosters) > _MAX_ROSTERS:
+                stale_key = min(
+                    (k for k in self._rosters if k != key),
+                    key=lambda k: self._rosters[k].last_used,
+                )
+                stale = self._rosters.pop(stale_key)
+                if stale.batch is not None:
+                    stale.batch.valid = False
+                    self._m_invalid.inc()
+            return roster.streak >= self.lock_waves, roster
+
+    @staticmethod
+    def _materialize(resident) -> Tuple[Any, Any, Any]:
+        m = getattr(resident, "materialize", None)
+        return m() if m is not None else resident
+
+    # -- the three-stage dispatch ------------------------------------------
+
+    def _staging_slot(
+        self, key: Tuple, n_pad: int, bucket: int, dtype
+    ) -> _StagingSlot:
+        """Next of the two rotating staging buffers for (key, n_pad) —
+        flusher-thread only."""
+        k = (key, n_pad)
+        pair = self._staging.get(k)
+        if pair is None:
+            pair = self._staging[k] = [
+                _StagingSlot(n_pad, bucket, dtype),
+                _StagingSlot(n_pad, bucket, dtype),
+                0,
+                self._tick,
+            ]
+            if len(self._staging) > _MAX_STAGING:
+                # Evict the stalest IDLE pair (both slots released by
+                # their readbacks — never a pair with a wave in flight).
+                idle = [
+                    (p[3], key2) for key2, p in self._staging.items()
+                    if key2 != k and p[0].ready.is_set()
+                    and p[1].ready.is_set()
+                ]
+                if idle:
+                    self._staging.pop(min(idle)[1])
+        pair[3] = self._tick
+        slot = pair[pair[2]]
+        pair[2] ^= 1
+        return slot
+
+    def _stage_upload(
+        self,
+        rows: List[EpochSubmission],
+        n_pad: int,
+        row_of: Callable[[int], int],
+    ):
+        """Upload stage: fill a rotating staging buffer (row placement
+        via ``row_of`` — wave order for re-stacks, the stable roster
+        index for locked waves; pad rows stay zero-lag / 0.0-limit) and
+        start the async H2D.  Returns (slot, lags_dev, limits_dev); the
+        slot's ``ready`` is cleared and must be re-set by the wave's
+        readback (or by the caller on a dispatch error)."""
         s0 = rows[0]
-        B, C = s0.bucket, s0.num_consumers
+        slot = self._staging_slot(
+            s0.shape_key, n_pad, s0.bucket, s0.payload.dtype
+        )
+        with metrics.span("coalesce.upload"):
+            slot.ready.wait()  # prior wave's readback released it
+            slot.ready.clear()
+            slot.lags[:] = 0
+            slot.limits[:] = 0.0
+            for i, s in enumerate(rows):
+                r = row_of(i)
+                slot.lags[r, : s.payload.shape[0]] = s.payload
+                slot.limits[r] = s.limit
+            try:
+                lags_dev = jax.device_put(slot.lags)
+                limits_dev = jax.device_put(slot.limits)
+            except Exception:
+                slot.ready.set()
+                raise
+        return slot, lags_dev, limits_dev
+
+    def _dispatch_megabatch(
+        self, rows: List[EpochSubmission]
+    ) -> Callable[[], None]:
+        """Upload + dispatch one multi-row group; returns the readback
+        job (runs on the readback worker when pipelined)."""
+        key = rows[0].shape_key
+        with self._roster_lock:
+            roster = self._rosters.get(key)
+            batch = roster.batch if roster is not None else None
+        if batch is not None and self._covers(batch, rows):
+            with self._roster_lock:
+                if roster is not None:
+                    roster.last_used = self._tick
+            return self._dispatch_locked(batch, rows)
+        if batch is not None:
+            # Roster churn (join/leave/poison/stale-rebuild): exactly
+            # one invalidation, one re-stack wave, then re-lock.
+            self._invalidate(key, batch)
+        lock_now, roster = self._note_wave(key, rows)
+        return self._dispatch_restack(rows, lock_now, roster)
+
+    def _dispatch_locked(
+        self, batch: _ResidentBatch, rows: List[EpochSubmission]
+    ) -> Callable[[], None]:
+        s0 = rows[0]
+        C = s0.num_consumers
+        slot, lags_dev, limits_dev = self._stage_upload(
+            rows, batch.n_pad, lambda i: rows[i].resident.row
+        )
+        try:
+            with metrics.span("coalesce.dispatch"):
+                with batch.lock:
+                    out = _megabatch_fused_locked(
+                        lags_dev, batch.choice, batch.row_tab,
+                        batch.counts, limits_dev,
+                        num_consumers=C, iters=s0.iters,
+                        max_pairs=s0.max_pairs,
+                        exchange_budget=s0.exchange_budget,
+                    )
+                    narrow, choice_b, tab_b, counts_b, totals, rounds, ex = (
+                        out
+                    )
+                    batch.choice = choice_b
+                    batch.row_tab = tab_b
+                    batch.counts = counts_b
+        except Exception:
+            self._poison(batch)  # donated state is unrecoverable
+            slot.ready.set()
+            raise
+        self._m_hits.inc()
+        self._record_flush(rows, batch.n_pad, roster=True)
+
+        def readback() -> None:
+            try:
+                with metrics.span("coalesce.readback"):
+                    with batch.lock:
+                        jax.block_until_ready((narrow, totals, rounds, ex))
+                        narrow_np = np.asarray(narrow)
+                        totals_np = np.asarray(totals)
+                        counts_np = np.asarray(counts_b)
+                        rounds_np = np.asarray(rounds)
+                        ex_np = np.asarray(ex)
+                for s in rows:
+                    r = s.resident.row
+                    if not s.future.done():
+                        s.future.set_result(EpochResult(
+                            narrow=narrow_np[r],
+                            resident=s.resident,  # ownership stays batched
+                            totals=totals_np[r],
+                            counts=counts_np[r],
+                            rounds=int(rounds_np[r]),
+                            exchanges=int(ex_np[r]),
+                        ))
+            except Exception:  # noqa: BLE001 — per-row outcome below
+                LOGGER.warning(
+                    "locked megabatch readback failed; poisoning the "
+                    "resident batch", exc_info=True,
+                )
+                self._poison(batch)
+                for s in rows:
+                    if not s.future.done():
+                        self._resolve_single(s)
+            finally:
+                slot.ready.set()
+
+        return readback
+
+    def _dispatch_restack(
+        self,
+        rows: List[EpochSubmission],
+        lock_now: bool,
+        roster: _Roster,
+    ) -> Callable[[], None]:
+        s0 = rows[0]
         N = len(rows)
+        C = s0.num_consumers
         # Batch-axis bucket: pad to a power of two so the executable
         # count per shape bucket stays log2(max_batch).  Padding rows
-        # repeat row 0's buffers; their results are dropped.
+        # cycle the SURVIVING rows' buffers (never a dropped stream's)
+        # and run at zero lags / 0.0 limit — bit-exact pass-through.
         n_pad = 1 << (N - 1).bit_length()
-        lags = np.zeros((n_pad, B), dtype=s0.payload.dtype)
-        limits = np.full(n_pad, s0.limit, dtype=np.float64)
-        for i, s in enumerate(rows):
-            lags[i, : s.payload.shape[0]] = s.payload
-            limits[i] = s.limit
-        padded = rows + [s0] * (n_pad - N)
-        with metrics.span("coalesce.dispatch"):
-            out = _megabatch_fused_resident(
-                lags,
-                tuple(s.choice for s in padded),
-                tuple(s.row_tab for s in padded),
-                tuple(s.counts for s in padded),
-                limits,
-                num_consumers=C, iters=s0.iters,
-                max_pairs=s0.max_pairs,
-                exchange_budget=s0.exchange_budget,
+        residents = [self._materialize(s.resident) for s in rows]
+        padded = residents + [
+            residents[i % N] for i in range(n_pad - N)
+        ]
+        slot, lags_dev, limits_dev = self._stage_upload(
+            rows, n_pad, lambda i: i
+        )
+        try:
+            with metrics.span("coalesce.dispatch"):
+                out = _megabatch_fused_resident(
+                    lags_dev,
+                    tuple(r[0] for r in padded),
+                    tuple(r[1] for r in padded),
+                    tuple(r[2] for r in padded),
+                    limits_dev,
+                    num_consumers=C, iters=s0.iters,
+                    max_pairs=s0.max_pairs,
+                    exchange_budget=s0.exchange_budget,
+                )
+        except Exception:
+            slot.ready.set()
+            raise
+        self._m_restack.inc()
+        narrow, choice_b, tab_b, counts_b, totals, rounds, ex = out
+        batch: Optional[_ResidentBatch] = None
+        handles: Optional[List[ResidentRow]] = None
+        if lock_now:
+            # The roster locks: this wave's stacked successors BECOME
+            # the resident batch; rows' ownership moves to it.
+            batch = _ResidentBatch(
+                s0.shape_key, choice_b, tab_b, counts_b, n_real=N
             )
-            narrow, choice_b, tab_b, counts_b, totals, rounds, ex = out
-            # ONE bulk device->host fetch covers every row's host-facing
-            # outputs (the serialized per-stream round-trips this module
-            # exists to amortize); the resident successors stay on
-            # device as rows of the batch buffers.
-            narrow = np.asarray(narrow)
-            totals_np = np.asarray(totals)
-            counts_np = np.asarray(counts_b)
-            rounds_np = np.asarray(rounds)
-            ex_np = np.asarray(ex)
+            handles = [ResidentRow(batch, i) for i in range(N)]
+            with self._roster_lock:
+                roster.batch = batch
+        self._record_flush(rows, n_pad, roster=False)
+
+        def readback() -> None:
+            try:
+                with metrics.span("coalesce.readback"):
+                    jax.block_until_ready((narrow, totals, rounds, ex))
+                    narrow_np = np.asarray(narrow)
+                    totals_np = np.asarray(totals)
+                    counts_np = np.asarray(counts_b)
+                    rounds_np = np.asarray(rounds)
+                    ex_np = np.asarray(ex)
+                for i, s in enumerate(rows):
+                    if s.future.done():
+                        continue
+                    # Unlocked waves slice per-row resident successors
+                    # out of the batch output (the 3N gathers the locked
+                    # fast path exists to eliminate).
+                    resident = (
+                        handles[i] if handles is not None
+                        else (choice_b[i], tab_b[i], counts_b[i])
+                    )
+                    s.future.set_result(EpochResult(
+                        narrow=narrow_np[i],
+                        resident=resident,
+                        totals=totals_np[i],
+                        counts=counts_np[i],
+                        rounds=int(rounds_np[i]),
+                        exchanges=int(ex_np[i]),
+                    ))
+            except Exception:  # noqa: BLE001 — per-row outcome below
+                LOGGER.warning(
+                    "megabatch readback failed; isolating rows via "
+                    "single-stream dispatch", exc_info=True,
+                )
+                if batch is not None:
+                    self._poison(batch)
+                for s in rows:
+                    if not s.future.done():
+                        self._resolve_single(s)
+            finally:
+                slot.ready.set()
+
+        return readback
+
+    def _record_flush(
+        self, rows: List[EpochSubmission], n_pad: int, roster: bool
+    ) -> None:
+        s0 = rows[0]
         metrics.FLIGHT.record(
             "coalesce_flush",
             {
-                "streams": N,
+                "streams": len(rows),
                 "padded_rows": n_pad,
-                "bucket": B,
-                "consumers": C,
+                "bucket": s0.bucket,
+                "consumers": s0.num_consumers,
+                "roster_locked": roster,
                 "request_ids": [
                     s.scope.request_id for s in rows
                     if s.scope is not None
                 ],
             },
         )
-        for i, s in enumerate(rows):
-            s.future.set_result(
-                EpochResult(
-                    narrow=narrow[i],
-                    resident=(choice_b[i], tab_b[i], counts_b[i]),
-                    totals=totals_np[i],
-                    counts=counts_np[i],
-                    rounds=int(rounds_np[i]),
-                    exchanges=int(ex_np[i]),
-                )
-            )
 
     def _resolve_single(self, s: EpochSubmission) -> None:
         """One epoch on the SINGLE-stream resident executable — the
         single-row flush and the per-row isolation fallback (both reuse
         the exact executable the inline path warmed, so neither costs a
-        fresh compile).  Never raises: the outcome — result or the
-        row's own exception — lands on the future.  Adopts the
-        submitter's request scope so solve-side telemetry keeps its
-        request id."""
+        fresh compile).  A handle resident materializes its row first
+        (the stream leaves the batch).  Never raises: the outcome —
+        result or the row's own exception — lands on the future.
+        Adopts the submitter's request scope so solve-side telemetry
+        keeps its request id."""
         with metrics.adopt_scope(s.scope):
             try:
+                choice, row_tab, counts = self._materialize(s.resident)
                 out = _warm_fused_resident(
-                    s.payload, s.choice, s.row_tab, s.counts, s.limit,
+                    s.payload, choice, row_tab, counts, s.limit,
                     num_consumers=s.num_consumers, iters=s.iters,
                     max_pairs=s.max_pairs,
                     exchange_budget=s.exchange_budget,

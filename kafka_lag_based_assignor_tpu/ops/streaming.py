@@ -69,6 +69,7 @@ import jax.numpy as jnp
 
 from ..utils import faults, metrics
 from ..utils.observability import count_constrained_bound
+from ..utils.watchdog import capture_abandon_check
 from .batched import _narrow_choice, _stream_device, assign_stream, stream_payload
 from .dispatch import ensure_x64, observe_pack_shift
 from .packing import pad_bucket, pad_chunk, table_rows
@@ -346,8 +347,12 @@ class StreamingAssignor:
         # choice[bucket], per-consumer row table int32[C, M], counts
         # int32[C]).  The fused warm executable takes these as DONATED
         # buffers and returns their successors, so the engine's own state
-        # never round-trips to host.  None = stale (host-side edits:
-        # repair, remap, reset, shape change).
+        # never round-trips to host.  While this stream's roster is
+        # locked in the megabatch coalescer the value is a ResidentRow
+        # HANDLE instead (ops/coalesce): the buffers live stacked in the
+        # coalescer-owned batch and the handle names this stream's row.
+        # None = stale (host-side edits: repair, remap, reset, shape
+        # change).
         self._resident = None
         self.last_stats = StreamingStats()
 
@@ -642,10 +647,18 @@ class StreamingAssignor:
         )
         payload, _ = stream_payload(lags)
         resident = self._resident
-        if (
-            resident is not None
-            and resident[0].shape[0] == B
-            and resident[1].shape == (C, table_rows(B, C))
+        # The resident state is either the engine's own (choice, row_tab,
+        # counts) device tuple or — while this stream's roster is locked
+        # in the megabatch coalescer — a ResidentRow handle whose buffers
+        # live stacked in the coalescer-owned batch (ops/coalesce).
+        handle_matches = getattr(resident, "matches", None)
+        if resident is not None and (
+            handle_matches(B, C, table_rows(B, C))
+            if handle_matches is not None
+            else (
+                resident[0].shape[0] == B
+                and resident[1].shape == (C, table_rows(B, C))
+            )
         ):
             # A lag-range drift across the int32 boundary changes the
             # payload dtype and retraces the fused executable — log it
@@ -665,12 +678,12 @@ class StreamingAssignor:
 
                 r = self._coalescer.submit(
                     EpochSubmission(
-                        payload=payload, bucket=B,
-                        choice=resident[0], row_tab=resident[1],
-                        counts=resident[2], limit=limit,
-                        num_consumers=C, iters=budget, max_pairs=pairs,
-                        exchange_budget=budget,
+                        payload=payload, bucket=B, resident=resident,
+                        limit=limit, num_consumers=C, iters=budget,
+                        max_pairs=pairs, exchange_budget=budget,
                         scope=metrics.capture_scope(),
+                        owner=self,
+                        abandoned=capture_abandon_check(),
                     )
                 ).result()
                 self._resident = r.resident
@@ -678,6 +691,12 @@ class StreamingAssignor:
                     stats, r.totals, r.counts, r.rounds, r.exchanges
                 )
                 return r.narrow[:P].astype(np.int32)
+            if handle_matches is not None:
+                # Inline dispatch needs concrete per-stream buffers:
+                # leaving the roster materializes this stream's row
+                # (ownership moves back from the batch to the engine;
+                # the next coalesced wave re-stacks and re-locks).
+                resident = resident.materialize()
             out = _warm_fused_resident(
                 payload, resident[0], resident[1], resident[2], limit,
                 num_consumers=C, iters=budget, max_pairs=pairs,

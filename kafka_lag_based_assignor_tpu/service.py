@@ -74,8 +74,16 @@ same shape bucket are stacked and served by ONE vmapped fused device
 dispatch instead of N serialized round-trips (knobs:
 ``coalesce_window_ms`` / ``coalesce_max_batch``, config keys
 ``tpu.assignor.coalesce.window.ms`` / ``tpu.assignor.coalesce.max_batch``;
-``max_batch <= 1`` disables).  A lone stream always takes the inline
-fast path, so single-tenant latency is unchanged.  Each live stream
+``max_batch <= 1`` disables).  Consecutive waves from the same stream
+set LOCK their roster: the stacked batch buffers stay device-resident
+between flushes and rows are index-addressed in place, eliminating the
+per-flush re-stack work, and the upload/dispatch/readback flush stages
+overlap across waves (knobs ``coalesce_lock_waves`` /
+``tpu.assignor.coalesce.roster.lock.waves`` and ``coalesce_pipeline`` /
+``tpu.assignor.coalesce.pipeline``; the wire ``stats`` response's
+``coalesce`` section tracks locked rosters, hits, and re-stacks).  A
+lone stream always takes the inline fast path, so single-tenant
+latency is unchanged.  Each live stream
 also keeps its OWN small flight-recorder ring (the process-wide
 256-record ring stays the aggregate); ``{"method": "stream_flight",
 "params": {"stream_id": ..., "clear": false}}`` dumps (and optionally
@@ -541,6 +549,13 @@ class AssignorService:
         # fast path — single-tenant p50 unchanged).
         coalesce_window_ms: float = 0.5,
         coalesce_max_batch: int = 32,
+        # Roster-stable fast path: consecutive identical-stream-set
+        # waves before a shape group's roster locks (stacked resident
+        # batch, index-addressed rows, zero per-flush re-stacks); and
+        # the double-buffered upload/dispatch/readback flush pipeline
+        # (False = strict-serial fallback).
+        coalesce_lock_waves: int = 1,
+        coalesce_pipeline: bool = True,
         # Opt-in plain-HTTP /metrics listener (utils/metrics_http):
         # port to bind on the service host (0 = ephemeral, for tests);
         # None disables.
@@ -579,6 +594,8 @@ class AssignorService:
             self._coalescer = MegabatchCoalescer(
                 window_s=max(float(coalesce_window_ms), 0.0) / 1000.0,
                 max_batch=int(coalesce_max_batch),
+                lock_waves=int(coalesce_lock_waves),
+                pipeline=bool(coalesce_pipeline),
             )
         else:
             self._coalescer = None
@@ -662,6 +679,8 @@ class AssignorService:
             "breaker_failures": cfg.breaker_failures,
             "coalesce_window_ms": cfg.coalesce_window_s * 1000.0,
             "coalesce_max_batch": cfg.coalesce_max_batch,
+            "coalesce_lock_waves": cfg.coalesce_lock_waves,
+            "coalesce_pipeline": cfg.coalesce_pipeline,
             "metrics_port": cfg.metrics_port,
             "warmup_shapes": cfg.warmup_shapes or None,
         }
@@ -750,6 +769,12 @@ class AssignorService:
             # Per-solver circuit-breaker states + trip counters — the
             # operator's view of which failure domains are sidelined.
             result["breakers"] = self._watchdog.stats()
+            if self._coalescer is not None:
+                # Roster tracking: how many shape groups currently
+                # serve on the locked fast path, plus the hit /
+                # re-stack / invalidation / dead-row counters (see
+                # DEPLOYMENT.md "Multi-tenant throughput").
+                result["coalesce"] = self._coalescer.stats()
             return result, None
         if method == "metrics":
             # The registry, both ways: structured JSON for programmatic
@@ -1207,6 +1232,14 @@ class AssignorService:
                     consumers=[consumers],
                     topics=[topics],
                     solvers=self._warmup_solvers,
+                    # Megabatch coverage: with coalescing enabled, one
+                    # synthetic multi-stream wave per batch-pow2 bucket
+                    # compiles the re-stack AND locked executables off
+                    # the serving path.
+                    coalesce_max_batch=(
+                        self._coalescer.max_batch
+                        if self._coalescer is not None else 1
+                    ),
                 )
         if self._metrics_port is not None:
             from .utils.metrics_http import MetricsHTTPServer
@@ -1444,11 +1477,24 @@ def main() -> None:
         help="max stream epochs per megabatch flush; <= 1 disables "
              "cross-stream coalescing (default 32)",
     )
+    parser.add_argument(
+        "--coalesce-lock-waves", type=int, default=1, metavar="N",
+        help="consecutive identical-stream-set waves before a shape "
+             "group's roster locks onto the device-resident fast path "
+             "(default 1)",
+    )
+    parser.add_argument(
+        "--coalesce-serial", action="store_true",
+        help="disable the double-buffered flush pipeline (strict-"
+             "serial upload/dispatch/readback per wave)",
+    )
     opts = parser.parse_args()
     service = AssignorService(
         opts.host, opts.port, warmup_shapes=opts.warmup,
         coalesce_window_ms=opts.coalesce_window_ms,
         coalesce_max_batch=opts.coalesce_max_batch,
+        coalesce_lock_waves=opts.coalesce_lock_waves,
+        coalesce_pipeline=not opts.coalesce_serial,
         metrics_port=opts.metrics_port,
     ).start()
     print(f"listening on {service.address[0]}:{service.address[1]}", flush=True)

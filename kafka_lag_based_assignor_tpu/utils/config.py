@@ -57,6 +57,15 @@ REFINE_ITERS_CONFIG = "tpu.assignor.refine.iters"
 # immediately; <= 1 disables cross-stream coalescing entirely).
 COALESCE_WINDOW_CONFIG = "tpu.assignor.coalesce.window.ms"
 COALESCE_MAX_BATCH_CONFIG = "tpu.assignor.coalesce.max_batch"
+# Roster-stable fast path + flush pipeline knobs (ops/coalesce): how
+# many consecutive identical-stream-set waves a shape group serves
+# before its roster LOCKS (stacked batch buffers stay device-resident,
+# rows index-addressed in place — 1 locks on the first megabatch flush;
+# a large value effectively disables the fast path), and whether the
+# upload/dispatch/readback flush stages overlap across waves (false =
+# strict-serial fallback).
+COALESCE_LOCK_WAVES_CONFIG = "tpu.assignor.coalesce.roster.lock.waves"
+COALESCE_PIPELINE_CONFIG = "tpu.assignor.coalesce.pipeline"
 # Opt-in plain-HTTP /metrics listener (utils/metrics_http): a port for a
 # stock Prometheus to scrape the registry's text exposition without a
 # sidecar shim.  0/unset disables (the JSON wire `metrics` method is
@@ -141,9 +150,12 @@ class AssignorConfig:
     # refinement); refine_iters None = per-path auto budget.
     sinkhorn_iters: int = 24
     refine_iters: Optional[int] = None
-    # Megabatch coalescer (ops/coalesce): admission window + batch cap.
+    # Megabatch coalescer (ops/coalesce): admission window + batch cap,
+    # roster lock threshold, and the flush-pipeline toggle.
     coalesce_window_s: float = 0.0005
     coalesce_max_batch: int = 32
+    coalesce_lock_waves: int = 1
+    coalesce_pipeline: bool = True
     # Plain-HTTP /metrics port (utils/metrics_http); None = disabled.
     metrics_port: Optional[int] = None
     # (max_partitions, num_consumers) shapes to pre-compile at configure().
@@ -260,6 +272,10 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
         refine_iters=refine_iters,
         coalesce_window_s=_as_ms(COALESCE_WINDOW_CONFIG, 0.5),
         coalesce_max_batch=_as_int(COALESCE_MAX_BATCH_CONFIG, 32, 1),
+        coalesce_lock_waves=_as_int(COALESCE_LOCK_WAVES_CONFIG, 1, 1),
+        coalesce_pipeline=_as_bool(
+            consumer_group_props.get(COALESCE_PIPELINE_CONFIG, True)
+        ),
         metrics_port=metrics_port if metrics_port > 0 else None,
         warmup_shapes=warmup_shapes,
         consumer_group_props=consumer_group_props,

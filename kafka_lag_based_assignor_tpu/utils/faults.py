@@ -21,6 +21,11 @@ Named fault points (every one threaded through production code):
                     (:meth:`..ops.coalesce.MegabatchCoalescer._flush`) —
                     a failure here exercises the batched-epoch isolation
                     path (every row re-dispatches single-stream)
+``coalesce.gather`` resident-row materialization out of a locked
+                    roster batch (:meth:`..ops.coalesce.ResidentRow.
+                    materialize`) — the roster-churn recovery path: a
+                    failure here exercises a stream's exit from the
+                    batch (inline dispatch, re-stack, row fallback)
 ``lag.begin``       the ListOffsets(beginning) broker RPC (:mod:`..lag`)
 ``lag.end``         the ListOffsets(end) broker RPC
 ``lag.committed``   the OffsetFetch broker RPC
@@ -74,6 +79,7 @@ FAULT_POINTS = frozenset(
         "device.compile",
         "stream.refine",
         "coalesce.flush",
+        "coalesce.gather",
         "lag.begin",
         "lag.end",
         "lag.committed",

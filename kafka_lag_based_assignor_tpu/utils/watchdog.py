@@ -63,6 +63,28 @@ T = TypeVar("T")
 
 _UNSET = object()
 
+# Worker-thread deadline note: Watchdog.call stamps each worker with
+# (clock, abandon_at) before running the callable, so code the worker
+# parks in (the megabatch coalescer's future wait) can hand downstream
+# threads an answer to "has my caller already abandoned me?".
+_worker_tls = threading.local()
+
+
+def capture_abandon_check() -> Optional[Callable[[], bool]]:
+    """Capture the calling watchdog worker's deadline as a zero-arg
+    predicate: True once the caller's deadline has passed (the caller
+    has certainly timed out and abandoned this thread — its result
+    would be discarded).  None when the calling thread is not a watched
+    worker (no deadline, nothing to abandon).  The token is safe to
+    evaluate from any thread: the coalescer's flusher uses it to DROP a
+    parked submission whose submitter is already gone (see
+    ops/coalesce)."""
+    note = getattr(_worker_tls, "deadline", None)
+    if note is None:
+        return None
+    clock, abandon_at = note
+    return lambda: clock() > abandon_at
+
 STATE_CLOSED = "closed"
 STATE_OPEN = "open"
 STATE_HALF_OPEN = "half_open"
@@ -312,12 +334,17 @@ class Watchdog:
             scope = metrics.capture_scope()
 
             def run() -> None:
+                # Deadline note for capture_abandon_check(): downstream
+                # code this worker parks in can learn when the caller
+                # will have abandoned it.
+                _worker_tls.deadline = (self._clock, started + effective)
                 try:
                     with metrics.adopt_scope(scope):
                         outcome["value"] = fn(*args, **kwargs)
                 except BaseException as exc:  # noqa: BLE001 — re-raised below
                     outcome["exc"] = exc
                 finally:
+                    _worker_tls.deadline = None
                     done.set()
 
             worker = threading.Thread(

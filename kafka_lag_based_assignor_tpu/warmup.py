@@ -53,6 +53,7 @@ def warmup(
     sinkhorn_iters: int = 24,
     refine_iters: Optional[int] = None,
     stream_refine_iters: int = 128,
+    coalesce_max_batch: int = 1,
 ) -> List[Tuple[str, int, int, int, float]]:
     """Pre-compile kernels for every shape the deployment will see.
 
@@ -87,6 +88,17 @@ def warmup(
         :class:`..ops.streaming.StreamingAssignor` (iters, pairs, and
         exchange budget are static args — a different budget is a
         different executable).
+      coalesce_max_batch: > 1 additionally warms the MEGABATCH
+        executables (ops/coalesce) the sidecar dispatches when several
+        streams coalesce: one synthetic multi-stream wave pair per
+        batch-pow2 bucket (2, 4, ... up to the cap) drives both the
+        re-stack executable and the roster-LOCKED executable at each
+        (shape bucket, batch bucket), so the first coalesced wave of a
+        scaled-out deployment never pays its compile on the serving
+        path.  Must match the production ``coalesce_max_batch`` /
+        ``stream_refine_iters`` (batch bucket and exchange budget are
+        both part of the executable signature).  Recorded as
+        ``("coalesce", batch_bucket, P, C, seconds)`` rows.
 
     Returns a list of (solver, T, P_bucket, C, seconds) for each shape
     compiled.  Failures are logged and skipped — warm-up must never take a
@@ -173,6 +185,71 @@ def warmup(
                     return out
 
                 jobs.append(("stream", 1, stream_job))
+            if "stream" in solvers and coalesce_max_batch > 1:
+                # Megabatch coverage: one synthetic multi-stream wave
+                # pair per batch-pow2 bucket — wave 1 compiles the
+                # re-stack executable (and locks the roster), wave 2
+                # compiles the roster-LOCKED executable, so neither is
+                # ever paid on the serving path (ops/coalesce).
+                n = 2
+                while n <= coalesce_max_batch:
+
+                    def coalesce_job(lags1d=lags1d, C=C, n=n):
+                        import threading
+
+                        from .ops.coalesce import MegabatchCoalescer
+                        from .ops.streaming import StreamingAssignor
+
+                        rng_j = np.random.default_rng(n)
+                        engines = [
+                            StreamingAssignor(
+                                num_consumers=C,
+                                refine_iters=stream_refine_iters,
+                                refine_threshold=None,
+                            )
+                            for _ in range(n)
+                        ]
+                        for eng in engines:
+                            eng.rebalance(lags1d)
+                        coal = MegabatchCoalescer(
+                            window_s=2.0, max_batch=n, lock_waves=1
+                        )
+                        out = None
+                        try:
+                            for _wave in range(2):
+                                arrs = [
+                                    rng_j.integers(
+                                        0, 1000, lags1d.shape[0]
+                                    ).astype(np.int64)
+                                    for _ in engines
+                                ]
+                                errs = []
+
+                                def run(eng, arr):
+                                    try:
+                                        eng.submit_epoch(arr, coal)
+                                    except Exception as exc:  # noqa: L011
+                                        errs.append(exc)  # re-raised below
+
+                                threads = [
+                                    threading.Thread(
+                                        target=run, args=(eng, arr)
+                                    )
+                                    for eng, arr in zip(engines, arrs)
+                                ]
+                                for t in threads:
+                                    t.start()
+                                for t in threads:
+                                    t.join()
+                                if errs:
+                                    raise errs[0]
+                                out = arrs
+                        finally:
+                            coal.close()
+                        return out
+
+                    jobs.append(("coalesce", n, coalesce_job))
+                    n *= 2
             if "sinkhorn" in solvers:
                 from .models.sinkhorn import assign_topic_sinkhorn
 

@@ -77,11 +77,13 @@ def test_constructor_validation_and_close():
     with pytest.raises(RuntimeError, match="closed"):
         coal.submit(
             EpochSubmission(
-                payload=np.zeros(4, np.int32), bucket=8, choice=None,
-                row_tab=None, counts=None, limit=-1.0, num_consumers=2,
-                iters=1, max_pairs=1, exchange_budget=1,
+                payload=np.zeros(4, np.int32), bucket=8, resident=None,
+                limit=-1.0, num_consumers=2, iters=1, max_pairs=1,
+                exchange_budget=1,
             )
         )
+    with pytest.raises(ValueError, match="lock_waves"):
+        MegabatchCoalescer(lock_waves=0)
 
 
 def test_single_row_window_timeout_flush():
@@ -430,6 +432,312 @@ def test_steady_state_megabatch_loop_compiles_nothing():
         coal.close()
 
 
+# -- roster-stable fast path ----------------------------------------------
+
+
+def _sub_for(engine, lags, resident, abandoned=None):
+    """An EpochSubmission exactly as StreamingAssignor.submit_epoch
+    would build it for an always-refine engine (limit disabled), but
+    with the resident state supplied explicitly — the white-box driver
+    for deterministic churn sequences."""
+    from kafka_lag_based_assignor_tpu.ops.batched import stream_payload
+    from kafka_lag_based_assignor_tpu.ops.coalesce import EpochSubmission
+
+    arr = np.ascontiguousarray(lags, dtype=np.int64)
+    payload, _ = stream_payload(arr)
+    C = engine.num_consumers
+    return EpochSubmission(
+        payload=payload, bucket=engine._bucket(arr.shape[0]),
+        resident=resident, limit=-1.0, num_consumers=C,
+        iters=engine.refine_iters, max_pairs=min(C // 2, 16),
+        exchange_budget=engine.refine_iters, owner=engine,
+        abandoned=abandoned,
+    )
+
+
+def _coalesce_counters():
+    return (
+        metrics.REGISTRY.counter("klba_coalesce_roster_hits_total"),
+        metrics.REGISTRY.counter("klba_coalesce_restack_total"),
+        metrics.REGISTRY.counter(
+            "klba_coalesce_roster_invalidations_total"
+        ),
+    )
+
+
+def test_roster_locks_and_eliminates_restack():
+    """THE tentpole pin: after the first megabatch flush the roster
+    locks — engines hold ResidentRow handles, every further wave is a
+    locked dispatch (roster-hit counter), the re-stack counter stays
+    flat, zero fresh compiles in the locked steady state, and every row
+    stays bit-identical to its inline twin."""
+    from kafka_lag_based_assignor_tpu.ops.coalesce import ResidentRow
+    from kafka_lag_based_assignor_tpu.utils.observability import (
+        compile_count,
+        install_compile_counter,
+    )
+
+    install_compile_counter()
+    rng = np.random.default_rng(60)
+    G, P = 3, 512
+    inline = _engines(G)
+    co = _engines(G)
+    coal = MegabatchCoalescer(window_s=5.0, max_batch=G, lock_waves=1)
+    hits, restack, _ = _coalesce_counters()
+    try:
+        lags = [_int32_lags(rng, P) for _ in range(G)]
+        for g in range(G):
+            np.testing.assert_array_equal(
+                inline[g].rebalance(lags[g]), co[g].rebalance(lags[g])
+            )
+        h0, r0 = hits.value, restack.value
+
+        def parity_wave():
+            arrs = [_int32_lags(rng, P) for _ in range(G)]
+            want = [inline[g].rebalance(arrs[g]) for g in range(G)]
+            got = _submit_all(co, arrs, coal)
+            for g in range(G):
+                np.testing.assert_array_equal(want[g], got[g])
+                si, sc = inline[g].last_stats, co[g].last_stats
+                assert si.refine_exchanges == sc.refine_exchanges
+                assert si.refine_rounds == sc.refine_rounds
+
+        # Wave 1: the one re-stack — and the lock: engines come back
+        # holding handles into the coalescer-owned batch.
+        parity_wave()
+        assert (hits.value, restack.value) == (h0, r0 + 1)
+        for g in range(G):
+            assert isinstance(co[g]._resident, ResidentRow)
+        # Wave 2 compiles the locked executable; waves 3+ must be the
+        # pure steady state: locked dispatches only, nothing compiled.
+        parity_wave()
+        assert (hits.value, restack.value) == (h0 + 1, r0 + 1)
+        before_compiles = compile_count()
+        for _ in range(3):
+            parity_wave()
+        assert (hits.value, restack.value) == (h0 + 4, r0 + 1)
+        assert compile_count() == before_compiles, (
+            "roster-locked steady state compiled a fresh executable"
+        )
+    finally:
+        coal.close()
+
+
+def test_roster_churn_invalidates_once_then_relocks():
+    """Satellite pin: a stream joining, leaving, or replacing its
+    resident state between flushes invalidates the resident batch
+    EXACTLY once, the churn wave falls back to the re-stack path, and
+    the next stable wave re-locks — bit-exact vs inline throughout and
+    zero extra steady-state compiles after a re-lock."""
+    from kafka_lag_based_assignor_tpu.ops.coalesce import ResidentRow
+    from kafka_lag_based_assignor_tpu.utils.observability import (
+        compile_count,
+        install_compile_counter,
+    )
+
+    install_compile_counter()
+    rng = np.random.default_rng(61)
+    G, P = 3, 512
+    inline = _engines(G)
+    co = _engines(G)
+    # pipeline=False: _flush resolves futures synchronously, so each
+    # white-box wave's counter deltas are deterministic.
+    coal = MegabatchCoalescer(
+        window_s=5.0, max_batch=G, lock_waves=1, pipeline=False
+    )
+    hits, restack, inv = _coalesce_counters()
+    state = {}
+    try:
+        for g in range(G):
+            lg = _int32_lags(rng, P)
+            np.testing.assert_array_equal(
+                inline[g].rebalance(lg), co[g].rebalance(lg)
+            )
+            state[g] = co[g]._resident
+
+        def wave(members):
+            arrs = {g: _int32_lags(rng, P) for g in members}
+            want = {g: inline[g].rebalance(arrs[g]) for g in members}
+            subs = {g: _sub_for(co[g], arrs[g], state[g])
+                    for g in members}
+            coal._flush(list(subs.values()))
+            for g in members:
+                r = subs[g].future.result(timeout=180.0)
+                state[g] = r.resident
+                np.testing.assert_array_equal(want[g], r.narrow[:P])
+
+        h0, r0, i0 = hits.value, restack.value, inv.value
+        wave([0, 1, 2])  # re-stack + lock
+        assert all(isinstance(state[g], ResidentRow) for g in range(G))
+        wave([0, 1, 2])  # locked
+        assert (hits.value, restack.value, inv.value) == (
+            h0 + 1, r0 + 1, i0
+        )
+        # LEAVE: stream 2 sits the wave out — one invalidation, one
+        # re-stack (survivors' handles materialize), re-lock at size 2.
+        wave([0, 1])
+        assert (hits.value, restack.value, inv.value) == (
+            h0 + 1, r0 + 2, i0 + 1
+        )
+        wave([0, 1])  # the smaller roster is locked again
+        assert (hits.value, restack.value, inv.value) == (
+            h0 + 2, r0 + 2, i0 + 1
+        )
+        # JOIN: stream 2 returns (its handle names the old, frozen
+        # batch) — one invalidation, one re-stack, re-lock at size 3.
+        wave([0, 1, 2])
+        assert (hits.value, restack.value, inv.value) == (
+            h0 + 2, r0 + 3, i0 + 2
+        )
+        wave([0, 1, 2])
+        assert (hits.value, restack.value, inv.value) == (
+            h0 + 3, r0 + 3, i0 + 2
+        )
+        # STALE-RESIDENT REBUILD (the poison/warm-restart recovery
+        # shape): stream 1 leaves the batch for a concrete tuple — the
+        # same materialization its engine performs on an inline
+        # dispatch.  One invalidation, one re-stack, re-lock; the
+        # executables are all cached, so NOTHING compiles.
+        state[1] = state[1].materialize()
+        before_compiles = compile_count()
+        wave([0, 1, 2])
+        assert (hits.value, restack.value, inv.value) == (
+            h0 + 3, r0 + 4, i0 + 3
+        )
+        wave([0, 1, 2])
+        assert (hits.value, restack.value, inv.value) == (
+            h0 + 4, r0 + 4, i0 + 3
+        )
+        assert compile_count() == before_compiles, (
+            "churn recovery + re-lock compiled a fresh executable"
+        )
+    finally:
+        coal.close()
+
+
+def test_roster_and_staging_retention_is_bounded():
+    """A retired shape key (departed fleet, payload-dtype flip) must
+    not strand its locked batch or staging buffers forever: both maps
+    evict least-recently-used entries past their caps, invalidating an
+    evicted batch so stray handles stay honest."""
+    from kafka_lag_based_assignor_tpu.ops import coalesce as cm
+
+    coal = MegabatchCoalescer(pipeline=False)
+    owners = [object() for _ in range(cm._MAX_ROSTERS + 3)]
+    batches = []
+    for i, owner in enumerate(owners):
+        coal._tick += 1
+        sub = cm.EpochSubmission(
+            payload=np.zeros(4, np.int32), bucket=8, resident=None,
+            limit=-1.0, num_consumers=2, iters=1, max_pairs=1,
+            exchange_budget=1, owner=owner,
+        )
+        _, roster = coal._note_wave(("key", i), [sub])
+        batch = cm._ResidentBatch(("key", i), None, None, None, n_real=1)
+        roster.batch = batch
+        batches.append(batch)
+    assert len(coal._rosters) == cm._MAX_ROSTERS
+    assert not batches[0].valid  # oldest roster evicted + invalidated
+    assert batches[-1].valid
+    for i in range(cm._MAX_STAGING + 4):
+        coal._tick += 1
+        coal._staging_slot(("skey", i), 2, 8, np.int32)
+    assert len(coal._staging) <= cm._MAX_STAGING + 1
+
+
+def test_dead_submitter_rows_dropped_before_grouping():
+    """Satellite pin: a submission whose parked waiter is already
+    abandoned (watchdog deadline passed between park and flush) is
+    dropped BEFORE grouping — its future fails with SubmitterGone, the
+    dead-row counter moves, and the surviving rows' results stay
+    bit-identical to their inline twins."""
+    from kafka_lag_based_assignor_tpu.ops.coalesce import SubmitterGone
+
+    rng = np.random.default_rng(62)
+    G, P = 3, 512
+    inline = _engines(G)
+    co = _engines(G)
+    coal = MegabatchCoalescer(window_s=5.0, max_batch=8, pipeline=False)
+    dead_c = metrics.REGISTRY.counter("klba_coalesce_dead_rows_total")
+    try:
+        base = [_int32_lags(rng, P) for _ in range(G)]
+        for g in range(G):
+            np.testing.assert_array_equal(
+                inline[g].rebalance(base[g]), co[g].rebalance(base[g])
+            )
+        arrs = [_int32_lags(rng, P) for _ in range(G)]
+        # Streams 0 and 1 survive; stream 2's waiter is gone.
+        want = [inline[g].rebalance(arrs[g]) for g in (0, 1)]
+        subs = [
+            _sub_for(co[0], arrs[0], co[0]._resident),
+            _sub_for(co[2], arrs[2], co[2]._resident,
+                     abandoned=lambda: True),
+            _sub_for(co[1], arrs[1], co[1]._resident),
+        ]
+        before = dead_c.value
+        coal._flush(subs)
+        with pytest.raises(SubmitterGone):
+            subs[1].future.result(timeout=10.0)
+        for sub, expect in zip((subs[0], subs[2]), want):
+            r = sub.future.result(timeout=180.0)
+            np.testing.assert_array_equal(expect, r.narrow[:P])
+        assert dead_c.value == before + 1
+    finally:
+        coal.close()
+
+
+def test_gather_fault_isolates_rows_on_churn_wave():
+    """An injected ``coalesce.gather`` fault (resident-row
+    materialization on a churn wave's re-stack) fails the BATCH
+    dispatch, not the epochs: every row re-dispatches single-stream —
+    re-materializing past the spent fault — and still returns the
+    bit-exact inline result."""
+    rng = np.random.default_rng(63)
+    G, P = 3, 512
+    inline = _engines(G)
+    co = _engines(G)
+    coal = MegabatchCoalescer(
+        window_s=5.0, max_batch=G, lock_waves=1, pipeline=False
+    )
+    fallback = metrics.REGISTRY.counter(
+        "klba_coalesce_flushes_total", {"path": "fallback"}
+    )
+    state = {}
+    try:
+        for g in range(G):
+            lg = _int32_lags(rng, P)
+            np.testing.assert_array_equal(
+                inline[g].rebalance(lg), co[g].rebalance(lg)
+            )
+            state[g] = co[g]._resident
+        # Lock a roster of {0, 1} so those streams hold handles.
+        arrs = {g: _int32_lags(rng, P) for g in (0, 1)}
+        want01 = {g: inline[g].rebalance(arrs[g]) for g in (0, 1)}
+        subs01 = {g: _sub_for(co[g], arrs[g], state[g]) for g in (0, 1)}
+        coal._flush(list(subs01.values()))
+        for g in (0, 1):
+            r = subs01[g].future.result(timeout=180.0)
+            np.testing.assert_array_equal(want01[g], r.narrow[:P])
+            state[g] = r.resident
+        # Churn wave: stream 2 joins with a concrete tuple, forcing the
+        # re-stack path to materialize 0 and 1 — where the fault fires.
+        arrs = {g: _int32_lags(rng, P) for g in range(G)}
+        want = {g: inline[g].rebalance(arrs[g]) for g in range(G)}
+        subs = {g: _sub_for(co[g], arrs[g], state[g]) for g in range(G)}
+        before = fallback.value
+        with faults.injected(
+            faults.FaultInjector().plan("coalesce.gather", times=1)
+        ) as inj:
+            coal._flush(list(subs.values()))
+            for g in range(G):
+                r = subs[g].future.result(timeout=180.0)
+                np.testing.assert_array_equal(want[g], r.narrow[:P])
+        assert inj.fired("coalesce.gather") == 1
+        assert fallback.value == before + 1
+    finally:
+        coal.close()
+
+
 # -- service-level routing ------------------------------------------------
 
 
@@ -571,6 +879,26 @@ def test_service_stats_is_registry_view(service):
     assert service.fallbacks == stats["fallbacks"] == 0
 
 
+def test_service_stats_exposes_coalesce_roster_tracking(service):
+    """The wire ``stats`` response carries the coalescer's roster
+    tracking (locked rosters + hit/re-stack/invalidation/dead-row
+    counters) whenever coalescing is enabled."""
+    with _client(service) as c:
+        stats = c.request("stats")
+    co = stats["coalesce"]
+    assert set(co) == {
+        "locked_rosters", "roster_hits", "restack_flushes",
+        "roster_invalidations", "dead_rows_dropped",
+    }
+    assert all(isinstance(v, int) for v in co.values())
+    # A max_batch <= 1 service has no coalescer and no section.
+    from kafka_lag_based_assignor_tpu.service import AssignorService
+
+    with AssignorService(port=0, coalesce_max_batch=1) as svc2:
+        with _client(svc2) as c2:
+            assert "coalesce" not in c2.request("stats")
+
+
 def test_metrics_http_listener_serves_exposition():
     import http.client
 
@@ -608,18 +936,29 @@ def test_coalesce_config_knobs_parse():
         "group.id": "g",
         "tpu.assignor.coalesce.window.ms": "2.5",
         "tpu.assignor.coalesce.max_batch": "8",
+        "tpu.assignor.coalesce.roster.lock.waves": "3",
+        "tpu.assignor.coalesce.pipeline": "false",
         "tpu.assignor.metrics.port": "9109",
     })
     assert cfg.coalesce_window_s == pytest.approx(0.0025)
     assert cfg.coalesce_max_batch == 8
+    assert cfg.coalesce_lock_waves == 3
+    assert cfg.coalesce_pipeline is False
     assert cfg.metrics_port == 9109
     dflt = parse_config({"group.id": "g"})
     assert dflt.coalesce_window_s == pytest.approx(0.0005)
     assert dflt.coalesce_max_batch == 32
+    assert dflt.coalesce_lock_waves == 1
+    assert dflt.coalesce_pipeline is True
     assert dflt.metrics_port is None
     with pytest.raises(ValueError, match="coalesce.max_batch"):
         parse_config({
             "group.id": "g", "tpu.assignor.coalesce.max_batch": "0",
+        })
+    with pytest.raises(ValueError, match="lock.waves"):
+        parse_config({
+            "group.id": "g",
+            "tpu.assignor.coalesce.roster.lock.waves": "0",
         })
 
 
@@ -635,6 +974,8 @@ def test_service_from_config_consumes_knobs():
             "tpu.assignor.solve.timeout.ms": "5000",
             "tpu.assignor.coalesce.window.ms": "2.0",
             "tpu.assignor.coalesce.max_batch": "4",
+            "tpu.assignor.coalesce.roster.lock.waves": "2",
+            "tpu.assignor.coalesce.pipeline": "false",
             "tpu.assignor.metrics.port": "0",  # 0/unset = disabled
         },
         port=0,
@@ -643,6 +984,8 @@ def test_service_from_config_consumes_knobs():
         assert svc._coalescer is not None
         assert svc._coalescer.window_s == pytest.approx(0.002)
         assert svc._coalescer.max_batch == 4
+        assert svc._coalescer.lock_waves == 2
+        assert svc._coalescer.pipeline is False
         assert svc._metrics_port is None
         assert svc.metrics_address is None
     # max_batch <= 1 disables coalescing; overrides beat config values.

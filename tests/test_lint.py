@@ -77,6 +77,64 @@ def test_lint_silent_except_exception_in_package():
     assert any(f.code == "L011" for f in lint.lint_source(pkg, tup))
 
 
+def test_lint_blocking_sync_in_coalescer():
+    """L013: the coalescer's admission/grouping/upload/dispatch path
+    must never block on the device — jax.device_get / block_until_ready
+    belong to the readback stage (functions whose name contains
+    'readback'), keeping the flush pipeline's overlap contract."""
+    coalesce = Path("kafka_lag_based_assignor_tpu/ops/coalesce.py")
+    bad = (
+        "import jax\n\n"
+        "def _flush(rows):\n"
+        "    jax.block_until_ready(rows)\n"
+        "    return jax.device_get(rows)\n"
+    )
+    codes = [f.code for f in lint.lint_source(coalesce, bad)]
+    assert codes.count("L013") == 2
+    # A readback-stage function (top-level or a nested closure) is the
+    # sanctioned home for blocking fetches.
+    ok = bad.replace("def _flush", "def _readback")
+    assert not any(
+        f.code == "L013" for f in lint.lint_source(coalesce, ok)
+    )
+    nested = (
+        "import jax\n\n"
+        "def _dispatch(rows):\n"
+        "    def readback():\n"
+        "        jax.block_until_ready(rows)\n"
+        "    return readback\n"
+    )
+    assert not any(
+        f.code == "L013" for f in lint.lint_source(coalesce, nested)
+    )
+    # Method-style sync and from-imports do not evade the rule.
+    method = "def _flush(x):\n    return x.block_until_ready()\n"
+    assert any(
+        f.code == "L013" for f in lint.lint_source(coalesce, method)
+    )
+    from_imp = (
+        "from jax import block_until_ready\n\n"
+        "def _flush(x):\n"
+        "    return block_until_ready(x)\n"
+    )
+    assert any(
+        f.code == "L013" for f in lint.lint_source(coalesce, from_imp)
+    )
+    # Waivable per line; scoped to the coalescer module only.
+    waived = bad.replace(
+        "    jax.block_until_ready(rows)\n",
+        "    jax.block_until_ready(rows)  # noqa: L013\n",
+    )
+    waived_codes = [
+        f.code for f in lint.lint_source(coalesce, waived)
+    ]
+    assert waived_codes.count("L013") == 1
+    other = Path("kafka_lag_based_assignor_tpu/ops/streaming.py")
+    assert not any(
+        f.code == "L013" for f in lint.lint_source(other, bad)
+    )
+
+
 def test_lint_no_false_positives_on_format_specs():
     src = 'x = 3\nprint(f"{x:02d}")\n'
     assert lint.lint_source(Path("ok.py"), src) == []

@@ -122,6 +122,93 @@ def test_stream_warmup_covers_cold_and_fused_warm_variants():
     assert after == before
 
 
+def test_warmup_job_selection_follows_solvers():
+    """Job scheduling honors the solvers argument independently of the
+    coalesce knob: sinkhorn warms iff requested, and the megabatch job
+    requires BOTH the stream solver and coalesce_max_batch > 1
+    (regression: the sinkhorn guard must not be coupled to the
+    coalesce branch)."""
+    from kafka_lag_based_assignor_tpu.warmup import warmup
+
+    done = warmup(max_partitions=8, consumers=[2], solvers=("sinkhorn",))
+    assert [d[0] for d in done] == ["sinkhorn"]
+    done2 = warmup(
+        max_partitions=8, consumers=[2], solvers=("rounds",),
+        coalesce_max_batch=4,
+    )
+    assert all(d[0] == "rounds" for d in done2)
+
+
+def test_warmup_covers_megabatch_executables():
+    """With coalescing enabled, warm-up drives one synthetic
+    multi-stream wave pair per batch-pow2 bucket, compiling the
+    re-stack AND roster-locked megabatch executables (ops/coalesce) off
+    the serving path — so a fresh engine fleet's first coalesced waves
+    at the warmed shape are pure cache hits."""
+    import threading
+
+    from kafka_lag_based_assignor_tpu.ops.coalesce import (
+        MegabatchCoalescer,
+        _megabatch_fused_locked,
+        _megabatch_fused_resident,
+    )
+    from kafka_lag_based_assignor_tpu.ops.streaming import StreamingAssignor
+    from kafka_lag_based_assignor_tpu.warmup import warmup
+
+    done = warmup(
+        max_partitions=20, consumers=[3], solvers=("stream",),
+        stream_refine_iters=16, coalesce_max_batch=2,
+    )
+    assert any(
+        name == "coalesce" and t == 2 for name, t, _p, _c, _s in done
+    )
+    before = (
+        _megabatch_fused_resident._cache_size(),
+        _megabatch_fused_locked._cache_size(),
+    )
+    rng = np.random.default_rng(3)
+    engines = [
+        StreamingAssignor(
+            num_consumers=3, refine_iters=16, refine_threshold=None
+        )
+        for _ in range(2)
+    ]
+    for eng in engines:
+        eng.rebalance(rng.integers(0, 1000, 20).astype(np.int64))
+    coal = MegabatchCoalescer(window_s=5.0, max_batch=2, lock_waves=1)
+    errs = []
+    try:
+        for _wave in range(2):  # wave 1 re-stacks (and locks); wave 2
+            arrs = [                # dispatches the locked executable
+                rng.integers(0, 1000, 20).astype(np.int64)
+                for _ in engines
+            ]
+
+            def run(eng, arr):
+                try:
+                    eng.submit_epoch(arr, coal)
+                except Exception as exc:  # noqa: BLE001 — asserted below
+                    errs.append(exc)
+
+            threads = [
+                threading.Thread(target=run, args=(e, a))
+                for e, a in zip(engines, arrs)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=180.0)
+                assert not t.is_alive()
+    finally:
+        coal.close()
+    assert errs == []
+    after = (
+        _megabatch_fused_resident._cache_size(),
+        _megabatch_fused_locked._cache_size(),
+    )
+    assert after == before, "a coalesced wave compiled after warm-up"
+
+
 def test_warmup_covers_oneshot_refined_variant():
     """An explicit refine budget (tpu.assignor.refine.iters with the
     default solver) warms the REFINED executable — a different static-arg

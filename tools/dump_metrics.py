@@ -89,7 +89,8 @@ def main() -> int:
         print(json.dumps(flight["last_dump"], indent=2, sort_keys=True))
         return 0
     if args.summary:
-        for name, entry in sorted(result["json"].items()):
+        js = result["json"]
+        for name, entry in sorted(js.items()):
             for s in entry["series"]:
                 labels = ",".join(
                     f"{k}={v}" for k, v in sorted(s["labels"].items())
@@ -102,6 +103,32 @@ def main() -> int:
                     )
                 else:
                     print(f"{sig} {s['value']}")
+
+        # Derived coalescer view: roster hit-rate (locked fast-path
+        # flushes over all multi-row flushes) and the per-stage
+        # pipeline latencies — the "is the fast path engaging, and
+        # where does a flush spend its time" look.
+        def counter_total(name: str) -> float:
+            return sum(
+                s["value"] for s in js.get(name, {}).get("series", [])
+            )
+
+        hits = counter_total("klba_coalesce_roster_hits_total")
+        restacks = counter_total("klba_coalesce_restack_total")
+        if hits + restacks:
+            rate = hits / (hits + restacks)
+            print(
+                f"coalesce roster hit-rate {rate:.3f} "
+                f"({int(hits)} locked / {int(restacks)} re-stack)"
+            )
+        for s in js.get("klba_span_duration_ms", {}).get("series", []):
+            span = s["labels"].get("span", "")
+            if span.startswith("coalesce.") and span != "coalesce.window":
+                stage = span.split(".", 1)[1]
+                print(
+                    f"coalesce stage {stage}: count={s['count']} "
+                    f"p50={s['p50']} p99={s['p99']}"
+                )
         return 0
     print(json.dumps(result["json"], indent=2, sort_keys=True))
     return 0

@@ -29,6 +29,13 @@ Rules:
         parameter) so durations land in the unified registry and tests
         can fake the clock — the same discipline the breaker tests rely
         on.  Waivable with ``# noqa: L012``.
+  L013  blocking device sync (``jax.device_get`` / ``block_until_ready``)
+        in the coalescer (ops/coalesce.py) outside a readback-stage
+        function: the admission/grouping/upload/dispatch path must stay
+        async so wave k+1's admission can overlap wave k's D2H — the
+        double-buffered flush pipeline's contract.  Blocking fetches
+        belong in functions whose name contains ``readback`` (the
+        pipeline's readback stage).  Waivable with ``# noqa: L013``.
 """
 
 from __future__ import annotations
@@ -119,6 +126,57 @@ def _handler_is_loud(handler: ast.ExceptHandler) -> bool:
     return False
 
 
+def _is_blocking_sync_call(node: ast.Call, from_jax_names: set) -> bool:
+    """True for ``jax.device_get(...)`` / ``jax.block_until_ready(...)``,
+    any ``x.block_until_ready()`` method call, and bare calls of those
+    names when imported via ``from jax import ...``."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr in ("device_get", "block_until_ready")
+    if isinstance(func, ast.Name):
+        return func.id in from_jax_names
+    return False
+
+
+def _l013_findings(rel: str, tree: ast.AST, lines: List[str]) -> List[Finding]:
+    """Walk with enclosing-function context: blocking syncs are allowed
+    only inside functions whose name marks the readback stage."""
+    from_jax = {
+        alias.asname or alias.name
+        for node in ast.walk(tree)
+        if isinstance(node, ast.ImportFrom) and node.module == "jax"
+        for alias in node.names
+        if alias.name in ("device_get", "block_until_ready")
+    }
+    findings: List[Finding] = []
+
+    def visit(node: ast.AST, in_readback: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_scope = in_readback
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child_scope = in_readback or "readback" in child.name
+            if (
+                isinstance(child, ast.Call)
+                and not in_readback
+                and _is_blocking_sync_call(child, from_jax)
+                and "noqa: L013" not in lines[child.lineno - 1]
+            ):
+                findings.append(
+                    Finding(
+                        rel,
+                        child.lineno,
+                        "L013",
+                        "blocking device sync on the coalescer's "
+                        "admission/dispatch path: move it to the "
+                        "readback stage (or waive with `# noqa: L013`)",
+                    )
+                )
+            visit(child, child_scope)
+
+    visit(tree, False)
+    return findings
+
+
 def _is_banned_clock_call(node: ast.Call, from_time_names: set) -> bool:
     """True for ``time.time(...)`` / ``time.perf_counter(...)`` and for
     bare calls of those names when imported via ``from time import``."""
@@ -148,6 +206,10 @@ def lint_source(path: Path, source: str) -> List[Finding]:
     # L011/L012 apply to the package (the module boundaries the failure
     # model depends on), not to tests/tools/bench scaffolding.
     is_package = "kafka_lag_based_assignor_tpu" in path.parts
+    # L013 applies to the coalescer module only: its flush pipeline is
+    # the one place the async-dispatch discipline is load-bearing.
+    if is_package and path.name == "coalesce.py":
+        findings.extend(_l013_findings(rel, tree, lines))
     # The two clock-owning modules: stopwatch/span live there, so direct
     # perf_counter use is their implementation, not a violation.
     clock_exempt = path.name in ("metrics.py", "observability.py")
