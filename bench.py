@@ -963,6 +963,183 @@ def config6_multistream():
     }
 
 
+def config7_overload():
+    """Overload stampede probe (ISSUE 6): 16 mixed-class tenants hitting
+    a sidecar whose megabatch cap is 4 — a 4x-oversubscribed wave every
+    round — with the overload detector tuned to engage.  What must hold
+    (gated in main, every backend): critical-class p99 request latency
+    stays within its configured 2 s deadline budget, ALL shedding lands
+    on the lower classes first (critical is never shed; standard only
+    sheds while best_effort sheds too), every served assignment is
+    count-balanced, the measured waves compile NOTHING, and the
+    ``recommend`` wire call returns a monotone consumer-count
+    recommendation as one stream's lag trend steepens."""
+    import concurrent.futures as cf
+
+    from kafka_lag_based_assignor_tpu.service import (
+        AssignorService,
+        AssignorServiceClient,
+    )
+    from kafka_lag_based_assignor_tpu.utils import metrics as klba_metrics
+    from kafka_lag_based_assignor_tpu.utils.observability import (
+        compile_count,
+        install_compile_counter,
+    )
+
+    install_compile_counter()
+    P, C, ROUNDS = 2048, 8, 8
+    CRITICAL_BUDGET_S = 2.0
+    classes = (
+        {f"crit-{i}": "critical" for i in range(4)}
+        | {f"std-{i}": "standard" for i in range(4)}
+        | {f"be-{i}": "best_effort" for i in range(8)}
+    )
+    members = [f"m{j}" for j in range(C)]
+    rngs = {sid: np.random.default_rng(7000 + i)
+            for i, sid in enumerate(sorted(classes))}
+    lags_now = {
+        sid: rng.integers(10**6, 10**8, P).astype(np.int64)
+        for sid, rng in rngs.items()
+    }
+
+    def drift(sid):
+        arr = lags_now[sid]
+        bump = rngs[sid].integers(0, 10**6, P)
+        lags_now[sid] = np.minimum(arr + bump, np.int64(2**31 - 2))
+        return lags_now[sid]
+
+    def rows(arr):
+        return [[i, int(v)] for i, v in enumerate(arr)]
+
+    from kafka_lag_based_assignor_tpu.testing import (
+        assert_valid_assignment,
+        shed_totals_by_class as shed_by_class,
+    )
+    from kafka_lag_based_assignor_tpu.utils.overload import ShedReject
+
+    svc = AssignorService(
+        port=0, solve_timeout_s=120.0,
+        slo_classes=classes,
+        slo_deadline_s={"critical": CRITICAL_BUDGET_S},
+        overload_depth_high=6.0,
+        coalesce_window_ms=2.0, coalesce_max_batch=4,
+        # The stampede churns the stream set per wave (sheds drop rows);
+        # keep the probe on the re-stack path — roster stability is
+        # config6's concern.
+        coalesce_lock_waves=1 << 30,
+    ).start()
+    svc._overload.eval_interval_s = 0.0
+    pool = cf.ThreadPoolExecutor(max_workers=len(classes))
+    clients = {
+        sid: AssignorServiceClient(*svc.address, timeout_s=180.0)
+        for sid in classes
+    }
+    lat = {"critical": [], "standard": [], "best_effort": []}
+    served = {"critical": 0, "standard": 0, "best_effort": 0}
+    rejected = {"critical": 0, "standard": 0, "best_effort": 0}
+    errors = {"critical": 0, "standard": 0, "best_effort": 0}
+    invalid = [0]
+
+    def one(sid, override=None, record=True):
+        klass = override or classes[sid]
+        t0 = time.perf_counter()
+        try:
+            r = clients[sid].request("stream_assign", {
+                "stream_id": sid, "topic": "t0",
+                "lags": rows(drift(sid)), "members": members,
+                **({"slo_class": override} if override else {}),
+            })
+        except ShedReject:
+            # The ladder's structured rejection — the one outcome the
+            # stampede is designed to produce for the lower classes.
+            if record:
+                rejected[klass] += 1
+            return
+        except (RuntimeError, ConnectionError):
+            # Anything else is a genuine failure, not a shed: counted
+            # apart so a partially-failing class cannot slip past the
+            # p99/shed gates by vanishing from both.
+            if record:
+                errors[klass] += 1
+            return
+        if record:
+            lat[klass].append(time.perf_counter() - t0)
+            served[klass] += 1
+            try:
+                assert_valid_assignment(r["assignments"], P)
+            except AssertionError:
+                invalid[0] += 1
+
+    try:
+        # Warm phase: cold chains + fused executables, serially, every
+        # stream overridden to "standard" so no cold compile races the
+        # critical class's 2 s budget; then two full stampede waves to
+        # compile the batch-4 megabatch executable off the record.
+        for sid in sorted(classes):
+            one(sid, override="standard", record=False)
+        for _ in range(2):
+            list(pool.map(lambda s: one(s, record=False),
+                          sorted(classes)))
+        shed_before = shed_by_class()
+        compiles_before = compile_count()
+        t0 = time.perf_counter()
+        for _ in range(ROUNDS):
+            list(pool.map(one, sorted(classes)))
+        wall_s = time.perf_counter() - t0
+        warm_compiles = compile_count() - compiles_before
+        shed_delta = {
+            k: v - shed_before.get(k, 0)
+            for k, v in shed_by_class().items()
+        }
+        overload = clients["crit-0"].request("stats")["overload"]
+
+        # Elasticity: steepen one stream's lag trend and require a
+        # monotone non-decreasing consumer-count recommendation.
+        recs = []
+        for pct in (5, 15, 45):
+            arr = lags_now["std-0"]
+            lags_now["std-0"] = np.minimum(
+                arr + arr // (100 // pct), np.int64(2**31 - 2)
+            )
+            one("std-0", record=False)
+            rec = clients["std-0"].request(
+                "recommend", {"stream_id": "std-0"}
+            )["streams"]["std-0"]
+            recs.append(rec["recommended_consumers"])
+    finally:
+        for cl in clients.values():
+            cl.close()
+        pool.shutdown(wait=True)
+        svc.stop()
+
+    def p99(key):
+        return (
+            float(np.percentile(lat[key], 99)) if lat[key] else None
+        )
+
+    return {
+        "config": "overload_stampede",
+        "streams": len(classes),
+        "partitions": P,
+        "consumers": C,
+        "oversubscription": len(classes) / 4,
+        "rounds": ROUNDS,
+        "wall_s": wall_s,
+        "served": served,
+        "rejected": rejected,
+        "request_errors": errors,
+        "invalid_assignments": invalid[0],
+        "critical_p99_s": p99("critical"),
+        "critical_budget_s": CRITICAL_BUDGET_S,
+        "standard_p99_s": p99("standard"),
+        "shed_by_class": shed_delta,
+        "overload_state": overload,
+        "warm_compile_count": warm_compiles,
+        "recommend_trajectory": recs,
+        "recommend_monotone": recs == sorted(recs) and recs[-1] > C,
+    }
+
+
 def main():
     # A wedged accelerator tunnel must degrade the benchmark, not hang it
     # (the framework's own watchdog philosophy, SURVEY §5 failure row):
@@ -1011,7 +1188,7 @@ def main():
     from kafka_lag_based_assignor_tpu.utils import metrics as klba_metrics
 
     for fn in (config1_readme, config2_zipf, config3_vmap, config4_skew,
-               config5_northstar, config6_multistream):
+               config5_northstar, config6_multistream, config7_overload):
         before = klba_metrics.REGISTRY.snapshot()
         r = fn()
         deltas = klba_metrics.histogram_deltas(
@@ -1109,6 +1286,59 @@ def main():
             f"multistream_32g speedup_locked_vs_coalesced {lspd:.2f} < "
             f"{locked_floor}x — the roster-stable fast path is not "
             "paying for itself"
+        )
+    # Overload-stampede gates (every backend — the budgets are config
+    # this probe sets, not hardware-dependent): the critical class must
+    # hold its deadline while the lower classes shed, sheds must walk
+    # the ladder bottom-up, no served assignment may be invalid, the
+    # measured waves must compile nothing, and the elasticity loop must
+    # scale monotonically with a steepening lag trend.
+    ov = results.get("overload_stampede", {})
+    crit_p99 = ov.get("critical_p99_s")
+    if crit_p99 is None:
+        failures.append(
+            "overload_stampede served no critical requests — the probe "
+            "is not exercising the priority path"
+        )
+    elif crit_p99 > ov["critical_budget_s"]:
+        failures.append(
+            f"overload_stampede critical_p99_s {crit_p99:.3f} exceeds "
+            f"the {ov['critical_budget_s']}s class deadline budget"
+        )
+    crit_errors = ov.get("request_errors", {}).get("critical", 0)
+    if crit_errors > 0:
+        failures.append(
+            f"overload_stampede saw {crit_errors} non-shed critical "
+            "request error(s) — critical traffic failed outright rather "
+            "than being served or shed"
+        )
+    shed = ov.get("shed_by_class", {})
+    if shed.get("critical", 0) > 0:
+        failures.append(
+            f"overload_stampede shed {shed['critical']} critical "
+            "request(s) — the ladder must never shed the top class"
+        )
+    if shed.get("standard", 0) > 0 and shed.get("best_effort", 0) == 0:
+        failures.append(
+            "overload_stampede shed standard without shedding "
+            "best_effort — shedding must land on the lowest class first"
+        )
+    if ov.get("invalid_assignments", 0) > 0:
+        failures.append(
+            f"overload_stampede produced {ov['invalid_assignments']} "
+            "invalid (count-imbalanced) assignment(s) under overload"
+        )
+    if ov.get("warm_compile_count", 0) > 0:
+        failures.append(
+            f"overload_stampede warm_compile_count "
+            f"{ov['warm_compile_count']} != 0 — fresh XLA compiles "
+            "inside the stampede's measured waves"
+        )
+    if ov and not ov.get("recommend_monotone", False):
+        failures.append(
+            f"overload_stampede recommend trajectory "
+            f"{ov.get('recommend_trajectory')} is not a monotone "
+            "scale-up under a rising lag trend"
         )
     for msg in failures:
         log(f"bench: REGRESSION GATE FAILED: {msg}")

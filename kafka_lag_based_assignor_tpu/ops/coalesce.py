@@ -74,6 +74,28 @@ that used it completed readback (its ``ready`` event), which also
 proves the device consumed the H2D.  ``pipeline=False`` is the
 strict-serial fallback knob (``tpu.assignor.coalesce.pipeline``).
 
+SLO placement and deadline triage
+---------------------------------
+
+Every submission carries an SLO class/rank and an optional absolute
+admission deadline (utils/overload; the sidecar fills them from the
+stream's class).  The flush sorts live rows by **(class rank,
+remaining deadline)** before grouping and chunking, so a critical
+stream never parks behind a full best-effort wave; a row whose
+remaining budget is below the measured flush-cost EWMA is re-routed
+to the inline path (``klba_coalesce_deadline_reroutes_total``) — its
+future fails with the :class:`DeadlineReroute` marker after the waves
+dispatch, and the submitter's own parked worker runs the inline
+dispatch (laggards resolve in parallel; the flusher thread stays
+admission-only) — and a row whose budget already expired is shed with
+:class:`DeadlineShed` — a :class:`..utils.watchdog.SolveRejected`
+subtype, so the submitter's warm state is known-intact, the service
+serves ``kept_previous``, and no breaker is charged
+(``klba_shed_total{class,rung="admit_deadline"}``).  The service's
+overload controller scales the admission window down under pressure
+(:meth:`MegabatchCoalescer.set_window_scale`, shed-ladder rung 1) —
+batch efficiency yields before latency.
+
 Submitters park on a :class:`concurrent.futures.Future`
 (:meth:`StreamingAssignor.submit_epoch` blocks on it inside the same
 watchdog deadline that guards an inline dispatch), so the degraded-mode
@@ -134,7 +156,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..utils import faults, metrics
+from ..utils import faults, metrics, observability
+from ..utils.overload import record_shed
+from ..utils.watchdog import SolveRejected
 from .batched import _narrow_choice
 from .refine import refine_rounds_resident
 from .streaming import _warm_fused_resident
@@ -146,6 +170,24 @@ class SubmitterGone(RuntimeError):
     """A parked submission's waiter abandoned its wait (its watchdog
     deadline passed) before the flush; the row was dropped from the
     wave and this exception unparks the orphaned worker thread."""
+
+
+class DeadlineShed(SolveRejected):
+    """A parked submission's SLO deadline expired before its flush: the
+    row was shed from the wave WITHOUT touching the device, so the
+    submitter's warm state is intact (the :class:`SolveRejected`
+    contract) — the service then serves ``kept_previous`` instead of
+    poisoning the stream, and the shed never charges a breaker."""
+
+
+class DeadlineReroute(Exception):
+    """Internal marker: the flush re-routed this row to the inline path
+    (remaining budget below the flush-cost EWMA).  Never escapes
+    :meth:`StreamingAssignor.submit_epoch` — the submitter's own parked
+    worker thread catches it and runs the inline single-stream dispatch
+    itself, so k laggards resolve on k already-parked threads in
+    parallel instead of serially stalling the flusher's admission
+    loop during the exact overload that produces laggards."""
 
 
 def _epoch_rows(
@@ -374,6 +416,16 @@ class EpochSubmission:
     exchange_budget: int
     scope: Any = None  # metrics.capture_scope() token of the submitter
     owner: Any = None  # stable stream identity (the engine) for rosters
+    # SLO placement (utils/overload): rank orders every flush — chunks
+    # are cut in (rank, remaining deadline) order, so a critical stream
+    # never parks behind a full best-effort wave; ``deadline_at`` is
+    # the absolute coalescer-clock instant the row's class budget
+    # expires — a row that cannot survive a full flush is re-routed to
+    # the inline single-stream path (or shed, once expired) instead of
+    # slowing the wave.  Defaults reproduce the pre-SLO behavior.
+    klass: str = "standard"
+    rank: int = 1
+    deadline_at: Optional[float] = None
     # "Is the parked waiter already abandoned?" — captured from the
     # submitter's watchdog call (utils/watchdog.capture_abandon_check);
     # None when no watchdog wraps the park (library use, tests).
@@ -425,8 +477,21 @@ class MegabatchCoalescer:
         self.max_batch = int(max_batch)
         self.lock_waves = int(lock_waves)
         self.pipeline = bool(pipeline)
+        # Overload backpressure: the shed ladder's rung-1 action scales
+        # the admission window down (smaller waves, lower parked
+        # latency — batch efficiency yields before latency).  A plain
+        # float write/read (GIL-atomic); the service sets it per its
+        # overload controller's rung.
+        self._window_scale = 1.0
+        # EWMA of a megabatch flush's dispatch->readback wall time: the
+        # deadline-admission estimate of "can this row survive a full
+        # flush".  Starts at 0 (no rerouting until measured).
+        self._flush_cost_s = 0.0
         self._cond = threading.Condition()
-        self._pending: List[EpochSubmission] = []
+        # noqa: L014 below — drained to empty by every flusher pass;
+        # occupancy is bounded by the live-submitter count (each stream
+        # parks at most one epoch) and dead submitters are dropped.
+        self._pending: List[EpochSubmission] = []  # noqa: L014
         self._thread: Optional[threading.Thread] = None
         self._closed = False
         self._clock = metrics.REGISTRY.clock
@@ -461,13 +526,35 @@ class MegabatchCoalescer:
         self._m_dead = metrics.REGISTRY.counter(
             "klba_coalesce_dead_rows_total"
         )
+        self._m_reroutes = metrics.REGISTRY.counter(
+            "klba_coalesce_deadline_reroutes_total"
+        )
+        self._m_window_scale = metrics.REGISTRY.gauge(
+            "klba_coalesce_window_scale"
+        )
+        self._m_window_scale.set(1.0)
 
     # -- submission --------------------------------------------------------
+
+    def set_window_scale(self, scale: float) -> None:
+        """Overload backpressure hook: scale the admission window to
+        ``window_s * scale`` (clamped to [0.05, 1.0]) — rung 1 of the
+        shed ladder.  Safe from any thread."""
+        scale = min(max(float(scale), 0.05), 1.0)
+        if scale == self._window_scale:
+            # Called on every admitted request (service admission path):
+            # the steady state at rung 0 must not pay the gauge lock.
+            return
+        self._window_scale = scale
+        self._m_window_scale.set(scale)
 
     def submit(self, sub: EpochSubmission) -> Future:
         """Enqueue one epoch; returns the future its flush resolves.
         Raises RuntimeError after :meth:`close` (the caller's ladder
-        then degrades exactly as for any failed dispatch)."""
+        then degrades exactly as for any failed dispatch).  Fault point
+        ``admit.park`` fires here — a parked-admission failure must
+        surface on the submitting stream alone."""
+        faults.fire("admit.park")
         with self._cond:
             if self._closed:
                 raise RuntimeError("megabatch coalescer is closed")
@@ -552,11 +639,14 @@ class MegabatchCoalescer:
                         self._rb_q.put(None)  # drain + stop the worker
                     return  # closed and drained
                 if not self._closed and self.window_s > 0:
-                    # Admission window from the OLDEST submission; a
-                    # full shape group (or roster wave) short-circuits.
+                    # Admission window from the OLDEST submission,
+                    # scaled down under overload (shed ladder rung 1);
+                    # a full shape group (or roster wave)
+                    # short-circuits.
                     with metrics.span("coalesce.window"):
                         deadline = (
-                            self._pending[0].enqueued_at + self.window_s
+                            self._pending[0].enqueued_at
+                            + self.window_s * self._window_scale
                         )
                         while not self._closed:
                             if self._flush_ready():
@@ -596,8 +686,16 @@ class MegabatchCoalescer:
         # Dead-submitter drop (BEFORE grouping): a stream whose parked
         # waiter was abandoned by its watchdog between park and flush
         # must not keep a row in the wave — fail its future (unparking
-        # the orphaned worker) and group only the live rows.
+        # the orphaned worker) and group only the live rows.  Deadline
+        # triage rides the same pass: a row whose class budget already
+        # expired is SHED (fails fast as DeadlineShed — warm state
+        # intact, the service serves kept_previous), and a row whose
+        # remaining budget cannot survive a full megabatch flush
+        # (measured EWMA) is re-routed to the inline single-stream
+        # path AFTER the waves dispatch — late, but not wave-poisoning.
+        now = self._clock()
         live: List[EpochSubmission] = []
+        laggards: List[EpochSubmission] = []
         for s in batch:
             abandoned = s.abandoned
             if abandoned is not None and abandoned():
@@ -607,8 +705,42 @@ class MegabatchCoalescer:
                         "submitter abandoned its wait (deadline passed) "
                         "before the coalesced flush"
                     ))
-            else:
-                live.append(s)
+                continue
+            if s.deadline_at is not None:
+                remaining = s.deadline_at - now
+                if remaining <= 0:
+                    # Shared shed accounting (utils/overload): served
+                    # is None here — the submitter's recovery (the
+                    # service's kept_previous / snake ladder) decides
+                    # what the client actually gets, after this shed.
+                    record_shed(
+                        s.klass, "admit_deadline", None,
+                        request_id=(
+                            s.scope.request_id
+                            if s.scope is not None else None
+                        ),
+                    )
+                    if not s.future.done():
+                        s.future.set_exception(DeadlineShed(
+                            f"{s.klass!r} epoch's deadline budget "
+                            "expired while parked for the coalesced "
+                            "flush"
+                        ))
+                    continue
+                if remaining < self._flush_cost_s:
+                    self._m_reroutes.inc()
+                    laggards.append(s)
+                    continue
+            live.append(s)
+        # SLO placement order: (class rank, remaining deadline) — the
+        # max_batch chunking below then cuts waves in this order, so a
+        # critical stream never parks behind a full best-effort wave.
+        # Stable sort: rows with equal keys keep arrival order.
+        live.sort(key=lambda s: (
+            s.rank,
+            (s.deadline_at - now) if s.deadline_at is not None
+            else float("inf"),
+        ))
         groups: Dict[Tuple, List[EpochSubmission]] = {}
         for s in live:
             groups.setdefault(s.shape_key, []).append(s)
@@ -620,6 +752,21 @@ class MegabatchCoalescer:
             # cap into a fresh, bigger executable on the serving path.
             for i in range(0, len(group), self.max_batch):
                 self._flush_group(group[i: i + self.max_batch])
+        for s in laggards:
+            # Hand the row back to its own parked worker AFTER the
+            # waves dispatch (waves carry the critical rows — they keep
+            # device priority): the submitter catches the marker and
+            # runs the inline dispatch itself, so k laggards resolve on
+            # k threads in parallel and the flusher returns straight to
+            # admission — a serial inline loop here would age every
+            # parked wave by k x inline-cost exactly when budgets are
+            # tightest, a self-reinforcing spiral the window-scale knob
+            # cannot counter.
+            if not s.future.done():
+                s.future.set_exception(DeadlineReroute(
+                    f"{s.klass!r} epoch's remaining budget cannot "
+                    "survive a full flush; re-routed to the inline path"
+                ))
 
     def _flush_group(self, rows: List[EpochSubmission]) -> None:
         self._tick += 1
@@ -728,6 +875,22 @@ class MegabatchCoalescer:
         m = getattr(resident, "materialize", None)
         return m() if m is not None else resident
 
+    def _note_flush_cost(self, started: float, compiles_before: int) -> None:
+        """EWMA of dispatch->readback wall time — the deadline-triage
+        estimate of what one more full flush would cost a parked row.
+        Plain float write (GIL-atomic); alpha 0.3 tracks regime shifts
+        in a few waves without one outlier dominating.  A flush that
+        compiled a fresh executable is excluded outright: folding a
+        ~40 s compile into a millisecond-regime EWMA would reroute
+        every tight-budget (critical) row to the inline path
+        for the next ~10 waves — steady-state flushes never compile,
+        so the sample carries no predictive value for the next wave."""
+        if observability.compile_count() != compiles_before:
+            return
+        self._flush_cost_s += 0.3 * (
+            (self._clock() - started) - self._flush_cost_s
+        )
+
     # -- the three-stage dispatch ------------------------------------------
 
     def _staging_slot(
@@ -816,6 +979,8 @@ class MegabatchCoalescer:
     def _dispatch_locked(
         self, batch: _ResidentBatch, rows: List[EpochSubmission]
     ) -> Callable[[], None]:
+        started = self._clock()
+        compiles_before = observability.compile_count()
         s0 = rows[0]
         C = s0.num_consumers
         slot, lags_dev, limits_dev = self._stage_upload(
@@ -875,6 +1040,7 @@ class MegabatchCoalescer:
                     if not s.future.done():
                         self._resolve_single(s)
             finally:
+                self._note_flush_cost(started, compiles_before)
                 slot.ready.set()
 
         return readback
@@ -885,6 +1051,8 @@ class MegabatchCoalescer:
         lock_now: bool,
         roster: _Roster,
     ) -> Callable[[], None]:
+        started = self._clock()
+        compiles_before = observability.compile_count()
         s0 = rows[0]
         N = len(rows)
         C = s0.num_consumers
@@ -968,6 +1136,7 @@ class MegabatchCoalescer:
                     if not s.future.done():
                         self._resolve_single(s)
             finally:
+                self._note_flush_cost(started, compiles_before)
                 slot.ready.set()
 
         return readback
@@ -984,6 +1153,11 @@ class MegabatchCoalescer:
                 "bucket": s0.bucket,
                 "consumers": s0.num_consumers,
                 "roster_locked": roster,
+                # SLO placement audit: the wave's classes in placement
+                # order — a critical row showing up behind a
+                # best-effort one here is the bug the ordered flush
+                # exists to prevent.
+                "classes": [s.klass for s in rows],
                 "request_ids": [
                     s.scope.request_id for s in rows
                     if s.scope is not None

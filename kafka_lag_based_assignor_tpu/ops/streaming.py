@@ -327,6 +327,9 @@ class StreamingAssignor:
         # warm dispatch routes through the megabatch coalescer
         # (ops/coalesce) instead of dispatching inline.
         self._coalescer = None
+        # Transient SLO placement for the coalesced submission
+        # (class name, rank, absolute deadline) — see submit_epoch.
+        self._slo_submit = ("standard", 1, None)
         self._epoch_num = 0
         # Pre-bound registry series (utils/metrics): the warm no-op epoch
         # is the hot path (<1% overhead budget, asserted in tests), so
@@ -402,7 +405,14 @@ class StreamingAssignor:
             )
         return choice
 
-    def submit_epoch(self, lags: np.ndarray, coalescer) -> np.ndarray:
+    def submit_epoch(
+        self,
+        lags: np.ndarray,
+        coalescer,
+        slo_class: str = "standard",
+        rank: int = 1,
+        deadline_at: Optional[float] = None,
+    ) -> np.ndarray:
         """One rebalance epoch whose fused warm dispatch — if the epoch
         needs one — is routed through ``coalescer``
         (:class:`..ops.coalesce.MegabatchCoalescer`): instead of
@@ -418,12 +428,21 @@ class StreamingAssignor:
         failure surfaces on THIS stream only (the coalescer isolates
         rows; see ops/coalesce).  Intended caller: the sidecar's
         stream_assign path when more than one stream is live; a lone
-        tenant keeps the inline :meth:`rebalance` fast path."""
+        tenant keeps the inline :meth:`rebalance` fast path.
+
+        ``slo_class`` / ``rank`` / ``deadline_at`` are the submission's
+        SLO placement (utils/overload): rank orders the flush so
+        deadline-critical streams never park behind a full lower-class
+        wave, and ``deadline_at`` (absolute, in the coalescer's —
+        registry — clock) lets the flush re-route or shed a row whose
+        class budget cannot survive a full wave."""
         self._coalescer = coalescer
+        self._slo_submit = (str(slo_class), int(rank), deadline_at)
         try:
             return self.rebalance(lags)
         finally:
             self._coalescer = None
+            self._slo_submit = ("standard", 1, None)
 
     def _rebalance_inner(self, lags: np.ndarray) -> np.ndarray:
         ensure_x64()  # int64 lags would silently downcast to int32 otherwise
@@ -674,23 +693,36 @@ class StreamingAssignor:
                 # its same-bucket batchmates into ONE vmapped fused
                 # dispatch, and the resident successors come back as
                 # rows of the batch output (still device-resident).
-                from .coalesce import EpochSubmission
+                from .coalesce import DeadlineReroute, EpochSubmission
 
-                r = self._coalescer.submit(
-                    EpochSubmission(
-                        payload=payload, bucket=B, resident=resident,
-                        limit=limit, num_consumers=C, iters=budget,
-                        max_pairs=pairs, exchange_budget=budget,
-                        scope=metrics.capture_scope(),
-                        owner=self,
-                        abandoned=capture_abandon_check(),
+                klass, rank, deadline_at = self._slo_submit
+                try:
+                    r = self._coalescer.submit(
+                        EpochSubmission(
+                            payload=payload, bucket=B, resident=resident,
+                            limit=limit, num_consumers=C, iters=budget,
+                            max_pairs=pairs, exchange_budget=budget,
+                            scope=metrics.capture_scope(),
+                            owner=self,
+                            abandoned=capture_abandon_check(),
+                            klass=klass, rank=rank,
+                            deadline_at=deadline_at,
+                        )
+                    ).result()
+                except DeadlineReroute:
+                    # Deadline triage re-routed this row out of the
+                    # wave: the remaining class budget cannot survive a
+                    # full flush, so THIS (already-parked) thread runs
+                    # the inline dispatch below — in parallel with any
+                    # other rerouted laggards, leaving the flusher
+                    # admission-only.
+                    pass
+                else:
+                    self._resident = r.resident
+                    self._fill_stats_from_device(
+                        stats, r.totals, r.counts, r.rounds, r.exchanges
                     )
-                ).result()
-                self._resident = r.resident
-                self._fill_stats_from_device(
-                    stats, r.totals, r.counts, r.rounds, r.exchanges
-                )
-                return r.narrow[:P].astype(np.int32)
+                    return r.narrow[:P].astype(np.int32)
             if handle_matches is not None:
                 # Inline dispatch needs concrete per-stream buffers:
                 # leaving the roster materializes this stream's row

@@ -25,6 +25,7 @@ virtual 8-device CPU mesh and dry-run by the driver via
 from __future__ import annotations
 
 import functools
+import inspect
 from typing import Optional, Sequence
 
 import jax
@@ -33,6 +34,20 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.rounds_kernel import assign_topic_rounds
+
+# shard_map moved to the jax namespace (and its replication-check kwarg
+# was renamed check_rep -> check_vma) across the jax versions this
+# package supports; resolve both ONCE so the sharded step builds on
+# either API without a per-call probe.
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:  # jax <= 0.4.x: the experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map
+_CHECK_KW = (
+    "check_vma"
+    if "check_vma" in inspect.signature(_shard_map).parameters
+    else "check_rep"
+)
 
 
 def make_mesh(
@@ -138,7 +153,7 @@ def _jitted_sharded_step(
     refine budget) — jax.jit caches per function object, so constructing a
     fresh wrapper on every call would retrace and recompile each
     rebalance."""
-    step = jax.shard_map(
+    step = _shard_map(
         functools.partial(
             _sharded_step,
             num_consumers=num_consumers,
@@ -157,8 +172,9 @@ def _jitted_sharded_step(
         # The rounds kernel's scan carry starts from literal zeros, which the
         # varying-manual-axes checker types as unvarying even though the data
         # flowing into it varies over "topics"; parity with the unsharded
-        # kernel is asserted by tests instead.
-        check_vma=False,
+        # kernel is asserted by tests instead.  (check_vma on current jax,
+        # check_rep on the 0.4.x experimental API — see _CHECK_KW above.)
+        **{_CHECK_KW: False},
     )
     return jax.jit(step)
 

@@ -106,6 +106,25 @@ assignment unchanged (``kept_previous``, warm state intact) — and
 ``{"method": "stats"}`` exports per-breaker state/trip counters plus
 ``fallbacks``/``poisoned_snapshots``.
 
+Overload control (utils/overload; DEPLOYMENT.md "Overload and SLOs"):
+every stream carries an SLO class (``critical`` | ``standard`` |
+``best_effort``; config ``tpu.assignor.slo.class.<stream>``, wire
+override ``params.slo_class``) with an optional per-class deadline
+budget that caps the request budget and rides into the coalescer as
+the epoch's admission deadline — megabatch waves are placed in
+(class, remaining deadline) order, and a row whose budget cannot
+survive a full flush is re-routed inline or shed.  A service-level
+overload detector (EWMA of weighted in-flight depth, windowed
+``stream.epoch`` p99, breaker state) walks a shed ladder: shrink the
+admission window -> serve ``kept_previous`` for best_effort -> reject
+best_effort with a ``retry_after_ms`` hint -> degrade standard; every
+shed emits ``klba_shed_total{class,rung}`` and a flight record, and a
+rejected request's error envelope carries a structured ``shed``
+object.  ``{"method": "recommend"}`` closes the elasticity loop: a
+per-stream consumer-count recommendation from each stream's recorded
+lag trend plus the current overload state, for the external
+autoscaler.
+
 Wire limits: a request line may be at most ``MAX_LINE_BYTES`` (16 MiB —
 comfortably above a 100k-partition request, ~2 MB); longer lines are
 answered with an error and drained without buffering.  ``params.options``
@@ -139,6 +158,14 @@ from .utils.observability import (
     RebalanceStats,
     install_compile_counter,
     summarize_assignment,
+)
+from .utils.overload import (
+    CLASS_WEIGHTS,
+    OverloadController,
+    ShedReject,
+    SloPolicy,
+    class_rank,
+    recommend_payload,
 )
 from .utils.watchdog import SolveRejected, Watchdog
 
@@ -183,9 +210,15 @@ STREAM_FLIGHT_CAPACITY = 64
 _KNOWN_METHODS = frozenset(
     {
         "ping", "stats", "metrics", "assign", "stream_assign",
-        "stream_reset", "stream_flight",
+        "stream_reset", "stream_flight", "recommend",
     }
 )
+
+# Per-stream lag-trend window for the elasticity loop ({"method":
+# "recommend"}): (time, total_lag) samples per live stream.  64 epochs
+# at a 30 s cadence is a ~30 min trend window — enough slope signal for
+# the horizon projection without unbounded growth (lint L014).
+STREAM_HISTORY = 64
 
 
 def _counter_total(name: str) -> int:
@@ -326,6 +359,14 @@ def _snake_fallback(lags, C: int, prev):
     return choice, _host_choice_stats(choice, lags, C, prev, cold_start=True)
 
 
+def _serve_previous(prev, lags, C: int):
+    """The kept-previous answer (shed ladder, deadline shed, fail-fast
+    fallback alike): the stream's last served choice plus host-computed
+    stats for it — zero churn, zero device work, warm state untouched.
+    Callers must have checked :func:`_keepable` first."""
+    return prev, _host_choice_stats(prev, lags, C, prev, cold_start=False)
+
+
 def _keepable(prev, P: int, C: int) -> bool:
     """True when the previous choice is directly servable for this epoch:
     complete (no orphaned rows from a membership remap awaiting repair),
@@ -344,11 +385,17 @@ class _Stream:
     """Warm per-stream solver state (see the module docstring)."""
 
     def __init__(self):
+        from collections import deque
+
         self.lock = threading.Lock()
         self.engine = None
         self.members: List[str] = []
         self.pids = None  # np.int64[P], sorted — the row order contract
         self.flight = None  # per-stream FlightRecorder ring
+        self.klass = "standard"  # effective SLO class of the last epoch
+        # (time_s, total_lag) per served epoch — the recommend trend
+        # window (bounded: deque maxlen).
+        self.history = deque(maxlen=STREAM_HISTORY)
 
 
 def _stream_ring() -> metrics.FlightRecorder:
@@ -560,6 +607,20 @@ class AssignorService:
         # port to bind on the service host (0 = ephemeral, for tests);
         # None disables.
         metrics_port: Optional[int] = None,
+        # SLO classes + overload control (utils/overload): per-stream
+        # class map (stream_id -> critical|standard|best_effort; the
+        # wire params.slo_class override wins), per-class deadline
+        # budgets in SECONDS (each caps that class's request budget
+        # below solve_timeout_s and rides into the coalescer as the
+        # epoch's admission deadline), and the overload detector's
+        # pressure normalizers.  latency budget 0 = auto (half the
+        # solve timeout — permissive: an unconfigured sidecar never
+        # sheds on cold-compile epochs).
+        slo_classes: Optional[Dict[str, str]] = None,
+        slo_deadline_s: Optional[Dict[str, float]] = None,
+        overload_latency_budget_ms: float = 0.0,
+        overload_depth_high: float = 24.0,
+        overload_cooldown_s: float = 1.0,
         # Uptime/budget clock (L012 discipline: injectable, monotonic).
         clock: Callable[[], float] = time.monotonic,
     ):
@@ -601,6 +662,25 @@ class AssignorService:
             self._coalescer = None
         self._metrics_port = metrics_port
         self._metrics_http = None
+        # SLO policy + the overload controller (utils/overload): the
+        # shed ladder walks on the stream breaker's state plus
+        # registry-fed depth/latency pressure.
+        self._slo = SloPolicy(
+            classes=slo_classes, deadline_s=slo_deadline_s
+        )
+        self._overload = OverloadController(
+            latency_budget_ms=(
+                overload_latency_budget_ms if overload_latency_budget_ms > 0
+                else (solve_timeout_s or 120.0) * 500.0
+            ),
+            depth_high=overload_depth_high,
+            cooldown_s=overload_cooldown_s,
+            breaker_open=lambda: self._watchdog.state("stream") == "open",
+        )
+        # Weighted in-flight stream-request depth (the controller's
+        # queue signal); guarded by its own leaf lock.
+        self._inflight_lock = threading.Lock()
+        self._inflight_weight = 0.0
         # The request/error/fallback counters live in the registry
         # (klba_requests_total / klba_request_errors_total /
         # klba_fallbacks_total — the same series a scraper reads); the
@@ -662,8 +742,9 @@ class AssignorService:
         consumer of the service-relevant ``tpu.assignor.*`` keys
         (utils/config.parse_config): ``solve.timeout.ms``,
         ``host.fallback``, ``breaker.cooldown.ms`` / ``breaker.failures``,
-        ``coalesce.window.ms`` / ``coalesce.max_batch``, and
-        ``metrics.port``.  An embedder that already holds the consumer
+        ``coalesce.window.ms`` / ``coalesce.max_batch``,
+        ``slo.class.<stream>`` / ``slo.deadline.ms.<class>`` /
+        ``overload.*``, and ``metrics.port``.  An embedder that already holds the consumer
         config (which always carries the required ``group.id``) gets a
         service whose knobs agree with the plugin's, one parse for both
         surfaces.  Explicit ``overrides`` kwargs win over config values
@@ -683,6 +764,10 @@ class AssignorService:
             "coalesce_pipeline": cfg.coalesce_pipeline,
             "metrics_port": cfg.metrics_port,
             "warmup_shapes": cfg.warmup_shapes or None,
+            "slo_classes": cfg.slo_classes,
+            "slo_deadline_s": cfg.slo_deadline_s,
+            "overload_latency_budget_ms": cfg.overload_latency_budget_ms,
+            "overload_depth_high": cfg.overload_depth_high,
         }
         kwargs.update(overrides)
         return cls(host, port, **kwargs)
@@ -735,6 +820,29 @@ class AssignorService:
                 return json.dumps(
                     {"id": req_id, "request_id": rid, "result": result}
                 ).encode()
+            except ShedReject as exc:
+                # An overload shed is a DECISION, not a failure: counted
+                # as a served request (the shed itself is accounted in
+                # klba_shed_total), answered as a structured error the
+                # client can back off on.
+                metrics.REGISTRY.counter(
+                    "klba_requests_total", {"method": label}
+                ).inc()
+                LOGGER.warning("request shed: %s", exc)
+                return json.dumps(
+                    {
+                        "id": req_id,
+                        "request_id": rid,
+                        "error": {
+                            "message": str(exc),
+                            "shed": {
+                                "class": exc.klass,
+                                "rung": exc.rung,
+                                "retry_after_ms": exc.retry_after_ms,
+                            },
+                        },
+                    }
+                ).encode()
             except Exception as exc:  # noqa: BLE001 — wire boundary
                 metrics.REGISTRY.counter(
                     "klba_request_errors_total", {"method": label}
@@ -769,6 +877,9 @@ class AssignorService:
             # Per-solver circuit-breaker states + trip counters — the
             # operator's view of which failure domains are sidelined.
             result["breakers"] = self._watchdog.stats()
+            # The shed ladder's position + pressure signals
+            # (utils/overload; see DEPLOYMENT.md "Overload and SLOs").
+            result["overload"] = self._overload.snapshot()
             if self._coalescer is not None:
                 # Roster tracking: how many shape groups currently
                 # serve on the locked fast path, plus the hit /
@@ -864,10 +975,18 @@ class AssignorService:
                 "options": options,
             }, budget
         if method == "stream_assign":
-            budget = _DeadlineBudget(
-                self._watchdog.timeout_s, clock=self._clock
+            params = req.get("params") or {}
+            # SLO class: wire override > config map > "standard"; the
+            # class's deadline budget (if configured) caps this
+            # request's budget below the global solve timeout.
+            klass = self._slo.resolve(
+                params.get("stream_id"), params.get("slo_class")
             )
-            result = self._stream_assign(req.get("params") or {}, budget)
+            budget = _DeadlineBudget(
+                self._slo.budget_s(klass, self._watchdog.timeout_s),
+                clock=self._clock,
+            )
+            result = self._stream_assign(params, budget, klass)
             rung = result["stream"]["degraded_rung"]
             metrics.REGISTRY.counter(
                 "klba_ladder_rung_total",
@@ -889,6 +1008,8 @@ class AssignorService:
                     "quality_ratio": s["quality_ratio"],
                     "warm_restart": s["warm_restart"],
                     "fallback_used": s["fallback_used"],
+                    "slo_class": s["slo_class"],
+                    "shed": s["shed"],
                 },
             )
             if rung != "none":
@@ -906,6 +1027,44 @@ class AssignorService:
                 dropped = self._streams.pop(sid, None) is not None
                 self._snapshots.pop(sid, None)
             return {"dropped": dropped}, None
+        if method == "recommend":
+            # The elasticity loop (utils/overload.recommend_payload):
+            # per-stream consumer-count recommendations from the
+            # lag-trend windows the stream path already records, plus
+            # the current overload state — the external autoscaler
+            # closes the loop on this.  params.stream_id (optional)
+            # narrows to one stream; unknown ids simply return empty.
+            params = req.get("params") or {}
+            only = params.get("stream_id")
+            horizon = params.get("horizon_s", 60.0)
+            if isinstance(horizon, bool) or not isinstance(
+                horizon, (int, float)
+            ) or not 1.0 <= float(horizon) <= 86400.0:
+                raise ValueError(
+                    "params.horizon_s must be a number in [1, 86400]"
+                )
+            with self._streams_lock:
+                items = list(self._streams.items())
+            streams: Dict[str, Any] = {}
+            for sid, st in items:
+                if only is not None and sid != only:
+                    continue
+                # Snapshot without the stream lock: history is a
+                # bounded deque (appends are GIL-atomic) and a torn
+                # read here is a monitoring read, like any scrape.
+                samples = list(st.history)
+                streams[sid] = {
+                    "slo_class": st.klass,
+                    "consumers": len(st.members),
+                    "partitions": (
+                        int(st.pids.shape[0]) if st.pids is not None else 0
+                    ),
+                    "samples": samples,
+                }
+            return recommend_payload(
+                streams, self._overload.snapshot(),
+                horizon_s=float(horizon),
+            ), None
         if method == "stream_flight":
             # One stream's private flight ring, dumped (and optionally
             # cleared) on demand — the global 256-record ring stays the
@@ -930,11 +1089,12 @@ class AssignorService:
         raise ValueError(f"unknown method {method!r}")
 
     def _stream_assign(
-        self, params: Dict[str, Any], budget: Optional[_DeadlineBudget] = None
+        self,
+        params: Dict[str, Any],
+        budget: Optional[_DeadlineBudget] = None,
+        klass: str = "standard",
     ) -> Dict[str, Any]:
         import numpy as np
-
-        from .ops.streaming import StreamingAssignor
 
         if budget is None:
             budget = _DeadlineBudget(self._watchdog.timeout_s)
@@ -976,6 +1136,70 @@ class AssignorService:
             np.diff(pids_sorted) == 0
         ).any():
             raise ValueError("params.lags contains duplicate partition ids")
+
+        # Overload admission (utils/overload): the shed ladder decides
+        # this request's fate BEFORE any solver state is touched.  The
+        # decision path itself is a fault point (shed.decide) — if it
+        # faults, the service FAILS OPEN and admits: overload control
+        # must never be what takes healthy traffic down.
+        # Feed the CURRENT in-flight depth before deciding: rejected
+        # requests return before the post-admission accounting below,
+        # so without this feed an all-shed class mix would freeze the
+        # depth EWMA at its stampede peak and the ladder could never
+        # step down (livelock) — every arrival, admitted or not, must
+        # let the controller see the true (decaying) depth.
+        with self._inflight_lock:
+            depth_now = self._inflight_weight
+        self._overload.note_depth(depth_now)
+        decision = None
+        try:
+            decision = self._overload.admission(klass)
+        except Exception:
+            # ANY failure in the decision path — the injected
+            # shed.decide fault or a genuine controller bug — fails
+            # OPEN: overload control must never be what takes healthy
+            # traffic down (the documented contract, DEPLOYMENT.md
+            # "Overload and SLOs").
+            LOGGER.warning(
+                "overload admission decision failed; failing open "
+                "(admit)", exc_info=True,
+            )
+        if decision is not None:
+            if self._coalescer is not None:
+                # Rung 1+ shrinks the megabatch admission window —
+                # batch efficiency yields before latency.
+                self._coalescer.set_window_scale(decision.window_scale)
+            if decision.action == "reject":
+                self._overload.note_shed(
+                    klass, decision.rung_name, "rejected", stream_id=sid
+                )
+                raise ShedReject(
+                    klass, decision.rung_name, decision.retry_after_ms
+                )
+
+        weight = CLASS_WEIGHTS.get(klass, 1.0)
+        with self._inflight_lock:
+            self._inflight_weight += weight
+            depth = self._inflight_weight
+        self._overload.note_depth(depth)
+        try:
+            return self._stream_assign_admitted(
+                params, budget, klass, decision,
+                sid, topic, lags, pids_sorted, members_sorted, C, opts,
+            )
+        finally:
+            with self._inflight_lock:
+                self._inflight_weight -= weight
+
+    def _stream_assign_admitted(
+        self, params, budget, klass, decision,
+        sid, topic, lags, pids_sorted, members_sorted, C, opts,
+    ) -> Dict[str, Any]:
+        """The admitted remainder of a stream_assign: stream state,
+        the solve (or the degrade rung's kept_previous), the ladder."""
+        import numpy as np
+
+        from .ops.streaming import StreamingAssignor
 
         while True:
             with self._streams_lock:
@@ -1050,7 +1274,36 @@ class AssignorService:
 
             fallback_used = False
             degraded_rung = "none"
+            shed_info: Optional[Dict[str, Any]] = None
             prev = st.engine._prev_choice
+            if (
+                decision is not None
+                and decision.action == "degrade"
+                and _keepable(prev, lags.shape[0], C)
+            ):
+                # Shed ladder (degrade rung): serve the PREVIOUS
+                # assignment — zero churn, zero device work, warm
+                # state untouched.  Nothing failed, so this is not a
+                # fallback and not a ladder descent; the shed itself
+                # is the record.  A stream with no servable previous
+                # choice (cold) is admitted instead — there is
+                # nothing cheaper to serve it.
+                choice, s = _serve_previous(prev, lags, C)
+                self._overload.note_shed(
+                    klass, decision.rung_name, "kept_previous",
+                    stream_id=sid,
+                )
+                shed_info = {
+                    "rung": decision.rung_name,
+                    "served": "kept_previous",
+                }
+                self._note_epoch(st, klass, lags)
+                return self._stream_result(
+                    topic, members_sorted, pids_sorted, choice, s,
+                    fallback_used=False, degraded_rung="none",
+                    warm_restart=warm_restart, opts=opts, klass=klass,
+                    shed=shed_info,
+                )
             # Multi-tenant routing: with MORE than one live stream the
             # warm dispatch goes through the megabatch coalescer (one
             # vmapped device dispatch serves every concurrent epoch in
@@ -1065,17 +1318,31 @@ class AssignorService:
                 # Ladder rung 1: the warm-resident engine, under the
                 # stream breaker with the request's REMAINING budget.
                 if coalescer is not None:
+                    # The submission's admission deadline: the
+                    # request's remaining budget, translated into the
+                    # coalescer's (registry) clock domain — the flush
+                    # triages rows whose class budget cannot survive a
+                    # full wave.
+                    rem = budget.remaining()
+                    deadline_at = (
+                        metrics.REGISTRY.clock() + rem
+                        if rem is not None else None
+                    )
                     choice = self._watchdog.call(
                         st.engine.submit_epoch, lags, coalescer,
                         key="stream", timeout_s=budget.remaining(),
+                        budget_total_s=budget.total_s,
+                        slo_class=klass, rank=class_rank(klass),
+                        deadline_at=deadline_at,
                     )
                 else:
                     choice = self._watchdog.call(
                         st.engine.rebalance, lags, key="stream",
                         timeout_s=budget.remaining(),
+                        budget_total_s=budget.total_s,
                     )
                 s = st.engine.last_stats
-            except SolveRejected:
+            except SolveRejected as rej:
                 # FAIL-FAST rejection (breaker open / probe in flight /
                 # budget spent): nothing ever ran, so the warm engine is
                 # untouched and still valid — an open shared breaker must
@@ -1084,24 +1351,45 @@ class AssignorService:
                 # previous assignment (zero churn) when it is directly
                 # servable, else deal the snake and SEED the engine with
                 # it so the stream state matches what the clients now run.
-                if not self._host_fallback:
-                    raise
-                LOGGER.warning(
-                    "stream %r solve rejected without running; keeping "
-                    "warm state and answering host-side",
-                    sid, exc_info=True,
-                )
-                fallback_used = True
-                if _keepable(prev, lags.shape[0], C):
-                    choice = prev
-                    s = _host_choice_stats(
-                        prev, lags, C, prev, cold_start=False
-                    )
-                    degraded_rung = "kept_previous"
+                # A DeadlineShed is the same fail-fast contract arriving
+                # from the coalescer's admission triage (the row's class
+                # budget expired while parked) — but it is a shed, not a
+                # failure: when the previous assignment is servable, the
+                # request is answered as a SHED (klba_shed_total was
+                # already counted by the coalescer) without touching the
+                # fallback/ladder incident accounting — a routine
+                # overload shed must not burn the flight-recorder dump
+                # budget or inflate the series operators page on.
+                from .ops.coalesce import DeadlineShed
+
+                deadline_shed = isinstance(rej, DeadlineShed)
+                if deadline_shed and _keepable(prev, lags.shape[0], C):
+                    choice, s = _serve_previous(prev, lags, C)
+                    shed_info = {
+                        "rung": "admit_deadline",
+                        "served": "kept_previous",
+                    }
                 else:
-                    choice, s = _snake_fallback(lags, C, prev)
-                    st.engine.seed_choice(np.asarray(choice))
-                    degraded_rung = "host_snake"
+                    if not self._host_fallback:
+                        raise
+                    LOGGER.warning(
+                        "stream %r solve rejected without running; "
+                        "keeping warm state and answering host-side",
+                        sid, exc_info=True,
+                    )
+                    fallback_used = True
+                    if _keepable(prev, lags.shape[0], C):
+                        choice, s = _serve_previous(prev, lags, C)
+                        degraded_rung = "kept_previous"
+                    else:
+                        choice, s = _snake_fallback(lags, C, prev)
+                        st.engine.seed_choice(np.asarray(choice))
+                        degraded_rung = "host_snake"
+                    if deadline_shed:
+                        shed_info = {
+                            "rung": "admit_deadline",
+                            "served": degraded_rung,
+                        }
             except Exception:
                 # A watchdog-abandoned worker thread may STILL be running
                 # the engine's rebalance and will mutate its warm state
@@ -1127,6 +1415,31 @@ class AssignorService:
                 )
         finally:
             st.lock.release()
+
+        self._note_epoch(st, klass, lags)
+        return self._stream_result(
+            topic, members_sorted, pids_sorted, choice, s,
+            fallback_used=fallback_used, degraded_rung=degraded_rung,
+            warm_restart=warm_restart, opts=opts, klass=klass,
+            shed=shed_info,
+        )
+
+    def _note_epoch(self, st: _Stream, klass: str, lags) -> None:
+        """Record one served epoch's elasticity sample: (time, total
+        lag) into the stream's bounded trend window, plus its effective
+        class — the raw material of ``{"method": "recommend"}``."""
+        st.klass = klass
+        st.history.append(
+            (self._clock(), int(lags.sum(dtype="int64")))
+        )
+
+    def _stream_result(
+        self, topic, members_sorted, pids_sorted, choice, s, *,
+        fallback_used: bool, degraded_rung: str, warm_restart: bool,
+        opts: Dict[str, Any], klass: str,
+        shed: Optional[Dict[str, Any]],
+    ) -> Dict[str, Any]:
+        import numpy as np
 
         choice_l = np.asarray(choice).tolist()
         pids_l = pids_sorted.tolist()
@@ -1156,6 +1469,11 @@ class AssignorService:
                 # epoch warm-restarted from a poisoned-stream snapshot.
                 "degraded_rung": degraded_rung,
                 "warm_restart": warm_restart,
+                # SLO surface: the request's effective class, and — when
+                # the shed ladder (or the coalescer's deadline triage)
+                # degraded it — which rung shed it and what was served.
+                "slo_class": klass,
+                "shed": shed,
             },
             "options": opts,
         }
@@ -1365,6 +1683,15 @@ class AssignorServiceClient:
                 line = self._round_trip(payload)
         resp = json.loads(line)
         if "error" in resp:
+            shed = resp["error"].get("shed")
+            if shed is not None:
+                # Rebuild the typed rejection so callers implement the
+                # backoff contract from fields, not by parsing the
+                # human-readable message.
+                raise ShedReject(
+                    shed["class"], shed["rung"],
+                    int(shed["retry_after_ms"]),
+                )
             raise RuntimeError(resp["error"]["message"])
         return resp["result"]
 

@@ -82,3 +82,32 @@ class FakeBroker:
         for infos in topics.values():
             infos.sort(key=lambda p: p.partition)
         return Cluster(topics)
+
+
+# -- shared overload/chaos assertions -------------------------------------
+#
+# One walker over klba_shed_total and one count-balance invariant, shared
+# by bench.py's overload gates and the chaos/overload test suites — a
+# shed-label schema change that updated only one hand-rolled copy would
+# silently skew the others' per-class totals and weaken the very gates
+# (critical-never-shed, bottom-up shedding) they enforce.
+
+
+def shed_totals_by_class() -> Dict[Optional[str], float]:
+    """Current ``klba_shed_total`` value per class, summed over rungs."""
+    from .utils import metrics
+
+    out: Dict[Optional[str], float] = {}
+    for counter in metrics.REGISTRY.series("klba_shed_total"):
+        klass = counter.labels.get("class")
+        out[klass] = out.get(klass, 0) + counter.value
+    return out
+
+
+def assert_valid_assignment(assignments, expect_partitions: int) -> None:
+    """Count-balanced (max - min <= 1), complete, no duplicates."""
+    sizes = [len(v) for v in assignments.values()]
+    got = [tuple(tp) for tps in assignments.values() for tp in tps]
+    assert sorted(got) == sorted(set(got)), "duplicate partitions"
+    assert len(got) == expect_partitions, (len(got), expect_partitions)
+    assert max(sizes) - min(sizes) <= 1, sizes

@@ -66,6 +66,21 @@ COALESCE_MAX_BATCH_CONFIG = "tpu.assignor.coalesce.max_batch"
 # strict-serial fallback).
 COALESCE_LOCK_WAVES_CONFIG = "tpu.assignor.coalesce.roster.lock.waves"
 COALESCE_PIPELINE_CONFIG = "tpu.assignor.coalesce.pipeline"
+# SLO classes + overload control (utils/overload, served by the
+# sidecar).  Per-stream class: "tpu.assignor.slo.class.<stream_id>" =
+# critical | standard | best_effort (a wire-level params.slo_class
+# override wins per request; unlisted streams are "standard").
+# Per-class deadline budget: "tpu.assignor.slo.deadline.ms.<class>" —
+# caps that class's request budget BELOW solve.timeout.ms and rides
+# into the coalescer as the epoch's admission deadline.  The overload
+# detector's knobs: the epoch-latency level (ms) treated as pressure
+# 1.0 (0/unset = auto: half the solve timeout — permissive, an
+# unconfigured sidecar never sheds on cold compiles) and the weighted
+# in-flight depth treated as pressure 1.0.
+SLO_CLASS_PREFIX = "tpu.assignor.slo.class."
+SLO_DEADLINE_PREFIX = "tpu.assignor.slo.deadline.ms."
+OVERLOAD_LATENCY_BUDGET_CONFIG = "tpu.assignor.overload.latency.budget.ms"
+OVERLOAD_DEPTH_HIGH_CONFIG = "tpu.assignor.overload.depth.high"
 # Opt-in plain-HTTP /metrics listener (utils/metrics_http): a port for a
 # stock Prometheus to scrape the registry's text exposition without a
 # sidecar shim.  0/unset disables (the JSON wire `metrics` method is
@@ -156,6 +171,13 @@ class AssignorConfig:
     coalesce_max_batch: int = 32
     coalesce_lock_waves: int = 1
     coalesce_pipeline: bool = True
+    # SLO classes + overload control (utils/overload): per-stream class
+    # map, per-class deadline budgets (seconds), and the overload
+    # detector's pressure normalizers (0 latency budget = auto).
+    slo_classes: Dict[str, str] = field(default_factory=dict)
+    slo_deadline_s: Dict[str, float] = field(default_factory=dict)
+    overload_latency_budget_ms: float = 0.0
+    overload_depth_high: float = 24.0
     # Plain-HTTP /metrics port (utils/metrics_http); None = disabled.
     metrics_port: Optional[int] = None
     # (max_partitions, num_consumers) shapes to pre-compile at configure().
@@ -255,6 +277,51 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
 
     metrics_port = _as_int(METRICS_PORT_CONFIG, 0, 0)
 
+    # SLO class map + per-class deadline budgets: prefix-keyed entries,
+    # validated against the class roster (utils/overload) so a typo'd
+    # class fails at configure() time, not mid-stampede.
+    from .overload import SLO_CLASSES
+
+    slo_classes: Dict[str, str] = {}
+    slo_deadline_s: Dict[str, float] = {}
+    for key, value in consumer_group_props.items():
+        if key.startswith(SLO_CLASS_PREFIX):
+            stream_id = key[len(SLO_CLASS_PREFIX):]
+            klass = str(value)
+            if not stream_id or klass not in SLO_CLASSES:
+                raise ValueError(
+                    f"{key}={value!r} invalid; classes: {list(SLO_CLASSES)}"
+                )
+            slo_classes[stream_id] = klass
+        elif key.startswith(SLO_DEADLINE_PREFIX):
+            klass = key[len(SLO_DEADLINE_PREFIX):]
+            if klass not in SLO_CLASSES:
+                raise ValueError(
+                    f"{key}: unknown class {klass!r}; "
+                    f"classes: {list(SLO_CLASSES)}"
+                )
+            secs = _as_ms(key, 0.0)  # ms-typed knob, seconds out
+            if secs <= 0:
+                raise ValueError(f"{key}={value!r} must be > 0 ms")
+            slo_deadline_s[klass] = secs
+
+    # The controller keeps this knob in ms (it normalizes a p99 that is
+    # measured in ms), so convert _as_ms's seconds back out once, here.
+    overload_latency_budget_ms = (
+        _as_ms(OVERLOAD_LATENCY_BUDGET_CONFIG, 0.0) * 1000.0
+    )
+    raw_depth = consumer_group_props.get(OVERLOAD_DEPTH_HIGH_CONFIG, 24.0)
+    try:
+        overload_depth_high = float(raw_depth)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"{OVERLOAD_DEPTH_HIGH_CONFIG}={raw_depth!r} is not a number"
+        )
+    if overload_depth_high <= 0:
+        raise ValueError(
+            f"{OVERLOAD_DEPTH_HIGH_CONFIG}={overload_depth_high} must be > 0"
+        )
+
     return AssignorConfig(
         group_id=str(group_id),
         auto_offset_reset=str(
@@ -276,6 +343,10 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
         coalesce_pipeline=_as_bool(
             consumer_group_props.get(COALESCE_PIPELINE_CONFIG, True)
         ),
+        slo_classes=slo_classes,
+        slo_deadline_s=slo_deadline_s,
+        overload_latency_budget_ms=overload_latency_budget_ms,
+        overload_depth_high=overload_depth_high,
         metrics_port=metrics_port if metrics_port > 0 else None,
         warmup_shapes=warmup_shapes,
         consumer_group_props=consumer_group_props,

@@ -26,6 +26,15 @@ Named fault points (every one threaded through production code):
                     materialize`) — the roster-churn recovery path: a
                     failure here exercises a stream's exit from the
                     batch (inline dispatch, re-stack, row fallback)
+``admit.park``      a warm epoch parking in the megabatch coalescer's
+                    admission queue (:meth:`..ops.coalesce.
+                    MegabatchCoalescer.submit`) — a failure here
+                    exercises the submitter's degraded-mode ladder
+                    (the epoch never entered a wave)
+``shed.decide``     the overload controller's admission decision
+                    (:meth:`..utils.overload.OverloadController.
+                    admission`) — the service FAILS OPEN (admits) when
+                    the shed decision itself faults
 ``lag.begin``       the ListOffsets(beginning) broker RPC (:mod:`..lag`)
 ``lag.end``         the ListOffsets(end) broker RPC
 ``lag.committed``   the OffsetFetch broker RPC
@@ -80,6 +89,8 @@ FAULT_POINTS = frozenset(
         "stream.refine",
         "coalesce.flush",
         "coalesce.gather",
+        "admit.park",
+        "shed.decide",
         "lag.begin",
         "lag.end",
         "lag.committed",

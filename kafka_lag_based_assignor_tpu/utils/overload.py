@@ -1,0 +1,525 @@
+"""Closed-loop overload control: SLO classes, shed ladder, elasticity.
+
+The sidecar serves many tenants through one device pipeline, and under
+overload every stream used to degrade equally — the only pressure valve
+was the per-request deadline budget, which fires *after* a request has
+already parked behind a full wave.  This module is the control plane in
+front of that: per-tenant **SLO classes**, a registry-fed **overload
+detector** that walks a shed ladder, and the **elasticity** math behind
+the wire ``{"method": "recommend"}`` call (the consumer-count
+recommendation loop of the multi-objective consumer-group autoscaling
+literature, arXiv:2402.06085) — degrade batch efficiency before
+latency, and shed the lowest class first.
+
+SLO classes
+-----------
+
+Every stream carries one of three classes (config
+``tpu.assignor.slo.class.<stream>``, overridable per request via the
+wire ``params.slo_class``):
+
+================  ====  ======  =============================================
+class             rank  weight  meaning
+================  ====  ======  =============================================
+``critical``        0       4   never shed; placed first in every wave
+``standard``        1       2   default; degraded only at the last rung
+``best_effort``     2       1   first to degrade, then first to be rejected
+================  ====  ======  =============================================
+
+Rank orders megabatch chunk placement (ops/coalesce sorts every flush
+by ``(rank, remaining deadline)``, so a critical stream never parks
+behind a full best-effort wave); weight scales a class's contribution
+to the queue-depth pressure signal.  A per-class **deadline budget**
+(config ``tpu.assignor.slo.deadline.ms.<class>``) caps the request's
+deadline budget below the global ``solve.timeout.ms``, and rides into
+the coalescer as the submission's absolute deadline — a row whose
+remaining budget cannot survive a full flush is re-routed to the
+inline path (or shed) instead of poisoning the wave.
+
+The shed ladder
+---------------
+
+:class:`OverloadController` derives a pressure score from three
+registry-fed signals — an EWMA of the in-flight stream-request depth,
+the windowed p99 of ``klba_span_duration_ms{span=stream.epoch}``
+(bucket-delta since the previous evaluation, so one cold compile does
+not poison the signal forever), and the stream breaker's state — and
+maps it onto the rungs:
+
+====  ====================  =================================================
+rung  name                  action
+====  ====================  =================================================
+0     ``none``              admit everything, full admission window
+1     ``shrink_window``     coalescer admission window scaled down
+2     ``degrade_best_effort``  best_effort served ``kept_previous`` (zero
+                            device work; warm state intact)
+3     ``reject_best_effort``  best_effort rejected with a retry-after hint
+4     ``degrade_standard``  standard also ``kept_previous``; critical still
+                            solves
+====  ====================  =================================================
+
+Escalation is immediate; de-escalation steps down one rung per
+``cooldown_s`` below threshold (hysteresis — a stampede must not
+flap the ladder).  Every shed emits a flight record and
+``klba_shed_total{class,rung}``; rung transitions set the
+``klba_overload_rung`` gauge and record an ``overload_rung`` flight
+record.  The fault point ``shed.decide`` fires inside
+:meth:`OverloadController.admission` — the service FAILS OPEN (admits)
+when the decision path itself faults, pinned by the chaos suite.
+
+Elasticity
+----------
+
+:func:`recommend_consumers` projects a stream's backlog ``horizon_s``
+ahead from its recent (time, total lag) samples and sizes the group so
+the projected backlog per consumer stays at today's level::
+
+    rec = ceil(C * (lag_now + max(0, slope) * horizon) / lag_now)
+
+Monotone in the lag trend by construction (the acceptance gate the
+bench's stampede probe pins); the current overload rung bumps the
+floor to ``C + 1`` once the ladder is degrading traffic.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+from typing import Any, Callable, Dict, Mapping, Optional, Sequence, Tuple
+
+from . import faults, metrics
+
+LOGGER = logging.getLogger(__name__)
+
+#: The SLO classes, most- to least-important.  Index = rank (placement
+#: and shed order both key on it).
+SLO_CLASSES = ("critical", "standard", "best_effort")
+
+_CLASS_RANK = {c: i for i, c in enumerate(SLO_CLASSES)}
+
+#: Default admission weights (config-overridable is deliberately NOT
+#: offered — the weights only scale the depth-pressure signal, and a
+#: per-deployment knob there would be unfalsifiable tuning surface).
+CLASS_WEIGHTS = {"critical": 4.0, "standard": 2.0, "best_effort": 1.0}
+
+#: Shed-ladder rungs, least to most severe (index = rung).
+RUNGS = (
+    "none",
+    "shrink_window",
+    "degrade_best_effort",
+    "reject_best_effort",
+    "degrade_standard",
+)
+
+#: Coalescer admission-window scale per rung: rung 1 is "shrink the
+#: admission window" (smaller waves, lower parked latency); deeper
+#: rungs keep shrinking — batch efficiency yields before latency does.
+_WINDOW_SCALE = (1.0, 0.5, 0.25, 0.25, 0.1)
+
+#: Pressure thresholds: rung i engages at pressure >= _THRESHOLDS[i-1].
+_THRESHOLDS = (1.0, 1.5, 2.5, 4.0)
+
+
+def class_rank(klass: str) -> int:
+    return _CLASS_RANK[klass]
+
+
+#: Get-or-create cache for the shed counters (sheds happen on the
+#: overloaded hot path, where a label-dict registry lookup per event is
+#: the wrong cost).  Plain dict: get/set are GIL-atomic, and a racing
+#: double-create just fetches the same registry child twice.
+_SHED_COUNTERS: Dict[Tuple[str, str], "metrics.Counter"] = {}
+
+
+def record_shed(
+    klass: str,
+    rung_name: str,
+    served: Optional[str],
+    stream_id: Optional[str] = None,
+    request_id: Optional[str] = None,
+) -> None:
+    """Account one shed event — ``klba_shed_total{class,rung}`` plus a
+    flight record — with ONE schema no matter which layer shed the
+    request (the controller's ladder or the coalescer's deadline
+    triage).  ``served`` is what the client got (``kept_previous`` /
+    ``rejected``), or None when the shedding layer cannot know (the
+    coalescer sheds before the submitter's recovery picks the answer).
+    ``request_id`` is only needed from threads outside the request
+    scope (the flight recorder attaches the in-scope id itself)."""
+    key = (klass, rung_name)
+    counter = _SHED_COUNTERS.get(key)
+    if counter is None:
+        counter = _SHED_COUNTERS[key] = metrics.REGISTRY.counter(
+            "klba_shed_total", {"class": klass, "rung": rung_name}
+        )
+    counter.inc()
+    rec: Dict[str, Any] = {
+        "class": klass,
+        "rung": rung_name,
+        "served": served,
+        "stream_id": stream_id,
+    }
+    if request_id is not None:
+        rec["request_id"] = request_id
+    metrics.FLIGHT.record("shed", rec)
+
+
+class ShedReject(RuntimeError):
+    """A request rejected by the shed ladder (never an internal error):
+    the wire layer turns this into an error envelope carrying the class,
+    the rung, and a ``retry_after_ms`` hint for the client's backoff."""
+
+    def __init__(self, klass: str, rung: str, retry_after_ms: int):
+        super().__init__(
+            f"overload: {klass!r} traffic is being shed at rung {rung!r}; "
+            f"retry after {retry_after_ms} ms"
+        )
+        self.klass = klass
+        self.rung = rung
+        self.retry_after_ms = retry_after_ms
+
+
+class SloPolicy:
+    """Per-stream class resolution + per-class deadline budgets.
+
+    ``classes`` maps stream id -> class name (from
+    ``tpu.assignor.slo.class.<stream>``); a wire-level override wins.
+    ``deadline_s`` maps class name -> seconds; :meth:`budget_s` returns
+    the TIGHTER of the class deadline and the service's global solve
+    timeout (a class budget can only shrink the request budget, never
+    extend past the watchdog's)."""
+
+    def __init__(
+        self,
+        classes: Optional[Mapping[str, str]] = None,
+        deadline_s: Optional[Mapping[str, float]] = None,
+        default_class: str = "standard",
+    ):
+        self._classes = dict(classes or {})
+        self._deadline_s = dict(deadline_s or {})
+        for sid, klass in self._classes.items():
+            if klass not in SLO_CLASSES:
+                raise ValueError(
+                    f"unknown SLO class {klass!r} for stream {sid!r}; "
+                    f"valid: {list(SLO_CLASSES)}"
+                )
+        for klass, secs in self._deadline_s.items():
+            if klass not in SLO_CLASSES:
+                raise ValueError(
+                    f"unknown SLO class {klass!r} in deadline map; "
+                    f"valid: {list(SLO_CLASSES)}"
+                )
+            if not secs > 0:
+                raise ValueError(
+                    f"SLO deadline for {klass!r} must be > 0, got {secs}"
+                )
+        if default_class not in SLO_CLASSES:
+            raise ValueError(f"unknown default class {default_class!r}")
+        self.default_class = default_class
+
+    def resolve(self, stream_id: Any, override: Any = None) -> str:
+        """The stream's effective class: wire override > config map >
+        default.  An unknown override is a client error (loud, like
+        every other wire-boundary validation)."""
+        if override is not None:
+            if override not in SLO_CLASSES:
+                raise ValueError(
+                    f"unknown slo_class {override!r}; valid: "
+                    f"{list(SLO_CLASSES)}"
+                )
+            return override
+        if isinstance(stream_id, str):
+            return self._classes.get(stream_id, self.default_class)
+        return self.default_class
+
+    def deadline_s(self, klass: str) -> Optional[float]:
+        return self._deadline_s.get(klass)
+
+    def budget_s(
+        self, klass: str, global_timeout_s: Optional[float]
+    ) -> Optional[float]:
+        """The request's total deadline budget for this class."""
+        d = self._deadline_s.get(klass)
+        if d is None:
+            return global_timeout_s
+        if global_timeout_s is None:
+            return d
+        return min(d, global_timeout_s)
+
+
+class _Decision:
+    """One admission decision: what to do with this request, and the
+    ladder context that produced it (snapshotted — the rung may move
+    while the request runs)."""
+
+    __slots__ = ("action", "rung", "rung_name", "retry_after_ms",
+                 "window_scale")
+
+    def __init__(self, action: str, rung: int, retry_after_ms: int):
+        self.action = action  # "admit" | "degrade" | "reject"
+        self.rung = rung
+        self.rung_name = RUNGS[rung]
+        self.retry_after_ms = retry_after_ms
+        self.window_scale = _WINDOW_SCALE[rung]
+
+
+class OverloadController:
+    """The service-level overload detector + shed ladder (module
+    docstring).  One instance per service; thread-safe; clock
+    injectable (L012 discipline) so the hysteresis is testable without
+    real waits.
+
+    ``latency_budget_ms`` is the epoch-latency level treated as
+    pressure 1.0 (default: half the solve timeout — permissive, so an
+    unconfigured sidecar never sheds on the cold-compile epochs);
+    ``depth_high`` is the weighted in-flight depth treated as pressure
+    1.0.  ``eval_interval_s`` rate-limits the registry walk; between
+    evaluations the cached rung serves."""
+
+    def __init__(
+        self,
+        latency_budget_ms: float = 60_000.0,
+        depth_high: float = 24.0,
+        ewma_alpha: float = 0.3,
+        cooldown_s: float = 1.0,
+        eval_interval_s: float = 0.1,
+        clock: Optional[Callable[[], float]] = None,
+        breaker_open: Optional[Callable[[], bool]] = None,
+    ):
+        if not latency_budget_ms > 0:
+            raise ValueError(
+                f"latency_budget_ms={latency_budget_ms} must be > 0"
+            )
+        if not depth_high > 0:
+            raise ValueError(f"depth_high={depth_high} must be > 0")
+        self.latency_budget_ms = float(latency_budget_ms)
+        self.depth_high = float(depth_high)
+        self.ewma_alpha = float(ewma_alpha)
+        self.cooldown_s = float(cooldown_s)
+        self.eval_interval_s = float(eval_interval_s)
+        self._clock = clock or metrics.REGISTRY.clock
+        self._breaker_open = breaker_open or (lambda: False)
+        self._lock = threading.Lock()
+        self._ewma_depth = 0.0
+        self._rung = 0
+        self._pressure = 0.0
+        self._p99_ms: Optional[float] = None
+        self._last_eval: Optional[float] = None
+        self._last_step_down: float = self._clock()
+        # Windowed latency signal: bucket-delta p99 of the stream.epoch
+        # span since the previous evaluation (one cold compile must not
+        # poison the lifetime percentile forever).
+        self._epoch_hist = metrics.REGISTRY.histogram(
+            "klba_span_duration_ms", {"span": "stream.epoch"}
+        )
+        self._hist_prev = self._epoch_hist.state()
+        self._m_rung = metrics.REGISTRY.gauge("klba_overload_rung")
+        self._m_pressure = metrics.REGISTRY.gauge("klba_overload_pressure")
+
+    # -- signals -----------------------------------------------------------
+
+    def note_depth(self, weighted_depth: float) -> None:
+        """Feed the weighted in-flight depth (sum of CLASS_WEIGHTS over
+        requests currently in the stream path)."""
+        with self._lock:
+            self._ewma_depth += self.ewma_alpha * (
+                float(weighted_depth) - self._ewma_depth
+            )
+
+    def _windowed_p99(self) -> Optional[float]:
+        """p99 of the stream.epoch observations made since the previous
+        evaluation (bucket-wise delta) — None when nothing new."""
+        cur = self._epoch_hist.state()
+        prev, self._hist_prev = self._hist_prev, cur
+        count = cur["count"] - prev["count"]
+        if count <= 0:
+            return None
+        deltas = [a - b for a, b in zip(cur["buckets"], prev["buckets"])]
+        return metrics._delta_percentile(deltas, count, 0.99)
+
+    def _evaluate_locked(self, now: float) -> None:
+        """Caller holds the lock: recompute pressure + rung (rate
+        limited to ``eval_interval_s``)."""
+        if (
+            self._last_eval is not None
+            and now - self._last_eval < self.eval_interval_s
+        ):
+            return
+        self._last_eval = now
+        p99 = self._windowed_p99()
+        if p99 is not None:
+            self._p99_ms = p99
+        elif self._p99_ms is not None:
+            # No stream.epoch completed since the last evaluation: the
+            # congestion that p99 measured has drained (or the ladder
+            # is rejecting everything that would refresh it) — decay
+            # the stale signal so an all-shed class mix cannot pin the
+            # ladder at its last reading forever (livelock: rejected
+            # requests never produce new epochs).
+            self._p99_ms *= 0.8
+            if self._p99_ms < 1.0:
+                self._p99_ms = None
+        depth_pressure = self._ewma_depth / self.depth_high
+        lat_pressure = (
+            (self._p99_ms / self.latency_budget_ms)
+            if self._p99_ms is not None else 0.0
+        )
+        pressure = max(depth_pressure, lat_pressure)
+        if self._breaker_open():
+            pressure += 1.0
+        self._pressure = pressure
+        target = 0
+        for i, threshold in enumerate(_THRESHOLDS):
+            if pressure >= threshold:
+                target = i + 1
+        if target > self._rung:
+            # Escalation is immediate — the ladder's whole point is to
+            # act before queues melt.
+            self._transition(target, now)
+        elif target < self._rung:
+            # De-escalate one rung per cooldown below threshold.
+            if now - self._last_step_down >= self.cooldown_s:
+                self._transition(self._rung - 1, now)
+        self._m_pressure.set(pressure)
+
+    def _transition(self, rung: int, now: float) -> None:
+        old = self._rung
+        self._rung = rung
+        self._last_step_down = now
+        self._m_rung.set(rung)
+        metrics.FLIGHT.record(
+            "overload_rung",
+            {
+                "from": RUNGS[old],
+                "to": RUNGS[rung],
+                "pressure": round(self._pressure, 3),
+                "ewma_depth": round(self._ewma_depth, 3),
+                "p99_ms": self._p99_ms,
+            },
+        )
+        LOGGER.warning(
+            "overload ladder %s -> %s (pressure %.2f, depth %.2f, "
+            "p99 %s ms)",
+            RUNGS[old], RUNGS[rung], self._pressure, self._ewma_depth,
+            self._p99_ms,
+        )
+
+    # -- decisions ---------------------------------------------------------
+
+    def admission(self, klass: str) -> _Decision:
+        """Decide this request's fate under the current ladder rung.
+
+        Fault point ``shed.decide`` fires here: the SERVICE fails open
+        (admits) when the decision path faults — overload control must
+        never be the thing that takes healthy traffic down."""
+        faults.fire("shed.decide")
+        now = self._clock()
+        with self._lock:
+            self._evaluate_locked(now)
+            rung = self._rung
+            pressure = self._pressure
+        rank = _CLASS_RANK[klass]
+        action = "admit"
+        if rung >= 4 and rank >= 1:
+            action = "reject" if rank >= 2 else "degrade"
+        elif rung >= 3 and rank >= 2:
+            action = "reject"
+        elif rung >= 2 and rank >= 2:
+            action = "degrade"
+        retry_ms = int(min(5000.0, max(100.0, self.cooldown_s * 1000.0
+                                       * max(pressure, 1.0))))
+        return _Decision(action, rung, retry_ms)
+
+    def note_shed(
+        self, klass: str, rung_name: str, served: str,
+        stream_id: Optional[str] = None,
+    ) -> None:
+        """Account one shed event: ``klba_shed_total{class,rung}`` plus
+        a flight record (every shed is visible post-incident) — thin
+        delegate to the module's :func:`record_shed`, the ONE schema
+        every shedding layer shares."""
+        record_shed(klass, rung_name, served, stream_id=stream_id)
+
+    def rung(self) -> int:
+        with self._lock:
+            return self._rung
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The operator's view (wire ``stats`` / ``recommend``)."""
+        with self._lock:
+            return {
+                "rung": RUNGS[self._rung],
+                "rung_index": self._rung,
+                "pressure": round(self._pressure, 4),
+                "ewma_depth": round(self._ewma_depth, 4),
+                "p99_ms": self._p99_ms,
+                "window_scale": _WINDOW_SCALE[self._rung],
+                "latency_budget_ms": self.latency_budget_ms,
+                "depth_high": self.depth_high,
+            }
+
+
+def recommend_consumers(
+    samples: Sequence[Tuple[float, float]],
+    consumers: int,
+    partitions: int,
+    horizon_s: float = 60.0,
+) -> Tuple[int, float]:
+    """Consumer-count recommendation from (time_s, total_lag) samples.
+
+    Projects the backlog ``horizon_s`` ahead at the window's trend and
+    sizes the group so per-consumer backlog stays at today's level:
+    ``ceil(C * projected / now)``.  Monotone non-decreasing in the lag
+    slope (the bench gate); clamped to ``[1, partitions]`` — more
+    consumers than partitions can never help (Kafka semantics).  Fewer
+    than two samples (or a zero-length window) recommend the status
+    quo.  Returns ``(recommended_consumers, slope_lag_per_s)``."""
+    consumers = max(int(consumers), 1)
+    floor_parts = max(int(partitions), 1)
+    if len(samples) < 2:
+        return min(consumers, floor_parts), 0.0
+    t0, l0 = samples[0]
+    t1, l1 = samples[-1]
+    dt = t1 - t0
+    if dt <= 0:
+        return min(consumers, floor_parts), 0.0
+    slope = (float(l1) - float(l0)) / dt
+    lag_now = max(float(l1), 1.0)
+    growth = max(0.0, slope) * horizon_s / lag_now
+    rec = math.ceil(consumers * (1.0 + growth))
+    return min(max(rec, 1), floor_parts), slope
+
+
+def recommend_payload(
+    streams: Mapping[str, Dict[str, Any]],
+    overload: Dict[str, Any],
+    horizon_s: float = 60.0,
+) -> Dict[str, Any]:
+    """Assemble the wire ``recommend`` result: per-stream entries (each
+    holding ``samples`` [(t, lag), ...] oldest-first, ``consumers``,
+    ``partitions``, ``slo_class``) plus the overload snapshot.  Once
+    the ladder is actively degrading (rung >= 2) every stream's floor
+    is ``C + 1`` — the detector is saying capacity, not drift."""
+    degrading = overload.get("rung_index", 0) >= 2
+    out: Dict[str, Any] = {"overload": overload, "streams": {}}
+    for sid, info in streams.items():
+        C = int(info["consumers"])
+        P = int(info["partitions"])
+        rec, slope = recommend_consumers(
+            info["samples"], C, P, horizon_s=horizon_s
+        )
+        if degrading:
+            rec = min(max(rec, C + 1), max(P, 1))
+        out["streams"][sid] = {
+            "slo_class": info["slo_class"],
+            "consumers": C,
+            "partitions": P,
+            "recommended_consumers": rec,
+            "lag_trend_per_s": round(slope, 3),
+            "total_lag": int(info["samples"][-1][1])
+            if info["samples"] else 0,
+            "samples": len(info["samples"]),
+            "horizon_s": horizon_s,
+        }
+    return out

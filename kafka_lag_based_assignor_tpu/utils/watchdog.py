@@ -298,6 +298,7 @@ class Watchdog:
         *args: Any,
         key: str = "device",
         timeout_s: Any = _UNSET,
+        budget_total_s: Optional[float] = None,
         **kwargs: Any,
     ) -> T:
         """Run ``fn`` under the deadline with ``key``'s breaker.
@@ -308,6 +309,13 @@ class Watchdog:
         charging the breaker — an exhausted budget is the request's
         fault, not the device's.  With an effective deadline of None the
         call runs inline (watchdog disabled).
+
+        ``budget_total_s`` is the request's INITIAL deadline budget when
+        it is smaller than the configured timeout (a per-class SLO
+        budget, utils/overload): the timeout-truncation test then
+        compares against the request's own full window, so a first-rung
+        hang under a 2 s class budget still charges the breaker instead
+        of reading as a residual-ladder truncation forever.
         """
         effective = self.timeout_s if timeout_s is _UNSET else timeout_s
         if effective is None:
@@ -354,13 +362,20 @@ class Watchdog:
             if not done.wait(effective):
                 metrics.REGISTRY.counter(_TIMEOUTS, {"key": key}).inc()
                 # "Truncated" = the ladder handed the device a residual
-                # budget well below the configured window.  The 0.9
-                # factor absorbs the request-validation time between
-                # budget creation and rung 1 (microseconds-to-ms), so a
-                # first-rung hang still trips at ~the full deadline.
+                # budget well below the request's full window — the
+                # configured timeout, or the caller's (smaller) initial
+                # deadline budget when a per-class SLO budget capped it.
+                # The 0.9 factor absorbs the request-validation time
+                # between budget creation and rung 1 (microseconds-to-
+                # ms), so a first-rung hang still trips at ~the full
+                # deadline.
+                window = self.timeout_s
+                if budget_total_s is not None and (
+                    window is None or budget_total_s < window
+                ):
+                    window = budget_total_s
                 truncated = (
-                    self.timeout_s is not None
-                    and effective < self.timeout_s * 0.9
+                    window is not None and effective < window * 0.9
                 )
                 self._on_timeout(key, probing, truncated)
                 settled = True
@@ -372,13 +387,29 @@ class Watchdog:
                 )
                 raise SolveTimeout(f"{key!r} call exceeded {effective}s")
             exc = outcome.get("exc")
-            metrics.REGISTRY.histogram(_SOLVE_MS, {"key": key}).observe(
-                (self._clock() - started) * 1000.0
-            )
+            if not isinstance(exc, SolveRejected):
+                # A shed parked for its whole class budget before the
+                # rejection surfaced — observing it here would turn the
+                # solver-latency p99 into park-until-shed time under
+                # sustained overload, so only genuine solve attempts
+                # feed the series.
+                metrics.REGISTRY.histogram(_SOLVE_MS, {"key": key}).observe(
+                    (self._clock() - started) * 1000.0
+                )
             if exc is None:
                 self._on_success(key)
                 settled = True
                 return outcome["value"]
+            if isinstance(exc, SolveRejected):
+                # A nested fail-fast rejection surfaced THROUGH the
+                # worker (e.g. the coalescer shedding a parked epoch
+                # whose SLO deadline expired — ops/coalesce
+                # DeadlineShed): the device was never touched, so the
+                # breaker must not be charged — an overload shed is the
+                # request's fate, not the solver's failure.  The
+                # half-open probe slot (if any) is released by the
+                # not-settled finally below.
+                raise exc
             if isinstance(exc, Exception):
                 self._on_exception(key, probing)
                 settled = True

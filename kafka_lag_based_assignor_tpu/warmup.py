@@ -214,6 +214,16 @@ def warmup(
                         coal = MegabatchCoalescer(
                             window_s=2.0, max_batch=n, lock_waves=1
                         )
+                        # Mixed SLO placement (utils/overload): the
+                        # warm-up waves submit under alternating
+                        # classes with far-future deadlines, so the
+                        # deadline-ordered flush path (class-rank sort
+                        # + deadline triage) runs here too — host-side
+                        # code, but the one wave shape production
+                        # serves must be the one warm-up drove.
+                        from .utils.metrics import REGISTRY
+                        from .utils.overload import SLO_CLASSES, class_rank
+
                         out = None
                         try:
                             for _wave in range(2):
@@ -225,17 +235,27 @@ def warmup(
                                 ]
                                 errs = []
 
-                                def run(eng, arr):
+                                def run(eng, arr, i=0):
+                                    klass = SLO_CLASSES[i % len(SLO_CLASSES)]
                                     try:
-                                        eng.submit_epoch(arr, coal)
+                                        eng.submit_epoch(
+                                            arr, coal,
+                                            slo_class=klass,
+                                            rank=class_rank(klass),
+                                            deadline_at=(
+                                                REGISTRY.clock() + 600.0
+                                            ),
+                                        )
                                     except Exception as exc:  # noqa: L011
                                         errs.append(exc)  # re-raised below
 
                                 threads = [
                                     threading.Thread(
-                                        target=run, args=(eng, arr)
+                                        target=run, args=(eng, arr, i)
                                     )
-                                    for eng, arr in zip(engines, arrs)
+                                    for i, (eng, arr) in enumerate(
+                                        zip(engines, arrs)
+                                    )
                                 ]
                                 for t in threads:
                                     t.start()

@@ -3,11 +3,20 @@
 Multi-chip hardware is not available in CI; sharding tests run on a virtual
 8-device CPU mesh (jax.sharding.Mesh over forced host devices).  int64 lags
 require x64 mode (SURVEY §7 step 2).
+
+Multi-device is a TESTED backend (ROADMAP): the early-env guard below
+must run before anything imports jax, so ``tests/test_parallel.py``'s
+``jax.shard_map`` meshes exist on plain CPU.  If the flag loses the race
+anyway (an externally-pinned XLA_FLAGS, a jax already initialized by a
+plugin), the collection hook degrades those tests to an explicit SKIP
+with the reason — never a raw "environmental" failure.
 """
 
 import os
 
-# XLA_FLAGS must be set before the backend initializes.
+# XLA_FLAGS must be set before the backend initializes.  We force 8
+# devices so every mesh shape in test_parallel.py (8x1 ... 1x8) is
+# constructible — the suite asserts exactly 8.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -21,3 +30,24 @@ import jax  # noqa: E402
 # override the same way — config.update before any backend touch wins.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
+def pytest_collection_modifyitems(config, items):
+    """Guard the multi-device suite: when the forced host platform did
+    not take (fewer than 8 devices visible — every mesh shape in
+    test_parallel.py needs the full 8), skip test_parallel.py with the
+    actionable reason instead of failing as 'environmental'."""
+    import pytest
+
+    if len(jax.devices()) >= 8:
+        return
+    skip = pytest.mark.skip(
+        reason=(
+            "multi-device CPU platform unavailable "
+            f"({len(jax.devices())} device(s)); set XLA_FLAGS="
+            "--xla_force_host_platform_device_count=8 before jax init"
+        )
+    )
+    for item in items:
+        if "test_parallel" in str(item.fspath):
+            item.add_marker(skip)

@@ -222,7 +222,7 @@ def test_max_batch_flush_fires_before_window():
     rng = np.random.default_rng(42)
     G, P = 2, 512
     co = _engines(G)
-    coal = MegabatchCoalescer(window_s=5.0, max_batch=G)
+    coal = MegabatchCoalescer(window_s=30.0, max_batch=G)
     try:
         lags = [_int32_lags(rng, P) for _ in range(G)]
         for g in range(G):
@@ -231,7 +231,10 @@ def test_max_batch_flush_fires_before_window():
         _submit_all(co, [_int32_lags(rng, P) for _ in range(G)], coal)
         t0 = time.monotonic()
         _submit_all(co, [_int32_lags(rng, P) for _ in range(G)], coal)
-        assert time.monotonic() - t0 < 2.5, (
+        # Far below the 30 s window, with headroom for a loaded CI box
+        # — the assertion is "did not wait out the window", not a
+        # latency benchmark.
+        assert time.monotonic() - t0 < 10.0, (
             "full batch waited out the admission window"
         )
     finally:

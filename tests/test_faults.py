@@ -24,12 +24,16 @@ from kafka_lag_based_assignor_tpu.service import (
     AssignorService,
     AssignorServiceClient,
 )
-from kafka_lag_based_assignor_tpu.testing import FakeBroker
+from kafka_lag_based_assignor_tpu.testing import (
+    FakeBroker,
+    assert_valid_assignment,
+)
 from kafka_lag_based_assignor_tpu.types import (
     GroupSubscription,
     Subscription,
 )
 from kafka_lag_based_assignor_tpu.utils import faults
+from kafka_lag_based_assignor_tpu.utils.overload import ShedReject
 
 
 @pytest.fixture(autouse=True)
@@ -53,15 +57,6 @@ def service():
 
 def client_for(svc):
     return AssignorServiceClient(*svc.address)
-
-
-def assert_valid_assignment(assignments, expect_partitions):
-    """Count-balanced (max - min <= 1), complete, no duplicates."""
-    sizes = [len(v) for v in assignments.values()]
-    got = [tuple(tp) for tps in assignments.values() for tp in tps]
-    assert sorted(got) == sorted(set(got)), "duplicate partitions"
-    assert len(got) == expect_partitions, (len(got), expect_partitions)
-    assert max(sizes) - min(sizes) <= 1, sizes
 
 
 # -- FaultInjector unit behavior -----------------------------------------
@@ -621,3 +616,72 @@ def test_chaos_soak_random_schedule_bounded_p99():
     # not the common case.
     assert p99 < 4.0, f"p99 {p99:.2f}s over {len(latencies)} requests"
     assert wire_kills < len(latencies) // 2
+
+    # -- mixed-class stampede phase (ISSUE 6): a fresh service whose
+    # overload detector trips on the first wave, six streams across the
+    # three SLO classes, seeded faults still firing.  Invariants: every
+    # SERVED assignment is count-balanced, and shedding only ever lands
+    # on the lowest live classes — critical is never shed.
+    from kafka_lag_based_assignor_tpu.testing import (
+        shed_totals_by_class as shed_by_class,
+    )
+
+    shed_before = shed_by_class()
+    classes = {
+        "st-crit-0": "critical", "st-crit-1": "critical",
+        "st-std-0": "standard", "st-std-1": "standard",
+        "st-be-0": "best_effort", "st-be-1": "best_effort",
+    }
+    with AssignorService(
+        port=0, solve_timeout_s=5.0, breaker_cooldown_s=0.5,
+        overload_depth_high=0.05, coalesce_window_ms=2.0,
+        slo_classes=classes,
+    ) as svc:
+        svc._overload.eval_interval_s = 0.0
+        c = client_for(svc)
+        served = rejected = 0
+        base = (np.arange(96) + 1) * 40
+        for wave in range(12):
+            inj = faults.FaultInjector(seed=rng.randrange(2**31))
+            for point in ("stream.refine", "coalesce.flush",
+                          "admit.park", "shed.decide"):
+                if rng.random() < 0.25:
+                    inj.plan(point, mode="raise",
+                             times=rng.randrange(1, 3))
+            drift = base + np.asarray(
+                [rng.randrange(0, 20000) for _ in range(96)]
+            )
+            with faults.injected(inj):
+                for sid, klass in classes.items():
+                    try:
+                        r = c.stream_assign(
+                            sid, "t0",
+                            [[i, int(v)] for i, v in enumerate(drift)],
+                            ["A", "B", "C"],
+                        )
+                    except (ConnectionError, OSError):
+                        c.close()
+                        c = client_for(svc)
+                        continue
+                    except RuntimeError as exc:
+                        # A shed reject (or an injected fault surfaced
+                        # loudly) — never a silent wrong answer.
+                        rejected += 1
+                        if isinstance(exc, ShedReject):
+                            assert klass != "critical", (sid, exc)
+                            assert exc.retry_after_ms > 0
+                        continue
+                    served += 1
+                    assert_valid_assignment(r["assignments"], 96)
+                    shed = r["stream"].get("shed")
+                    if shed is not None:
+                        assert klass != "critical", (sid, shed)
+        c.close()
+    assert served > 0
+    shed_delta = {
+        k: v - shed_before.get(k, 0) for k, v in shed_by_class().items()
+    }
+    assert shed_delta.get("critical", 0) == 0, shed_delta
+    # The detector was pinned deep into the ladder: the lowest class
+    # must actually have been shed, and never ONLY the middle one.
+    assert shed_delta.get("best_effort", 0) > 0, shed_delta

@@ -177,3 +177,54 @@ def test_lint_direct_clock_calls_in_package():
         "time.perf_counter()", "time.perf_counter()  # noqa: L012"
     )
     assert not any(f.code == "L012" for f in lint.lint_source(pkg, waived))
+
+
+def test_lint_unbounded_buffers_in_package():
+    """L014: queues/deques/list buffers in package code must carry an
+    explicit bound — overload paths exist because buffers fill, so an
+    unbounded one under backpressure IS the outage."""
+    pkg = Path("kafka_lag_based_assignor_tpu/x.py")
+    bad = (
+        "import queue\n"
+        "from collections import deque\n"
+        "class X:\n"
+        "    def __init__(self):\n"
+        "        self.buf = []\n"
+        "        self.q = queue.Queue()\n"
+        "        self.d = deque()\n"
+        "    def go(self):\n"
+        "        self.buf.append(1)\n"
+    )
+    codes = [f.code for f in lint.lint_source(pkg, bad)]
+    assert codes.count("L014") == 3, codes
+    # Bounded constructors and trimmed list buffers pass.
+    ok = (
+        "import queue\n"
+        "from collections import deque\n"
+        "class X:\n"
+        "    def __init__(self):\n"
+        "        self.buf = []\n"
+        "        self.q = queue.Queue(maxsize=2)\n"
+        "        self.d = deque(maxlen=8)\n"
+        "    def go(self):\n"
+        "        self.buf.append(1)\n"
+        "        del self.buf[:-4]\n"
+    )
+    assert not any(f.code == "L014" for f in lint.lint_source(pkg, ok))
+    # A re-slice assignment also counts as a visible trim.
+    resliced = ok.replace("del self.buf[:-4]", "self.buf = self.buf[-4:]")
+    assert not any(
+        f.code == "L014" for f in lint.lint_source(pkg, resliced)
+    )
+    # maxsize=0 is queue-speak for unbounded; a waiver silences.
+    zero = ok.replace("queue.Queue(maxsize=2)", "queue.Queue(maxsize=0)")
+    assert any(f.code == "L014" for f in lint.lint_source(pkg, zero))
+    waived = bad.replace(
+        "self.q = queue.Queue()",
+        "self.q = queue.Queue()  # noqa: L014",
+    )
+    assert [f.code for f in lint.lint_source(pkg, waived)].count("L014") == 2
+    # Tests/tools/bench scaffolding is out of scope.
+    assert not any(
+        f.code == "L014" for f in lint.lint_source(Path("tests/x.py"), bad)
+    )

@@ -1,0 +1,810 @@
+"""Closed-loop overload control (utils/overload + service + coalescer):
+SLO classes, the shed ladder, deadline-aware megabatch admission, and
+the ``{"method": "recommend"}`` elasticity loop.
+
+The invariant family under test: shedding only ever lands on the lowest
+live class first, every served assignment stays count-balanced, a shed
+never destroys warm state or charges a breaker, and the recommendation
+is monotone in the lag trend.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from kafka_lag_based_assignor_tpu.ops.coalesce import (
+    DeadlineReroute,
+    DeadlineShed,
+    EpochSubmission,
+    MegabatchCoalescer,
+)
+from kafka_lag_based_assignor_tpu.ops.streaming import StreamingAssignor
+from kafka_lag_based_assignor_tpu.testing import assert_valid_assignment
+from kafka_lag_based_assignor_tpu.service import (
+    AssignorService,
+    AssignorServiceClient,
+)
+from kafka_lag_based_assignor_tpu.utils import faults, metrics
+from kafka_lag_based_assignor_tpu.utils.config import parse_config
+from kafka_lag_based_assignor_tpu.utils.overload import (
+    OverloadController,
+    ShedReject,
+    SloPolicy,
+    class_rank,
+    recommend_consumers,
+    recommend_payload,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    yield
+    faults.deactivate()
+
+
+def _shed_counts():
+    """Current klba_shed_total value per (class, rung) label pair."""
+    return {
+        (c.labels.get("class"), c.labels.get("rung")): c.value
+        for c in metrics.REGISTRY.series("klba_shed_total")
+    }
+
+
+def _shed_delta(before, by_class=None):
+    after = _shed_counts()
+    delta = {}
+    for key, value in after.items():
+        d = value - before.get(key, 0)
+        if d:
+            delta[key] = d
+    if by_class is not None:
+        return sum(
+            v for (klass, _), v in delta.items() if klass == by_class
+        )
+    return delta
+
+
+# -- SloPolicy ------------------------------------------------------------
+
+
+def test_slo_policy_resolution_and_budget():
+    pol = SloPolicy(
+        classes={"orders": "critical", "logs": "best_effort"},
+        deadline_s={"critical": 2.0, "best_effort": 30.0},
+    )
+    assert pol.resolve("orders") == "critical"
+    assert pol.resolve("logs") == "best_effort"
+    assert pol.resolve("anything-else") == "standard"
+    # The wire override wins over the config map.
+    assert pol.resolve("orders", "best_effort") == "best_effort"
+    # Class budget caps BELOW the global timeout, never extends it.
+    assert pol.budget_s("critical", 120.0) == 2.0
+    assert pol.budget_s("critical", 1.0) == 1.0
+    assert pol.budget_s("standard", 120.0) == 120.0
+    assert pol.budget_s("critical", None) == 2.0
+
+
+def test_slo_policy_rejects_unknown_classes():
+    with pytest.raises(ValueError, match="unknown SLO class"):
+        SloPolicy(classes={"x": "ultra"})
+    with pytest.raises(ValueError, match="unknown SLO class"):
+        SloPolicy(deadline_s={"ultra": 1.0})
+    with pytest.raises(ValueError, match="must be > 0"):
+        SloPolicy(deadline_s={"critical": 0.0})
+    pol = SloPolicy()
+    with pytest.raises(ValueError, match="unknown slo_class"):
+        pol.resolve("s", "ultra")
+
+
+def test_config_parses_slo_and_overload_keys():
+    cfg = parse_config({
+        "group.id": "g",
+        "tpu.assignor.slo.class.orders": "critical",
+        "tpu.assignor.slo.class.logs": "best_effort",
+        "tpu.assignor.slo.deadline.ms.critical": "2500",
+        "tpu.assignor.overload.latency.budget.ms": "400",
+        "tpu.assignor.overload.depth.high": "12",
+    })
+    assert cfg.slo_classes == {"orders": "critical", "logs": "best_effort"}
+    assert cfg.slo_deadline_s == {"critical": 2.5}
+    assert cfg.overload_latency_budget_ms == 400.0
+    assert cfg.overload_depth_high == 12.0
+    with pytest.raises(ValueError, match="invalid"):
+        parse_config({
+            "group.id": "g", "tpu.assignor.slo.class.x": "ultra",
+        })
+    with pytest.raises(ValueError, match="unknown class"):
+        parse_config({
+            "group.id": "g", "tpu.assignor.slo.deadline.ms.ultra": "5",
+        })
+
+
+# -- OverloadController ---------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def _controller(**kw):
+    clock = FakeClock()
+    kw.setdefault("latency_budget_ms", 100.0)
+    kw.setdefault("depth_high", 4.0)
+    kw.setdefault("cooldown_s", 1.0)
+    kw.setdefault("eval_interval_s", 0.0)
+    ctl = OverloadController(clock=clock, **kw)
+    return ctl, clock
+
+
+def test_controller_walks_the_ladder_on_depth_pressure():
+    ctl, clock = _controller()
+    assert ctl.admission("standard").action == "admit"
+    assert ctl.rung() == 0
+    # Drive the depth EWMA up: pressure = ewma / depth_high.
+    for _ in range(30):
+        ctl.note_depth(40.0)  # ewma -> 40, pressure -> 10
+    clock.t += 0.01
+    d = ctl.admission("best_effort")
+    assert ctl.rung() == 4
+    assert d.action == "reject"
+    assert d.retry_after_ms >= 100
+    # Class ordering at the deepest rung: standard degrades (served
+    # kept_previous), critical is NEVER shed.
+    assert ctl.admission("standard").action == "degrade"
+    assert ctl.admission("critical").action == "admit"
+
+
+def test_controller_rung_actions_by_class():
+    ctl, clock = _controller()
+    # pressure just past each threshold; re-evaluate each step.
+    for target_pressure, rung in ((1.1, 1), (1.6, 2), (2.6, 3), (4.1, 4)):
+        ctl._ewma_depth = target_pressure * ctl.depth_high
+        clock.t += 0.01
+        d_be = ctl.admission("best_effort")
+        d_std = ctl.admission("standard")
+        d_crit = ctl.admission("critical")
+        assert ctl.rung() == rung, (target_pressure, ctl.rung())
+        assert d_crit.action == "admit"
+        if rung == 1:
+            assert d_be.action == "admit" and d_std.action == "admit"
+            assert d_be.window_scale < 1.0
+        elif rung == 2:
+            assert d_be.action == "degrade" and d_std.action == "admit"
+        elif rung == 3:
+            assert d_be.action == "reject" and d_std.action == "admit"
+        else:
+            assert d_be.action == "reject" and d_std.action == "degrade"
+
+
+def test_controller_deescalates_one_rung_per_cooldown():
+    ctl, clock = _controller()
+    ctl._ewma_depth = 100.0  # pressure 25 -> rung 4
+    ctl.admission("standard")
+    assert ctl.rung() == 4
+    ctl._ewma_depth = 0.0  # pressure gone
+    # Immediately after: still rung 4 (escalation was the last step).
+    clock.t += 0.01
+    ctl.admission("standard")
+    assert ctl.rung() == 4
+    for expect in (3, 2, 1, 0):
+        clock.t += 1.1  # one cooldown per step down
+        ctl.admission("standard")
+        assert ctl.rung() == expect
+    # And it stays down.
+    clock.t += 5.0
+    assert ctl.admission("best_effort").action == "admit"
+
+
+def test_controller_stale_p99_decays_without_new_epochs():
+    """Livelock regression: a latency spike that tripped the ladder
+    must DECAY once no new epochs run (an all-shed class mix produces
+    no fresh stream.epoch samples — the stale p99 must not pin the
+    rung at its last reading forever)."""
+    ctl, clock = _controller()  # latency_budget_ms=100
+    hist = metrics.REGISTRY.histogram(
+        "klba_span_duration_ms", {"span": "stream.epoch"}
+    )
+    for _ in range(50):
+        hist.observe(2000.0)  # p99 ~ 2048 ms >> 100 ms budget
+    clock.t += 0.01
+    ctl.admission("best_effort")
+    assert ctl.rung() == 4
+    # No new epochs from here on; only evaluations.  The p99 decays
+    # 0.8x per evaluation, and the rung steps down once per cooldown.
+    for _ in range(60):
+        clock.t += 1.1
+        ctl.admission("best_effort")
+        if ctl.rung() == 0:
+            break
+    assert ctl.rung() == 0
+    assert ctl.admission("best_effort").action == "admit"
+
+
+def test_service_reject_storm_deescalates():
+    """Livelock regression at the service layer: with ONLY best_effort
+    tenants, a depth stampede that reaches reject_best_effort must
+    still de-escalate — every arrival (rejected or not) now feeds the
+    true in-flight depth, so the EWMA decays and the ladder walks back
+    down instead of rejecting forever."""
+    import time as _time
+
+    from kafka_lag_based_assignor_tpu.service import AssignorService
+
+    with AssignorService(
+        port=0, solve_timeout_s=30.0,
+        slo_classes={"be": "best_effort"},
+        overload_depth_high=3.0, overload_cooldown_s=0.05,
+    ) as svc:
+        svc._overload.eval_interval_s = 0.0
+        lags = [[i, (i + 1) * 100] for i in range(64)]
+        params = {"stream_id": "be", "topic": "t0", "lags": lags,
+                  "members": ["A", "B"]}
+        # Warm under a standard override, then storm the EWMA up.
+        # depth_high stays ABOVE one request's weight so a lone served
+        # request cannot re-trip the ladder by itself.
+        r = _wire(svc, "stream_assign",
+                  {**params, "slo_class": "standard"})
+        assert "result" in r, r
+        for _ in range(10):
+            svc._overload.note_depth(30.0)  # ewma ~29, pressure ~10
+        rejected = fully_served = 0
+        for _ in range(300):
+            r = _wire(svc, "stream_assign", dict(params))
+            if "error" in r:
+                assert "shed" in r["error"], r
+                rejected += 1
+                _time.sleep(0.01)
+                continue
+            if r["result"]["stream"]["shed"] is None:
+                fully_served = 1  # ladder walked all the way back down
+                break
+            _time.sleep(0.01)  # degrade rung: served, keep stepping down
+    assert rejected > 0, "storm never engaged the reject rung"
+    assert fully_served == 1, (
+        f"best_effort never recovered after {rejected} rejects (livelock)"
+    )
+
+
+def test_controller_breaker_open_adds_pressure():
+    flag = [False]
+    ctl, clock = _controller(breaker_open=lambda: flag[0])
+    ctl.admission("standard")
+    assert ctl.rung() == 0
+    flag[0] = True
+    clock.t += 0.01
+    ctl.admission("standard")
+    # +1.0 pressure alone = rung 1: shrink the window, shed nothing.
+    assert ctl.rung() == 1
+
+
+def test_controller_sheds_are_counted_and_recorded():
+    ctl, _ = _controller()
+    before = _shed_counts()
+    ctl.note_shed("best_effort", "reject_best_effort", "rejected",
+                  stream_id="s1")
+    delta = _shed_delta(before)
+    assert delta == {("best_effort", "reject_best_effort"): 1}
+    recs = [r for r in metrics.FLIGHT.records() if r.get("kind") == "shed"]
+    assert recs and recs[-1]["class"] == "best_effort"
+
+
+def test_shed_decide_fault_point_fires_in_admission():
+    ctl, _ = _controller()
+    inj = faults.FaultInjector().plan("shed.decide", times=1)
+    with faults.injected(inj):
+        with pytest.raises(faults.FaultError):
+            ctl.admission("standard")
+        ctl.admission("standard")  # next call passes
+    assert inj.fired("shed.decide") == 1
+
+
+# -- recommend math -------------------------------------------------------
+
+
+def test_recommend_monotone_in_lag_trend():
+    base = [(0.0, 1000.0), (30.0, 1000.0)]
+    flat, slope0 = recommend_consumers(base, consumers=4, partitions=64)
+    assert flat == 4 and slope0 == 0.0
+    recs = []
+    for rise in (10.0, 50.0, 200.0, 1000.0):
+        samples = [(0.0, 1000.0), (30.0, 1000.0 + rise * 30.0)]
+        rec, slope = recommend_consumers(samples, 4, 64)
+        assert slope == pytest.approx(rise)
+        recs.append(rec)
+    assert recs == sorted(recs), recs  # monotone in the trend
+    assert recs[0] >= 4 and recs[-1] > recs[0]
+    # Clamped to the partition count — more consumers never help.
+    rec, _ = recommend_consumers(
+        [(0.0, 10.0), (1.0, 10**9)], consumers=4, partitions=8
+    )
+    assert rec == 8
+
+
+def test_recommend_edge_cases():
+    assert recommend_consumers([], 3, 100) == (3, 0.0)
+    assert recommend_consumers([(0.0, 5.0)], 3, 100) == (3, 0.0)
+    # Zero-length window; falling lag never scales up.
+    assert recommend_consumers([(1.0, 5.0), (1.0, 9.0)], 3, 100)[0] == 3
+    rec, slope = recommend_consumers(
+        [(0.0, 10**6), (60.0, 10.0)], 3, 100
+    )
+    assert rec == 3 and slope < 0
+    # C > P clamps down to P.
+    assert recommend_consumers([], 16, 4) == (4, 0.0)
+
+
+def test_recommend_payload_overload_floor():
+    streams = {
+        "s": {
+            "slo_class": "standard", "consumers": 3, "partitions": 32,
+            "samples": [(0.0, 100.0), (10.0, 100.0)],
+        }
+    }
+    calm = recommend_payload(streams, {"rung_index": 0, "rung": "none"})
+    assert calm["streams"]["s"]["recommended_consumers"] == 3
+    hot = recommend_payload(
+        streams, {"rung_index": 2, "rung": "degrade_best_effort"}
+    )
+    # A degrading ladder is a capacity signal: floor C + 1.
+    assert hot["streams"]["s"]["recommended_consumers"] == 4
+
+
+# -- coalescer: SLO placement + deadline triage ---------------------------
+
+
+def _warm_engine(C=8, P=256, seed=0):
+    rng = np.random.default_rng(seed)
+    lags = rng.integers(1, 10**6, size=P).astype(np.int64)
+    eng = StreamingAssignor(
+        num_consumers=C, refine_iters=16, refine_threshold=None
+    )
+    eng.rebalance(lags)
+    return eng, lags
+
+
+def _sub(eng, lags, klass="standard", deadline_at=None):
+    return EpochSubmission(
+        payload=lags, bucket=eng._bucket(lags.shape[0]),
+        resident=eng._resident, limit=-1.0,
+        num_consumers=eng.num_consumers, iters=eng.refine_iters,
+        max_pairs=4, exchange_budget=eng.refine_iters,
+        owner=eng, klass=klass, rank=class_rank(klass),
+        deadline_at=deadline_at,
+    )
+
+
+def test_flush_places_critical_before_best_effort():
+    """With max_batch=2 and four parked rows (two best_effort arriving
+    FIRST, then a critical and a standard), the (rank, deadline) sort
+    must cut the first chunk as [critical, standard] — a critical
+    stream never parks behind a full best-effort wave."""
+    pairs = [_warm_engine(seed=i) for i in range(4)]
+    engines = [p[0] for p in pairs]
+    lags = [p[1] for p in pairs]
+    coal = MegabatchCoalescer(window_s=0.0, max_batch=2, pipeline=False)
+    subs = [
+        _sub(engines[0], lags[0], "best_effort"),
+        _sub(engines[1], lags[1], "best_effort"),
+        _sub(engines[2], lags[2], "critical"),
+        _sub(engines[3], lags[3], "standard"),
+    ]
+    try:
+        coal._flush(list(subs))
+    finally:
+        coal.close()
+    for s in subs:
+        s.future.result(timeout=60)
+    # The flush's two waves are the NEWEST coalesce_flush records; take
+    # them by filtering, not by index — the global ring may already
+    # have wrapped during a full suite run, which shifts indices.
+    waves = [
+        r["classes"] for r in metrics.FLIGHT.records()
+        if r.get("kind") == "coalesce_flush"
+    ][-2:]
+    assert waves[0] == ["critical", "standard"], waves
+    assert waves[1] == ["best_effort", "best_effort"], waves
+
+
+def test_expired_deadline_row_is_shed_not_dispatched():
+    eng, lags = _warm_engine(seed=7)
+    peer_eng, peer_lags = _warm_engine(seed=8)
+    coal = MegabatchCoalescer(window_s=0.0, max_batch=4, pipeline=False)
+    now = metrics.REGISTRY.clock()
+    expired = _sub(eng, lags, "best_effort", deadline_at=now - 1.0)
+    live = _sub(peer_eng, peer_lags, "critical", deadline_at=now + 60.0)
+    before = _shed_counts()
+    try:
+        coal._flush([expired, live])
+    finally:
+        coal.close()
+    with pytest.raises(DeadlineShed):
+        expired.future.result(timeout=60)
+    live.future.result(timeout=60)  # the batchmate is unharmed
+    assert _shed_delta(before) == {("best_effort", "admit_deadline"): 1}
+
+
+def test_tight_deadline_row_reroutes_inline():
+    """A row whose remaining budget is below the measured flush cost is
+    handed back to its submitter via the DeadlineReroute marker (the
+    flusher thread stays admission-only — it must not run the laggard's
+    inline dispatch serially), while the roomy batchmate is served by
+    the wave."""
+    eng, lags = _warm_engine(seed=9)
+    peer_eng, peer_lags = _warm_engine(seed=10)
+    coal = MegabatchCoalescer(window_s=0.0, max_batch=4, pipeline=False)
+    coal._flush_cost_s = 30.0  # pretend flushes are very expensive
+    now = metrics.REGISTRY.clock()
+    tight = _sub(eng, lags, "critical", deadline_at=now + 1.0)
+    roomy = _sub(peer_eng, peer_lags, "standard", deadline_at=now + 600.0)
+    reroutes = metrics.REGISTRY.counter(
+        "klba_coalesce_deadline_reroutes_total"
+    )
+    n0 = reroutes.value
+    try:
+        coal._flush([tight, roomy])
+    finally:
+        coal.close()
+    with pytest.raises(DeadlineReroute):
+        tight.future.result(timeout=60)
+    roomy.future.result(timeout=60)
+    assert reroutes.value == n0 + 1
+
+
+def test_rerouted_laggard_served_inline_by_submitter():
+    """End to end through submit_epoch: the submitter's own thread
+    catches the reroute marker and serves the epoch via the inline
+    single-stream executable — the answer is bit-identical to a
+    reference engine's inline dispatch, and the marker never escapes."""
+    rng = np.random.default_rng(11)
+    P, C = 256, 8
+    lags0 = rng.integers(1, 10**6, size=P).astype(np.int64)
+    eng = StreamingAssignor(
+        num_consumers=C, refine_iters=16, refine_threshold=None
+    )
+    ref = StreamingAssignor(
+        num_consumers=C, refine_iters=16, refine_threshold=None
+    )
+    np.testing.assert_array_equal(eng.rebalance(lags0), ref.rebalance(lags0))
+    coal = MegabatchCoalescer(window_s=0.005, max_batch=4)
+    coal._flush_cost_s = 30.0  # every deadline is tighter than a flush
+    reroutes = metrics.REGISTRY.counter(
+        "klba_coalesce_deadline_reroutes_total"
+    )
+    n0 = reroutes.value
+    lags1 = rng.integers(1, 10**6, size=P).astype(np.int64)
+    try:
+        choice = eng.submit_epoch(
+            lags1, coal, slo_class="critical", rank=class_rank("critical"),
+            deadline_at=metrics.REGISTRY.clock() + 1.0,
+        )
+    finally:
+        coal.close()
+    assert reroutes.value == n0 + 1
+    np.testing.assert_array_equal(choice, ref.rebalance(lags1))
+    assert eng.last_stats.refined
+
+
+def test_flush_cost_ewma_excludes_compile_flushes():
+    """A flush that compiled a fresh executable never feeds the
+    deadline-triage EWMA: folding a ~40 s compile into a millisecond
+    regime would reroute every tight-budget (critical) row to the
+    serial inline path for the ~10 waves the EWMA needs to decay."""
+    from kafka_lag_based_assignor_tpu.utils import observability
+
+    coal = MegabatchCoalescer(window_s=0.0, max_batch=4, pipeline=False)
+    try:
+        t = [100.0]
+        coal._clock = lambda: t[0]
+        n = observability.compile_count()
+        # Compile-free flush: the 10 ms sample folds in at alpha 0.3.
+        t[0] = 100.01
+        coal._note_flush_cost(100.0, n)
+        assert coal._flush_cost_s == pytest.approx(0.3 * 0.01)
+        before = coal._flush_cost_s
+        # A flush during which the compile counter moved is excluded —
+        # its 40 s wall time carries no steady-state prediction.
+        t[0] = 140.0
+        coal._note_flush_cost(100.0, n - 1)
+        assert coal._flush_cost_s == before
+    finally:
+        coal.close()
+
+
+def test_window_scale_clamps():
+    coal = MegabatchCoalescer(window_s=0.001, max_batch=4)
+    try:
+        coal.set_window_scale(0.0)
+        assert coal._window_scale == 0.05
+        coal.set_window_scale(5.0)
+        assert coal._window_scale == 1.0
+        coal.set_window_scale(0.5)
+        assert coal._window_scale == 0.5
+    finally:
+        coal.close()
+
+
+# -- service end-to-end ---------------------------------------------------
+
+
+def _rows(arr):
+    return [[i, int(v)] for i, v in enumerate(arr)]
+
+
+@pytest.fixture()
+def hot_service():
+    """A service whose overload detector trips to the deepest rung on
+    the very first request (depth_high far below one request's weight),
+    so the shed ladder is observable without a real stampede."""
+    with AssignorService(
+        port=0, solve_timeout_s=60.0, breaker_cooldown_s=0.2,
+        overload_depth_high=0.01,
+    ) as svc:
+        svc._overload.eval_interval_s = 0.0  # evaluate on every request
+        yield svc
+
+
+def _wire(svc, method, params):
+    """Drive handle_line directly: unlike the client, this exposes the
+    raw error envelope (the structured shed object)."""
+    line = json.dumps({"id": 1, "method": method, "params": params})
+    return json.loads(svc.handle_line(line.encode()))
+
+
+def test_client_raises_typed_shed_reject(hot_service):
+    """The reference client rebuilds the structured shed envelope as a
+    ShedReject — callers back off on ``retry_after_ms`` from fields,
+    never by parsing the human-readable message string."""
+    svc = hot_service
+    lags = _rows((np.arange(48) + 1) * 10)
+    c = AssignorServiceClient(*svc.address)
+    try:
+        # First request trips the hot detector; best_effort is then
+        # rejected at the deepest rung.
+        c.request("stream_assign", {
+            "stream_id": "crit", "topic": "t", "lags": lags,
+            "members": ["A", "B"], "slo_class": "critical",
+        })
+        with pytest.raises(ShedReject) as ei:
+            c.request("stream_assign", {
+                "stream_id": "be", "topic": "t", "lags": lags,
+                "members": ["A", "B"], "slo_class": "best_effort",
+            })
+        assert ei.value.klass == "best_effort"
+        assert ei.value.rung in ("reject_best_effort", "degrade_standard")
+        assert ei.value.retry_after_ms >= 100
+    finally:
+        c.close()
+
+
+def test_service_shed_ladder_orders_classes(hot_service):
+    svc = hot_service
+    lags = _rows((np.arange(64) + 1) * 10)
+    members = ["A", "B", "C"]
+    before = _shed_counts()
+
+    # Request 1 (critical): evaluated at zero pressure -> admitted.
+    r = _wire(svc, "stream_assign", {
+        "stream_id": "crit", "topic": "t", "lags": lags,
+        "members": members, "slo_class": "critical",
+    })
+    assert "result" in r and r["result"]["stream"]["shed"] is None
+    assert r["result"]["stream"]["slo_class"] == "critical"
+    assert_valid_assignment(r["result"]["assignments"], 64)
+
+    # The first request drove the depth EWMA past threshold: rung 4.
+    # best_effort is REJECTED with a structured retry hint...
+    r = _wire(svc, "stream_assign", {
+        "stream_id": "be", "topic": "t", "lags": lags,
+        "members": members, "slo_class": "best_effort",
+    })
+    assert "error" in r
+    shed = r["error"]["shed"]
+    assert shed["class"] == "best_effort"
+    assert shed["rung"] == "degrade_standard"
+    assert shed["retry_after_ms"] >= 100
+
+    # ...standard's FIRST epoch is admitted (nothing cheaper to serve —
+    # no previous assignment), its SECOND is kept_previous.
+    r = _wire(svc, "stream_assign", {
+        "stream_id": "std", "topic": "t", "lags": lags,
+        "members": members,
+    })
+    assert "result" in r and r["result"]["stream"]["shed"] is None
+    first = r["result"]["assignments"]
+    r = _wire(svc, "stream_assign", {
+        "stream_id": "std", "topic": "t", "lags": lags,
+        "members": members,
+    })
+    s = r["result"]["stream"]
+    assert s["shed"] == {
+        "rung": "degrade_standard", "served": "kept_previous",
+    }
+    assert s["churn"] == 0 and s["degraded_rung"] == "none"
+    assert r["result"]["assignments"] == first  # literally kept
+    assert_valid_assignment(r["result"]["assignments"], 64)
+
+    # Critical is still served the real solve at the deepest rung.
+    r = _wire(svc, "stream_assign", {
+        "stream_id": "crit", "topic": "t", "lags": lags,
+        "members": members, "slo_class": "critical",
+    })
+    assert "result" in r and r["result"]["stream"]["shed"] is None
+
+    # Shed accounting: only the lower classes were ever shed.
+    delta = _shed_delta(before)
+    assert all(k[0] != "critical" for k in delta), delta
+    assert _shed_delta(before, by_class="best_effort") >= 1
+    assert _shed_delta(before, by_class="standard") >= 1
+    # stats exposes the ladder position.
+    st = _wire(svc, "stats", {})["result"]
+    assert st["overload"]["rung"] == "degrade_standard"
+
+
+def test_service_shed_decide_fault_fails_open(hot_service):
+    """If the shed decision itself faults, the request is ADMITTED —
+    overload control must never take healthy traffic down."""
+    svc = hot_service
+    lags = _rows((np.arange(32) + 1) * 7)
+    # Prime the detector to a rejecting rung.
+    _wire(svc, "stream_assign", {
+        "stream_id": "s1", "topic": "t", "lags": lags, "members": ["A"],
+    })
+    inj = faults.FaultInjector().plan("shed.decide", times=1)
+    with faults.injected(inj):
+        r = _wire(svc, "stream_assign", {
+            "stream_id": "be2", "topic": "t", "lags": lags,
+            "members": ["A", "B"], "slo_class": "best_effort",
+        })
+    assert inj.fired("shed.decide") == 1
+    assert "result" in r  # failed OPEN: served, not rejected
+    assert_valid_assignment(r["result"]["assignments"], 32)
+
+
+def test_service_admission_bug_fails_open(hot_service):
+    """The fail-open contract covers GENUINE controller failures, not
+    just the injected shed.decide fault — a bug in the decision path
+    must never turn every stream_assign into a wire error."""
+    svc = hot_service
+    lags = _rows((np.arange(32) + 1) * 7)
+
+    def boom(klass):
+        raise ValueError("synthetic controller bug")
+
+    svc._overload.admission = boom
+    r = _wire(svc, "stream_assign", {
+        "stream_id": "bug1", "topic": "t", "lags": lags,
+        "members": ["A", "B"], "slo_class": "best_effort",
+    })
+    assert "result" in r, r  # failed OPEN: served despite the bug
+    assert_valid_assignment(r["result"]["assignments"], 32)
+
+
+def test_service_rejects_unknown_slo_class(hot_service):
+    r = _wire(hot_service, "stream_assign", {
+        "stream_id": "s", "topic": "t",
+        "lags": [[0, 1]], "members": ["A"], "slo_class": "ultra",
+    })
+    assert "error" in r and "unknown slo_class" in r["error"]["message"]
+
+
+def test_admit_park_fault_recovers_via_ladder():
+    """A fault at the coalescer's admission park surfaces on the
+    submitting stream alone and descends its degraded-mode ladder —
+    the request is still answered with a valid assignment."""
+    with AssignorService(
+        port=0, solve_timeout_s=60.0, breaker_cooldown_s=0.2,
+        coalesce_window_ms=50.0,
+    ) as svc:
+        c = AssignorServiceClient(*svc.address)
+        lags = [[i, (i + 1) * 13] for i in range(48)]
+        # Two live streams so epochs route through the coalescer; warm
+        # both with drift so later epochs actually submit.
+        for sid in ("a", "b"):
+            c.stream_assign(sid, "t", lags, ["A", "B", "C"])
+        inj = faults.FaultInjector().plan("admit.park", times=1)
+        drift = [[i, (i + 1) * 13 + (7000 if i % 5 == 0 else 0)]
+                 for i in range(48)]
+        with faults.injected(inj):
+            r = c.stream_assign("a", "t", drift, ["A", "B", "C"])
+        assert_valid_assignment(r["assignments"], 48)
+        if inj.fired("admit.park"):
+            assert r["stream"]["degraded_rung"] in (
+                "cold_device", "host_snake",
+            )
+        c.close()
+
+
+def test_recommend_wire_end_to_end():
+    # Huge latency budget: a cold-compile epoch must not walk the
+    # ladder mid-test (the rung assertion below pins "none").
+    with AssignorService(
+        port=0, solve_timeout_s=60.0,
+        overload_latency_budget_ms=10_000_000.0,
+    ) as svc:
+        c = AssignorServiceClient(*svc.address)
+        base = (np.arange(32) + 1) * 100
+        # Flat phase: several epochs at constant total lag.
+        for _ in range(3):
+            c.stream_assign("orders", "t", _rows(base), ["A", "B"])
+            time.sleep(0.01)
+        flat = c.request("recommend")
+        rec_flat = flat["streams"]["orders"]
+        assert rec_flat["recommended_consumers"] == 2
+        assert rec_flat["consumers"] == 2 and rec_flat["partitions"] == 32
+        assert "overload" in flat and flat["overload"]["rung"] == "none"
+        # Rising phase: total lag climbs steeply -> scale-up, monotone.
+        arr = base.copy()
+        last = 2
+        for step in range(3):
+            arr = arr + 50_000
+            c.stream_assign("orders", "t", _rows(arr), ["A", "B"])
+            time.sleep(0.01)
+            rec = c.request("recommend", {"stream_id": "orders"})
+            entry = rec["streams"]["orders"]
+            assert entry["lag_trend_per_s"] > 0
+            assert entry["recommended_consumers"] >= last
+            last = entry["recommended_consumers"]
+        assert last > 2  # rising trend recommends adding consumers
+        assert last <= 32  # never past the partition count
+        # Validation: bad horizon rejected.
+        with pytest.raises(RuntimeError, match="horizon_s"):
+            c.request("recommend", {"horizon_s": -1})
+        c.close()
+
+
+def test_from_config_wires_slo_and_overload():
+    with AssignorService.from_config({
+        "group.id": "g",
+        "tpu.assignor.slo.class.orders": "critical",
+        "tpu.assignor.slo.deadline.ms.critical": "2000",
+        "tpu.assignor.overload.depth.high": "7",
+    }, port=0) as svc:
+        assert svc._slo.resolve("orders") == "critical"
+        assert svc._slo.budget_s("critical", 120.0) == 2.0
+        assert svc._overload.depth_high == 7.0
+
+
+def test_deadline_shed_keeps_warm_state_and_skips_breaker():
+    """A DeadlineShed surfacing through the watchdog serves
+    kept_previous WITHOUT charging the stream breaker or poisoning the
+    stream — sheds are the request's fate, not the solver's failure."""
+    with AssignorService(
+        port=0, solve_timeout_s=60.0, breaker_failures=1,
+        coalesce_window_ms=20.0,
+    ) as svc:
+        c = AssignorServiceClient(*svc.address)
+        lags = [[i, (i + 1) * 11] for i in range(40)]
+        for sid in ("x", "y"):
+            c.stream_assign(sid, "t", lags, ["A", "B"])
+        first = c.stream_assign("x", "t", lags, ["A", "B"])
+        # Force the coalescer to treat every parked row as expired.
+        orig = svc._coalescer._clock
+        svc._coalescer._clock = lambda: orig() + 10_000.0
+        drift = [[i, (i + 1) * 11 + (9000 if i % 3 == 0 else 0)]
+                 for i in range(40)]
+        try:
+            r = c.stream_assign("x", "t", drift, ["A", "B"])
+        finally:
+            svc._coalescer._clock = orig
+        s = r["stream"]
+        assert s["shed"] is not None
+        assert s["shed"]["rung"] == "admit_deadline"
+        assert s["shed"]["served"] == "kept_previous"
+        # A routine shed is NOT a fallback incident: the previous
+        # assignment is served as shed semantics, not ladder descent.
+        assert s["degraded_rung"] == "none"
+        assert not s["fallback_used"]
+        assert_valid_assignment(r["assignments"], 40)
+        assert r["assignments"] == first["assignments"]
+        # Warm state survived: breaker still closed, next epoch normal.
+        assert svc._watchdog.state("stream") == "closed"
+        r2 = c.stream_assign("x", "t", drift, ["A", "B"])
+        assert r2["stream"]["shed"] is None
+        assert not r2["stream"]["cold_start"]
+        c.close()

@@ -9,7 +9,13 @@ total determinism, per-topic independence, all members present).
 """
 
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+# The whole module is property fuzzing: without the optional hypothesis
+# extra (pyproject `test`/`dev` extras) skip it cleanly instead of
+# failing collection.
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from kafka_lag_based_assignor_tpu import TopicPartitionLag, assign_greedy
 from kafka_lag_based_assignor_tpu.models.greedy import assign_greedy_global

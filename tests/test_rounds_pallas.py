@@ -11,6 +11,13 @@ validates the plan-stats kernel); hardware timing is probed separately
 import numpy as np
 import pytest
 
+# The module's property fuzz needs the optional hypothesis extra
+# (pyproject `test`/`dev` extras): without it, skip the module cleanly
+# instead of failing collection.  (The interpret-mode parity tests here
+# are far too slow for the tier-1 gate anyway — they run in richer
+# environments where the extras are installed.)
+pytest.importorskip("hypothesis")
+
 import jax
 import jax.numpy as jnp
 

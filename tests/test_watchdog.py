@@ -191,6 +191,30 @@ def test_fail_fast_raises_the_rejected_subtype():
         wd.call(lambda: 1, key="other", timeout_s=-1.0)
 
 
+def test_shed_passthrough_not_observed_as_solve_duration():
+    """A SolveRejected surfacing THROUGH the worker (a coalescer shed
+    after parking for its whole class budget) must not feed the
+    klba_solve_duration_ms series — under sustained overload the
+    solver-latency p99 would become park-until-shed time, not device
+    solve time."""
+    from kafka_lag_based_assignor_tpu.utils import metrics
+
+    wd = Watchdog(timeout_s=5.0)
+    hist = metrics.REGISTRY.histogram(
+        "klba_solve_duration_ms", {"key": "shed-key"}
+    )
+    before = hist.count
+
+    def shed():
+        raise SolveRejected("deadline budget expired while parked")
+
+    with pytest.raises(SolveRejected):
+        wd.call(shed, key="shed-key")
+    assert hist.count == before  # the shed was not a solve
+    wd.call(lambda: 1, key="shed-key")
+    assert hist.count == before + 1  # genuine solves still observed
+
+
 def test_straggler_failure_does_not_retrip_open_breaker():
     """Concurrent calls admitted before a trip that fail AFTER it are the
     same incident: the trip counter must not inflate and tripped_at must
@@ -225,6 +249,26 @@ def test_truncated_budget_timeout_does_not_trip():
     with pytest.raises(SolveTimeout):
         wd2.call(time.sleep, 10)  # the configured window: a real wedge
     assert wd2.state() == "open"
+
+
+def test_class_budget_timeout_charges_breaker():
+    """A per-class SLO deadline budget (utils/overload) caps the request
+    budget below the configured window.  A FIRST-RUNG hang against that
+    full class budget is still the device's fault: with
+    ``budget_total_s`` passed, the truncation test compares against the
+    request's own window, so the breaker trips instead of reading every
+    class-budgeted timeout as a residual-ladder truncation forever."""
+    wd = Watchdog(timeout_s=30.0, cooldown_s=30.0)
+    with pytest.raises(SolveTimeout):
+        wd.call(time.sleep, 10, timeout_s=0.05, budget_total_s=0.05)
+    assert wd.state() == "open"
+    # A ladder descent's RESIDUAL call under the same class budget is
+    # still truncated (effective well below the request's window).
+    wd2 = Watchdog(timeout_s=30.0, cooldown_s=30.0)
+    with pytest.raises(SolveTimeout):
+        wd2.call(time.sleep, 10, timeout_s=0.01, budget_total_s=2.0)
+    assert wd2.state() == "closed"
+    assert wd2.stats()["device"]["consecutive_failures"] == 1
 
 
 def test_budget_exhaustion_fails_fast_without_charging_breaker():

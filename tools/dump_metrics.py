@@ -129,6 +129,30 @@ def main() -> int:
                     f"coalesce stage {stage}: count={s['count']} "
                     f"p50={s['p50']} p99={s['p99']}"
                 )
+
+        # Overload view: the shed ladder's position and who is being
+        # shed — the "is this sidecar protecting its critical tenants"
+        # look (DEPLOYMENT.md "Overload and SLOs").
+        rung_series = js.get("klba_overload_rung", {}).get("series", [])
+        if rung_series:
+            from kafka_lag_based_assignor_tpu.utils.overload import RUNGS
+
+            idx = int(rung_series[0]["value"])
+            name = RUNGS[idx] if 0 <= idx < len(RUNGS) else str(idx)
+            pressure = ""
+            for s in js.get("klba_overload_pressure", {}).get("series", []):
+                pressure = f" pressure={s['value']:.2f}"
+            print(f"overload state: rung {idx} ({name}){pressure}")
+        shed_rows = js.get("klba_shed_total", {}).get("series", [])
+        if shed_rows:
+            total = 0
+            for s in shed_rows:
+                total += s["value"]
+                print(
+                    f"shed class={s['labels'].get('class')} "
+                    f"rung={s['labels'].get('rung')}: {s['value']}"
+                )
+            print(f"shed total: {int(total)}")
         return 0
     print(json.dumps(result["json"], indent=2, sort_keys=True))
     return 0

@@ -36,6 +36,15 @@ Rules:
         double-buffered flush pipeline's contract.  Blocking fetches
         belong in functions whose name contains ``readback`` (the
         pipeline's readback stage).  Waivable with ``# noqa: L013``.
+  L014  unbounded buffer in package code: a ``deque()`` without
+        ``maxlen``, a ``queue.Queue``/``LifoQueue``/``PriorityQueue``
+        without a positive ``maxsize``, or an instance-attribute list
+        buffer (assigned ``[]`` and ``.append``-ed in the same class)
+        with no visible trim (``del self.x[...]`` / ``self.x =
+        self.x[...]`` re-slice).  The overload paths exist because
+        queues fill — a buffer that can grow without bound under
+        backpressure is the outage, so every one must carry an explicit
+        bound or a ``# noqa: L014`` waiver stating its bound.
 """
 
 from __future__ import annotations
@@ -43,7 +52,7 @@ from __future__ import annotations
 import ast
 import sys
 from pathlib import Path
-from typing import Iterator, List, NamedTuple
+from typing import Iterator, List, NamedTuple, Optional
 
 MAX_LINE = 100
 
@@ -177,6 +186,127 @@ def _l013_findings(rel: str, tree: ast.AST, lines: List[str]) -> List[Finding]:
     return findings
 
 
+_UNBOUNDED_QUEUE_TYPES = ("Queue", "LifoQueue", "PriorityQueue")
+
+
+def _call_name(node: ast.Call) -> str:
+    """Terminal name of the called object: ``deque`` for both
+    ``deque(...)`` and ``collections.deque(...)``."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _is_unbounded_buffer_ctor(node: ast.Call) -> Optional[str]:
+    """L014 constructor check: returns the offending type name for a
+    ``deque`` without a (non-None) ``maxlen`` or a queue.Queue family
+    call without a positive ``maxsize``; None when bounded/unrelated."""
+    name = _call_name(node)
+    if name == "deque":
+        for kw in node.keywords:
+            if kw.arg == "maxlen" and not (
+                isinstance(kw.value, ast.Constant) and kw.value.value is None
+            ):
+                return None
+        if len(node.args) >= 2:  # deque(iterable, maxlen) positional
+            return None
+        return "deque"
+    if name in _UNBOUNDED_QUEUE_TYPES:
+        bound = None
+        if node.args:
+            bound = node.args[0]
+        for kw in node.keywords:
+            if kw.arg == "maxsize":
+                bound = kw.value
+        if bound is None:
+            return name
+        # A literal bound must be positive (maxsize=0 means unbounded);
+        # a computed bound is taken on faith — the rule targets the
+        # default-unbounded constructors, not arithmetic.
+        if isinstance(bound, ast.Constant) and (
+            not isinstance(bound.value, int) or bound.value <= 0
+        ):
+            return name
+        return None
+    return None
+
+
+def _l014_list_buffer_findings(
+    rel: str, tree: ast.AST, lines: List[str]
+) -> List[Finding]:
+    """Instance-attribute list buffers: within one class, an attribute
+    assigned an empty list literal AND ``.append``-ed, with no visible
+    trim (``del self.x[...]`` or a ``self.x = self.x[...]`` re-slice),
+    must carry an explicit ``# noqa: L014`` waiver stating its bound."""
+    findings: List[Finding] = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        assigns: dict = {}  # attr -> first empty-list assignment node
+        appended: set = set()
+        trimmed: set = set()
+
+        def self_attr(node) -> Optional[str]:
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+            ):
+                return node.attr
+            return None
+
+        for node in ast.walk(cls):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                value = node.value
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for target in targets:
+                    attr = self_attr(target)
+                    if attr is None:
+                        continue
+                    if isinstance(value, ast.List) and not value.elts:
+                        assigns.setdefault(attr, node)
+                    elif isinstance(value, ast.Subscript):
+                        inner = self_attr(value.value)
+                        if inner == attr:
+                            trimmed.add(attr)  # self.x = self.x[...]
+            elif isinstance(node, ast.Delete):
+                for target in node.targets:
+                    if isinstance(target, ast.Subscript):
+                        attr = self_attr(target.value)
+                        if attr is not None:
+                            trimmed.add(attr)  # del self.x[...]
+            elif isinstance(node, ast.Call):
+                func = node.func
+                if isinstance(func, ast.Attribute) and func.attr in (
+                    "append", "extend", "insert",
+                ):
+                    attr = self_attr(func.value)
+                    if attr is not None:
+                        appended.add(attr)
+        for attr, node in assigns.items():
+            if attr not in appended or attr in trimmed:
+                continue
+            if "noqa: L014" in lines[node.lineno - 1]:
+                continue
+            findings.append(
+                Finding(
+                    rel,
+                    node.lineno,
+                    "L014",
+                    f"unbounded list buffer self.{attr} (assigned [] and "
+                    "appended, no visible trim): add an explicit bound "
+                    "or waive with `# noqa: L014` stating the bound",
+                )
+            )
+    return findings
+
+
 def _is_banned_clock_call(node: ast.Call, from_time_names: set) -> bool:
     """True for ``time.time(...)`` / ``time.perf_counter(...)`` and for
     bare calls of those names when imported via ``from time import``."""
@@ -210,6 +340,8 @@ def lint_source(path: Path, source: str) -> List[Finding]:
     # the one place the async-dispatch discipline is load-bearing.
     if is_package and path.name == "coalesce.py":
         findings.extend(_l013_findings(rel, tree, lines))
+    if is_package:
+        findings.extend(_l014_list_buffer_findings(rel, tree, lines))
     # The two clock-owning modules: stopwatch/span live there, so direct
     # perf_counter use is their implementation, not a violation.
     clock_exempt = path.name in ("metrics.py", "observability.py")
@@ -282,6 +414,22 @@ def lint_source(path: Path, source: str) -> List[Finding]:
                     "direct time.time()/time.perf_counter() call: use "
                     "stopwatch/metrics.span or an injectable clock "
                     "(waive with `# noqa: L012`)",
+                )
+            )
+        elif (
+            isinstance(node, ast.Call)
+            and is_package
+            and (unbounded := _is_unbounded_buffer_ctor(node)) is not None
+            and "noqa: L014" not in lines[node.lineno - 1]
+        ):
+            findings.append(
+                Finding(
+                    rel,
+                    node.lineno,
+                    "L014",
+                    f"unbounded {unbounded} buffer: "
+                    "pass maxlen/maxsize (or waive with `# noqa: L014` "
+                    "stating the bound)",
                 )
             )
         elif isinstance(node, ast.Compare):
