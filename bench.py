@@ -1140,6 +1140,172 @@ def config7_overload():
     }
 
 
+def config8_restart():
+    """Restart-storm probe (ISSUE 7): N tenants on a snapshotting
+    sidecar, a crash-equivalent stop (no drain — the periodic snapshot
+    is all that survives), then a restart where EVERY tenant fires its
+    next epoch at once.  What must hold (gated in main, every
+    backend): every stream recovers from the snapshot, each recovered
+    stream's first warm epoch is BIT-IDENTICAL to what an
+    uninterrupted process would have produced from the same seeded
+    choice, zero invalid assignments, zero warm-loop compiles after
+    recovery (the recovered-shape warm-up runs off the serving path),
+    and the storm's time-to-first-warm-epoch does not regress past
+    10x the pre-crash warm-epoch baseline."""
+    import concurrent.futures as cf
+    import tempfile
+
+    from kafka_lag_based_assignor_tpu.ops.streaming import (
+        StreamingAssignor,
+    )
+    from kafka_lag_based_assignor_tpu.service import (
+        AssignorService,
+        AssignorServiceClient,
+    )
+    from kafka_lag_based_assignor_tpu.testing import (
+        assert_valid_assignment,
+    )
+    from kafka_lag_based_assignor_tpu.utils.observability import (
+        compile_count,
+        install_compile_counter,
+    )
+
+    install_compile_counter()
+    P, C, N = 2048, 8, 8
+    streams = [f"s{i}" for i in range(N)]
+    members = [f"m{j}" for j in range(C)]
+    rngs = {sid: np.random.default_rng(8000 + i)
+            for i, sid in enumerate(streams)}
+
+    def fresh(sid):
+        return rngs[sid].integers(0, 10**6, P).astype(np.int64)
+
+    def rows(arr):
+        return [[i, int(v)] for i, v in enumerate(arr)]
+
+    snap_dir = tempfile.mkdtemp(prefix="klba-restart-")
+    snap_path = f"{snap_dir}/snapshot.json"
+
+    def decode(assignments):
+        midx = {m: j for j, m in enumerate(members)}
+        got = np.full(P, -1, np.int32)
+        for m, tps in assignments.items():
+            for _t, p in tps:
+                got[p] = midx[m]
+        return got
+
+    # Phase A: serve warm epochs, snapshot, crash (stop without drain).
+    svc = AssignorService(
+        port=0, snapshot_path=snap_path, snapshot_interval_s=3600.0,
+        coalesce_max_batch=N,
+    ).start()
+    pool = cf.ThreadPoolExecutor(max_workers=N)
+    clients = {
+        sid: AssignorServiceClient(*svc.address, timeout_s=300.0)
+        for sid in streams
+    }
+    baseline_ms = []
+
+    def epoch(sid, record=False):
+        t0 = time.perf_counter()
+        r = clients[sid].stream_assign(
+            sid, "t0", rows(fresh(sid)), members
+        )
+        if record:
+            baseline_ms.append((time.perf_counter() - t0) * 1000.0)
+        return r
+
+    try:
+        for sid in streams:  # cold chains, serial
+            epoch(sid)
+        for _ in range(2):  # warm the megabatch path
+            list(pool.map(epoch, streams))
+        # The pre-crash warm-epoch baseline: one concurrent round.
+        list(pool.map(lambda s: epoch(s, record=True), streams))
+        assert svc.snapshot_now()["ok"]
+        snap_choices = {
+            sid: svc._streams[sid].engine.export_state()
+            for sid in streams
+        }
+    finally:
+        for cl in clients.values():
+            cl.close()
+        svc.stop()  # crash-equivalent: NO drain, NO final snapshot
+
+    # The uninterrupted oracle: engines seeded with the same choices.
+    next_lags = {sid: fresh(sid) for sid in streams}
+    expected = {}
+    for sid in streams:
+        base = StreamingAssignor(
+            num_consumers=C, imbalance_guardrail=1.25
+        )
+        base.seed_choice(snap_choices[sid])
+        expected[sid] = np.asarray(base.rebalance(next_lags[sid]))
+
+    # Phase B: restart + storm.  recovery_warmup covers the recovered
+    # shapes (incl. megabatch buckets) off the serving path.
+    svc2 = AssignorService(
+        port=0, snapshot_path=snap_path, snapshot_interval_s=3600.0,
+        coalesce_max_batch=N,
+    ).start()
+    recovery = dict(svc2._last_recovery or {})
+    clients2 = {
+        sid: AssignorServiceClient(*svc2.address, timeout_s=300.0)
+        for sid in streams
+    }
+    storm_ms = {}
+    mismatched = [0]
+    invalid = [0]
+    warm_restarts = [0]
+    compiles_before = compile_count()
+
+    def storm(sid):
+        t0 = time.perf_counter()
+        r = clients2[sid].stream_assign(
+            sid, "t0", rows(next_lags[sid]), members
+        )
+        storm_ms[sid] = (time.perf_counter() - t0) * 1000.0
+        if r["stream"]["warm_restart"]:
+            warm_restarts[0] += 1
+        try:
+            assert_valid_assignment(r["assignments"], P)
+        except AssertionError:
+            invalid[0] += 1
+        if not np.array_equal(decode(r["assignments"]), expected[sid]):
+            mismatched[0] += 1
+
+    try:
+        t0 = time.perf_counter()
+        list(pool.map(storm, streams))
+        storm_wall_s = time.perf_counter() - t0
+        post_compiles = compile_count() - compiles_before
+    finally:
+        for cl in clients2.values():
+            cl.close()
+        pool.shutdown(wait=True)
+        svc2.stop()
+
+    lat = sorted(storm_ms.values())
+    return {
+        "config": "restart_storm",
+        "streams": N,
+        "partitions": P,
+        "consumers": C,
+        "streams_expected": N,
+        "streams_recovered": recovery.get("streams_recovered", 0),
+        "recovery_outcome": recovery.get("outcome"),
+        "recovery_ms": recovery.get("duration_ms"),
+        "warm_restart_epochs": warm_restarts[0],
+        "baseline_epoch_p50_ms": float(np.percentile(baseline_ms, 50)),
+        "first_epoch_p50_ms": float(np.percentile(lat, 50)),
+        "first_epoch_max_ms": float(lat[-1]),
+        "storm_wall_s": storm_wall_s,
+        "mismatched_assignments": mismatched[0],
+        "invalid_assignments": invalid[0],
+        "post_recovery_compile_count": post_compiles,
+    }
+
+
 def main():
     # A wedged accelerator tunnel must degrade the benchmark, not hang it
     # (the framework's own watchdog philosophy, SURVEY §5 failure row):
@@ -1188,7 +1354,8 @@ def main():
     from kafka_lag_based_assignor_tpu.utils import metrics as klba_metrics
 
     for fn in (config1_readme, config2_zipf, config3_vmap, config4_skew,
-               config5_northstar, config6_multistream, config7_overload):
+               config5_northstar, config6_multistream, config7_overload,
+               config8_restart):
         before = klba_metrics.REGISTRY.snapshot()
         r = fn()
         deltas = klba_metrics.histogram_deltas(
@@ -1340,6 +1507,44 @@ def main():
             f"{ov.get('recommend_trajectory')} is not a monotone "
             "scale-up under a rising lag trend"
         )
+    # Restart-storm gates (every backend — crash-safety is config, not
+    # hardware): every stream must recover, first warm epochs must be
+    # bit-identical to the uninterrupted baseline, valid, compile-free,
+    # and not regress time-to-first-warm-epoch past 10x the pre-crash
+    # warm baseline (the recovered-shape warm-up's whole point).
+    rs = results.get("restart_storm", {})
+    if rs:
+        if rs.get("streams_recovered", 0) < rs.get("streams_expected", 0):
+            failures.append(
+                f"restart_storm recovered {rs.get('streams_recovered')}"
+                f"/{rs.get('streams_expected')} streams — snapshot "
+                "recovery is dropping warm state"
+            )
+        if rs.get("mismatched_assignments", 0) > 0:
+            failures.append(
+                f"restart_storm produced {rs['mismatched_assignments']} "
+                "first-epoch assignment(s) differing from the "
+                "uninterrupted baseline — recovery is not bit-exact"
+            )
+        if rs.get("invalid_assignments", 0) > 0:
+            failures.append(
+                f"restart_storm produced {rs['invalid_assignments']} "
+                "invalid (count-imbalanced) assignment(s) post-recovery"
+            )
+        if rs.get("post_recovery_compile_count", 0) > 0:
+            failures.append(
+                f"restart_storm post_recovery_compile_count "
+                f"{rs['post_recovery_compile_count']} != 0 — fresh XLA "
+                "compiles inside the restart storm's first warm epochs"
+            )
+        base_ms = rs.get("baseline_epoch_p50_ms") or 0.0
+        first_ms = rs.get("first_epoch_p50_ms")
+        if base_ms and first_ms is not None and first_ms > 10.0 * base_ms:
+            failures.append(
+                f"restart_storm first_epoch_p50_ms {first_ms:.1f} > "
+                f"10x the pre-crash baseline {base_ms:.1f} — "
+                "time-to-first-warm-epoch regressed"
+            )
     for msg in failures:
         log(f"bench: REGRESSION GATE FAILED: {msg}")
     sys.exit(1 if failures else 0)

@@ -504,6 +504,13 @@ class MegabatchCoalescer:
         self._tick = 0  # flush-group counter driving LRU eviction
         self._rb_q: Optional[queue.Queue] = None
         self._rb_thread: Optional[threading.Thread] = None
+        # Drain bookkeeping (graceful-drain quiesce, service lifecycle):
+        # how many waves the flusher is inside (_busy) and how many
+        # pipelined readback jobs are issued-but-unfinished.  Guarded by
+        # its own leaf condition; :meth:`drain` waits on it.
+        self._quiesce = threading.Condition()
+        self._busy = 0
+        self._rb_outstanding = 0
         # Pre-bound series: flushes run on the hot multi-tenant path.
         self._m_batch = metrics.REGISTRY.histogram(
             "klba_coalesce_batch_size"
@@ -606,6 +613,49 @@ class MegabatchCoalescer:
             self._closed = True
             self._cond.notify_all()
 
+    def drain(self, timeout_s: Optional[float] = 30.0) -> bool:
+        """Quiesce for a graceful drain: wait until every admitted
+        submission has flushed AND every pipelined readback completed
+        (futures resolved — no wave is torn mid-flight when the final
+        snapshot is written).  Does NOT stop admissions (the service's
+        lifecycle gate rejects new work first) and does NOT close the
+        coalescer (:meth:`close` still owns shutdown); safe to call on
+        an idle or never-started coalescer.  Returns True when quiet,
+        False on timeout.  Fault point ``drain.flush`` fires first and
+        propagates — the service logs it and proceeds with the drain
+        (a broken flush must never block the final snapshot)."""
+        faults.fire("drain.flush")
+        deadline = (
+            self._clock() + timeout_s if timeout_s is not None else None
+        )
+        while not self._quiet():
+            remaining = (
+                None if deadline is None else deadline - self._clock()
+            )
+            if remaining is not None and remaining <= 0:
+                return False
+            with self._quiesce:
+                self._quiesce.wait(
+                    0.05 if remaining is None else min(0.05, remaining)
+                )
+        return True
+
+    def _quiet(self) -> bool:
+        """True when no submission is parked, no wave is inside the
+        flusher, and no readback job is outstanding.  The two locks are
+        taken sequentially, never nested here — the flusher nests
+        ``_quiesce`` inside ``_cond`` (pop and busy-mark are one
+        atomic step), so a pending pop can never hide between the two
+        reads."""
+        with self._cond:
+            pending = len(self._pending)
+        with self._quiesce:
+            return (
+                pending == 0
+                and self._busy == 0
+                and self._rb_outstanding == 0
+            )
+
     # -- the flusher -------------------------------------------------------
 
     def _flush_ready(self) -> bool:
@@ -656,6 +706,12 @@ class MegabatchCoalescer:
                                 break
                             self._cond.wait(remaining)
                 batch, self._pending = self._pending, []
+                # Busy-mark INSIDE the admission lock: the pop and the
+                # mark are one atomic step, so a drain's quiet check can
+                # never observe "pending empty, flusher idle" while a
+                # wave is actually in hand.
+                with self._quiesce:
+                    self._busy += 1
             try:
                 self._flush(batch)
             except Exception as exc:  # noqa: BLE001 — delivered to waiters
@@ -663,6 +719,10 @@ class MegabatchCoalescer:
                 for s in batch:
                     if not s.future.done():
                         s.future.set_exception(exc)
+            finally:
+                with self._quiesce:
+                    self._busy -= 1
+                    self._quiesce.notify_all()
 
     def _readback_loop(self) -> None:
         while True:
@@ -675,11 +735,17 @@ class MegabatchCoalescer:
                 LOGGER.warning(
                     "coalescer readback job crashed", exc_info=True
                 )
+            finally:
+                with self._quiesce:
+                    self._rb_outstanding -= 1
+                    self._quiesce.notify_all()
 
     def _enqueue_readback(self, job: Callable[[], None]) -> None:
         if self._rb_q is None:
             job()  # strict-serial fallback: readback on the flusher
         else:
+            with self._quiesce:
+                self._rb_outstanding += 1
             self._rb_q.put(job)
 
     def _flush(self, batch: List[EpochSubmission]) -> None:

@@ -898,6 +898,18 @@ class StreamingAssignor:
             totals[recv] += lags[p]
         return choice, int((choice != original).sum())
 
+    def export_state(self) -> Optional[np.ndarray]:
+        """The engine's host-durable snapshot unit (utils/snapshot):
+        a copy of the previous choice vector, or None while cold.
+        Deliberately host-only — the device-resident (choice, table,
+        counts) buffers (or a locked-roster handle) are NOT exported:
+        they are rebuildable from this vector by the next refine
+        dispatch, exactly the :meth:`seed_choice` contract recovery
+        replays, so a snapshot never has to block on (or race) a
+        device readback."""
+        prev = self._prev_choice
+        return None if prev is None else np.array(prev, copy=True)
+
     def seed_choice(self, choice: np.ndarray) -> None:
         """Warm-restart seed: adopt a host-side choice vector as the
         previous assignment (the degraded-mode ladder's recovery path —

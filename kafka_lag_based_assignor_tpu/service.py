@@ -125,6 +125,28 @@ per-stream consumer-count recommendation from each stream's recorded
 lag trend plus the current overload state, for the external
 autoscaler.
 
+Lifecycle (utils/snapshot; DEPLOYMENT.md "Restarts and recovery"):
+with ``snapshot_path`` configured the service periodically (and on
+roster churn) writes a versioned, per-section-checksummed, ATOMIC
+snapshot of all host-recoverable state — per-stream ``{choice, member
+roster, SLO class, lag-trend window}``, breaker states/cooldowns, the
+overload rung — and a restarting process rehydrates from it BEFORE
+serving: recovered streams are seeded via ``seed_choice`` and their
+shapes warmed (megabatch executables included) off the serving path,
+so the restart stampede's first warm epochs are bit-identical to what
+an uninterrupted process would have produced from the same seeded
+choice, with zero compiles.  Per-stream staleness guards apply: a
+snapshot older than ``snapshot_max_age_s`` rehydrates nothing, and a
+recovered stream whose first post-restart epoch arrives with a drifted
+membership or partition set is discarded (cold start) for that stream
+only.  Graceful drain — SIGTERM/SIGINT (``install_signal_handlers``)
+or the wire ``{"method": "drain"}`` call — stops admissions (new
+``assign``/``stream_assign`` requests get a structured reject with a
+``retry_after_ms`` hint), waits for in-flight requests and coalescer
+waves to flush, writes a final snapshot, then closes the listener;
+``{"method": "stats"}`` exports the lifecycle state
+(serving/draining/stopped), snapshot age, and last-recovery outcome.
+
 Wire limits: a request line may be at most ``MAX_LINE_BYTES`` (16 MiB —
 comfortably above a 100k-partition request, ~2 MB); longer lines are
 answered with an error and drained without buffering.  ``params.options``
@@ -161,11 +183,13 @@ from .utils.observability import (
 )
 from .utils.overload import (
     CLASS_WEIGHTS,
+    SLO_CLASSES,
     OverloadController,
     ShedReject,
     SloPolicy,
     class_rank,
     recommend_payload,
+    record_shed,
 )
 from .utils.watchdog import SolveRejected, Watchdog
 
@@ -210,9 +234,12 @@ STREAM_FLIGHT_CAPACITY = 64
 _KNOWN_METHODS = frozenset(
     {
         "ping", "stats", "metrics", "assign", "stream_assign",
-        "stream_reset", "stream_flight", "recommend",
+        "stream_reset", "stream_flight", "recommend", "drain",
     }
 )
+
+# Lifecycle states (the klba_lifecycle_state gauge exports the index).
+_LIFECYCLE_STATES = ("serving", "draining", "stopped")
 
 # Per-stream lag-trend window for the elasticity loop ({"method":
 # "recommend"}): (time, total_lag) samples per live stream.  64 epochs
@@ -381,6 +408,24 @@ def _keepable(prev, P: int, C: int) -> bool:
     return int(counts.max() - counts.min()) <= 1
 
 
+class DrainReject(ShedReject):
+    """A request rejected because the sidecar is draining: same
+    structured wire shape as an overload shed (class, rung
+    ``"draining"``, ``retry_after_ms``) so clients reuse one backoff
+    path — but the hint means "retry against another instance", not
+    "this one will recover"."""
+
+    def __init__(self, klass: str, retry_after_ms: int):
+        RuntimeError.__init__(
+            self,
+            f"draining: new {klass!r} work is not admitted; retry "
+            f"another instance after {retry_after_ms} ms",
+        )
+        self.klass = klass
+        self.rung = "draining"
+        self.retry_after_ms = retry_after_ms
+
+
 class _Stream:
     """Warm per-stream solver state (see the module docstring)."""
 
@@ -393,6 +438,11 @@ class _Stream:
         self.pids = None  # np.int64[P], sorted — the row order contract
         self.flight = None  # per-stream FlightRecorder ring
         self.klass = "standard"  # effective SLO class of the last epoch
+        # True between snapshot rehydration and the stream's first
+        # post-restart epoch: that epoch re-validates the roster — a
+        # drifted membership or pid set discards THIS stream's warm
+        # state (cold start) instead of remapping a stale roster.
+        self.recovered = False
         # (time_s, total_lag) per served epoch — the recommend trend
         # window (bounded: deque maxlen).
         self.history = deque(maxlen=STREAM_HISTORY)
@@ -404,6 +454,20 @@ def _stream_ring() -> metrics.FlightRecorder:
     KLBA_FLIGHT_DIR env default)."""
     return metrics.FlightRecorder(
         capacity=STREAM_FLIGHT_CAPACITY, dump_dir=""
+    )
+
+
+def _fresh_engine(C: int, flight: metrics.FlightRecorder):
+    """THE service-default engine construction (guardrail ON at 1.25,
+    unlike the library default, plus the stream's flight ring) — every
+    site that makes an engine (first epoch, degraded-ladder cold rung,
+    drift-guard rebuild, snapshot rehydration) goes through here, so a
+    recovered or rebuilt engine can never drift from a freshly created
+    one and silently break the bit-exact recovery contract."""
+    from .ops.streaming import StreamingAssignor
+
+    return StreamingAssignor(
+        num_consumers=C, imbalance_guardrail=1.25, flight=flight
     )
 
 
@@ -621,6 +685,23 @@ class AssignorService:
         overload_latency_budget_ms: float = 0.0,
         overload_depth_high: float = 24.0,
         overload_cooldown_s: float = 1.0,
+        # Lifecycle snapshots + graceful drain (utils/snapshot;
+        # DEPLOYMENT.md "Restarts and recovery").  snapshot_path names
+        # the atomic snapshot file (None disables snapshots AND
+        # recovery); interval is the periodic cadence (churn events
+        # write early, debounced); max_age is the boot-time staleness
+        # guard (an older snapshot rehydrates nothing); drain_timeout
+        # bounds how long a drain waits for in-flight work before the
+        # final snapshot and listener close.
+        snapshot_path: Optional[str] = None,
+        snapshot_interval_s: float = 30.0,
+        snapshot_max_age_s: float = 900.0,
+        drain_timeout_s: float = 10.0,
+        # False skips the recovered-shape warm-up pass in start()
+        # (tests/drills that assert recovery semantics without paying
+        # compiles); production keeps it on — it is what makes the
+        # restart stampede compile-free.
+        recovery_warmup: bool = True,
         # Uptime/budget clock (L012 discipline: injectable, monotonic).
         clock: Callable[[], float] = time.monotonic,
     ):
@@ -694,6 +775,42 @@ class AssignorService:
         }
         self._clock = clock
         self._started = clock()
+        # Lifecycle (module docstring "Lifecycle"): the serving/
+        # draining/stopped state machine, the snapshot store + periodic
+        # writer, and the drain bookkeeping.  The state gate is read on
+        # every admission, so it is a plain attribute (GIL-atomic read)
+        # mutated only under the lifecycle lock.
+        self._lifecycle = "serving"
+        self._lifecycle_lock = threading.Lock()
+        self._listener_closed = False
+        self._drain_timeout_s = float(drain_timeout_s)
+        self._drain_thread: Optional[threading.Thread] = None
+        self._stopped_event = threading.Event()
+        self._active_cond = threading.Condition()
+        self._active_requests = 0
+        self._last_recovery: Optional[Dict[str, Any]] = None
+        # (P, C) shapes discovered during recovery: warmed via the
+        # stream/megabatch warm-up in start(), OFF the serving path, so
+        # the restart stampede's first warm epochs compile nothing.
+        # noqa: L014 — appended only during boot recovery, bounded by
+        # MAX_STREAMS rehydrated streams.
+        self._recovery_shapes: List[Tuple[int, int]] = []  # noqa: L014
+        self._snapshot_max_age_s = float(snapshot_max_age_s)
+        self._recovery_warmup = bool(recovery_warmup)
+        self._m_lifecycle = metrics.REGISTRY.gauge("klba_lifecycle_state")
+        self._m_lifecycle.set(0)
+        if snapshot_path:
+            from .utils.snapshot import SnapshotStore, SnapshotWriter
+
+            self._snapshot_store = SnapshotStore(snapshot_path)
+            self._snapshot_writer = SnapshotWriter(
+                self._snapshot_store,
+                self._snapshot_sections,
+                interval_s=float(snapshot_interval_s),
+            )
+        else:
+            self._snapshot_store = None
+            self._snapshot_writer = None
 
     @property
     def requests_served(self) -> int:
@@ -744,7 +861,9 @@ class AssignorService:
         ``host.fallback``, ``breaker.cooldown.ms`` / ``breaker.failures``,
         ``coalesce.window.ms`` / ``coalesce.max_batch``,
         ``slo.class.<stream>`` / ``slo.deadline.ms.<class>`` /
-        ``overload.*``, and ``metrics.port``.  An embedder that already holds the consumer
+        ``overload.*``, ``snapshot.path`` / ``snapshot.interval.ms`` /
+        ``snapshot.max.age.ms`` / ``drain.timeout.ms``, and
+        ``metrics.port``.  An embedder that already holds the consumer
         config (which always carries the required ``group.id``) gets a
         service whose knobs agree with the plugin's, one parse for both
         surfaces.  Explicit ``overrides`` kwargs win over config values
@@ -763,6 +882,10 @@ class AssignorService:
             "coalesce_lock_waves": cfg.coalesce_lock_waves,
             "coalesce_pipeline": cfg.coalesce_pipeline,
             "metrics_port": cfg.metrics_port,
+            "snapshot_path": cfg.snapshot_path,
+            "snapshot_interval_s": cfg.snapshot_interval_s,
+            "snapshot_max_age_s": cfg.snapshot_max_age_s,
+            "drain_timeout_s": cfg.drain_timeout_s,
             "warmup_shapes": cfg.warmup_shapes or None,
             "slo_classes": cfg.slo_classes,
             "slo_deadline_s": cfg.slo_deadline_s,
@@ -798,6 +921,18 @@ class AssignorService:
         """One wire request: minted request id (echoed in the response
         envelope and on request-thread log lines), a ``wire.<method>``
         span, and deadline-budget-consumption accounting."""
+        with self._active_cond:
+            # Drain bookkeeping: the drain worker waits for this count
+            # to reach zero before flushing and closing the listener.
+            self._active_requests += 1
+        try:
+            return self._handle_line_counted(line)
+        finally:
+            with self._active_cond:
+                self._active_requests -= 1
+                self._active_cond.notify_all()
+
+    def _handle_line_counted(self, line: bytes) -> bytes:
         with metrics.request_scope() as rid:
             req_id = None
             label = "unknown"
@@ -886,6 +1021,10 @@ class AssignorService:
                 # re-stack / invalidation / dead-row counters (see
                 # DEPLOYMENT.md "Multi-tenant throughput").
                 result["coalesce"] = self._coalescer.stats()
+            # Lifecycle: serving/draining/stopped, snapshot age, and
+            # the last recovery's outcome (DEPLOYMENT.md "Restarts
+            # and recovery"; tools/dump_metrics.py --summary).
+            result["lifecycle"] = self.lifecycle_stats()
             return result, None
         if method == "metrics":
             # The registry, both ways: structured JSON for programmatic
@@ -922,7 +1061,19 @@ class AssignorService:
                     "last_dump": last,
                 }
             return result, None
+        if method == "drain":
+            # Graceful drain over the wire (same path as SIGTERM): the
+            # response answers IMMEDIATELY with the lifecycle state —
+            # the drain itself (quiesce, final snapshot, listener
+            # close) runs on its own thread so this connection still
+            # gets its reply before the listener goes away.
+            initiated = self.begin_drain()
+            return {
+                "state": self._lifecycle,
+                "initiated": initiated,
+            }, None
         if method == "assign":
+            self._reject_if_draining("standard")
             params = req.get("params") or {}
             solver = params.get("solver", "rounds")
             if solver not in VALID_SOLVERS:
@@ -982,6 +1133,7 @@ class AssignorService:
             klass = self._slo.resolve(
                 params.get("stream_id"), params.get("slo_class")
             )
+            self._reject_if_draining(klass)
             budget = _DeadlineBudget(
                 self._slo.budget_s(klass, self._watchdog.timeout_s),
                 clock=self._clock,
@@ -1026,6 +1178,8 @@ class AssignorService:
             with self._streams_lock:
                 dropped = self._streams.pop(sid, None) is not None
                 self._snapshots.pop(sid, None)
+            if dropped:
+                self._mark_churn()
             return {"dropped": dropped}, None
         if method == "recommend":
             # The elasticity loop (utils/overload.recommend_payload):
@@ -1199,8 +1353,7 @@ class AssignorService:
         the solve (or the degrade rung's kept_previous), the ladder."""
         import numpy as np
 
-        from .ops.streaming import StreamingAssignor
-
+        created = False
         while True:
             with self._streams_lock:
                 st = self._streams.get(sid)
@@ -1211,6 +1364,7 @@ class AssignorService:
                             "stream_reset unused ones"
                         )
                     st = self._streams[sid] = _Stream()
+                    created = True
             st.lock.acquire()
             # The stream may have been POISONED (solve failure) or reset
             # while this request waited on its lock — solving on the
@@ -1221,20 +1375,20 @@ class AssignorService:
                 if self._streams.get(sid) is st:
                     break
             st.lock.release()
+        if created:
+            # Roster churn: a new tenant's warm state should reach the
+            # snapshot ahead of the periodic cadence (debounced).
+            self._mark_churn()
 
         try:
             warm_restart = False
             if st.engine is None:
-                # Service-level defaults (guardrail on at 1.25, unlike the
-                # library default) — requested options are applied by the
-                # SAME update block every epoch uses, so each default
-                # lives in exactly one place.  Each stream gets its own
-                # small flight ring alongside the engine.
+                # Requested options are applied by the SAME update
+                # block every epoch uses, so each default lives in
+                # exactly one place.  Each stream gets its own small
+                # flight ring alongside the engine.
                 st.flight = _stream_ring()
-                st.engine = StreamingAssignor(
-                    num_consumers=C, imbalance_guardrail=1.25,
-                    flight=st.flight,
-                )
+                st.engine = _fresh_engine(C, st.flight)
                 st.members = members_sorted
                 # Poisoned-stream recovery: if the last epoch for this sid
                 # died on the snake rung, warm-restart from the snapshot of
@@ -1251,6 +1405,36 @@ class AssignorService:
                         st.engine.seed_choice(snap_choice)
                         st.pids = snap_pids
                         warm_restart = True
+            elif st.recovered and (
+                st.members != members_sorted
+                or st.pids is None
+                or st.pids.shape[0] != pids_sorted.shape[0]
+                or not np.array_equal(st.pids, pids_sorted)
+            ):
+                # Recovered-stream drift guard (DEPLOYMENT.md "Restarts
+                # and recovery"): the snapshot predates whatever moved
+                # this roster, so remapping it would carry STALE state
+                # into a membership change the process never observed —
+                # discard THIS stream's warm state only (cold start);
+                # every other recovered stream keeps its seed.
+                LOGGER.warning(
+                    "recovered stream %r arrived with a drifted "
+                    "roster; discarding its snapshot state (cold "
+                    "start)", sid,
+                )
+                # Rebuild, don't reset: the recovered engine is sized
+                # for the snapshot's consumer count — a reset() would
+                # cold-solve the NEW roster over the OLD C (imbalanced
+                # counts on growth, an index past members_sorted on
+                # shrink).  The stream keeps its flight ring.
+                st.engine = _fresh_engine(C, st.flight)
+                st.members = members_sorted
+                st.pids = None
+                metrics.REGISTRY.counter(
+                    "klba_recovery_streams_total",
+                    {"outcome": "discarded_drift"},
+                ).inc()
+                self._mark_churn()
             elif st.members != members_sorted:
                 # Membership change: remap by NAME so survivors keep their
                 # partitions (the engine's repair pass re-seats only
@@ -1262,6 +1446,7 @@ class AssignorService:
                 )
                 st.engine.remap_members(old_to_new, C)
                 st.members = members_sorted
+                self._mark_churn()
             # A different partition-id set at the SAME count would silently
             # misbind warm rows to new pids — force a cold solve (a count
             # change already does, via the engine's shape check).
@@ -1270,6 +1455,14 @@ class AssignorService:
             ):
                 st.engine.reset()
             st.pids = pids_sorted
+            if st.recovered:
+                # First post-restart epoch on INTACT recovered state:
+                # surfaced as a warm restart (same wire field as the
+                # poisoned-snapshot recovery) so the restart stampede
+                # is visible per stream; a drift-discarded stream
+                # reports a plain cold start instead.
+                warm_restart = st.engine._prev_choice is not None
+                st.recovered = False
             _apply_stream_opts(st.engine, opts)
 
             fallback_used = False
@@ -1400,6 +1593,7 @@ class AssignorService:
                 # of the SAME deadline budget.
                 with self._streams_lock:
                     self._streams.pop(sid, None)
+                self._mark_churn()
                 if not self._host_fallback:
                     raise
                 LOGGER.warning(
@@ -1488,12 +1682,8 @@ class AssignorService:
         ``(choice, stats, degraded_rung, fallback_used)``."""
         import numpy as np
 
-        from .ops.streaming import StreamingAssignor
-
         ring = _stream_ring()
-        fresh = StreamingAssignor(
-            num_consumers=C, imbalance_guardrail=1.25, flight=ring
-        )
+        fresh = _fresh_engine(C, ring)
         _apply_stream_opts(fresh, opts)
         try:
             choice = self._watchdog.call(
@@ -1517,6 +1707,7 @@ class AssignorService:
                     pids_sorted.copy(),
                     np.asarray(choice, dtype=np.int32),
                 )
+            self._mark_churn()
             return choice, s, "host_snake", True
         # The cold rung recovered: install the fresh engine as the
         # stream's new warm state (unless a concurrent request already
@@ -1529,9 +1720,378 @@ class AssignorService:
                 nst.members = list(members_sorted)
                 nst.pids = pids_sorted
                 self._streams[sid] = nst
+        self._mark_churn()
         return choice, fresh.last_stats, "cold_device", False
 
     # -- lifecycle ---------------------------------------------------------
+
+    def _set_lifecycle(self, state: str) -> None:
+        with self._lifecycle_lock:
+            self._lifecycle = state
+        self._m_lifecycle.set(_LIFECYCLE_STATES.index(state))
+
+    def _mark_churn(self) -> None:
+        """Roster churn (stream joined/left/poisoned, membership
+        moved): nudge the snapshot writer ahead of its cadence."""
+        if self._snapshot_writer is not None:
+            self._snapshot_writer.mark_churn()
+
+    def _reject_if_draining(self, klass: str) -> None:
+        """The drain's admission stop: new solve work gets a structured
+        reject (same wire shape as an overload shed, rung
+        ``"draining"``) with a retry hint sized to the drain window —
+        the client's backoff naturally lands on the replacement
+        instance.  Observability methods (ping/stats/metrics/flight)
+        stay served so the drain itself remains watchable."""
+        if self._lifecycle == "serving":
+            return
+        retry_ms = int(
+            min(60_000.0, max(500.0, self._drain_timeout_s * 1000.0))
+        )
+        record_shed(klass, "draining", "rejected")
+        raise DrainReject(klass, retry_ms)
+
+    def _snapshot_sections(self) -> Dict[str, Any]:
+        """Collect every host-recoverable section for utils/snapshot:
+        per-stream ``{members, pids, choice, slo_class, lag-trend
+        window}``, breaker states/cooldowns, the overload rung.  Lag
+        trend times are stored as AGES relative to the write (the
+        monotonic epoch dies with the process; ages rebase cleanly on
+        load).  A stream mid-epoch (lock contended) is skipped this
+        cadence rather than stalling the writer behind a device solve.
+        """
+        import numpy as np
+
+        with self._streams_lock:
+            items = list(self._streams.items())
+        now = self._clock()
+        streams: Dict[str, Any] = {}
+        for sid, st in items:
+            if not st.lock.acquire(timeout=0.5):
+                continue  # mid-epoch; the next cadence catches it
+            try:
+                if st.engine is None or st.pids is None:
+                    continue
+                choice = st.engine.export_state()
+                if choice is None or choice.shape[0] != st.pids.shape[0]:
+                    continue
+                P = int(st.pids.shape[0])
+                dense = bool(np.array_equal(st.pids, np.arange(P)))
+                streams[sid] = {
+                    "members": list(st.members),
+                    # Dense pid sets (the common case) compact to the
+                    # count — a 100k-partition stream should not cost
+                    # ~600 KB of JSON per snapshot for 0..P-1.
+                    "pids": P if dense else [int(p) for p in st.pids],
+                    "choice": [int(c) for c in choice],
+                    "slo_class": st.klass,
+                    "history": [
+                        [max(0.0, now - t), int(lag)]
+                        for t, lag in list(st.history)
+                    ],
+                }
+            finally:
+                st.lock.release()
+        return {
+            "streams": streams,
+            "breakers": self._watchdog.export_state(),
+            "overload": self._overload.export_state(),
+        }
+
+    def snapshot_now(self) -> Dict[str, Any]:
+        """One synchronous snapshot write (operator action / drills);
+        ``{"ok": False, "error": "snapshots disabled"}`` without a
+        configured path."""
+        if self._snapshot_writer is None:
+            return {"ok": False, "error": "snapshots disabled"}
+        return self._snapshot_writer.write_now()
+
+    def _final_snapshot(self) -> None:
+        """The drain's final write.  Unlike the periodic cadence —
+        where a lock-contended stream is simply caught by the next tick
+        — there IS no next tick here, and the atomic rename would
+        replace a previous snapshot that still holds that stream's
+        warm state with one that silently lacks it.  So any live
+        stream the collector had to skip (a wedged solve the drain
+        timed out on) carries its record FORWARD from the previous
+        file instead of vanishing; the recovery-side staleness and
+        drift guards already police how trustworthy that older record
+        is."""
+        try:
+            sections = self._snapshot_sections()
+            with self._streams_lock:
+                live = set(self._streams)
+            missing = live - set(sections.get("streams") or {})
+            if missing:
+                prev = self._snapshot_store.load()
+                prev_streams = (
+                    prev.sections.get("streams") or {}
+                    if prev.sections else {}
+                )
+                carried = 0
+                for sid in missing:
+                    body = prev_streams.get(sid)
+                    if body is not None:
+                        sections["streams"][sid] = body
+                        carried += 1
+                LOGGER.warning(
+                    "final snapshot: %d stream(s) still lock-held at "
+                    "drain timeout; carried %d forward from the "
+                    "previous snapshot", len(missing), carried,
+                )
+            self._snapshot_store.save(sections)
+        except Exception:  # noqa: BLE001 — the drain must complete
+            LOGGER.warning(
+                "final snapshot collection failed; skipping the write",
+                exc_info=True,
+            )
+
+    def lifecycle_stats(self) -> Dict[str, Any]:
+        """The wire ``stats.lifecycle`` section (also printed by
+        tools/dump_metrics.py --summary)."""
+        out: Dict[str, Any] = {
+            "state": self._lifecycle,
+            "snapshot": (
+                self._snapshot_store.stats()
+                if self._snapshot_store is not None else None
+            ),
+            "recovery": self._last_recovery,
+        }
+        return out
+
+    def _recover(self) -> None:
+        """Boot-time warm-restart recovery (called by :meth:`start`
+        BEFORE the warm-up and the accept loop): load the snapshot
+        fail-open, restore breaker/overload state, and rehydrate each
+        stream via ``seed_choice`` — staleness guards per the module
+        docstring.  Never raises; the worst outcome is a counted cold
+        start."""
+        import numpy as np
+
+        t0 = metrics.REGISTRY.clock()
+        load = self._snapshot_store.load()
+        info: Dict[str, Any] = {
+            "outcome": load.outcome,
+            "age_s": load.age_s,
+            "sections_skipped": list(load.skipped),
+            "streams_recovered": 0,
+            "streams_discarded": 0,
+        }
+        stale = (
+            load.age_s is not None
+            and load.age_s > self._snapshot_max_age_s
+        )
+        if stale and load.outcome in ("ok", "partial"):
+            # Whole-file staleness guard: rosters and lag trends older
+            # than the max age are misinformation — cold start, loudly.
+            LOGGER.warning(
+                "snapshot is %.0fs old (> max age %.0fs); rehydrating "
+                "nothing", load.age_s, self._snapshot_max_age_s,
+            )
+            info["outcome"] = "stale"
+        elif load.sections:
+            breakers = load.sections.get("breakers")
+            if breakers is not None:
+                self._watchdog.restore_state(breakers)
+            overload = load.sections.get("overload")
+            if overload is not None:
+                self._overload.restore_state(overload)
+            recovered, discarded = self._rehydrate_streams(
+                load.sections.get("streams") or {}, np
+            )
+            info["streams_recovered"] = recovered
+            info["streams_discarded"] = discarded
+        info["duration_ms"] = (metrics.REGISTRY.clock() - t0) * 1000.0
+        self._last_recovery = info
+        metrics.REGISTRY.gauge("klba_recovery_duration_ms").set(
+            info["duration_ms"]
+        )
+        metrics.FLIGHT.record("lifecycle", {"event": "recovery", **info})
+        LOGGER.info(
+            "recovery: outcome=%s streams_recovered=%d discarded=%d "
+            "in %.1f ms", info["outcome"], info["streams_recovered"],
+            info["streams_discarded"], info["duration_ms"],
+        )
+
+    def _rehydrate_streams(
+        self, bodies: Dict[str, Any], np
+    ) -> Tuple[int, int]:
+        """Seed one engine per snapshot stream; a malformed or
+        unservable stream record is discarded ALONE (counted), never an
+        exception into the boot path.  Returns (recovered, discarded).
+        """
+        recovered = discarded = 0
+        m_rec = metrics.REGISTRY.counter(
+            "klba_recovery_streams_total", {"outcome": "recovered"}
+        )
+        m_disc = metrics.REGISTRY.counter(
+            "klba_recovery_streams_total", {"outcome": "discarded"}
+        )
+        now = self._clock()
+        for sid, body in dict(bodies).items():
+            try:
+                members = sorted(str(m) for m in body["members"])
+                if not members or len(set(members)) != len(members):
+                    raise ValueError("bad member roster")
+                C = len(members)
+                pids_raw = body["pids"]
+                pids = (
+                    np.arange(int(pids_raw), dtype=np.int64)
+                    if isinstance(pids_raw, int)
+                    else np.asarray(
+                        [int(p) for p in pids_raw], dtype=np.int64
+                    )
+                )
+                choice = np.asarray(
+                    [int(c) for c in body["choice"]], dtype=np.int32
+                )
+                if (
+                    choice.shape[0] != pids.shape[0]
+                    or not _keepable(choice, choice.shape[0], C)
+                ):
+                    raise ValueError("choice not servable for roster")
+                klass = body.get("slo_class", "standard")
+                if klass not in SLO_CLASSES:
+                    klass = "standard"
+                st = _Stream()
+                st.flight = _stream_ring()
+                st.engine = _fresh_engine(C, st.flight)
+                # The recovery contract: the first warm epoch must be
+                # bit-identical to an uninterrupted process's epoch
+                # from the SAME seeded choice — seed_choice leaves
+                # device state stale, so both sides rebuild their
+                # tables from this host vector deterministically.
+                st.engine.seed_choice(choice)
+                st.members = members
+                st.pids = pids
+                st.klass = klass
+                st.recovered = True
+                for age, lag in body.get("history") or []:
+                    st.history.append(
+                        (now - float(age), int(lag))
+                    )
+                with self._streams_lock:
+                    if len(self._streams) >= MAX_STREAMS:
+                        raise ValueError("stream cap reached")
+                    self._streams[str(sid)] = st
+                self._recovery_shapes.append((int(pids.shape[0]), C))
+                recovered += 1
+                m_rec.inc()
+            except Exception:  # noqa: BLE001 — discard THIS stream only
+                LOGGER.warning(
+                    "discarding unrecoverable snapshot stream %r",
+                    sid, exc_info=True,
+                )
+                discarded += 1
+                m_disc.inc()
+        return recovered, discarded
+
+    def begin_drain(self) -> bool:
+        """Initiate a graceful drain (idempotent): stop admissions,
+        then — on the drain thread — wait out in-flight requests,
+        flush the coalescer's waves, write the final snapshot, and
+        close the listener.  Returns False when already draining or
+        stopped."""
+        with self._lifecycle_lock:
+            if self._lifecycle != "serving":
+                return False
+            self._lifecycle = "draining"
+        self._m_lifecycle.set(_LIFECYCLE_STATES.index("draining"))
+        if self._snapshot_writer is not None:
+            # Stop the cadence; the drain worker owns the final write.
+            self._snapshot_writer.close()
+        metrics.FLIGHT.record("lifecycle", {"event": "drain"})
+        LOGGER.warning(
+            "drain initiated: admissions stopped, flushing in-flight "
+            "work (timeout %.1fs)", self._drain_timeout_s,
+        )
+        self._drain_thread = threading.Thread(
+            target=self._drain_worker, name="klba-drain", daemon=True
+        )
+        self._drain_thread.start()
+        return True
+
+    def _drain_worker(self) -> None:
+        deadline = self._clock() + self._drain_timeout_s
+        # 1. In-flight requests: every admitted request finishes (or
+        #    the timeout fires — a wedged solve must not hold the
+        #    drain past its window; its watchdog abandons it anyway).
+        with self._active_cond:
+            while self._active_requests > 0:
+                remaining = deadline - self._clock()
+                if remaining <= 0:
+                    LOGGER.warning(
+                        "drain timeout with %d request(s) in flight; "
+                        "proceeding", self._active_requests,
+                    )
+                    break
+                self._active_cond.wait(min(0.05, remaining))
+        # 2. Coalescer: flush the parked waves and their readbacks so
+        #    no future is abandoned mid-wave.  Fault point drain.flush
+        #    fires inside; a failure is logged and the drain proceeds —
+        #    a broken flush must never block the final snapshot.
+        if self._coalescer is not None:
+            try:
+                quiet = self._coalescer.drain(
+                    timeout_s=max(0.0, deadline - self._clock())
+                )
+                if not quiet:
+                    LOGGER.warning(
+                        "coalescer did not quiesce within the drain "
+                        "window; proceeding"
+                    )
+            except Exception:  # noqa: BLE001 — drain must complete
+                LOGGER.warning(
+                    "coalescer drain failed; proceeding with the final "
+                    "snapshot", exc_info=True,
+                )
+        # 3. Final snapshot: the state the restart rehydrates from
+        #    (merge-aware: a lock-held stream keeps its previous
+        #    record instead of vanishing from the file).
+        if self._snapshot_writer is not None:
+            self._final_snapshot()
+        # 4. Close the listener; the process may now exit.
+        self._close_listener()
+        if self._coalescer is not None:
+            self._coalescer.close()
+        self._set_lifecycle("stopped")
+        metrics.FLIGHT.record("lifecycle", {"event": "drained"})
+        LOGGER.warning("drain complete: listener closed")
+        self._stopped_event.set()
+
+    def wait_stopped(self, timeout_s: Optional[float] = None) -> bool:
+        """Block until a drain (or stop) finished; True when it did."""
+        return self._stopped_event.wait(timeout_s)
+
+    def install_signal_handlers(self) -> None:
+        """Graceful drain on SIGTERM/SIGINT (main-thread only — a
+        Python signal-handler constraint).  The FIRST signal starts
+        the drain; a second one (drain hung, operator insisting)
+        force-stops without the final snapshot."""
+        import signal
+
+        def _handler(signum, frame):
+            LOGGER.warning("signal %d: draining", signum)
+            if not self.begin_drain():
+                LOGGER.warning(
+                    "signal %d during drain: forcing stop", signum
+                )
+                self.stop()
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, _handler)
+
+    def _close_listener(self) -> None:
+        with self._lifecycle_lock:
+            if self._listener_closed:
+                return
+            self._listener_closed = True
+        if self._thread is not None:
+            self._tcp.shutdown()
+        self._tcp.server_close()
+        if self._metrics_http is not None:
+            self._metrics_http.stop()
+            self._metrics_http = None
 
     def start(self) -> "AssignorService":
         # Process-wide telemetry hooks, BEFORE the warm-up builds the
@@ -1539,6 +2099,15 @@ class AssignorService:
         # and request-thread log lines carry the minted request id.
         install_compile_counter()
         metrics.install_log_request_ids()
+        if self._snapshot_store is not None:
+            # Warm-restart recovery BEFORE the warm-up and the accept
+            # loop: rehydrated streams contribute their shapes to the
+            # warm-up below, so the restart stampede's first warm
+            # epochs compile nothing (the restart_storm bench gate).
+            self._recover()
+        coalesce_batch = (
+            self._coalescer.max_batch if self._coalescer is not None else 1
+        )
         if self._warmup_shapes:
             # Pre-compile before serving: connections arriving meanwhile
             # queue in the TCP backlog and are answered once warm.
@@ -1554,21 +2123,52 @@ class AssignorService:
                     # synthetic multi-stream wave per batch-pow2 bucket
                     # compiles the re-stack AND locked executables off
                     # the serving path.
-                    coalesce_max_batch=(
-                        self._coalescer.max_batch
-                        if self._coalescer is not None else 1
-                    ),
+                    coalesce_max_batch=coalesce_batch,
                 )
-        if self._metrics_port is not None:
-            from .utils.metrics_http import MetricsHTTPServer
+        if self._recovery_shapes and self._recovery_warmup:
+            # Megabatch warm-up for the RECOVERED shapes, off the
+            # serving path: only the stream engine's executables (cold
+            # chain, fused warm build/resident, and — multi-tenant —
+            # the megabatch pair per batch bucket); the stateless
+            # solvers warm via warmup_shapes as before.
+            from .warmup import warmup
 
-            self._metrics_http = MetricsHTTPServer(
-                self.address[0], self._metrics_port
-            ).start()
-        self._thread = threading.Thread(
-            target=self._tcp.serve_forever, name="klba-service", daemon=True
-        )
-        self._thread.start()
+            for max_p, consumers in sorted(set(self._recovery_shapes)):
+                warmup(
+                    max_partitions=max_p,
+                    consumers=[consumers],
+                    solvers=("stream",),
+                    coalesce_max_batch=coalesce_batch,
+                )
+        # The serving surfaces come up under the lifecycle lock: a
+        # drain/stop that raced the (possibly minutes-long) recovery
+        # warm-up — SIGTERM mid-deploy, with install_signal_handlers()
+        # armed before start() — has already closed the TCP socket, and
+        # spawning serve_forever on it (or resurrecting the metrics
+        # listener on a stopped instance) would crash the accept thread
+        # and present a service that can never answer.  _close_listener
+        # flips ``_listener_closed`` under this same lock, so exactly
+        # one side wins.
+        with self._lifecycle_lock:
+            if self._lifecycle != "serving" or self._listener_closed:
+                LOGGER.warning(
+                    "start() aborted: drain/stop arrived during "
+                    "recovery/warm-up; not opening the listener"
+                )
+                return self
+            if self._snapshot_writer is not None:
+                self._snapshot_writer.start()
+            if self._metrics_port is not None:
+                from .utils.metrics_http import MetricsHTTPServer
+
+                self._metrics_http = MetricsHTTPServer(
+                    self.address[0], self._metrics_port
+                ).start()
+            self._thread = threading.Thread(
+                target=self._tcp.serve_forever, name="klba-service",
+                daemon=True,
+            )
+            self._thread.start()
         LOGGER.info("assignor service listening on %s:%d", *self.address)
         return self
 
@@ -1581,13 +2181,18 @@ class AssignorService:
         return self._metrics_http.address
 
     def stop(self) -> None:
-        self._tcp.shutdown()
-        self._tcp.server_close()
+        """Immediate stop WITHOUT a drain: no admission wind-down and
+        no FINAL snapshot (the file holds whatever the periodic
+        cadence last wrote — the crash-equivalent contract the restart
+        drills rely on).  Use :meth:`begin_drain` for the graceful
+        path; stop() after a completed drain is a no-op."""
+        if self._snapshot_writer is not None:
+            self._snapshot_writer.close()
+        self._close_listener()
         if self._coalescer is not None:
             self._coalescer.close()
-        if self._metrics_http is not None:
-            self._metrics_http.stop()
-            self._metrics_http = None
+        self._set_lifecycle("stopped")
+        self._stopped_event.set()
 
     def __enter__(self) -> "AssignorService":
         return self.start()
@@ -1815,6 +2420,28 @@ def main() -> None:
         help="disable the double-buffered flush pipeline (strict-"
              "serial upload/dispatch/readback per wave)",
     )
+    parser.add_argument(
+        "--snapshot-path", default=None, metavar="FILE",
+        help="crash-safe lifecycle snapshot file (atomic writes); "
+             "enables warm-restart recovery at boot; omit to disable",
+    )
+    parser.add_argument(
+        "--snapshot-interval-ms", type=float, default=30_000.0,
+        metavar="MS",
+        help="periodic snapshot cadence (churn writes happen sooner; "
+             "default 30000)",
+    )
+    parser.add_argument(
+        "--snapshot-max-age-ms", type=float, default=900_000.0,
+        metavar="MS",
+        help="boot-time staleness guard: an older snapshot rehydrates "
+             "nothing (default 900000)",
+    )
+    parser.add_argument(
+        "--drain-timeout-ms", type=float, default=10_000.0, metavar="MS",
+        help="graceful-drain window for in-flight requests and "
+             "coalescer waves (default 10000)",
+    )
     opts = parser.parse_args()
     service = AssignorService(
         opts.host, opts.port, warmup_shapes=opts.warmup,
@@ -1823,12 +2450,19 @@ def main() -> None:
         coalesce_lock_waves=opts.coalesce_lock_waves,
         coalesce_pipeline=not opts.coalesce_serial,
         metrics_port=opts.metrics_port,
-    ).start()
+        snapshot_path=opts.snapshot_path,
+        snapshot_interval_s=max(opts.snapshot_interval_ms, 1.0) / 1000.0,
+        snapshot_max_age_s=max(opts.snapshot_max_age_ms, 1.0) / 1000.0,
+        drain_timeout_s=max(opts.drain_timeout_ms, 0.0) / 1000.0,
+    )
+    # SIGTERM/SIGINT drain gracefully: admissions stop with a
+    # structured retry-after reject, in-flight waves flush, the final
+    # snapshot lands, the listener closes — a deploy is a non-event
+    # (DEPLOYMENT.md "Restarts and recovery").
+    service.install_signal_handlers()
+    service.start()
     print(f"listening on {service.address[0]}:{service.address[1]}", flush=True)
-    try:
-        threading.Event().wait()
-    except KeyboardInterrupt:
-        service.stop()
+    service.wait_stopped()
 
 
 if __name__ == "__main__":

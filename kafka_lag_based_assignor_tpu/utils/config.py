@@ -86,6 +86,22 @@ OVERLOAD_DEPTH_HIGH_CONFIG = "tpu.assignor.overload.depth.high"
 # sidecar shim.  0/unset disables (the JSON wire `metrics` method is
 # always available).
 METRICS_PORT_CONFIG = "tpu.assignor.metrics.port"
+# Lifecycle snapshots + graceful drain (utils/snapshot, served by the
+# sidecar; DEPLOYMENT.md "Restarts and recovery").  ``snapshot.path``
+# names the snapshot FILE (written atomically: tmp + rename) —
+# empty/unset disables snapshots AND recovery.  ``snapshot.interval.ms``
+# is the periodic write cadence (churn events additionally trigger a
+# debounced early write).  ``snapshot.max.age.ms`` is the per-boot
+# staleness guard: a snapshot older than this at recovery rehydrates
+# NOTHING (counted stale cold start) — lag trends and rosters that old
+# are misinformation, not warm state.  ``drain.timeout.ms`` bounds how
+# long a graceful drain (SIGTERM / wire ``drain``) waits for in-flight
+# requests and coalescer waves before writing the final snapshot and
+# closing the listener anyway.
+SNAPSHOT_PATH_CONFIG = "tpu.assignor.snapshot.path"
+SNAPSHOT_INTERVAL_CONFIG = "tpu.assignor.snapshot.interval.ms"
+SNAPSHOT_MAX_AGE_CONFIG = "tpu.assignor.snapshot.max.age.ms"
+DRAIN_TIMEOUT_CONFIG = "tpu.assignor.drain.timeout.ms"
 # "P:C[:T][,P:C[:T]...]" — shapes to pre-compile at configure() time
 # (consumer startup, NOT on the rebalance critical path): each entry warms
 # the kernels for max_partitions P / num_consumers C / a topic batch of T
@@ -180,6 +196,11 @@ class AssignorConfig:
     overload_depth_high: float = 24.0
     # Plain-HTTP /metrics port (utils/metrics_http); None = disabled.
     metrics_port: Optional[int] = None
+    # Lifecycle snapshots + drain (utils/snapshot; None path disables).
+    snapshot_path: Optional[str] = None
+    snapshot_interval_s: float = 30.0
+    snapshot_max_age_s: float = 900.0
+    drain_timeout_s: float = 10.0
     # (max_partitions, num_consumers) shapes to pre-compile at configure().
     warmup_shapes: list = field(default_factory=list)
     consumer_group_props: Dict[str, Any] = field(default_factory=dict)
@@ -277,6 +298,20 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
 
     metrics_port = _as_int(METRICS_PORT_CONFIG, 0, 0)
 
+    raw_snap_path = consumer_group_props.get(SNAPSHOT_PATH_CONFIG, "")
+    snapshot_path = (
+        str(raw_snap_path) if raw_snap_path not in (None, "") else None
+    )
+    snapshot_interval_s = _as_ms(SNAPSHOT_INTERVAL_CONFIG, 30_000.0)
+    if snapshot_interval_s <= 0:
+        raise ValueError(
+            f"{SNAPSHOT_INTERVAL_CONFIG} must be > 0 ms"
+        )
+    snapshot_max_age_s = _as_ms(SNAPSHOT_MAX_AGE_CONFIG, 900_000.0)
+    if snapshot_max_age_s <= 0:
+        raise ValueError(f"{SNAPSHOT_MAX_AGE_CONFIG} must be > 0 ms")
+    drain_timeout_s = _as_ms(DRAIN_TIMEOUT_CONFIG, 10_000.0)
+
     # SLO class map + per-class deadline budgets: prefix-keyed entries,
     # validated against the class roster (utils/overload) so a typo'd
     # class fails at configure() time, not mid-stampede.
@@ -348,6 +383,10 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
         overload_latency_budget_ms=overload_latency_budget_ms,
         overload_depth_high=overload_depth_high,
         metrics_port=metrics_port if metrics_port > 0 else None,
+        snapshot_path=snapshot_path,
+        snapshot_interval_s=snapshot_interval_s,
+        snapshot_max_age_s=snapshot_max_age_s,
+        drain_timeout_s=drain_timeout_s,
         warmup_shapes=warmup_shapes,
         consumer_group_props=consumer_group_props,
         metadata_consumer_props=metadata_consumer_props,

@@ -35,6 +35,18 @@ Named fault points (every one threaded through production code):
                     (:meth:`..utils.overload.OverloadController.
                     admission`) — the service FAILS OPEN (admits) when
                     the shed decision itself faults
+``snapshot.write``  a lifecycle snapshot save (:meth:`..utils.snapshot.
+                    SnapshotStore.save`) — a failure here exercises the
+                    fail-open write contract (serving continues on the
+                    previous snapshot, counted as a write error)
+``snapshot.load``   boot-time snapshot load (:meth:`..utils.snapshot.
+                    SnapshotStore.load`) — a failure here exercises the
+                    fail-open recovery contract (counted cold start,
+                    never an exception into the serving path)
+``drain.flush``     the graceful drain's coalescer quiesce
+                    (:meth:`..ops.coalesce.MegabatchCoalescer.drain`)
+                    — a failure here must not stop the drain from
+                    writing its final snapshot and closing the listener
 ``lag.begin``       the ListOffsets(beginning) broker RPC (:mod:`..lag`)
 ``lag.end``         the ListOffsets(end) broker RPC
 ``lag.committed``   the OffsetFetch broker RPC
@@ -91,6 +103,9 @@ FAULT_POINTS = frozenset(
         "coalesce.gather",
         "admit.park",
         "shed.decide",
+        "snapshot.write",
+        "snapshot.load",
+        "drain.flush",
         "lag.begin",
         "lag.end",
         "lag.committed",

@@ -762,13 +762,23 @@ class FlightRecorder:
         ).inc()
         if write_file:
             try:
-                os.makedirs(self.dump_dir, exist_ok=True)
+                # Durable writes go through the atomic helper (tmp +
+                # rename; lint L015): an incident dump racing a crash
+                # must never leave a torn file for the post-mortem.
+                # Imported lazily — utils/snapshot imports this module
+                # for its telemetry.
+                from .snapshot import atomic_write_bytes
+
                 path = os.path.join(
                     self.dump_dir,
                     f"flight-{seq % self.keep_files}.json",
                 )
-                with open(path, "w") as f:
-                    json.dump(payload, f, indent=2, sort_keys=True)
+                atomic_write_bytes(
+                    path,
+                    json.dumps(
+                        payload, indent=2, sort_keys=True
+                    ).encode("utf-8"),
+                )
             except OSError:
                 LOGGER.warning(
                     "flight-recorder dump to %s failed", self.dump_dir,

@@ -445,6 +445,48 @@ class OverloadController:
         with self._lock:
             return self._rung
 
+    # -- lifecycle snapshot (utils/snapshot) -------------------------------
+
+    def export_state(self) -> Dict[str, Any]:
+        """Host-durable ladder state for the lifecycle snapshot: the
+        rung plus the pressure signals that produced it.  Restoring the
+        rung is what keeps a restart from serving the post-deploy
+        stampede at rung 0 with a zeroed detector — the ladder resumes
+        where it was and de-escalates through the normal hysteresis."""
+        with self._lock:
+            return {
+                "rung": self._rung,
+                "pressure": self._pressure,
+                "ewma_depth": self._ewma_depth,
+                "p99_ms": self._p99_ms,
+            }
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        """Adopt exported ladder state after a restart (clamped to the
+        known rungs; malformed input is discarded whole — overload
+        control fails open, same contract as the admission path).  The
+        step-down clock restarts now, so de-escalation still waits a
+        full ``cooldown_s`` before the first downward step."""
+        try:
+            rung = min(max(int(state.get("rung", 0)), 0), len(RUNGS) - 1)
+            pressure = float(state.get("pressure", 0.0))
+            ewma = float(state.get("ewma_depth", 0.0))
+            p99 = state.get("p99_ms")
+            p99_ms = float(p99) if p99 is not None else None
+        except (TypeError, ValueError, AttributeError):
+            LOGGER.warning(
+                "discarding malformed overload snapshot", exc_info=True
+            )
+            return
+        with self._lock:
+            self._rung = rung
+            self._pressure = pressure
+            self._ewma_depth = ewma
+            self._p99_ms = p99_ms
+            self._last_step_down = self._clock()
+            self._m_rung.set(rung)
+            self._m_pressure.set(pressure)
+
     def snapshot(self) -> Dict[str, Any]:
         """The operator's view (wire ``stats`` / ``recommend``)."""
         with self._lock:

@@ -1,0 +1,432 @@
+"""Crash-safe lifecycle snapshots: the sidecar's warm state, durable.
+
+Rounds 7 and 11 hardened the service against *external* failures, but
+every byte of warm state — per-stream choices and rosters, SLO classes,
+the recommend call's lag-trend windows, breaker cooldowns, the overload
+rung — lived only in process memory.  A deploy or crash therefore
+cold-started ALL tenants at once: the self-inflicted stampede the
+round-11 shed ladder exists to survive, and a blackout for the
+elasticity loop (the lag history an external autoscaler projects from,
+arXiv:2402.06085).  This module makes restarts a non-event: the
+service periodically (and on churn) snapshots its host-recoverable
+state, and a restarting process rehydrates from it off the serving
+path (see service.py's recovery and DEPLOYMENT.md "Restarts and
+recovery").
+
+Format (one JSON document)::
+
+    {"format": "klba-snapshot", "version": 1, "written_at": <unix s>,
+     "sections": {"streams":  {"crc32": <int>, "body": {...}},
+                  "breakers": {"crc32": <int>, "body": {...}},
+                  "overload": {"crc32": <int>, "body": {...}}}}
+
+Design rules, in failure-model order:
+
+* **Atomic**: a snapshot is written to a same-directory temp file and
+  ``os.rename``-d into place (:func:`atomic_write_bytes` — THE helper
+  every durable package write must go through, lint rule L015), so a
+  crash mid-write leaves the previous snapshot intact and a reader can
+  never observe a torn file from this writer.
+* **Versioned**: a loader only trusts ``version == SNAPSHOT_VERSION``.
+  A WRONG version (older writer) and a FUTURE version (newer writer, a
+  rolled-back deploy) both load as a counted cold start — never a
+  guess at a foreign schema.
+* **Per-section checksummed**: each section's body carries a CRC32 of
+  its canonical JSON encoding.  A corrupt section (bit rot, a torn
+  copy) is SKIPPED and counted — the other sections still load; losing
+  the breaker states must not cost every tenant its warm roster.
+* **Fail-open**: :meth:`SnapshotStore.load` never raises into the
+  serving path.  Anything unreadable — missing file, truncated JSON,
+  wrong format marker — is a counted cold start; anything partially
+  readable is a counted partial load.  :meth:`SnapshotStore.save`
+  never raises either (an outage of the snapshot volume must not take
+  the sidecar down); failures land in
+  ``klba_snapshot_writes_total{outcome="error"}``.
+
+Fault points (utils/faults, wired into the chaos suite):
+``snapshot.write`` fires at the head of every save, ``snapshot.load``
+at the head of every load — both exercise the fail-open contracts
+above.
+
+Telemetry: ``klba_snapshot_writes_total{outcome}``,
+``klba_snapshot_write_duration_ms``, ``klba_snapshot_bytes``,
+``klba_snapshot_loads_total{outcome}``,
+``klba_snapshot_sections_skipped_total{section}``.
+
+Clock discipline: durations flow through the registry clock (L012);
+``written_at`` / snapshot age need a WALL clock that survives a
+process restart, so the store takes an injectable ``wall_clock``
+defaulting to ``time.time`` (referenced, never called directly).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import zlib
+from typing import Any, Callable, Dict, List, Optional
+
+from . import faults, metrics
+
+LOGGER = logging.getLogger(__name__)
+
+#: The schema version THIS writer produces and the only one the loader
+#: trusts.  Bump it on any incompatible body change; the rollout story
+#: (DEPLOYMENT.md "Restarts and recovery") is that a version mismatch
+#: is a clean cold start, never a migration attempt in the sidecar.
+SNAPSHOT_VERSION = 1
+
+_FORMAT = "klba-snapshot"
+
+#: Load outcomes, the ``klba_snapshot_loads_total`` label values:
+#: ``ok`` (every section verified), ``partial`` (>= 1 section skipped),
+#: ``cold`` (nothing usable: corrupt/wrong-version/unreadable),
+#: ``missing`` (no file — the normal first boot).
+LOAD_OUTCOMES = ("ok", "partial", "cold", "missing")
+
+
+def _canonical(body: Any) -> bytes:
+    """THE byte encoding the section checksums are computed over —
+    shared by save and load so the two can never disagree on
+    whitespace or key order."""
+    return json.dumps(
+        body, sort_keys=True, separators=(",", ":")
+    ).encode("utf-8")
+
+
+def section_crc(body: Any) -> int:
+    """CRC32 of a section body's canonical encoding (exposed so tests
+    can build hand-tampered snapshots)."""
+    return zlib.crc32(_canonical(body))
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """THE durable-write helper (lint rule L015): write ``data`` to a
+    same-directory temp file, fsync, then ``os.rename`` over ``path``.
+    A reader can observe the old file or the new file, never a torn
+    mix; a crash mid-write leaves the old file untouched.  The temp
+    name carries the pid so two processes pointed at one path cannot
+    corrupt each other's staging (last rename still wins, atomically).
+    """
+    directory = os.path.dirname(os.path.abspath(path))
+    os.makedirs(directory, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.rename(tmp, path)
+    except BaseException:
+        # Never leave staging litter next to the real file; the rename
+        # either happened (tmp is gone) or the write is abandoned.
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+class LoadResult:
+    """One load's outcome: the verified section bodies, what was
+    skipped, and the snapshot's age (seconds at load time, from the
+    file's own ``written_at`` wall-clock stamp)."""
+
+    __slots__ = ("outcome", "sections", "skipped", "age_s", "reason")
+
+    def __init__(
+        self,
+        outcome: str,
+        sections: Dict[str, Any],
+        skipped: List[str],
+        age_s: Optional[float],
+        reason: Optional[str] = None,
+    ):
+        self.outcome = outcome
+        self.sections = sections
+        self.skipped = skipped
+        self.age_s = age_s
+        self.reason = reason
+
+
+class SnapshotStore:
+    """Owns one snapshot path: atomic save, corruption-tolerant load.
+
+    ``wall_clock`` stamps ``written_at`` (it must survive restarts, so
+    it is wall time, not the registry's perf counter); durations still
+    flow through the registry clock.  Thread-safe: saves serialize on
+    an internal lock (the periodic writer, a churn trigger, and the
+    drain's final snapshot may race)."""
+
+    def __init__(
+        self,
+        path: str,
+        wall_clock: Callable[[], float] = time.time,
+    ):
+        if not path:
+            raise ValueError("snapshot path must be non-empty")
+        self.path = str(path)
+        self._wall = wall_clock
+        self._lock = threading.Lock()
+        # Last successful save's wall stamp + size, for the lifecycle
+        # stats surface (None until a save succeeds or a load finds a
+        # file).
+        self._last_written_at: Optional[float] = None
+        self._last_bytes: Optional[int] = None
+        self._m_writes = {
+            o: metrics.REGISTRY.counter(
+                "klba_snapshot_writes_total", {"outcome": o}
+            )
+            for o in ("ok", "error")
+        }
+        self._m_write_ms = metrics.REGISTRY.histogram(
+            "klba_snapshot_write_duration_ms"
+        )
+        self._m_bytes = metrics.REGISTRY.gauge("klba_snapshot_bytes")
+        self._m_loads = {
+            o: metrics.REGISTRY.counter(
+                "klba_snapshot_loads_total", {"outcome": o}
+            )
+            for o in LOAD_OUTCOMES
+        }
+
+    # -- save --------------------------------------------------------------
+
+    def save(self, sections: Dict[str, Any]) -> Dict[str, Any]:
+        """Write one snapshot atomically; NEVER raises (a snapshot
+        volume outage must not take the service down).  Returns
+        ``{"ok", "bytes", "duration_ms"[, "error"]}``.  Fault point
+        ``snapshot.write`` fires first — an injected failure exercises
+        exactly the fail-open path a full disk would."""
+        started = metrics.REGISTRY.clock()
+        try:
+            faults.fire("snapshot.write")
+            payload = {
+                "format": _FORMAT,
+                "version": SNAPSHOT_VERSION,
+                "written_at": self._wall(),
+                "sections": {
+                    name: {"crc32": section_crc(body), "body": body}
+                    for name, body in sections.items()
+                },
+            }
+            data = json.dumps(payload, sort_keys=True).encode("utf-8")
+            with self._lock:
+                atomic_write_bytes(self.path, data)
+                self._last_written_at = payload["written_at"]
+                self._last_bytes = len(data)
+        except Exception as exc:  # noqa: BLE001 — fail-open by contract
+            LOGGER.warning(
+                "snapshot save to %s failed; serving continues on the "
+                "previous snapshot", self.path, exc_info=True,
+            )
+            self._m_writes["error"].inc()
+            return {"ok": False, "error": str(exc)}
+        duration_ms = (metrics.REGISTRY.clock() - started) * 1000.0
+        self._m_writes["ok"].inc()
+        self._m_write_ms.observe(duration_ms)
+        self._m_bytes.set(len(data))
+        return {"ok": True, "bytes": len(data), "duration_ms": duration_ms}
+
+    # -- load --------------------------------------------------------------
+
+    def load(self) -> LoadResult:
+        """Read + verify the snapshot; NEVER raises into the serving
+        path.  A bad section is skipped and counted; an unusable file
+        is a counted cold start.  Fault point ``snapshot.load`` fires
+        first (fails open to cold)."""
+        skipped: List[str] = []
+        try:
+            faults.fire("snapshot.load")
+            try:
+                with open(self.path, "rb") as f:
+                    raw = f.read()
+            except FileNotFoundError:
+                return self._finish(
+                    LoadResult("missing", {}, [], None, "no snapshot file")
+                )
+            payload = json.loads(raw.decode("utf-8"))
+            if (
+                not isinstance(payload, dict)
+                or payload.get("format") != _FORMAT
+            ):
+                return self._finish(LoadResult(
+                    "cold", {}, [], None, "not a klba snapshot"
+                ))
+            version = payload.get("version")
+            if version != SNAPSHOT_VERSION:
+                # Wrong OR future version: a foreign schema is a clean
+                # cold start, never a guess (DEPLOYMENT.md versioning
+                # policy).
+                return self._finish(LoadResult(
+                    "cold", {}, [], None,
+                    f"snapshot version {version!r} != {SNAPSHOT_VERSION}",
+                ))
+            written_at = payload.get("written_at")
+            age_s = (
+                max(0.0, self._wall() - float(written_at))
+                if isinstance(written_at, (int, float)) else None
+            )
+            sections_in = payload.get("sections")
+            if not isinstance(sections_in, dict):
+                return self._finish(LoadResult(
+                    "cold", {}, [], age_s, "sections block missing"
+                ))
+            sections: Dict[str, Any] = {}
+            for name, entry in sections_in.items():
+                try:
+                    body = entry["body"]
+                    if int(entry["crc32"]) != section_crc(body):
+                        raise ValueError("checksum mismatch")
+                except Exception:  # noqa: BLE001 — skip + count, per section
+                    LOGGER.warning(
+                        "snapshot section %r failed verification; "
+                        "skipping it (other sections still load)",
+                        name, exc_info=True,
+                    )
+                    skipped.append(str(name))
+                    metrics.REGISTRY.counter(
+                        "klba_snapshot_sections_skipped_total",
+                        {"section": str(name)},
+                    ).inc()
+                    continue
+                sections[str(name)] = body
+            if isinstance(written_at, (int, float)):
+                with self._lock:
+                    if self._last_written_at is None:
+                        self._last_written_at = float(written_at)
+                        self._last_bytes = len(raw)
+            if not sections and skipped:
+                return self._finish(LoadResult(
+                    "cold", {}, skipped, age_s, "every section corrupt"
+                ))
+            outcome = "partial" if skipped else "ok"
+            return self._finish(
+                LoadResult(outcome, sections, skipped, age_s)
+            )
+        except Exception as exc:  # noqa: BLE001 — fail-open by contract
+            LOGGER.warning(
+                "snapshot load from %s failed; cold start",
+                self.path, exc_info=True,
+            )
+            return self._finish(
+                LoadResult("cold", {}, skipped, None, str(exc))
+            )
+
+    def _finish(self, result: LoadResult) -> LoadResult:
+        self._m_loads[result.outcome].inc()
+        if result.outcome != "ok":
+            LOGGER.warning(
+                "snapshot load outcome=%s skipped=%s reason=%s",
+                result.outcome, result.skipped, result.reason,
+            )
+        return result
+
+    # -- observability ------------------------------------------------------
+
+    def age_s(self) -> Optional[float]:
+        """Seconds since the last KNOWN successful write (this process
+        or, after a load, the loaded file's stamp); None before
+        either."""
+        with self._lock:
+            if self._last_written_at is None:
+                return None
+            return max(0.0, self._wall() - self._last_written_at)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            last = self._last_written_at
+            size = self._last_bytes
+        return {
+            "path": self.path,
+            "age_s": (
+                max(0.0, self._wall() - last) if last is not None else None
+            ),
+            "bytes": size,
+            "writes": self._m_writes["ok"].value,
+            "write_errors": self._m_writes["error"].value,
+        }
+
+
+class SnapshotWriter:
+    """Background snapshot cadence: one daemon thread writes
+    ``collect()``'s sections through ``store`` every ``interval_s``,
+    plus soon after any :meth:`mark_churn` (debounced — a registration
+    storm coalesces into one write, bounded by ``debounce_s``).  The
+    writer never raises (the store's save is fail-open); ``close()``
+    stops the thread WITHOUT a final write — the drain path owns the
+    final snapshot explicitly, and a crash by definition never gets
+    one."""
+
+    def __init__(
+        self,
+        store: SnapshotStore,
+        collect: Callable[[], Dict[str, Any]],
+        interval_s: float = 30.0,
+        debounce_s: float = 0.2,
+    ):
+        if interval_s <= 0:
+            raise ValueError(f"interval_s={interval_s} must be > 0")
+        self._store = store
+        self._collect = collect
+        self.interval_s = float(interval_s)
+        self.debounce_s = min(float(debounce_s), self.interval_s)
+        self._cond = threading.Condition()
+        self._churn = False
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "SnapshotWriter":
+        with self._cond:
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, name="klba-snapshot", daemon=True
+                )
+                self._thread.start()
+        return self
+
+    def mark_churn(self) -> None:
+        """State changed (stream joined/left/poisoned, membership
+        moved): write a snapshot soon, ahead of the cadence."""
+        with self._cond:
+            self._churn = True
+            self._cond.notify_all()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def write_now(self) -> Dict[str, Any]:
+        """One synchronous snapshot through the store (the drain's
+        final write and the operator's on-demand path)."""
+        try:
+            return self._store.save(self._collect())
+        except Exception as exc:  # noqa: BLE001 — collector fail-open
+            LOGGER.warning(
+                "snapshot collection failed; skipping this write",
+                exc_info=True,
+            )
+            return {"ok": False, "error": str(exc)}
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                if not self._closed and not self._churn:
+                    self._cond.wait(self.interval_s)
+                if self._closed:
+                    return
+                churned = self._churn
+            if churned:
+                # Debounce a churn burst into one write; a close during
+                # the debounce still exits without writing (the drain
+                # owns the final snapshot).
+                with self._cond:
+                    self._cond.wait(self.debounce_s)
+                    if self._closed:
+                        return
+                    self._churn = False
+            self.write_now()

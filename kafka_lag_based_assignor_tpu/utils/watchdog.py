@@ -199,6 +199,67 @@ class Watchdog:
                 br.consecutive_failures = 0
                 br.probe_in_flight = False
 
+    # -- lifecycle snapshot (utils/snapshot; DEPLOYMENT.md "Restarts") -----
+
+    def export_state(self) -> Dict[str, Dict[str, Any]]:
+        """Host-durable view of every breaker for the lifecycle
+        snapshot.  ``tripped_at`` is a monotonic instant that dies with
+        the process, so an open breaker exports its REMAINING cooldown
+        instead — the restored breaker resumes the remainder, not a
+        fresh full cooldown (a restart must not extend a sidelining)
+        and not an instant close (a restart must not reset a wedged
+        device's quarantine)."""
+        with self._lock:
+            now = self._clock()
+            out: Dict[str, Dict[str, Any]] = {}
+            for key, br in self._breakers.items():
+                remaining = 0.0
+                if br.state == STATE_OPEN and br.tripped_at is not None:
+                    remaining = max(
+                        0.0, self.cooldown_s - (now - br.tripped_at)
+                    )
+                out[key] = {
+                    "state": self._effective_state(br),
+                    "cooldown_remaining_s": remaining,
+                    "consecutive_failures": br.consecutive_failures,
+                    "trips": br.trips,
+                }
+            return out
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        """Adopt exported breaker state after a restart: an open
+        breaker resumes the remainder of its cooldown (clamped to this
+        process's configured cooldown), failure/trip counters carry
+        over, and the half-open probe slot is always reset (a probe
+        never survives a process).  Malformed entries are discarded
+        per key — a corrupt breaker record must not cost the others."""
+        with self._lock:
+            now = self._clock()
+            for key, info in dict(state or {}).items():
+                try:
+                    br = self._breaker(str(key))
+                    br.consecutive_failures = int(
+                        info.get("consecutive_failures", 0)
+                    )
+                    br.trips = int(info.get("trips", 0))
+                    remaining = min(
+                        max(float(info.get("cooldown_remaining_s", 0.0)),
+                            0.0),
+                        self.cooldown_s,
+                    )
+                    if info.get("state") == STATE_OPEN and remaining > 0:
+                        br.state = STATE_OPEN
+                        br.tripped_at = now - (self.cooldown_s - remaining)
+                    else:
+                        br.state = STATE_CLOSED
+                        br.tripped_at = None
+                    br.probe_in_flight = False
+                except (TypeError, ValueError, AttributeError):
+                    LOGGER.warning(
+                        "discarding malformed breaker snapshot for %r",
+                        key, exc_info=True,
+                    )
+
     # -- transitions (hold the lock) --------------------------------------
 
     def _trip(self, br: _Breaker) -> bool:

@@ -169,6 +169,14 @@ def warmup(
                     # compiles exactly that executable.
                     engine.remap_members(np.arange(C, dtype=np.int32), C)
                     engine.rebalance(lags1d)
+                    # Warm-restart recovery (service._recover) replays
+                    # seed_choice + rebalance: a host-seeded choice with
+                    # stale device state, the same table-build
+                    # executable as the repair epoch above — driven
+                    # explicitly so the recovery path stays pinned to
+                    # warmed code even if the two variants ever drift.
+                    engine.seed_choice(np.asarray(out))
+                    engine.rebalance(lags1d)
                     # assign_stream downcasts the upload to int32 when the
                     # lag range allows; ALSO warm the wide-lag (int64)
                     # variants of both the stream kernel and the fused

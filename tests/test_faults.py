@@ -528,6 +528,98 @@ def test_client_reconnects_after_server_side_drop(service):
         assert c.reconnects == 1
 
 
+# -- lifecycle fault points (ISSUE 7) ------------------------------------
+
+
+class TestLifecycleFaults:
+    """``snapshot.write`` / ``snapshot.load`` / ``drain.flush`` under
+    the chaos invariant: an injected lifecycle fault may cost a
+    snapshot or a warm restart, NEVER a serving-path error."""
+
+    MEMBERS = ["C0", "C1", "C2", "C3"]
+
+    def _rows(self, seed):
+        arr = np.random.default_rng(seed).integers(0, 10**6, 256)
+        return [[i, int(v)] for i, v in enumerate(arr)]
+
+    def test_snapshot_write_fault_keeps_serving(self, tmp_path):
+        svc = AssignorService(
+            port=0, snapshot_path=str(tmp_path / "s.json"),
+            snapshot_interval_s=3600.0, recovery_warmup=False,
+        ).start()
+        try:
+            with client_for(svc) as c:
+                c.stream_assign("s1", "t0", self._rows(1), self.MEMBERS)
+                with faults.injected(
+                    faults.FaultInjector(0).plan("snapshot.write")
+                ):
+                    assert not svc.snapshot_now()["ok"]
+                    # Serving is untouched while the snapshot volume
+                    # is down.
+                    r = c.stream_assign(
+                        "s1", "t0", self._rows(2), self.MEMBERS
+                    )
+                    assert_valid_assignment(r["assignments"], 256)
+                # The fault cleared: the next write succeeds.
+                assert svc.snapshot_now()["ok"]
+        finally:
+            svc.stop()
+
+    def test_snapshot_load_fault_cold_starts_and_serves(self, tmp_path):
+        path = str(tmp_path / "s.json")
+        svc = AssignorService(
+            port=0, snapshot_path=path,
+            snapshot_interval_s=3600.0, recovery_warmup=False,
+        ).start()
+        try:
+            with client_for(svc) as c:
+                c.stream_assign("s1", "t0", self._rows(1), self.MEMBERS)
+            assert svc.snapshot_now()["ok"]
+        finally:
+            svc.stop()
+        with faults.injected(
+            faults.FaultInjector(0).plan("snapshot.load")
+        ):
+            svc2 = AssignorService(
+                port=0, snapshot_path=path,
+                snapshot_interval_s=3600.0, recovery_warmup=False,
+            ).start()
+        try:
+            assert svc2._last_recovery["outcome"] == "cold"
+            with client_for(svc2) as c:
+                r = c.stream_assign(
+                    "s1", "t0", self._rows(3), self.MEMBERS
+                )
+                assert r["stream"]["cold_start"]
+                assert_valid_assignment(r["assignments"], 256)
+        finally:
+            svc2.stop()
+
+    def test_drain_flush_fault_drain_still_completes(self, tmp_path):
+        path = str(tmp_path / "s.json")
+        svc = AssignorService(
+            port=0, snapshot_path=path, drain_timeout_s=5.0,
+            snapshot_interval_s=3600.0, recovery_warmup=False,
+        ).start()
+        try:
+            with client_for(svc) as c:
+                c.stream_assign("s1", "t0", self._rows(1), self.MEMBERS)
+                c.stream_assign("s2", "t0", self._rows(2), self.MEMBERS)
+            with faults.injected(
+                faults.FaultInjector(0).plan("drain.flush")
+            ):
+                assert svc.begin_drain()
+                assert svc.wait_stopped(15.0)
+            # The final snapshot landed despite the flush fault.
+            from kafka_lag_based_assignor_tpu.utils.snapshot import (
+                SnapshotStore,
+            )
+
+            assert SnapshotStore(path).load().outcome == "ok"
+        finally:
+            svc.stop()
+
+
 # -- the seeded chaos soak (slow tier) -----------------------------------
 
 

@@ -228,3 +228,59 @@ def test_lint_unbounded_buffers_in_package():
     assert not any(
         f.code == "L014" for f in lint.lint_source(Path("tests/x.py"), bad)
     )
+
+
+def test_lint_bare_write_open_in_package():
+    """L015: durable package writes (snapshots, flight dumps) must go
+    through the atomic write helper — a bare open(..., 'w') can leave
+    a torn file for the recovery path to trip over.  Write-mode opens
+    are sanctioned only inside an ``atomic_write*`` function."""
+    pkg = Path("kafka_lag_based_assignor_tpu/utils/state.py")
+    bad = (
+        "def dump(path, data):\n"
+        "    with open(path, 'w') as f:\n"
+        "        f.write(data)\n"
+    )
+    assert any(f.code == "L015" for f in lint.lint_source(pkg, bad))
+    # Binary write, append, create, and mode= keyword all count.
+    for mode in ("'wb'", "'a'", "'x'", "'r+'", "mode='w'"):
+        variant = bad.replace("open(path, 'w')", f"open(path, {mode})")
+        assert any(
+            f.code == "L015" for f in lint.lint_source(pkg, variant)
+        ), mode
+    # Read-mode (and default-mode) opens are untouched.
+    for mode_src in ("open(path)", "open(path, 'rb')", "open(path, 'r')"):
+        ok = bad.replace("open(path, 'w')", mode_src)
+        assert not any(
+            f.code == "L015" for f in lint.lint_source(pkg, ok)
+        ), mode_src
+    # The helper's own implementation (any atomic_write* function,
+    # including nested closures) is the sanctioned home.
+    helper = (
+        "def atomic_write_bytes(path, data):\n"
+        "    with open(path + '.tmp', 'wb') as f:\n"
+        "        f.write(data)\n"
+    )
+    assert not any(f.code == "L015" for f in lint.lint_source(pkg, helper))
+    nested = (
+        "def atomic_write_json(path, obj):\n"
+        "    def _spill():\n"
+        "        with open(path + '.tmp', 'w') as f:\n"
+        "            f.write(obj)\n"
+        "    _spill()\n"
+    )
+    assert not any(f.code == "L015" for f in lint.lint_source(pkg, nested))
+    # A computed mode is taken on faith; a waiver silences; non-package
+    # scaffolding is out of scope.
+    computed = bad.replace("'w'", "mode_var")
+    assert not any(
+        f.code == "L015" for f in lint.lint_source(pkg, computed)
+    )
+    waived = bad.replace(
+        "open(path, 'w') as f:", "open(path, 'w') as f:  # noqa: L015"
+    )
+    assert not any(f.code == "L015" for f in lint.lint_source(pkg, waived))
+    assert not any(
+        f.code == "L015"
+        for f in lint.lint_source(Path("tools/x.py"), bad)
+    )

@@ -11,12 +11,16 @@ validates the plan-stats kernel); hardware timing is probed separately
 import numpy as np
 import pytest
 
-# The module's property fuzz needs the optional hypothesis extra
-# (pyproject `test`/`dev` extras): without it, skip the module cleanly
-# instead of failing collection.  (The interpret-mode parity tests here
-# are far too slow for the tier-1 gate anyway — they run in richer
-# environments where the extras are installed.)
-pytest.importorskip("hypothesis")
+# The property fuzz needs the optional hypothesis extra (pyproject
+# `test`/`dev` extras): without it, ONLY the fuzz test is skipped — the
+# host-side gate/regression tests below run in tier-1 regardless.  The
+# interpret-mode parity tests are far too slow for the tier-1 gate and
+# carry @pytest.mark.slow; they run in richer environments.
+try:
+    from hypothesis import given, settings, strategies as st
+    HAVE_HYPOTHESIS = True
+except ImportError:  # the tier-1 image lacks the extra
+    HAVE_HYPOTHESIS = False
 
 import jax
 import jax.numpy as jnp
@@ -29,13 +33,15 @@ from kafka_lag_based_assignor_tpu.ops.rounds_pallas import (
 )
 
 
-@pytest.fixture(scope="module", autouse=True)
+@pytest.fixture(scope="module")
 def _drop_interpreter_executables():
     """The Pallas interpreter materializes MANY tiny XLA:CPU executables
     (every interpreter step at every new shape); letting them accumulate
     has produced flaky LLVM-JIT segfaults in LATER modules' compiles
     (observed twice at test_streaming's engine fuzz).  Drop them when
-    this module finishes."""
+    this module finishes.  Requested by the interpret-mode tests only,
+    so a tier-1 run (which deselects them as slow) never pays a
+    mid-suite cache clear."""
     yield
     jax.clear_caches()
 
@@ -52,6 +58,8 @@ def sorted_case(seed, P, C, max_lag=10**5, all_valid=False):
     return lags, valid, n_valid
 
 
+@pytest.mark.slow
+@pytest.mark.usefixtures("_drop_interpreter_executables")
 @pytest.mark.parametrize("seed", range(3))
 @pytest.mark.parametrize(
     "P,C",
@@ -76,6 +84,8 @@ def test_pallas_matches_xla_scan(seed, P, C):
     )
 
 
+@pytest.mark.slow
+@pytest.mark.usefixtures("_drop_interpreter_executables")
 def test_pallas_many_ties():
     """Equal lags everywhere: the id tiebreak alone orders every round."""
     P, C = 500, 16
@@ -125,6 +135,8 @@ def test_adapter_enforces_gate_and_empty_input():
     np.testing.assert_array_equal(np.asarray(totals), [0, 0])
 
 
+@pytest.mark.slow
+@pytest.mark.usefixtures("_drop_interpreter_executables")
 def test_stream_plumbing_parity_interpret():
     """The full stream composition around the Pallas core — packed
     processing-order sort, core scan, unsort — must reproduce
@@ -166,54 +178,57 @@ def test_stream_plumbing_parity_interpret():
     np.testing.assert_array_equal(got, ref)
 
 
-from hypothesis import given, settings, strategies as st  # noqa: E402
+if HAVE_HYPOTHESIS:
+
+    @st.composite
+    def pallas_instances(draw):
+        """Admissible Pallas instances: random P/C, tie-heavy or
+        spread lags, random valid prefix — Hypothesis shrinks any
+        parity violation."""
+        C = draw(st.integers(1, 64))
+        P = draw(st.integers(1, 300))
+        style = draw(st.integers(0, 2))
+        rng = np.random.default_rng(draw(st.integers(0, 2**31)))
+        if style == 0:
+            vals = rng.integers(0, 4, size=P)  # tie-heavy
+        elif style == 1:
+            vals = rng.integers(0, 10**6, size=P)
+        else:
+            vals = rng.integers(0, 2**28, size=P)  # near the totals gate
+        n_valid = draw(st.integers(0, P))
+        lags = np.zeros(P, dtype=np.int64)
+        lags[:n_valid] = -np.sort(-vals[:n_valid].astype(np.int64))
+        valid = np.arange(P) < n_valid
+        return lags, valid, n_valid, C
+
+    @pytest.mark.slow
+    @pytest.mark.usefixtures("_drop_interpreter_executables")
+    @settings(max_examples=15, deadline=None)
+    @given(pallas_instances())
+    def test_pallas_fuzz_matches_xla(instance):
+        lags, valid, n_valid, C = instance
+        total = int(lags.sum())
+        rounds = max(-(-len(lags) // C), 1)
+        if not pallas_rounds_supported(C, total, rounds):
+            return  # outside the gate (near-gate style can exceed it)
+        ref_totals, ref_choice = _rounds_scan(
+            jnp.asarray(lags), jnp.asarray(valid),
+            jnp.zeros((C,), jnp.int64), C, n_valid=n_valid,
+        )
+        p_totals, p_choice = assign_sorted_rounds_pallas(
+            lags, valid, num_consumers=C, n_valid=n_valid,
+            total_lag_bound=max(total, 1), interpret=True,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(p_choice), np.asarray(ref_choice)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(p_totals), np.asarray(ref_totals)
+        )
 
 
-@st.composite
-def pallas_instances(draw):
-    """Admissible Pallas instances: random P/C, tie-heavy or spread lags,
-    random valid prefix — Hypothesis shrinks any parity violation."""
-    C = draw(st.integers(1, 64))
-    P = draw(st.integers(1, 300))
-    style = draw(st.integers(0, 2))
-    rng = np.random.default_rng(draw(st.integers(0, 2**31)))
-    if style == 0:
-        vals = rng.integers(0, 4, size=P)  # tie-heavy
-    elif style == 1:
-        vals = rng.integers(0, 10**6, size=P)
-    else:
-        vals = rng.integers(0, 2**28, size=P)  # near the totals gate
-    n_valid = draw(st.integers(0, P))
-    lags = np.zeros(P, dtype=np.int64)
-    lags[:n_valid] = -np.sort(-vals[:n_valid].astype(np.int64))
-    valid = np.arange(P) < n_valid
-    return lags, valid, n_valid, C
-
-
-@settings(max_examples=15, deadline=None)
-@given(pallas_instances())
-def test_pallas_fuzz_matches_xla(instance):
-    lags, valid, n_valid, C = instance
-    total = int(lags.sum())
-    rounds = max(-(-len(lags) // C), 1)
-    if not pallas_rounds_supported(C, total, rounds):
-        return  # outside the gate (the near-gate style can exceed it)
-    ref_totals, ref_choice = _rounds_scan(
-        jnp.asarray(lags), jnp.asarray(valid),
-        jnp.zeros((C,), jnp.int64), C, n_valid=n_valid,
-    )
-    p_totals, p_choice = assign_sorted_rounds_pallas(
-        lags, valid, num_consumers=C, n_valid=n_valid,
-        total_lag_bound=max(total, 1), interpret=True,
-    )
-    np.testing.assert_array_equal(
-        np.asarray(p_choice), np.asarray(ref_choice)
-    )
-    np.testing.assert_array_equal(
-        np.asarray(p_totals), np.asarray(ref_totals)
-    )
-
-
+@pytest.mark.slow
+@pytest.mark.usefixtures("_drop_interpreter_executables")
 @pytest.mark.parametrize("T,P,C", [(5, 64, 8), (3, 40, 64), (8, 17, 4)])
 def test_global_pallas_matches_xla(T, P, C):
     """The global mode IS one long round sequence with carried totals —
@@ -257,6 +272,8 @@ def test_global_pallas_matches_xla(T, P, C):
     )
 
 
+@pytest.mark.slow
+@pytest.mark.usefixtures("_drop_interpreter_executables")
 def test_cold_chain_matches_xla_chain_interpret():
     """The Pallas cold chain (solve -> refine, one dispatch) must produce
     exactly what the XLA cold chain produces from the same budgets: both
@@ -299,6 +316,8 @@ def test_cold_chain_matches_xla_chain_interpret():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
+@pytest.mark.usefixtures("_drop_interpreter_executables")
 class TestWideTotals:
     """The two-plane (int64-totals) kernel variant: bias/carry logic is
     wide-only code, so it gets its own parity pins."""
@@ -384,19 +403,110 @@ class TestWideTotals:
             np.asarray(p_totals), np.asarray(ref_totals)
         )
 
-    def test_mode_boundaries(self):
-        from kafka_lag_based_assignor_tpu.ops.rounds_pallas import (
-            MAX_LAG_BOUND,
-            WIDE_TOTALS_BOUND,
-            pallas_rounds_mode,
-        )
+def test_mode_boundaries():
+    from kafka_lag_based_assignor_tpu.ops.rounds_pallas import (
+        MAX_LAG_BOUND,
+        WIDE_TOTALS_BOUND,
+        pallas_rounds_mode,
+    )
 
-        assert pallas_rounds_mode(8, TOTALS_BOUND - 1, 4, 100) == "narrow"
-        assert pallas_rounds_mode(8, TOTALS_BOUND, 4, 100) == "wide"
-        assert pallas_rounds_mode(
-            8, WIDE_TOTALS_BOUND, 4, 100
-        ) is None
-        # A single lag past 31 bits cannot ride the one-plane gains.
-        assert pallas_rounds_mode(
-            8, TOTALS_BOUND, 4, MAX_LAG_BOUND
-        ) is None
+    assert pallas_rounds_mode(8, TOTALS_BOUND - 1, 4, 100) == "narrow"
+    assert pallas_rounds_mode(8, TOTALS_BOUND, 4, 100) == "wide"
+    assert pallas_rounds_mode(
+        8, WIDE_TOTALS_BOUND, 4, 100
+    ) is None
+    # A single lag past 31 bits cannot ride the one-plane gains.
+    assert pallas_rounds_mode(
+        8, TOTALS_BOUND, 4, MAX_LAG_BOUND
+    ) is None
+
+
+# -- ADVICE round-5 regression pins (host-side, tier-1 fast) --------------
+
+
+def test_mode_for_empty_input_stays_on_xla():
+    """ADVICE r5: an empty lag array must NOT admit to the Pallas path
+    — the production inners have no R == 0 early-return, so a
+    zero-round pallas_call could be rejected by Mosaic at compile time
+    on hardware.  The XLA scan handles empty scans natively."""
+    from kafka_lag_based_assignor_tpu.ops.rounds_pallas import (
+        pallas_mode_for,
+    )
+
+    assert pallas_mode_for(np.empty(0, dtype=np.int64), 8, 1) is None
+    # A normal small instance still admits (the guard is not over-wide).
+    assert pallas_mode_for(
+        np.array([5, 3, 2], dtype=np.int64), 8, 1
+    ) == "narrow"
+
+
+def test_mode_for_negative_lags_stay_on_xla():
+    """ADVICE r5: the kernels read g >= 0 as the validity test, so an
+    out-of-contract negative lag on the Pallas path would silently be
+    treated as PADDING (partition left unassigned) while the XLA scan
+    assigns it — a silent divergence.  Contract violations must stay
+    on the XLA path, where behavior is unchanged."""
+    from kafka_lag_based_assignor_tpu.ops.rounds_pallas import (
+        pallas_mode_for,
+    )
+
+    assert pallas_mode_for(
+        np.array([7, -1, 3], dtype=np.int64), 8, 1
+    ) is None
+    assert pallas_mode_for(np.array([-5], dtype=np.int64), 8, 1) is None
+
+
+def test_speed_probe_instance_totals_stay_below_sentinel():
+    """ADVICE r5: the speed race's instance (P=65536, lags < 10^6)
+    deliberately sits OUTSIDE the narrow admission gate it certifies —
+    sound only because the kernel compares PER-CONSUMER totals,
+    bounded by R * max_lag, which must clear the int32 sentinel the
+    narrow planes reserve.  Pin the bound with the probe's exact
+    instance so a parameter change cannot silently overflow the race.
+    """
+    from kafka_lag_based_assignor_tpu.ops.rounds_pallas import _SENTINEL
+
+    P, C = 65536, 1000  # _probe_speed's instance
+    rng = np.random.default_rng(1)  # same seed as _probe_speed
+    lags = -np.sort(-rng.integers(0, 10**6, size=P).astype(np.int64))
+    R = -(-P // C)
+    assert R * int(lags.max()) < int(_SENTINEL)
+
+
+def test_probe_once_gate_is_thread_safe_single_decision():
+    """ADVICE r5: rounds_pallas_available's probe-once global is
+    decided under a double-checked lock — a threaded service's
+    configure-time warm-ups racing into the probe must produce ONE
+    settled verdict, never a concurrent multi-compile probe or a
+    partially-decided read.  On the CPU backend the decision is
+    deterministic (Pallas off), which makes the race harness exact."""
+    import threading
+
+    from kafka_lag_based_assignor_tpu.ops import rounds_pallas as rp
+
+    assert isinstance(rp._pallas_rounds_lock, type(threading.Lock()))
+    saved = rp._pallas_rounds_ok
+    try:
+        rp._pallas_rounds_ok = None
+        # Unprobed: production dispatch stays on the XLA scan.
+        assert rp.rounds_pallas_available() is False
+        assert rp._pallas_rounds_ok is None  # no implicit probe
+        results = []
+        barrier = threading.Barrier(8)
+
+        def race():
+            barrier.wait()
+            results.append(rp.rounds_pallas_available(run_probe=True))
+
+        threads = [threading.Thread(target=race) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # One settled verdict (CPU: Pallas off for both modes), seen
+        # identically by every racer.
+        assert results == [False] * 8
+        assert rp._pallas_rounds_ok == {"narrow": False, "wide": False}
+        assert rp.rounds_pallas_available(mode="wide") is False
+    finally:
+        rp._pallas_rounds_ok = saved

@@ -64,6 +64,12 @@ def main() -> int:
             args.host, args.port, timeout_s=args.timeout
         ) as client:
             result = client.request("metrics", {"view": view})
+            # The summary's lifecycle rows (state, snapshot age, last
+            # recovery) come from the stats surface, not the registry.
+            lifecycle = (
+                client.request("stats").get("lifecycle")
+                if args.summary else None
+            )
     except OSError as exc:
         print(
             f"cannot reach sidecar at {args.host}:{args.port}: {exc}",
@@ -153,6 +159,35 @@ def main() -> int:
                     f"rung={s['labels'].get('rung')}: {s['value']}"
                 )
             print(f"shed total: {int(total)}")
+
+        # Lifecycle view: serving/draining state, snapshot freshness,
+        # and the last recovery's outcome — the "would a restart be a
+        # non-event right now" look (DEPLOYMENT.md "Restarts and
+        # recovery").
+        if lifecycle:
+            print(f"lifecycle state: {lifecycle.get('state')}")
+            snap = lifecycle.get("snapshot")
+            if snap:
+                age = snap.get("age_s")
+                age_txt = (
+                    f"{age:.1f}s old" if age is not None
+                    else "never written"
+                )
+                print(
+                    f"snapshot: {age_txt} ({snap.get('writes', 0)} "
+                    f"writes, {snap.get('write_errors', 0)} errors, "
+                    f"path {snap.get('path')})"
+                )
+            else:
+                print("snapshot: disabled (no snapshot path configured)")
+            rec = lifecycle.get("recovery")
+            if rec:
+                print(
+                    f"last recovery: outcome={rec.get('outcome')} "
+                    f"streams_recovered={rec.get('streams_recovered')} "
+                    f"discarded={rec.get('streams_discarded')} "
+                    f"in {rec.get('duration_ms', 0):.1f} ms"
+                )
         return 0
     print(json.dumps(result["json"], indent=2, sort_keys=True))
     return 0

@@ -45,6 +45,15 @@ Rules:
         queues fill — a buffer that can grow without bound under
         backpressure is the outage, so every one must carry an explicit
         bound or a ``# noqa: L014`` waiver stating its bound.
+  L015  bare write-mode ``open(...)`` in package code: durable state
+        (snapshots, flight-recorder dumps) must go through the atomic
+        write helper (``utils/snapshot.atomic_write_bytes``: temp file
+        + fsync + ``os.rename``) so a crash mid-write can never leave
+        a torn file for the recovery/post-mortem path to trip over.
+        Write-mode opens are allowed only INSIDE a function whose name
+        contains ``atomic_write`` (the helper's own implementation);
+        anything else needs a ``# noqa: L015`` waiver stating why the
+        write is not durable state.  Read-mode opens are untouched.
 """
 
 from __future__ import annotations
@@ -178,6 +187,62 @@ def _l013_findings(rel: str, tree: ast.AST, lines: List[str]) -> List[Finding]:
                         "blocking device sync on the coalescer's "
                         "admission/dispatch path: move it to the "
                         "readback stage (or waive with `# noqa: L013`)",
+                    )
+                )
+            visit(child, child_scope)
+
+    visit(tree, False)
+    return findings
+
+
+def _open_write_mode(node: ast.Call) -> bool:
+    """True for ``open(...)`` / ``io.open(...)`` calls whose mode is a
+    string CONSTANT selecting a write/append/create/update mode.  A
+    missing mode is a read; a computed mode is taken on faith (the rule
+    targets the literal ``open(p, "w")`` idiom)."""
+    func = node.func
+    name = (
+        func.id if isinstance(func, ast.Name)
+        else func.attr if isinstance(func, ast.Attribute)
+        else ""
+    )
+    if name != "open":
+        return False
+    mode = node.args[1] if len(node.args) > 1 else None
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if not isinstance(mode, ast.Constant) or not isinstance(
+        mode.value, str
+    ):
+        return False
+    return any(ch in mode.value for ch in "wax+")
+
+
+def _l015_findings(rel: str, tree: ast.AST, lines: List[str]) -> List[Finding]:
+    """Walk with enclosing-function context: write-mode opens are
+    allowed only inside the atomic-write helper's implementation."""
+    findings: List[Finding] = []
+
+    def visit(node: ast.AST, in_helper: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_scope = in_helper
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child_scope = in_helper or "atomic_write" in child.name
+            if (
+                isinstance(child, ast.Call)
+                and not in_helper
+                and _open_write_mode(child)
+                and "noqa: L015" not in lines[child.lineno - 1]
+            ):
+                findings.append(
+                    Finding(
+                        rel,
+                        child.lineno,
+                        "L015",
+                        "bare write-mode open() in package code: go "
+                        "through utils/snapshot.atomic_write_bytes "
+                        "(or waive with `# noqa: L015`)",
                     )
                 )
             visit(child, child_scope)
@@ -342,6 +407,7 @@ def lint_source(path: Path, source: str) -> List[Finding]:
         findings.extend(_l013_findings(rel, tree, lines))
     if is_package:
         findings.extend(_l014_list_buffer_findings(rel, tree, lines))
+        findings.extend(_l015_findings(rel, tree, lines))
     # The two clock-owning modules: stopwatch/span live there, so direct
     # perf_counter use is their implementation, not a violation.
     clock_exempt = path.name in ("metrics.py", "observability.py")
