@@ -1140,6 +1140,107 @@ def config7_overload():
     }
 
 
+def config9_delta():
+    """Delta-drift probe (ISSUE 8): steady-state drift touching ~1.5%
+    of the partitions per epoch (inside the probe's 1-5% churn band),
+    served by a delta-epoch engine and by an always-dense twin over
+    IDENTICAL seeded lag sequences.  What must hold (gated in main,
+    every backend — the contract is correctness + upload bytes, not
+    wall time): the first delta epoch and every subsequent epoch are
+    BIT-IDENTICAL to the dense baseline, every drift epoch takes the
+    delta path (klba_delta_epochs_total{outcome=applied}), zero fresh
+    XLA compiles inside either measured loop (the K ladder warms via
+    warmup), and the per-epoch H2D lag-payload bytes
+    (klba_h2d_bytes_total{path=delta}) are >= 10x smaller than the
+    dense twin's."""
+    from kafka_lag_based_assignor_tpu.ops.streaming import (
+        StreamingAssignor,
+    )
+    from kafka_lag_based_assignor_tpu.utils import metrics as klba_metrics
+    from kafka_lag_based_assignor_tpu.utils.observability import (
+        compile_count,
+        install_compile_counter,
+    )
+    from kafka_lag_based_assignor_tpu.warmup import warmup
+
+    install_compile_counter()
+    P, C, epochs = 4096, 16, 12
+    churn = max(1, int(0.015 * P))
+    rng = np.random.default_rng(9)
+    # int32-range lags: the payload dtype every epoch shares (a range
+    # flip mid-loop would retrace the fused executable).
+    base = rng.integers(10**5, 10**6, P).astype(np.int64)
+
+    # Ladder + dense/cold executables off the measured path.
+    warmup(max_partitions=P, consumers=[C], solvers=("stream",))
+
+    dense_c = klba_metrics.REGISTRY.counter(
+        "klba_h2d_bytes_total", {"path": "dense"}
+    )
+    delta_c = klba_metrics.REGISTRY.counter(
+        "klba_h2d_bytes_total", {"path": "delta"}
+    )
+    applied_c = klba_metrics.REGISTRY.counter(
+        "klba_delta_epochs_total", {"outcome": "applied"}
+    )
+
+    def drive(delta_enabled: bool):
+        eng = StreamingAssignor(
+            num_consumers=C, refine_iters=128, refine_threshold=None,
+            delta_enabled=delta_enabled,
+        )
+        seq = np.random.default_rng(99)  # IDENTICAL drift both drives
+        lags = base.copy()
+        choices = [np.asarray(eng.rebalance(lags))]  # cold, unmeasured
+        before = (
+            dense_c.value, delta_c.value, applied_c.value,
+            compile_count(),
+        )
+        times = []
+        for _ in range(epochs):
+            idx = seq.choice(P, size=churn, replace=False)
+            lags = lags.copy()
+            lags[idx] = seq.integers(10**5, 10**6, churn)
+            t0 = time.perf_counter()
+            choices.append(np.asarray(eng.rebalance(lags)))
+            times.append((time.perf_counter() - t0) * 1000.0)
+        after = (
+            dense_c.value, delta_c.value, applied_c.value,
+            compile_count(),
+        )
+        return choices, times, [a - b for a, b in zip(after, before)]
+
+    dense_choices, dense_times, dense_delta_counts = drive(False)
+    delta_choices, delta_times, delta_counts = drive(True)
+    mismatched = sum(
+        int(not np.array_equal(a, b))
+        for a, b in zip(dense_choices, delta_choices)
+    )
+    dense_per_epoch = dense_delta_counts[0] / epochs
+    delta_per_epoch = delta_counts[1] / epochs
+    return {
+        "config": "delta_drift",
+        "partitions": P,
+        "consumers": C,
+        "epochs": epochs,
+        "churn_fraction": churn / P,
+        "dense_bytes_per_epoch": dense_per_epoch,
+        "delta_bytes_per_epoch": delta_per_epoch,
+        "upload_reduction_x": (
+            dense_per_epoch / max(delta_per_epoch, 1e-9)
+        ),
+        "delta_applied": delta_counts[2],
+        # Dense bytes charged DURING the delta engine's loop: any
+        # nonzero value means an epoch fell back off the delta path.
+        "delta_engine_dense_bytes": delta_counts[0],
+        "mismatched_epochs": mismatched,
+        "warm_compile_count": dense_delta_counts[3] + delta_counts[3],
+        "dense_epoch_p50_ms": float(np.percentile(dense_times, 50)),
+        "delta_epoch_p50_ms": float(np.percentile(delta_times, 50)),
+        "reduction_target_x": 10.0,
+    }
+
+
 def config8_restart():
     """Restart-storm probe (ISSUE 7): N tenants on a snapshotting
     sidecar, a crash-equivalent stop (no drain — the periodic snapshot
@@ -1355,7 +1456,7 @@ def main():
 
     for fn in (config1_readme, config2_zipf, config3_vmap, config4_skew,
                config5_northstar, config6_multistream, config7_overload,
-               config8_restart):
+               config8_restart, config9_delta):
         before = klba_metrics.REGISTRY.snapshot()
         r = fn()
         deltas = klba_metrics.histogram_deltas(
@@ -1544,6 +1645,40 @@ def main():
                 f"restart_storm first_epoch_p50_ms {first_ms:.1f} > "
                 f"10x the pre-crash baseline {base_ms:.1f} — "
                 "time-to-first-warm-epoch regressed"
+            )
+    # Delta-drift gates (every backend — correctness and upload bytes
+    # are config/shape facts, not hardware ones): every epoch must be
+    # bit-identical to the dense twin, every drift epoch must take the
+    # delta path, the measured loops must compile nothing, and the
+    # per-epoch upload bytes must shrink >= 10x at the probe's churn.
+    dd = results.get("delta_drift", {})
+    if dd:
+        if dd.get("mismatched_epochs", 0) > 0:
+            failures.append(
+                f"delta_drift produced {dd['mismatched_epochs']} "
+                "epoch(s) differing from the dense baseline — the "
+                "delta path is not bit-exact"
+            )
+        if dd.get("delta_applied", 0) < dd.get("epochs", 0):
+            failures.append(
+                f"delta_drift applied only {dd.get('delta_applied')}"
+                f"/{dd.get('epochs')} epochs via the delta path "
+                f"(dense bytes charged: "
+                f"{dd.get('delta_engine_dense_bytes')})"
+            )
+        if dd.get("warm_compile_count", 0) > 0:
+            failures.append(
+                f"delta_drift warm_compile_count "
+                f"{dd['warm_compile_count']} != 0 — fresh XLA compiles "
+                "inside the measured drift loops (the K ladder warm-up "
+                "is not covering the serving path)"
+            )
+        red = dd.get("upload_reduction_x")
+        if red is not None and red < dd.get("reduction_target_x", 10.0):
+            failures.append(
+                f"delta_drift upload_reduction_x {red:.1f} < "
+                f"{dd.get('reduction_target_x', 10.0)}x — the delta "
+                "path is not cutting per-epoch H2D bytes"
             )
     for msg in failures:
         log(f"bench: REGRESSION GATE FAILED: {msg}")

@@ -13,6 +13,11 @@ Reference semantics reproduced exactly:
 
 The broker client is abstracted behind ``MetadataConsumer`` so the I/O shell
 is testable with a fake — the reference left this layer untested (SURVEY §4).
+
+:class:`LagDeltaTracker` adds the DELTA-EPOCH differ (service.py "Delta
+epochs"): consecutive lag reads become sparse ``lag_delta`` wire params
+whenever little changed, with automatic dense re-seeding on resync — so
+existing read-everything clients get O(changed) uploads for free.
 """
 
 from __future__ import annotations
@@ -21,6 +26,7 @@ import logging
 import time
 from dataclasses import dataclass, field
 from typing import (
+    Any,
     Callable,
     Dict,
     Iterable,
@@ -89,6 +95,92 @@ def _call_with_retry(
             )
             retry.sleep(delay)
     raise AssertionError("unreachable")  # the loop returns or raises
+
+
+class LagDeltaTracker:
+    """Host-side differ for DELTA EPOCHS (service.py "Delta epochs"):
+    turns consecutive per-stream lag reads into the smallest valid
+    ``stream_assign`` params — a sparse ``lag_delta`` when little
+    changed, full ``lags`` rows whenever a dense base must be
+    (re)established — so the JVM shim (or any client that simply
+    re-reads lags each epoch) benefits from sparse uploads with no
+    protocol change of its own.
+
+    Usage, once per stream per epoch::
+
+        params = tracker.params_for(rows)      # {"lags": ...} or
+                                               # {"lag_delta": ...}
+        result = client.stream_assign(..., **params)
+        tracker.note_result(result)            # adopt lag_epoch/resync
+
+    The tracker sends dense until the server confirms a base
+    (``stream.lag_epoch``), diffs against the last CONFIRMED rows after
+    that, and falls back to dense whenever the pid set changed, more
+    than ``max_fraction`` of the partitions moved (the server would
+    upload dense anyway), the server answered ``resync: true``, or the
+    previous request failed outright.  Fault point ``delta.diff`` fires
+    inside the differ — an injected failure degrades to dense, never to
+    a lost epoch."""
+
+    def __init__(self, max_fraction: float = 0.125):
+        if not 0.0 < float(max_fraction) <= 1.0:
+            raise ValueError(
+                f"max_fraction={max_fraction} must be in (0, 1]"
+            )
+        self.max_fraction = float(max_fraction)
+        self._base: Optional[Dict[int, int]] = None  # pid -> lag
+        self._base_epoch: Optional[int] = None
+        self._pending: Optional[Dict[int, int]] = None  # awaiting confirm
+
+    def params_for(self, rows: Sequence) -> Dict[str, Any]:
+        """``rows`` is the epoch's full ``[[pid, lag], ...]`` read (any
+        order).  Returns the params fragment to merge into the
+        ``stream_assign`` request."""
+        current = {int(p): int(lag) for p, lag in rows}
+        self._pending = current
+        base, epoch = self._base, self._base_epoch
+        if base is None or epoch is None or set(base) != set(current):
+            return {"lags": [[p, v] for p, v in current.items()]}
+        try:
+            faults.fire("delta.diff")
+            changed = [
+                (p, v) for p, v in current.items() if base[p] != v
+            ]
+        except Exception:  # noqa: BLE001 — dense is the safe fallback
+            LOGGER.warning(
+                "lag delta diff failed; sending dense", exc_info=True
+            )
+            return {"lags": [[p, v] for p, v in current.items()]}
+        if len(changed) > self.max_fraction * max(len(current), 1):
+            return {"lags": [[p, v] for p, v in current.items()]}
+        return {
+            "lag_delta": {
+                "indices": [p for p, _ in changed],
+                "values": [v for _, v in changed],
+                "base_epoch": epoch,
+            }
+        }
+
+    def note_result(self, result: Mapping) -> None:
+        """Adopt the server's answer for the epoch last built by
+        :meth:`params_for`: on success the pending read becomes the
+        confirmed base at the reported ``lag_epoch``; a ``resync``
+        answer (or a missing stream section) drops the base so the next
+        epoch re-seeds dense."""
+        stream = (result or {}).get("stream") or {}
+        if stream.get("resync") or "lag_epoch" not in stream:
+            self.note_failure()
+            return
+        self._base = self._pending or self._base
+        self._base_epoch = int(stream["lag_epoch"])
+        self._pending = None
+
+    def note_failure(self) -> None:
+        """The request failed (error, drop, shed without a lag_epoch):
+        the server's base is unknown — send dense next epoch."""
+        self._base = None
+        self._base_epoch = None
+        self._pending = None
 
 
 def compute_partition_lag(

@@ -47,6 +47,19 @@ work (3N small device gathers to slice rows out, N buffer tuples in) is
 gone from the steady state: ``klba_coalesce_restack_total`` stays flat
 while ``klba_coalesce_roster_hits_total`` counts locked flushes.
 
+Delta epochs ride the locked fast path (ISSUE 8): the stacked batch
+also carries its rows' widened ``[N, B]`` lag buffer, and a locked
+wave whose EVERY live row arrived with a delta plan (the submitting
+engine's host-side diff, ops/streaming) dispatches
+:func:`_megabatch_fused_locked_delta` — a stacked ``[N, K]``
+index/value staging (through the same rotating upload buffers, so the
+pipeline overlap is preserved) scatter-applied to the donated resident
+lag buffer, cutting the wave's H2D bytes from O(N·B) to O(N·K).  Mixed
+waves, re-stack waves, an injected ``delta.apply`` fault, or a row
+failing the readback's lag-sum divergence check fall back to the dense
+staging (the faulted/diverged row re-syncs through the single-stream
+dense dispatch; ``klba_delta_epochs_total`` counts both outcomes).
+
 The lock is invalidated — exactly once per churn event — whenever a
 wave does not match the resident batch: a stream joined or left, a
 stream was poisoned/warm-restarted (its engine then submits a concrete
@@ -161,7 +174,7 @@ from ..utils.overload import record_shed
 from ..utils.watchdog import SolveRejected
 from .batched import _narrow_choice
 from .refine import refine_rounds_resident
-from .streaming import _warm_fused_resident
+from .streaming import _DELTA_ENTRY_BYTES, _warm_fused_resident
 
 LOGGER = logging.getLogger(__name__)
 
@@ -204,7 +217,10 @@ def _epoch_rows(
     meets the target before round one and they pass through unchanged.
 
     Returns ``(narrow [N, B], choice int32 [N, B], row_tab [N, C, M],
-    counts [N, C], totals [N, C], rounds [N], exchanges [N])``."""
+    counts [N, C], lags int64 [N, B], totals [N, C], rounds [N],
+    exchanges [N])`` — the widened lag rows ride along device-resident
+    so a locked batch can carry them and accept stacked deltas
+    (:func:`_megabatch_fused_locked_delta`)."""
 
     def one(lags_b, choice_b, tab_b, counts_b, limit):
         B = choice_b.shape[0]
@@ -225,7 +241,7 @@ def _epoch_rows(
             )
         )
         narrow = _narrow_choice(choice_b, num_consumers)
-        return narrow, choice_b, tab_b, counts_b, totals, rounds, ex
+        return narrow, choice_b, tab_b, counts_b, lags64, totals, rounds, ex
 
     return jax.vmap(one)(lags, choice, row_tab, cnt, limits)
 
@@ -269,7 +285,37 @@ def _megabatch_fused_locked(
     batch goes in as DONATED buffers and comes back as its own
     successor — no per-stream gathers, no re-stack, the only H2D is the
     ``[N, B]`` lag staging (each stream's row placed by its stable index
-    host-side) and the ``[N]`` limits."""
+    host-side) and the ``[N]`` limits.  (The batch's previous resident
+    lag buffer is simply replaced by this wave's staged rows, so it is
+    not passed/donated here.)"""
+    return _epoch_rows(
+        lags, choice, row_tab, counts, limits, num_consumers, iters,
+        max_pairs, exchange_budget,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "num_consumers", "iters", "max_pairs", "exchange_budget"
+    ),
+    donate_argnums=(2, 3, 4, 5),
+)
+def _megabatch_fused_locked_delta(
+    idx, vals, lags, choice, row_tab, counts, limits,
+    num_consumers: int, iters: int, max_pairs, exchange_budget: int,
+):
+    """The LOCKED DELTA megabatch executable (ISSUE 8): the stacked
+    ``[N, K]`` (index, value) updates scatter into the batch's DONATED
+    resident ``[N, B]`` lag buffer, then the shared vmapped warm core
+    runs — the only H2D is O(N·K) instead of O(N·B).  Per-row padding
+    entries write index 0's new value (a duplicate of an identical
+    value — a no-op; see :func:`..streaming._warm_fused_delta`);
+    batch-padding rows carry (0, 0) onto their all-zero lag rows.  K is
+    the coalescer's single configured ``delta_k`` (the ladder top), so
+    the executable count stays one per (shape bucket, batch bucket) —
+    warmed by :mod:`...warmup`'s megabatch job."""
+    lags = jax.vmap(lambda l, i, v: l.at[i].set(v))(lags, idx, vals)
     return _epoch_rows(
         lags, choice, row_tab, counts, limits, num_consumers, iters,
         max_pairs, exchange_budget,
@@ -279,11 +325,12 @@ def _megabatch_fused_locked(
 class EpochResult(NamedTuple):
     """One stream's share of a flush: host-facing outputs materialized,
     resident successor still on device — a concrete ``(choice, row_tab,
-    counts)`` tuple on the re-stack path, a :class:`ResidentRow` handle
-    (the row's ownership lives with the batch) once the roster locks."""
+    counts, lags)`` tuple on the re-stack path, a :class:`ResidentRow`
+    handle (the row's ownership lives with the batch) once the roster
+    locks."""
 
     narrow: np.ndarray  # int16-ish [B] padded choice (slice [:P] yourself)
-    resident: Any  # device (choice, row_tab, counts) tuple OR ResidentRow
+    resident: Any  # device (choice, row_tab, counts, lags) OR ResidentRow
     totals: np.ndarray  # int64 [C] per-consumer totals under the new lags
     counts: np.ndarray  # int32 [C]
     rounds: int
@@ -294,8 +341,10 @@ class _ResidentBatch:
     """One locked roster's stacked device-resident warm state.
 
     ``choice [n_pad, B]`` / ``row_tab [n_pad, C, M]`` / ``counts
-    [n_pad, C]`` are replaced by their successors on every locked flush
-    (the executable donates them); ``lock`` serializes that swap against
+    [n_pad, C]`` / ``lags int64 [n_pad, B]`` are replaced by their
+    successors on every locked flush (the executable donates them —
+    the lag buffer is what the stacked delta path scatters into);
+    ``lock`` serializes that swap against
     a :class:`ResidentRow` materializing a row from another thread (a
     stream leaving the batch for an inline dispatch).  ``valid`` False
     freezes the arrays — an invalidated batch is never donated again,
@@ -304,15 +353,18 @@ class _ResidentBatch:
     materialization must fail loudly instead of returning garbage."""
 
     __slots__ = (
-        "shape_key", "choice", "row_tab", "counts", "n_real", "valid",
-        "poisoned", "lock",
+        "shape_key", "choice", "row_tab", "counts", "lags", "n_real",
+        "valid", "poisoned", "lock",
     )
 
-    def __init__(self, shape_key, choice, row_tab, counts, n_real: int):
+    def __init__(
+        self, shape_key, choice, row_tab, counts, lags, n_real: int
+    ):
         self.shape_key = shape_key
         self.choice = choice
         self.row_tab = row_tab
         self.counts = counts
+        self.lags = lags
         self.n_real = int(n_real)
         self.valid = True
         self.poisoned = False
@@ -348,8 +400,8 @@ class ResidentRow:
             and b.row_tab.shape[1:] == (num_consumers, m_rows)
         )
 
-    def materialize(self) -> Tuple[Any, Any, Any]:
-        """Concrete per-stream device buffers for this row (three
+    def materialize(self) -> Tuple[Any, Any, Any, Any]:
+        """Concrete per-stream device buffers for this row (four
         gathers).  Fault point ``coalesce.gather`` fires here — the
         roster-churn recovery path the chaos drills target."""
         faults.fire("coalesce.gather")
@@ -361,7 +413,7 @@ class ResidentRow:
                     "flush); the row's warm state is gone"
                 )
             return (b.choice[self.row], b.row_tab[self.row],
-                    b.counts[self.row])
+                    b.counts[self.row], b.lags[self.row])
 
 
 class _Roster:
@@ -402,6 +454,21 @@ class _StagingSlot:
         self.ready.set()
 
 
+class _DeltaStagingSlot:
+    """Rotating staging pair for the stacked [N, K] DELTA flush: pow2
+    index/value arrays plus limits, same ``ready`` discipline as the
+    dense slots (the wave's readback releases the buffer)."""
+
+    __slots__ = ("idx", "vals", "limits", "ready")
+
+    def __init__(self, n_pad: int, k: int):
+        self.idx = np.zeros((n_pad, k), dtype=np.int32)
+        self.vals = np.zeros((n_pad, k), dtype=np.int64)
+        self.limits = np.zeros(n_pad, dtype=np.float64)
+        self.ready = threading.Event()
+        self.ready.set()
+
+
 @dataclass
 class EpochSubmission:
     """One stream's pending warm epoch (see the module docstring)."""
@@ -430,6 +497,17 @@ class EpochSubmission:
     # submitter's watchdog call (utils/watchdog.capture_abandon_check);
     # None when no watchdog wraps the park (library use, tests).
     abandoned: Optional[Callable[[], bool]] = None
+    # Delta-epoch plan (ISSUE 8; ops/streaming._delta_plan): the RAW
+    # changed positions (int32 [n]) and their new int64 values, when
+    # the submitting engine deemed this epoch delta-eligible.  A locked
+    # wave whose every live row carries one (and fits the coalescer's
+    # configured K) dispatches the stacked [N, K] delta executable;
+    # re-stack waves and mixed waves ignore it and stage dense.
+    delta_idx: Optional[np.ndarray] = None
+    delta_vals: Optional[np.ndarray] = None
+    # Host-side int64 lag sum (wrap-consistent with the device totals):
+    # the per-row divergence check of a delta wave's readback.
+    lag_sum: Optional[int] = None
     future: Future = field(default_factory=Future)
     enqueued_at: float = 0.0
 
@@ -466,6 +544,14 @@ class MegabatchCoalescer:
         max_batch: int = 32,
         lock_waves: int = 1,
         pipeline: bool = True,
+        # Delta-epoch K for the stacked [N, K] locked flush (ISSUE 8):
+        # a locked wave whose every row carries a delta plan that fits
+        # pads to this SINGLE K (the engines' ladder top), so the delta
+        # executable count stays one per (shape bucket, batch bucket) —
+        # unlike the inline path's per-rung ladder, the batch axis
+        # already multiplies the executable count.  0 disables the
+        # stacked delta path (every wave stages dense).
+        delta_k: int = 512,
     ):
         if window_s < 0:
             raise ValueError(f"window_s={window_s} must be >= 0")
@@ -473,10 +559,13 @@ class MegabatchCoalescer:
             raise ValueError(f"max_batch={max_batch} must be >= 1")
         if lock_waves < 1:
             raise ValueError(f"lock_waves={lock_waves} must be >= 1")
+        if delta_k < 0:
+            raise ValueError(f"delta_k={delta_k} must be >= 0")
         self.window_s = float(window_s)
         self.max_batch = int(max_batch)
         self.lock_waves = int(lock_waves)
         self.pipeline = bool(pipeline)
+        self.delta_k = int(delta_k)
         # Overload backpressure: the shed ladder's rung-1 action scales
         # the admission window down (smaller waves, lower parked
         # latency — batch efficiency yields before latency).  A plain
@@ -540,6 +629,21 @@ class MegabatchCoalescer:
             "klba_coalesce_window_scale"
         )
         self._m_window_scale.set(1.0)
+        # H2D byte accounting + delta-epoch outcomes for the staged
+        # paths (same series the inline engine charges, so the
+        # dense-vs-delta trade reads off one pair of counters).
+        self._m_h2d_dense = metrics.REGISTRY.counter(
+            "klba_h2d_bytes_total", {"path": "dense"}
+        )
+        self._m_h2d_delta = metrics.REGISTRY.counter(
+            "klba_h2d_bytes_total", {"path": "delta"}
+        )
+        self._m_delta_applied = metrics.REGISTRY.counter(
+            "klba_delta_epochs_total", {"outcome": "applied"}
+        )
+        self._m_delta_fallback = metrics.REGISTRY.counter(
+            "klba_delta_epochs_total", {"outcome": "fallback"}
+        )
 
     # -- submission --------------------------------------------------------
 
@@ -861,6 +965,16 @@ class MegabatchCoalescer:
             # dispatches; re-stack + re-lock on the next stable wave.
             self._invalidate(rows[0].shape_key, None)
         self._m_path[path].inc()
+        # Single-row flushes and flush-fault fallbacks dispatch dense:
+        # a delta-planned row completes with a fallback outcome (the
+        # locked/re-stack paths count theirs at their own dispatch
+        # sites, never inside _resolve_single — exactly once each).
+        planned = sum(
+            1 for s in rows
+            if s.delta_idx is not None and not s.future.done()
+        )
+        if planned:
+            self._m_delta_fallback.inc(planned)
         for s in rows:
             if not s.future.done():
                 self._resolve_single(s)
@@ -959,20 +1073,13 @@ class MegabatchCoalescer:
 
     # -- the three-stage dispatch ------------------------------------------
 
-    def _staging_slot(
-        self, key: Tuple, n_pad: int, bucket: int, dtype
-    ) -> _StagingSlot:
-        """Next of the two rotating staging buffers for (key, n_pad) —
-        flusher-thread only."""
-        k = (key, n_pad)
+    def _staging_pair(self, k: Tuple, make: Callable[[], Any]):
+        """Next of the two rotating staging buffers cached under ``k``
+        (dense: (shape key, n_pad); delta: (shape key, n_pad, "delta"))
+        — flusher-thread only."""
         pair = self._staging.get(k)
         if pair is None:
-            pair = self._staging[k] = [
-                _StagingSlot(n_pad, bucket, dtype),
-                _StagingSlot(n_pad, bucket, dtype),
-                0,
-                self._tick,
-            ]
+            pair = self._staging[k] = [make(), make(), 0, self._tick]
             if len(self._staging) > _MAX_STAGING:
                 # Evict the stalest IDLE pair (both slots released by
                 # their readbacks — never a pair with a wave in flight).
@@ -987,6 +1094,21 @@ class MegabatchCoalescer:
         slot = pair[pair[2]]
         pair[2] ^= 1
         return slot
+
+    def _staging_slot(
+        self, key: Tuple, n_pad: int, bucket: int, dtype
+    ) -> _StagingSlot:
+        return self._staging_pair(
+            (key, n_pad), lambda: _StagingSlot(n_pad, bucket, dtype)
+        )
+
+    def _delta_staging_slot(
+        self, key: Tuple, n_pad: int, k_bucket: int
+    ) -> _DeltaStagingSlot:
+        return self._staging_pair(
+            (key, n_pad, "delta"),
+            lambda: _DeltaStagingSlot(n_pad, k_bucket),
+        )
 
     def _stage_upload(
         self,
@@ -1013,6 +1135,7 @@ class MegabatchCoalescer:
                 r = row_of(i)
                 slot.lags[r, : s.payload.shape[0]] = s.payload
                 slot.limits[r] = s.limit
+            self._m_h2d_dense.inc(slot.lags.nbytes)
             try:
                 lags_dev = jax.device_put(slot.lags)
                 limits_dev = jax.device_put(slot.limits)
@@ -1020,6 +1143,43 @@ class MegabatchCoalescer:
                 slot.ready.set()
                 raise
         return slot, lags_dev, limits_dev
+
+    def _stage_delta_upload(
+        self,
+        rows: List[EpochSubmission],
+        n_pad: int,
+        row_of: Callable[[int], int],
+    ):
+        """Delta upload stage (locked waves only): fill the rotating
+        [n_pad, K] index/value staging pair — per-row padding entries
+        write index 0's new value (``payload[0]``), batch-padding rows
+        write (0, 0) onto their all-zero lag rows — and start the async
+        H2D.  O(N·K) bytes instead of the dense stage's O(N·B).  Same
+        ``ready`` discipline as :meth:`_stage_upload`."""
+        s0 = rows[0]
+        slot = self._delta_staging_slot(s0.shape_key, n_pad, self.delta_k)
+        with metrics.span("coalesce.upload"):
+            slot.ready.wait()
+            slot.ready.clear()
+            slot.idx[:] = 0
+            slot.vals[:] = 0
+            slot.limits[:] = 0.0
+            for i, s in enumerate(rows):
+                r = row_of(i)
+                n = s.delta_idx.shape[0]
+                slot.idx[r, :n] = s.delta_idx
+                slot.vals[r, :] = int(s.payload[0])
+                slot.vals[r, :n] = s.delta_vals
+                slot.limits[r] = s.limit
+            self._m_h2d_delta.inc(slot.idx.nbytes + slot.vals.nbytes)
+            try:
+                idx_dev = jax.device_put(slot.idx)
+                vals_dev = jax.device_put(slot.vals)
+                limits_dev = jax.device_put(slot.limits)
+            except Exception:
+                slot.ready.set()
+                raise
+        return slot, idx_dev, vals_dev, limits_dev
 
     def _dispatch_megabatch(
         self, rows: List[EpochSubmission]
@@ -1042,6 +1202,24 @@ class MegabatchCoalescer:
         lock_now, roster = self._note_wave(key, rows)
         return self._dispatch_restack(rows, lock_now, roster)
 
+    def _delta_wave_ok(self, rows: List[EpochSubmission]) -> bool:
+        """True when this locked wave can dispatch the stacked [N, K]
+        delta executable: the path is enabled, EVERY live row carries a
+        delta plan that fits the configured K, and the padded delta
+        staging is strictly smaller than the dense staging would be
+        (same per-entry cost the inline byte gate uses)."""
+        s0 = rows[0]
+        return (
+            self.delta_k > 0
+            and all(
+                s.delta_idx is not None
+                and s.delta_idx.shape[0] <= self.delta_k
+                for s in rows
+            )
+            and self.delta_k * _DELTA_ENTRY_BYTES
+            < s0.bucket * s0.payload.dtype.itemsize
+        )
+
     def _dispatch_locked(
         self, batch: _ResidentBatch, rows: List[EpochSubmission]
     ) -> Callable[[], None]:
@@ -1049,25 +1227,61 @@ class MegabatchCoalescer:
         compiles_before = observability.compile_count()
         s0 = rows[0]
         C = s0.num_consumers
-        slot, lags_dev, limits_dev = self._stage_upload(
-            rows, batch.n_pad, lambda i: rows[i].resident.row
-        )
+        row_of = lambda i: rows[i].resident.row  # noqa: E731
+        delta_wave = False
+        slot = None
+        if self._delta_wave_ok(rows):
+            # Stacked delta flush (ISSUE 8): O(N·K) staged bytes onto
+            # the batch's resident lag buffer.  The fault point fires
+            # BEFORE staging — a failure here (or in staging) falls
+            # back to the dense stage below with the batch untouched.
+            try:
+                faults.fire("delta.apply")
+                slot, idx_dev, vals_dev, limits_dev = (
+                    self._stage_delta_upload(rows, batch.n_pad, row_of)
+                )
+                delta_wave = True
+            except Exception:  # noqa: BLE001 — dense is the fallback
+                LOGGER.warning(
+                    "stacked delta staging failed; staging this wave "
+                    "dense", exc_info=True,
+                )
+        if not delta_wave:
+            slot, lags_dev, limits_dev = self._stage_upload(
+                rows, batch.n_pad, row_of
+            )
+            # Rows that PLANNED a delta but rode a dense wave (mixed
+            # wave, oversized K, an injected staging fault) are
+            # fallbacks: the hit-rate operators read must see them,
+            # exactly once each.
+            planned = sum(1 for s in rows if s.delta_idx is not None)
+            if planned:
+                self._m_delta_fallback.inc(planned)
         try:
             with metrics.span("coalesce.dispatch"):
                 with batch.lock:
-                    out = _megabatch_fused_locked(
-                        lags_dev, batch.choice, batch.row_tab,
-                        batch.counts, limits_dev,
-                        num_consumers=C, iters=s0.iters,
-                        max_pairs=s0.max_pairs,
-                        exchange_budget=s0.exchange_budget,
-                    )
-                    narrow, choice_b, tab_b, counts_b, totals, rounds, ex = (
-                        out
-                    )
+                    if delta_wave:
+                        out = _megabatch_fused_locked_delta(
+                            idx_dev, vals_dev, batch.lags, batch.choice,
+                            batch.row_tab, batch.counts, limits_dev,
+                            num_consumers=C, iters=s0.iters,
+                            max_pairs=s0.max_pairs,
+                            exchange_budget=s0.exchange_budget,
+                        )
+                    else:
+                        out = _megabatch_fused_locked(
+                            lags_dev, batch.choice, batch.row_tab,
+                            batch.counts, limits_dev,
+                            num_consumers=C, iters=s0.iters,
+                            max_pairs=s0.max_pairs,
+                            exchange_budget=s0.exchange_budget,
+                        )
+                    (narrow, choice_b, tab_b, counts_b, lags_b, totals,
+                     rounds, ex) = out
                     batch.choice = choice_b
                     batch.row_tab = tab_b
                     batch.counts = counts_b
+                    batch.lags = lags_b
         except Exception:
             self._poison(batch)  # donated state is unrecoverable
             slot.ready.set()
@@ -1087,15 +1301,40 @@ class MegabatchCoalescer:
                         ex_np = np.asarray(ex)
                 for s in rows:
                     r = s.resident.row
-                    if not s.future.done():
-                        s.future.set_result(EpochResult(
-                            narrow=narrow_np[r],
-                            resident=s.resident,  # ownership stays batched
-                            totals=totals_np[r],
-                            counts=counts_np[r],
-                            rounds=int(rounds_np[r]),
-                            exchanges=int(ex_np[r]),
-                        ))
+                    if s.future.done():
+                        continue
+                    if (
+                        delta_wave
+                        and s.lag_sum is not None
+                        and int(totals_np[r].sum()) != s.lag_sum
+                    ):
+                        # Divergence check (the conservation law — see
+                        # ops/streaming): this row's resident lag row
+                        # drifted from its submitter's mirror.  The row
+                        # falls out of the batch through the dense
+                        # single-stream dispatch (which re-uploads its
+                        # true payload); its engine then holds a
+                        # concrete tuple, so the next wave re-stacks.
+                        LOGGER.warning(
+                            "delta wave row diverged from its host lag "
+                            "sum; re-syncing the row dense"
+                        )
+                        self._m_delta_fallback.inc()
+                        self._resolve_single(s)
+                        continue
+                    if delta_wave:
+                        # Counted HERE, after the divergence check, so
+                        # applied + fallback sum to exactly one outcome
+                        # per delta-planned epoch.
+                        self._m_delta_applied.inc()
+                    s.future.set_result(EpochResult(
+                        narrow=narrow_np[r],
+                        resident=s.resident,  # ownership stays batched
+                        totals=totals_np[r],
+                        counts=counts_np[r],
+                        rounds=int(rounds_np[r]),
+                        exchanges=int(ex_np[r]),
+                    ))
             except Exception:  # noqa: BLE001 — per-row outcome below
                 LOGGER.warning(
                     "locked megabatch readback failed; poisoning the "
@@ -1104,6 +1343,8 @@ class MegabatchCoalescer:
                 self._poison(batch)
                 for s in rows:
                     if not s.future.done():
+                        if delta_wave:
+                            self._m_delta_fallback.inc()
                         self._resolve_single(s)
             finally:
                 self._note_flush_cost(started, compiles_before)
@@ -1150,14 +1391,23 @@ class MegabatchCoalescer:
             slot.ready.set()
             raise
         self._m_restack.inc()
-        narrow, choice_b, tab_b, counts_b, totals, rounds, ex = out
+        # Delta-planned rows riding a re-stack (churn) wave stage dense:
+        # count their fallback outcome here so applied + fallback still
+        # sum to exactly one outcome per planned epoch (the hit-rate's
+        # denominator stays honest through churn).
+        planned = sum(1 for s in rows if s.delta_idx is not None)
+        if planned:
+            self._m_delta_fallback.inc(planned)
+        narrow, choice_b, tab_b, counts_b, lags_b, totals, rounds, ex = out
         batch: Optional[_ResidentBatch] = None
         handles: Optional[List[ResidentRow]] = None
         if lock_now:
             # The roster locks: this wave's stacked successors BECOME
-            # the resident batch; rows' ownership moves to it.
+            # the resident batch (the widened lag rows included — the
+            # stacked delta path scatters into them); rows' ownership
+            # moves to it.
             batch = _ResidentBatch(
-                s0.shape_key, choice_b, tab_b, counts_b, n_real=N
+                s0.shape_key, choice_b, tab_b, counts_b, lags_b, n_real=N
             )
             handles = [ResidentRow(batch, i) for i in range(N)]
             with self._roster_lock:
@@ -1177,11 +1427,12 @@ class MegabatchCoalescer:
                     if s.future.done():
                         continue
                     # Unlocked waves slice per-row resident successors
-                    # out of the batch output (the 3N gathers the locked
+                    # out of the batch output (the 4N gathers the locked
                     # fast path exists to eliminate).
                     resident = (
                         handles[i] if handles is not None
-                        else (choice_b[i], tab_b[i], counts_b[i])
+                        else (choice_b[i], tab_b[i], counts_b[i],
+                              lags_b[i])
                     )
                     s.future.set_result(EpochResult(
                         narrow=narrow_np[i],
@@ -1242,18 +1493,20 @@ class MegabatchCoalescer:
         keeps its request id."""
         with metrics.adopt_scope(s.scope):
             try:
-                choice, row_tab, counts = self._materialize(s.resident)
+                choice, row_tab, counts = self._materialize(s.resident)[:3]
+                self._m_h2d_dense.inc(s.payload.nbytes)
                 out = _warm_fused_resident(
                     s.payload, choice, row_tab, counts, s.limit,
                     num_consumers=s.num_consumers, iters=s.iters,
                     max_pairs=s.max_pairs,
                     exchange_budget=s.exchange_budget,
                 )
-                narrow, choice_p, row_tab, counts, totals, rounds, ex = out
+                (narrow, choice_p, row_tab, counts, lags_p, totals,
+                 rounds, ex) = out
                 s.future.set_result(
                     EpochResult(
                         narrow=np.asarray(narrow),
-                        resident=(choice_p, row_tab, counts),
+                        resident=(choice_p, row_tab, counts, lags_p),
                         totals=np.asarray(totals),
                         counts=np.asarray(counts),
                         rounds=int(rounds),

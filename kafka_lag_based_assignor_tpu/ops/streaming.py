@@ -52,6 +52,34 @@ the optional warm start for the streaming-rebalance benchmark):
   and the exchange refinement re-tightens balance — churn bounded by
   ``repaired_rows + 2 * refine_iters``.
 
+* **delta epochs** — steady-state drift touches a small fraction of
+  partitions per epoch, yet a dense warm dispatch re-uploads the whole
+  ``[P]`` lag vector; at scale the H2D upload, not the refine, is the
+  binding per-wave cost (the FlashSinkhorn IO-vs-compute argument,
+  applied to the *input* instead of the operands).  The resident warm
+  state therefore carries the padded int64 lag vector as a FOURTH
+  device-resident donated buffer, and the engine keeps a host-side
+  mirror of what that buffer holds.  When the epoch's changed fraction
+  is small enough (``delta_max_fraction``, and the pow2-padded ``[K]``
+  index/value update is strictly fewer bytes than the dense payload),
+  :func:`_warm_fused_delta` scatter-applies the delta to the resident
+  lag buffer and runs the SAME warm refine core in the same dispatch —
+  bit-identical to the dense path by construction (the scattered buffer
+  holds the identical int64 values).  K pads to a bounded pow2 ladder
+  (``DELTA_MIN_K`` .. ``DELTA_MIN_K << (delta_buckets - 1)``, one
+  executable per rung — warm them via :mod:`..warmup`); padding entries
+  write index 0's NEW value, so they are no-ops even when index 0 is
+  itself part of the delta.  Fallbacks are automatic and dense: changed
+  fraction over the threshold, a failed divergence check (the device
+  totals' sum must equal the host lag sum — the assignment-invariant
+  conservation law), an injected ``delta.apply``/``delta.diff`` fault,
+  or any host state that predates the resident buffer (roster churn,
+  :meth:`StreamingAssignor.seed_choice` recovery, shape change) — the
+  dense dispatch re-seeds the resident lag buffer and the next epoch
+  re-enters delta mode.  ``klba_h2d_bytes_total{path=dense|delta}`` and
+  ``klba_delta_epochs_total{outcome=applied|fallback|resync}`` count
+  the trade; the ``stream.h2d_delta`` span times the delta staging.
+
 The churn/quality trade-off is configurable per rebalance via
 ``refine_iters``.
 """
@@ -59,6 +87,7 @@ The churn/quality trade-off is configurable per rebalance via
 from __future__ import annotations
 
 import functools
+import logging
 from dataclasses import dataclass
 from typing import Optional
 
@@ -74,6 +103,32 @@ from .batched import _narrow_choice, _stream_device, assign_stream, stream_paylo
 from .dispatch import ensure_x64, observe_pack_shift
 from .packing import pad_bucket, pad_chunk, table_rows
 from .refine import build_choice_tables, refine_rounds_resident
+
+LOGGER = logging.getLogger(__name__)
+
+# Delta-epoch K ladder: a sparse (indices, values) update pads to a pow2
+# K bucket so the executable count stays bounded — DELTA_MIN_K is the
+# smallest rung, and an engine's ladder tops out at
+# ``DELTA_MIN_K << (delta_buckets - 1)`` (one executable per rung,
+# warmed by ..warmup's stream job).  Per-entry upload cost: int32 index
+# + int64 value.
+DELTA_MIN_K = 16
+_DELTA_ENTRY_BYTES = 4 + 8
+
+
+def delta_bucket(n_changed: int) -> int:
+    """Pow2 K bucket a delta of ``n_changed`` entries pads to."""
+    n = max(int(n_changed), 1)
+    if n <= DELTA_MIN_K:
+        return DELTA_MIN_K
+    return 1 << (n - 1).bit_length()
+
+
+def delta_k_ladder(buckets: int) -> list:
+    """The bounded K ladder for ``buckets`` rungs (warm-up drives one
+    synthetic delta wave per rung so the serving path compiles
+    nothing)."""
+    return [DELTA_MIN_K << i for i in range(max(int(buckets), 0))]
 
 
 @dataclass
@@ -115,10 +170,13 @@ def _refine_core(
 ):
     """Shared tail of every fused refine executable: the resident round
     loop plus the narrowed host-facing output.  Returns
-    (narrow choice[P], choice int32[B], row_tab, counts, totals int64[C],
-    rounds int32, exchanges int32) — everything after the first element
-    stays device-resident with the caller.  ``bulk`` selects the warm
-    engine's anti-ranked bulk-swap rounds (see
+    (narrow choice[P], choice int32[B], row_tab, counts, lags int64[B],
+    totals int64[C], rounds int32, exchanges int32) — everything after
+    the first element stays device-resident with the caller; the padded
+    lag vector rides along as the fourth resident buffer so the NEXT
+    epoch can scatter-apply a sparse delta instead of re-uploading it
+    (:func:`_warm_fused_delta`).  ``bulk`` selects the warm engine's
+    anti-ranked bulk-swap rounds (see
     :func:`..ops.refine.refine_rounds_resident`) with a 4-way partner
     fan per heavy consumer; cold chains keep the parity selection."""
     choice_p, row_tab, counts, totals, rounds, ex = refine_rounds_resident(
@@ -128,7 +186,7 @@ def _refine_core(
         bulk_transfer=bulk, fan=8 if bulk else 1,
     )
     narrow = _narrow_choice(choice_p[:P], num_consumers)
-    return narrow, choice_p, row_tab, counts, totals, rounds, ex
+    return narrow, choice_p, row_tab, counts, lags_p, totals, rounds, ex
 
 
 @functools.partial(
@@ -181,7 +239,8 @@ def _refine_chain(
     sort) and returned device-resident, seeding the fused warm path.
 
     Returns (narrow choice[P] — the one output the host materializes —
-    choice int32[bucket], row_tab, counts, totals, rounds, exchanges).
+    choice int32[bucket], row_tab, counts, lags int64[bucket], totals,
+    rounds, exchanges).
     """
     P = lags.shape[0]
     B = int(bucket)
@@ -249,11 +308,52 @@ def _warm_fused_resident(
     equivalent of the host-side quality bincount — and the while-loop
     condition tests them against ``limit`` BEFORE the first round, so a
     dispatch whose kept assignment already meets the target performs
-    zero rounds.  Returns the same tuple as :func:`_refine_chain`."""
+    zero rounds.  Returns the same tuple as :func:`_refine_chain`; the
+    returned padded lag vector seeds the delta path's resident lag
+    buffer."""
     P = lags.shape[0]
     B = choice.shape[0]
     M = row_tab.shape[1]
     lags_p = jnp.pad(lags.astype(jnp.int64), (0, B - P))
+    slot_ok = jnp.arange(M, dtype=jnp.int32)[None, :] < counts[:, None]
+    totals = jnp.where(
+        slot_ok, lags_p[jnp.clip(row_tab, 0, B - 1)], 0
+    ).sum(axis=1)
+    return _refine_core(
+        lags_p, choice, row_tab, counts, totals, limit, P,
+        num_consumers, iters, max_pairs, exchange_budget, bulk=True,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "P", "num_consumers", "iters", "max_pairs", "exchange_budget"
+    ),
+    donate_argnums=(2, 3, 4, 5),
+)
+def _warm_fused_delta(
+    idx, vals, lags_p, choice, row_tab, counts, limit, P: int,
+    num_consumers: int, iters: int, max_pairs, exchange_budget: int,
+):
+    """THE delta-epoch executable: scatter-apply a fixed-size padded
+    ``[K]`` (index, value) update to the device-RESIDENT lag buffer,
+    then run the exact fused warm-epoch body of
+    :func:`_warm_fused_resident` in the same dispatch.
+
+    Only ``idx`` (int32[K]) and ``vals`` (int64[K]) cross host->device —
+    O(changed) bytes instead of O(P); the previous choice, row table,
+    counts AND the padded lag vector are the donated loop-carried
+    buffers from the last dispatch.  Padding entries carry (0, new value
+    of index 0): a duplicate scatter of an identical value, so padding
+    is a no-op whether or not index 0 is part of the real delta (never
+    a conflicting duplicate write, which XLA scatter leaves undefined).
+    Bit-parity with the dense path is structural: after the scatter the
+    resident buffer holds the identical int64 lag values the dense pad
+    would have uploaded, and the refine core is shared."""
+    B = choice.shape[0]
+    M = row_tab.shape[1]
+    lags_p = lags_p.at[idx].set(vals)
     slot_ok = jnp.arange(M, dtype=jnp.int32)[None, :] < counts[:, None]
     totals = jnp.where(
         slot_ok, lags_p[jnp.clip(row_tab, 0, B - 1)], 0
@@ -307,6 +407,19 @@ class StreamingAssignor:
         # (the sidecar attaches one small ring per live stream and
         # serves it via the stream_flight wire method).
         flight: Optional[metrics.FlightRecorder] = None,
+        # Delta epochs (module docstring): when the epoch's changed-lag
+        # fraction is at most ``delta_max_fraction`` (and the padded
+        # [K] update is strictly fewer bytes than the dense payload),
+        # the warm dispatch scatter-applies an (indices, values) delta
+        # onto the device-resident lag buffer instead of re-uploading
+        # the full [P] vector.  ``delta_buckets`` bounds the pow2 K
+        # ladder (DELTA_MIN_K .. DELTA_MIN_K << (buckets - 1)); each
+        # rung is one executable — warm them (..warmup) or the first
+        # delta epoch per rung pays a compile.  0 buckets or
+        # delta_enabled=False keeps every upload dense.
+        delta_enabled: bool = True,
+        delta_max_fraction: float = 0.125,
+        delta_buckets: int = 6,
     ):
         self.num_consumers = int(num_consumers)
         self.refine_iters = int(refine_iters)
@@ -323,6 +436,22 @@ class StreamingAssignor:
         self.refine_threshold = refine_threshold
         self.step_trace = bool(step_trace)
         self.flight = flight
+        if not 0.0 < float(delta_max_fraction) <= 1.0:
+            raise ValueError(
+                f"delta_max_fraction={delta_max_fraction} must be in "
+                "(0, 1]"
+            )
+        if int(delta_buckets) < 0:
+            raise ValueError(
+                f"delta_buckets={delta_buckets} must be >= 0"
+            )
+        self.delta_enabled = bool(delta_enabled) and int(delta_buckets) > 0
+        self.delta_max_fraction = float(delta_max_fraction)
+        self.delta_buckets = int(delta_buckets)
+        # Top rung of the K ladder; a delta whose bucket exceeds it
+        # falls back to the dense upload.
+        ladder = delta_k_ladder(self.delta_buckets)
+        self._delta_kmax = ladder[-1] if self.delta_enabled else 0
         # Set transiently by submit_epoch: when non-None, the resident
         # warm dispatch routes through the megabatch coalescer
         # (ops/coalesce) instead of dispatching inline.
@@ -345,18 +474,39 @@ class StreamingAssignor:
         self._m_guardrail = metrics.REGISTRY.counter(
             "klba_stream_guardrail_trips_total"
         )
+        # H2D accounting + delta-epoch outcomes (pre-bound: these sit
+        # on the warm dispatch path).  The byte counters charge only
+        # the WARM paths' lag payloads — the designated upload sites
+        # lint rule L016 funnels future code through.
+        self._m_h2d_dense = metrics.REGISTRY.counter(
+            "klba_h2d_bytes_total", {"path": "dense"}
+        )
+        self._m_h2d_delta = metrics.REGISTRY.counter(
+            "klba_h2d_bytes_total", {"path": "delta"}
+        )
+        self._m_delta = {
+            o: metrics.REGISTRY.counter(
+                "klba_delta_epochs_total", {"outcome": o}
+            )
+            for o in ("applied", "fallback", "resync")
+        }
         self._prev_choice: Optional[np.ndarray] = None
         # Device-RESIDENT warm state between dispatches: (padded int32
         # choice[bucket], per-consumer row table int32[C, M], counts
-        # int32[C]).  The fused warm executable takes these as DONATED
-        # buffers and returns their successors, so the engine's own state
-        # never round-trips to host.  While this stream's roster is
-        # locked in the megabatch coalescer the value is a ResidentRow
-        # HANDLE instead (ops/coalesce): the buffers live stacked in the
+        # int32[C], padded int64 lags[bucket]).  The fused warm
+        # executable takes these as DONATED buffers and returns their
+        # successors, so the engine's own state never round-trips to
+        # host.  While this stream's roster is locked in the megabatch
+        # coalescer the value is a ResidentRow HANDLE instead
+        # (ops/coalesce): the buffers live stacked in the
         # coalescer-owned batch and the handle names this stream's row.
         # None = stale (host-side edits: repair, remap, reset, shape
         # change).
         self._resident = None
+        # Host mirror of the resident lag buffer's first P entries —
+        # the base the delta differ diffs against.  None whenever the
+        # resident state is stale (the mirror lives and dies with it).
+        self._lag_mirror: Optional[np.ndarray] = None
         self.last_stats = StreamingStats()
 
     def rebalance(self, lags: np.ndarray) -> np.ndarray:
@@ -485,7 +635,7 @@ class StreamingAssignor:
             prev_for_churn = prev  # churn counts repair moves too
             choice, stats.repaired_rows = self._repair_choice(prev, lags)
             if stats.repaired_rows:
-                self._resident = None  # device state is stale now
+                self._drop_resident()  # device state is stale now
 
             # Evaluate the KEPT assignment under the new lags (host-side,
             # one weighted bincount) and dispatch the refinement only when
@@ -541,6 +691,20 @@ class StreamingAssignor:
         slowly-varying P."""
         return pad_chunk(P) if jax.default_backend() == "cpu" else pad_bucket(P)
 
+    def _drop_resident(self) -> None:
+        """Invalidate the device-resident warm state AND its host lag
+        mirror together — a mirror that outlives the buffer it mirrors
+        would let a later delta scatter onto the wrong base."""
+        self._resident = None
+        self._lag_mirror = None
+
+    def _adopt_resident(self, resident, lags: np.ndarray) -> None:
+        """Install a dispatch's resident successors and mirror the lag
+        vector they were computed under (copied: the caller's array may
+        be mutated between epochs)."""
+        self._resident = resident
+        self._lag_mirror = np.array(lags, dtype=np.int64, copy=True)
+
     def _cold_solve(self, lags: np.ndarray) -> np.ndarray:
         """Fresh greedy solve + quality refinement (unbounded-churn path;
         budget = ``cold_refine_iters``, 0 disables).
@@ -556,7 +720,7 @@ class StreamingAssignor:
     def _cold_solve_inner(self, lags: np.ndarray) -> np.ndarray:
         C = self.num_consumers
         if self.cold_refine_iters <= 0 or C < 2:
-            self._resident = None
+            self._drop_resident()
             return np.asarray(
                 assign_stream(lags, num_consumers=C)
             ).astype(np.int32)
@@ -590,7 +754,7 @@ class StreamingAssignor:
                     iters=self.cold_refine_iters, max_pairs=None,
                     bucket=self._bucket(P), wide=(mode == "wide"),
                 )
-                self._resident = tuple(resident[:3])
+                self._adopt_resident(tuple(resident[:4]), lags)
                 return np.asarray(narrow).astype(np.int32)
             observe_pack_shift(("stream", lags.shape, C), (shift, rb))
             with metrics.span("stream.h2d"):
@@ -605,7 +769,7 @@ class StreamingAssignor:
             iters=self.cold_refine_iters, max_pairs=None,
             bucket=self._bucket(P),
         )
-        self._resident = tuple(resident[:3])
+        self._adopt_resident(tuple(resident[:4]), lags)
         return np.asarray(narrow).astype(np.int32)
 
     def _quality_limit(self, bound: float, total_lag: float) -> float:
@@ -667,9 +831,9 @@ class StreamingAssignor:
         payload, _ = stream_payload(lags)
         resident = self._resident
         # The resident state is either the engine's own (choice, row_tab,
-        # counts) device tuple or — while this stream's roster is locked
-        # in the megabatch coalescer — a ResidentRow handle whose buffers
-        # live stacked in the coalescer-owned batch (ops/coalesce).
+        # counts, lags) device tuple or — while this stream's roster is
+        # locked in the megabatch coalescer — a ResidentRow handle whose
+        # buffers live stacked in the coalescer-owned batch (ops/coalesce).
         handle_matches = getattr(resident, "matches", None)
         if resident is not None and (
             handle_matches(B, C, table_rows(B, C))
@@ -687,12 +851,16 @@ class StreamingAssignor:
                 ("warm_fused", lags.shape, C),
                 int(payload.dtype.itemsize) * 8,
             )
+            delta = self._delta_plan(lags, payload)
             if self._coalescer is not None:
                 # Megabatched epoch (submit_epoch): park on the
                 # coalescer's future — the flush stacks this epoch with
                 # its same-bucket batchmates into ONE vmapped fused
                 # dispatch, and the resident successors come back as
                 # rows of the batch output (still device-resident).
+                # The delta plan rides along: a locked wave whose every
+                # row carries one applies the stacked [N, K] delta path
+                # (O(N·changed) upload) instead of staging [N, B].
                 from .coalesce import DeadlineReroute, EpochSubmission
 
                 klass, rank, deadline_at = self._slo_submit
@@ -707,6 +875,15 @@ class StreamingAssignor:
                             abandoned=capture_abandon_check(),
                             klass=klass, rank=rank,
                             deadline_at=deadline_at,
+                            delta_idx=(
+                                delta[0][: delta[3]]
+                                if delta is not None else None
+                            ),
+                            delta_vals=(
+                                delta[1][: delta[3]]
+                                if delta is not None else None
+                            ),
+                            lag_sum=int(lags.sum(dtype=np.int64)),
                         )
                     ).result()
                 except DeadlineReroute:
@@ -718,7 +895,7 @@ class StreamingAssignor:
                     # admission-only.
                     pass
                 else:
-                    self._resident = r.resident
+                    self._adopt_resident(r.resident, lags)
                     self._fill_stats_from_device(
                         stats, r.totals, r.counts, r.rounds, r.exchanges
                     )
@@ -729,25 +906,146 @@ class StreamingAssignor:
                 # (ownership moves back from the batch to the engine;
                 # the next coalesced wave re-stacks and re-locks).
                 resident = resident.materialize()
-            out = _warm_fused_resident(
-                payload, resident[0], resident[1], resident[2], limit,
-                num_consumers=C, iters=budget, max_pairs=pairs,
-                exchange_budget=budget,
-            )
+            out = None
+            if delta is not None:
+                out = self._dispatch_delta(
+                    delta, resident, limit, P, budget, pairs
+                )
+                if out is None:
+                    # The delta dispatch failed (injected delta.apply
+                    # fault, scatter error): the resident buffers may
+                    # already have been donated into the failed call,
+                    # so re-sync dense through the table-BUILD variant
+                    # — it needs only host state, and its outputs
+                    # re-seed the resident lag buffer for the next
+                    # epoch's delta.
+                    observe_pack_shift(
+                        ("warm_fused_build", lags.shape, C),
+                        int(payload.dtype.itemsize) * 8,
+                    )
+                    self._m_h2d_dense.inc(payload.nbytes)
+                    out = _warm_fused_build(
+                        payload, choice.astype(np.int32), limit,
+                        num_consumers=C, iters=budget, max_pairs=pairs,
+                        exchange_budget=budget, bucket=B,
+                    )
+                else:
+                    # Divergence check — the conservation law: refine
+                    # permutes ownership, never lag mass, so the device
+                    # totals must sum to the host lag sum exactly
+                    # (int64, wrap-consistent on both sides).  A
+                    # mismatch means the resident lag buffer diverged
+                    # from the mirror — re-sync dense on the delta's
+                    # own successors (assignment validity is preserved
+                    # by construction; only quality could be off).
+                    if int(np.asarray(out[5]).sum()) != int(
+                        lags.sum(dtype=np.int64)
+                    ):
+                        LOGGER.warning(
+                            "delta epoch diverged from the host lag "
+                            "sum; re-syncing with a dense upload"
+                        )
+                        self._m_delta["fallback"].inc()
+                        self._m_h2d_dense.inc(payload.nbytes)
+                        out = _warm_fused_resident(
+                            payload, out[1], out[2], out[3], limit,
+                            num_consumers=C, iters=budget,
+                            max_pairs=pairs, exchange_budget=budget,
+                        )
+                    else:
+                        self._m_delta["applied"].inc()
+            if out is None:
+                self._m_h2d_dense.inc(payload.nbytes)
+                out = _warm_fused_resident(
+                    payload, resident[0], resident[1], resident[2],
+                    limit, num_consumers=C, iters=budget,
+                    max_pairs=pairs, exchange_budget=budget,
+                )
         else:
             observe_pack_shift(
                 ("warm_fused_build", lags.shape, C),
                 int(payload.dtype.itemsize) * 8,
             )
+            self._m_h2d_dense.inc(payload.nbytes)
             out = _warm_fused_build(
                 payload, choice.astype(np.int32), limit,
                 num_consumers=C, iters=budget, max_pairs=pairs,
                 exchange_budget=budget, bucket=B,
             )
-        narrow, choice_p, row_tab, counts, totals, rounds, ex = out
-        self._resident = (choice_p, row_tab, counts)
+        narrow, choice_p, row_tab, counts, lags_p, totals, rounds, ex = out
+        self._adopt_resident((choice_p, row_tab, counts, lags_p), lags)
         self._fill_stats_from_device(stats, totals, counts, rounds, ex)
         return np.asarray(narrow).astype(np.int32)
+
+    def _delta_plan(self, lags: np.ndarray, payload):
+        """Build this epoch's padded (idx, vals) delta against the host
+        lag mirror, or None when the epoch must upload dense: delta
+        mode off, no mirror (cold/churn/recovery — those paths re-seed
+        it), the diff itself failed (fault point ``delta.diff``), the
+        changed fraction exceeds ``delta_max_fraction``, the pow2 K
+        bucket exceeds the warmed ladder, or the padded delta would not
+        actually be smaller than the dense payload.  Returns
+        ``(idx int32[K], vals int64[K], upload_bytes, n_changed)``."""
+        if not self.delta_enabled:
+            return None
+        mirror = self._lag_mirror
+        if mirror is None or mirror.shape[0] != lags.shape[0]:
+            return None
+        try:
+            faults.fire("delta.diff")
+            changed = np.flatnonzero(lags != mirror)
+        except Exception:  # noqa: BLE001 — dense is the safe fallback
+            LOGGER.warning(
+                "delta diff failed; uploading dense", exc_info=True
+            )
+            self._m_delta["fallback"].inc()
+            return None
+        n = int(changed.size)
+        P = lags.shape[0]
+        K = delta_bucket(n)
+        if (
+            n > self.delta_max_fraction * P
+            or K > self._delta_kmax
+            or K * _DELTA_ENTRY_BYTES >= payload.nbytes
+        ):
+            self._m_delta["fallback"].inc()
+            return None
+        idx = np.zeros(K, dtype=np.int32)
+        idx[:n] = changed
+        # Padding entries write index 0's NEW value: identical to the
+        # real delta's write when index 0 changed, identical to the
+        # current resident value when it did not — either way a no-op,
+        # never a conflicting duplicate scatter.
+        vals = np.full(K, int(lags[0]), dtype=np.int64)
+        vals[:n] = lags[changed]
+        return idx, vals, idx.nbytes + vals.nbytes, n
+
+    def _dispatch_delta(
+        self, delta, resident, limit, P: int, budget: int, pairs
+    ):
+        """One fused delta dispatch over the resident 4-tuple; returns
+        the executable's output tuple, or None when the dispatch failed
+        (fault point ``delta.apply`` fires first — the caller re-syncs
+        dense within the same epoch, warm host state intact)."""
+        idx, vals, nbytes, n = delta
+        try:
+            faults.fire("delta.apply")
+            with metrics.span("stream.h2d_delta"):
+                out = _warm_fused_delta(
+                    idx, vals, resident[3], resident[0], resident[1],
+                    resident[2], limit, P=P,
+                    num_consumers=self.num_consumers, iters=budget,
+                    max_pairs=pairs, exchange_budget=budget,
+                )
+        except Exception:  # noqa: BLE001 — dense re-sync is the contract
+            LOGGER.warning(
+                "delta apply failed (%d changed); falling back to a "
+                "dense upload", n, exc_info=True,
+            )
+            self._m_delta["fallback"].inc()
+            return None
+        self._m_h2d_delta.inc(nbytes)
+        return out
 
     def _fill_stats_from_device(
         self, stats: StreamingStats, totals, counts, rounds, ex
@@ -819,7 +1117,7 @@ class StreamingAssignor:
             remapped = np.full(prev.shape[0], -1, dtype=np.int32)
             remapped[valid] = old_to_new[prev[valid]]
             self._prev_choice = remapped
-        self._resident = None  # device state predates the remap
+        self._drop_resident()  # device state predates the remap
         self.num_consumers = int(new_num_consumers)
 
     def _repair_choice(self, choice: np.ndarray, lags: np.ndarray):
@@ -918,9 +1216,9 @@ class StreamingAssignor:
         device-resident state is left stale; the next refine dispatch
         rebuilds its tables from this host vector."""
         self._prev_choice = np.ascontiguousarray(choice, dtype=np.int32)
-        self._resident = None
+        self._drop_resident()
 
     def reset(self) -> None:
         """Drop warm state (force the next rebalance to solve cold)."""
         self._prev_choice = None
-        self._resident = None
+        self._drop_resident()

@@ -67,6 +67,33 @@ re-solves cold.  ``{"method": "stream_reset", "params": {"stream_id":
 lists are in ascending partition-id order — the row-stable order warm
 state is keyed on.
 
+Delta epochs (DEPLOYMENT.md "Delta epochs"): steady-state drift touches
+few partitions, so instead of re-sending every ``[pid, lag]`` row a
+client may send only what changed::
+
+    {"method": "stream_assign",
+     "params": {"stream_id": "orders", "members": [...],
+                "lag_delta": {"indices": [3, 17],   # partition ids
+                              "values": [812, 0],   # their new lags
+                              "base_epoch": 41}}}   # last seen lag_epoch
+
+``params.lags`` and ``params.lag_delta`` are mutually exclusive.  Every
+stream response reports ``stream.lag_epoch`` — a monotone per-stream
+counter of accepted lag vectors — and a delta applies only when its
+``base_epoch`` equals the server's current value for the stream
+(:mod:`..lag`'s ``LagDeltaTracker`` produces conforming deltas from
+consecutive lag reads, so the JVM shim needs no protocol change).  A
+stale, duplicate, or gapped ``base_epoch`` — or a server that lost the
+base (restart, poisoned-stream rebuild, ``stream_reset``) — forces a
+dense re-sync: the response carries ``stream.resync: true`` (serving
+the previous assignment unchanged when one is servable, an error
+asking for full lags otherwise) and the client must send dense rows
+next epoch.  Server-side, the engine diffs every epoch against its
+device-resident lag buffer regardless of wire shape, so even
+dense-wire deployments get O(changed) device uploads
+(``klba_h2d_bytes_total{path=dense|delta}``,
+``klba_delta_epochs_total{outcome=applied|fallback|resync}``).
+
 Multi-tenant dispatch coalescing: when MORE than one stream is live,
 warm refine epochs route through the megabatch coalescer
 (:class:`..ops.coalesce.MegabatchCoalescer`) — concurrent epochs in the
@@ -386,6 +413,42 @@ def _snake_fallback(lags, C: int, prev):
     return choice, _host_choice_stats(choice, lags, C, prev, cold_start=True)
 
 
+def _parse_lag_delta(delta: Any):
+    """Type-validate ``params.lag_delta`` (module docstring "Delta
+    epochs"); returns (pids int64[n], values int64[n], base_epoch).
+    Only shape/type errors reject here — whether the delta can APPLY
+    (base_epoch match, known pids) is decided against the stream's
+    stored base under its lock."""
+    import numpy as np
+
+    if not isinstance(delta, dict):
+        raise ValueError("params.lag_delta must be a JSON object")
+    idx = delta.get("indices")
+    vals = delta.get("values")
+    base = delta.get("base_epoch")
+    if not isinstance(idx, list) or not isinstance(vals, list):
+        raise ValueError(
+            "params.lag_delta.indices/values must be lists"
+        )
+    if len(idx) != len(vals):
+        raise ValueError(
+            "params.lag_delta.indices and values differ in length"
+        )
+    if isinstance(base, bool) or not isinstance(base, int) or base < 0:
+        raise ValueError(
+            "params.lag_delta.base_epoch must be a non-negative integer"
+        )
+    d_pids = np.fromiter((int(p) for p in idx), np.int64, count=len(idx))
+    d_vals = np.fromiter((int(v) for v in vals), np.int64, count=len(vals))
+    if d_vals.size and int(d_vals.min()) < 0:
+        raise ValueError("params.lag_delta contains negative lag values")
+    if np.unique(d_pids).size != d_pids.size:
+        raise ValueError(
+            "params.lag_delta.indices contains duplicate partition ids"
+        )
+    return d_pids, d_vals, base
+
+
 def _serve_previous(prev, lags, C: int):
     """The kept-previous answer (shed ladder, deadline shed, fail-fast
     fallback alike): the stream's last served choice plus host-computed
@@ -446,6 +509,14 @@ class _Stream:
         # (time_s, total_lag) per served epoch — the recommend trend
         # window (bounded: deque maxlen).
         self.history = deque(maxlen=STREAM_HISTORY)
+        # Delta-epoch wire state (module docstring "Delta epochs"):
+        # the last accepted full lag vector (sorted-pid order) and its
+        # monotone epoch counter — the base a ``params.lag_delta``
+        # applies to.  Dies with the stream (poison/reset/restart), so
+        # a client's next delta answers ``resync`` and re-seeds it
+        # dense.
+        self.lag_epoch = 0
+        self.last_lags = None  # np.int64[P] in st.pids order
 
 
 def _stream_ring() -> metrics.FlightRecorder:
@@ -457,17 +528,23 @@ def _stream_ring() -> metrics.FlightRecorder:
     )
 
 
-def _fresh_engine(C: int, flight: metrics.FlightRecorder):
+def _fresh_engine(
+    C: int,
+    flight: metrics.FlightRecorder,
+    delta_opts: Optional[Dict[str, Any]] = None,
+):
     """THE service-default engine construction (guardrail ON at 1.25,
-    unlike the library default, plus the stream's flight ring) — every
-    site that makes an engine (first epoch, degraded-ladder cold rung,
-    drift-guard rebuild, snapshot rehydration) goes through here, so a
-    recovered or rebuilt engine can never drift from a freshly created
-    one and silently break the bit-exact recovery contract."""
+    unlike the library default, plus the stream's flight ring and the
+    service's delta-epoch knobs) — every site that makes an engine
+    (first epoch, degraded-ladder cold rung, drift-guard rebuild,
+    snapshot rehydration) goes through here, so a recovered or rebuilt
+    engine can never drift from a freshly created one and silently
+    break the bit-exact recovery contract."""
     from .ops.streaming import StreamingAssignor
 
     return StreamingAssignor(
-        num_consumers=C, imbalance_guardrail=1.25, flight=flight
+        num_consumers=C, imbalance_guardrail=1.25, flight=flight,
+        **(delta_opts or {}),
     )
 
 
@@ -667,6 +744,16 @@ class AssignorService:
         # (False = strict-serial fallback).
         coalesce_lock_waves: int = 1,
         coalesce_pipeline: bool = True,
+        # Delta epochs (ops/streaming; DEPLOYMENT.md "Delta epochs"):
+        # accept sparse lag updates onto the device-resident lag
+        # buffer when at most max_fraction of the partitions changed,
+        # with a pow2 K ladder of delta_buckets rungs bounding the
+        # executable count (the coalescer's stacked delta path uses
+        # the ladder top).  delta_enabled=False keeps every upload —
+        # wire deltas still apply host-side — dense.
+        delta_enabled: bool = True,
+        delta_max_fraction: float = 0.125,
+        delta_buckets: int = 6,
         # Opt-in plain-HTTP /metrics listener (utils/metrics_http):
         # port to bind on the service host (0 = ephemeral, for tests);
         # None disables.
@@ -705,6 +792,18 @@ class AssignorService:
         # Uptime/budget clock (L012 discipline: injectable, monotonic).
         clock: Callable[[], float] = time.monotonic,
     ):
+        # Knob validation BEFORE any resource (socket) is acquired: a
+        # bad delta knob must fail the boot loudly, not error every
+        # stream_assign once the first engine is built.
+        if not 0.0 < float(delta_max_fraction) <= 1.0:
+            raise ValueError(
+                f"delta_max_fraction={delta_max_fraction} must be in "
+                "(0, 1]"
+            )
+        if int(delta_buckets) < 0:
+            raise ValueError(
+                f"delta_buckets={delta_buckets} must be >= 0"
+            )
         self._tcp = socketserver.ThreadingTCPServer(
             (host, port), _Handler, bind_and_activate=True
         )
@@ -730,6 +829,23 @@ class AssignorService:
         # running instead of paying a full cold solve.  Bounded alongside
         # the stream cap; consumed (popped) on use or stream_reset.
         self._snapshots: Dict[str, Tuple] = {}
+        # Delta-epoch knobs (validated above, before the socket bind),
+        # threaded into every engine construction (_fresh_engine) and —
+        # as the single stacked K, the engines' ladder top — the
+        # coalescer's locked delta path.
+        self._delta_opts = {
+            "delta_enabled": bool(delta_enabled),
+            "delta_max_fraction": float(delta_max_fraction),
+            "delta_buckets": int(delta_buckets),
+        }
+        # What the warm-up drives: 0 rungs when delta mode is off.
+        self._warm_delta_buckets = (
+            int(delta_buckets) if delta_enabled else 0
+        )
+        from .ops.streaming import delta_k_ladder
+
+        ladder = delta_k_ladder(delta_buckets) if delta_enabled else []
+        delta_k = ladder[-1] if ladder else 0
         if coalesce_max_batch > 1:
             from .ops.coalesce import MegabatchCoalescer
 
@@ -738,6 +854,7 @@ class AssignorService:
                 max_batch=int(coalesce_max_batch),
                 lock_waves=int(coalesce_lock_waves),
                 pipeline=bool(coalesce_pipeline),
+                delta_k=delta_k,
             )
         else:
             self._coalescer = None
@@ -881,6 +998,9 @@ class AssignorService:
             "coalesce_max_batch": cfg.coalesce_max_batch,
             "coalesce_lock_waves": cfg.coalesce_lock_waves,
             "coalesce_pipeline": cfg.coalesce_pipeline,
+            "delta_enabled": cfg.delta_enabled,
+            "delta_max_fraction": cfg.delta_max_fraction,
+            "delta_buckets": cfg.delta_buckets,
             "metrics_port": cfg.metrics_port,
             "snapshot_path": cfg.snapshot_path,
             "snapshot_interval_s": cfg.snapshot_interval_s,
@@ -1258,6 +1378,7 @@ class AssignorService:
             raise ValueError("params.stream_id must be a non-empty string")
         topic = params.get("topic", "t0")
         rows = params.get("lags") or []
+        delta_params = params.get("lag_delta")
         members = params.get("members") or []
         if not isinstance(members, list) or not members:
             raise ValueError("params.members must be a non-empty list")
@@ -1267,29 +1388,45 @@ class AssignorService:
         C = len(members_sorted)
         opts = _validate_stream_options(params.get("options") or {})
 
-        if not rows:
-            raise ValueError("params.lags must be a non-empty list")
-        pids = np.fromiter(
-            (int(p) for p, _ in rows), np.int64, count=len(rows)
-        )
-        lags_in = np.fromiter(
-            (int(lag) for _, lag in rows), np.int64, count=len(rows)
-        )
-        if lags_in.size and int(lags_in.min()) < 0:
-            # Every kernel documents lags >= 0 as a precondition (the packed
-            # sort keys, the int32 downcast, and the quality stats all
-            # assume it), and the reference's lag formula clamps at 0
-            # (LagBasedPartitionAssignor.java:376-404) — so a negative lag
-            # at the wire is a client-side computation bug, rejected loudly
-            # rather than silently producing undefined ordering.
-            raise ValueError("params.lags contains negative lag values")
-        order = np.argsort(pids, kind="stable")
-        pids_sorted = pids[order]
-        lags = lags_in[order]
-        if pids_sorted.size and (
-            np.diff(pids_sorted) == 0
-        ).any():
-            raise ValueError("params.lags contains duplicate partition ids")
+        if delta_params is not None and rows:
+            raise ValueError(
+                "params.lags and params.lag_delta are mutually exclusive"
+            )
+        if delta_params is not None:
+            # Sparse epoch (module docstring "Delta epochs"): only type
+            # validation here — the delta applies against the stream's
+            # stored base under its lock, inside the admitted path.
+            delta = _parse_lag_delta(delta_params)
+            lags = None
+            pids_sorted = None
+        else:
+            delta = None
+            if not rows:
+                raise ValueError("params.lags must be a non-empty list")
+            pids = np.fromiter(
+                (int(p) for p, _ in rows), np.int64, count=len(rows)
+            )
+            lags_in = np.fromiter(
+                (int(lag) for _, lag in rows), np.int64, count=len(rows)
+            )
+            if lags_in.size and int(lags_in.min()) < 0:
+                # Every kernel documents lags >= 0 as a precondition (the
+                # packed sort keys, the int32 downcast, and the quality
+                # stats all assume it), and the reference's lag formula
+                # clamps at 0 (LagBasedPartitionAssignor.java:376-404) —
+                # so a negative lag at the wire is a client-side
+                # computation bug, rejected loudly rather than silently
+                # producing undefined ordering.
+                raise ValueError("params.lags contains negative lag values")
+            order = np.argsort(pids, kind="stable")
+            pids_sorted = pids[order]
+            lags = lags_in[order]
+            if pids_sorted.size and (
+                np.diff(pids_sorted) == 0
+            ).any():
+                raise ValueError(
+                    "params.lags contains duplicate partition ids"
+                )
 
         # Overload admission (utils/overload): the shed ladder decides
         # this request's fate BEFORE any solver state is touched.  The
@@ -1340,6 +1477,7 @@ class AssignorService:
             return self._stream_assign_admitted(
                 params, budget, klass, decision,
                 sid, topic, lags, pids_sorted, members_sorted, C, opts,
+                delta=delta,
             )
         finally:
             with self._inflight_lock:
@@ -1348,6 +1486,7 @@ class AssignorService:
     def _stream_assign_admitted(
         self, params, budget, klass, decision,
         sid, topic, lags, pids_sorted, members_sorted, C, opts,
+        delta=None,
     ) -> Dict[str, Any]:
         """The admitted remainder of a stream_assign: stream state,
         the solve (or the degrade rung's kept_previous), the ladder."""
@@ -1382,13 +1521,82 @@ class AssignorService:
 
         try:
             warm_restart = False
+            if delta is not None:
+                # Apply the sparse delta against the stream's stored
+                # base, under its lock.  Any reason it cannot apply —
+                # stale/duplicate/gapped base_epoch, unknown partition
+                # ids (the roster moved), or no dense base at all
+                # (restart/poison/reset rebuilt the stream) — forces a
+                # dense RE-SYNC: the previous assignment is served
+                # unchanged with ``resync: true`` when one is
+                # servable, else the request errors asking for full
+                # lags; either way the client must send dense rows
+                # next epoch (test-pinned).
+                resolved = self._apply_wire_delta(st, delta)
+                if isinstance(resolved, str):
+                    metrics.REGISTRY.counter(
+                        "klba_delta_epochs_total", {"outcome": "resync"}
+                    ).inc()
+                    base = st.last_lags
+                    prev = (
+                        st.engine._prev_choice
+                        if st.engine is not None else None
+                    )
+                    # Servable only for the UNCHANGED roster: this
+                    # early return runs before the membership-remap
+                    # block, so serving prev onto a changed member
+                    # list would misattribute every partition (and a
+                    # changed roster invalidates the kept choice
+                    # anyway — orphans need the repair pass).
+                    servable = (
+                        prev is not None
+                        and st.members == members_sorted
+                        and st.pids is not None
+                        and st.pids.shape[0] == prev.shape[0]
+                        and _keepable(prev, prev.shape[0], C)
+                    )
+                    if not servable:
+                        if created and st.engine is None:
+                            # Don't leave an engine-less husk holding a
+                            # MAX_STREAMS slot: this stream was minted
+                            # by a delta that cannot seed it.
+                            with self._streams_lock:
+                                if self._streams.get(sid) is st:
+                                    self._streams.pop(sid)
+                        raise ValueError(
+                            f"params.lag_delta cannot apply "
+                            f"({resolved}); resync: resend full "
+                            "params.lags"
+                        )
+                    LOGGER.warning(
+                        "stream %r lag_delta forced a resync (%s); "
+                        "serving the previous assignment", sid, resolved,
+                    )
+                    # With the base lags gone (restart recovery holds
+                    # choice + pids but never lag vectors), the served
+                    # stats are NEUTRAL (zero lags -> quality 1.0) —
+                    # one flagged resync epoch per stream beats an
+                    # error storm undercutting the restarts-are-a-
+                    # non-event contract.
+                    stats_lags = (
+                        base if base is not None
+                        else np.zeros(prev.shape[0], dtype=np.int64)
+                    )
+                    choice, s = _serve_previous(prev, stats_lags, C)
+                    return self._stream_result(
+                        topic, members_sorted, st.pids, choice, s,
+                        fallback_used=False, degraded_rung="none",
+                        warm_restart=False, opts=opts, klass=klass,
+                        shed=None, lag_epoch=st.lag_epoch, resync=True,
+                    )
+                lags, pids_sorted = resolved
             if st.engine is None:
                 # Requested options are applied by the SAME update
                 # block every epoch uses, so each default lives in
                 # exactly one place.  Each stream gets its own small
                 # flight ring alongside the engine.
                 st.flight = _stream_ring()
-                st.engine = _fresh_engine(C, st.flight)
+                st.engine = _fresh_engine(C, st.flight, self._delta_opts)
                 st.members = members_sorted
                 # Poisoned-stream recovery: if the last epoch for this sid
                 # died on the snake rung, warm-restart from the snapshot of
@@ -1427,7 +1635,7 @@ class AssignorService:
                 # cold-solve the NEW roster over the OLD C (imbalanced
                 # counts on growth, an index past members_sorted on
                 # shrink).  The stream keeps its flight ring.
-                st.engine = _fresh_engine(C, st.flight)
+                st.engine = _fresh_engine(C, st.flight, self._delta_opts)
                 st.members = members_sorted
                 st.pids = None
                 metrics.REGISTRY.counter(
@@ -1495,7 +1703,7 @@ class AssignorService:
                     topic, members_sorted, pids_sorted, choice, s,
                     fallback_used=False, degraded_rung="none",
                     warm_restart=warm_restart, opts=opts, klass=klass,
-                    shed=shed_info,
+                    shed=shed_info, lag_epoch=st.lag_epoch,
                 )
             # Multi-tenant routing: with MORE than one live stream the
             # warm dispatch goes through the megabatch coalescer (one
@@ -1607,31 +1815,67 @@ class AssignorService:
                         members_sorted, pids_sorted,
                     )
                 )
+            # Advance the delta base UNDER the stream lock: a
+            # concurrent delta request validates base_epoch against
+            # last_lags inside this same lock, so an unlocked
+            # two-field update here could let it read a matched epoch
+            # with the successor's lag vector (a silently wrong base).
+            self._note_epoch(st, klass, lags)
+            lag_epoch_out = st.lag_epoch
         finally:
             st.lock.release()
 
-        self._note_epoch(st, klass, lags)
         return self._stream_result(
             topic, members_sorted, pids_sorted, choice, s,
             fallback_used=fallback_used, degraded_rung=degraded_rung,
             warm_restart=warm_restart, opts=opts, klass=klass,
-            shed=shed_info,
+            shed=shed_info, lag_epoch=lag_epoch_out,
         )
 
     def _note_epoch(self, st: _Stream, klass: str, lags) -> None:
         """Record one served epoch's elasticity sample: (time, total
         lag) into the stream's bounded trend window, plus its effective
-        class — the raw material of ``{"method": "recommend"}``."""
+        class — the raw material of ``{"method": "recommend"}`` — and
+        advance the stream's delta base: ``lags`` becomes the vector a
+        ``lag_delta`` with the NEW ``lag_epoch`` applies to.  Caller
+        holds ``st.lock`` (the base pair must never tear against
+        :meth:`_apply_wire_delta`'s locked read)."""
         st.klass = klass
         st.history.append(
             (self._clock(), int(lags.sum(dtype="int64")))
         )
+        st.last_lags = lags
+        st.lag_epoch += 1
+
+    def _apply_wire_delta(self, st: _Stream, delta):
+        """Apply a parsed ``lag_delta`` to the stream's stored base
+        (caller holds ``st.lock``).  Returns ``(lags, pids_sorted)`` on
+        success, or a human-readable REASON string when the delta
+        cannot apply and the stream must re-sync dense."""
+        import numpy as np
+
+        d_pids, d_vals, base = delta
+        if st.last_lags is None or st.pids is None:
+            return "no dense base held for this stream"
+        if base != st.lag_epoch:
+            return (
+                f"base_epoch {base} does not match the stream's "
+                f"current lag_epoch {st.lag_epoch}"
+            )
+        pos = np.searchsorted(st.pids, d_pids)
+        pos = np.clip(pos, 0, max(st.pids.shape[0] - 1, 0))
+        if d_pids.size and not np.array_equal(st.pids[pos], d_pids):
+            return "delta names partition ids outside the stream's set"
+        lags = st.last_lags.copy()
+        lags[pos] = d_vals
+        return lags, st.pids
 
     def _stream_result(
         self, topic, members_sorted, pids_sorted, choice, s, *,
         fallback_used: bool, degraded_rung: str, warm_restart: bool,
         opts: Dict[str, Any], klass: str,
         shed: Optional[Dict[str, Any]],
+        lag_epoch: int = 0, resync: bool = False,
     ) -> Dict[str, Any]:
         import numpy as np
 
@@ -1668,6 +1912,11 @@ class AssignorService:
                 # degraded it — which rung shed it and what was served.
                 "slo_class": klass,
                 "shed": shed,
+                # Delta-epoch surface (module docstring "Delta epochs"):
+                # the monotone base counter a lag_delta must name, and
+                # whether THIS answer demands a dense re-send.
+                "lag_epoch": lag_epoch,
+                "resync": resync,
             },
             "options": opts,
         }
@@ -1683,7 +1932,7 @@ class AssignorService:
         import numpy as np
 
         ring = _stream_ring()
-        fresh = _fresh_engine(C, ring)
+        fresh = _fresh_engine(C, ring, self._delta_opts)
         _apply_stream_opts(fresh, opts)
         try:
             choice = self._watchdog.call(
@@ -1955,7 +2204,7 @@ class AssignorService:
                     klass = "standard"
                 st = _Stream()
                 st.flight = _stream_ring()
-                st.engine = _fresh_engine(C, st.flight)
+                st.engine = _fresh_engine(C, st.flight, self._delta_opts)
                 # The recovery contract: the first warm epoch must be
                 # bit-identical to an uninterrupted process's epoch
                 # from the SAME seeded choice — seed_choice leaves
@@ -2122,8 +2371,10 @@ class AssignorService:
                     # Megabatch coverage: with coalescing enabled, one
                     # synthetic multi-stream wave per batch-pow2 bucket
                     # compiles the re-stack AND locked executables off
-                    # the serving path.
+                    # the serving path; the delta ladder warms with the
+                    # service's configured rung count.
                     coalesce_max_batch=coalesce_batch,
+                    delta_buckets=self._warm_delta_buckets,
                 )
         if self._recovery_shapes and self._recovery_warmup:
             # Megabatch warm-up for the RECOVERED shapes, off the
@@ -2139,6 +2390,7 @@ class AssignorService:
                     consumers=[consumers],
                     solvers=("stream",),
                     coalesce_max_batch=coalesce_batch,
+                    delta_buckets=self._warm_delta_buckets,
                 )
         # The serving surfaces come up under the lifecycle lock: a
         # drain/stop that raced the (possibly minutes-long) recovery
@@ -2326,18 +2578,26 @@ class AssignorServiceClient:
         self,
         stream_id: str,
         topic: str,
-        lags: List[Tuple[int, int]],
+        lags: Optional[List[Tuple[int, int]]],
         members: List[str],
         options: Optional[Dict[str, Any]] = None,
+        lag_delta: Optional[Dict[str, Any]] = None,
     ) -> Dict[str, Any]:
         """One warm-start epoch; returns the raw result dict
-        (``assignments`` + ``stream`` stats)."""
+        (``assignments`` + ``stream`` stats).  Pass ``lag_delta``
+        (and ``lags=None``) to send a sparse delta epoch — see the
+        module docstring "Delta epochs" and
+        :class:`..lag.LagDeltaTracker`, which produces both shapes
+        from consecutive lag reads."""
         params: Dict[str, Any] = {
             "stream_id": stream_id,
             "topic": topic,
-            "lags": lags,
             "members": members,
         }
+        if lags is not None:
+            params["lags"] = lags
+        if lag_delta is not None:
+            params["lag_delta"] = lag_delta
         if options is not None:
             params["options"] = options
         return self.request("stream_assign", params)
@@ -2421,6 +2681,22 @@ def main() -> None:
              "serial upload/dispatch/readback per wave)",
     )
     parser.add_argument(
+        "--no-delta", action="store_true",
+        help="disable delta epochs (sparse lag updates onto the "
+             "device-resident lag buffer; every upload stays dense)",
+    )
+    parser.add_argument(
+        "--delta-max-fraction", type=float, default=0.125,
+        metavar="FRAC",
+        help="changed-partition fraction above which a warm epoch "
+             "uploads dense instead of a delta (default 0.125)",
+    )
+    parser.add_argument(
+        "--delta-buckets", type=int, default=6, metavar="N",
+        help="pow2 K-ladder rungs for delta uploads (16..16<<N-1; each "
+             "rung is one warmed executable per shape; default 6)",
+    )
+    parser.add_argument(
         "--snapshot-path", default=None, metavar="FILE",
         help="crash-safe lifecycle snapshot file (atomic writes); "
              "enables warm-restart recovery at boot; omit to disable",
@@ -2449,6 +2725,9 @@ def main() -> None:
         coalesce_max_batch=opts.coalesce_max_batch,
         coalesce_lock_waves=opts.coalesce_lock_waves,
         coalesce_pipeline=not opts.coalesce_serial,
+        delta_enabled=not opts.no_delta,
+        delta_max_fraction=opts.delta_max_fraction,
+        delta_buckets=opts.delta_buckets,
         metrics_port=opts.metrics_port,
         snapshot_path=opts.snapshot_path,
         snapshot_interval_s=max(opts.snapshot_interval_ms, 1.0) / 1000.0,

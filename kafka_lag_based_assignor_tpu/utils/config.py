@@ -66,6 +66,17 @@ COALESCE_MAX_BATCH_CONFIG = "tpu.assignor.coalesce.max_batch"
 # strict-serial fallback).
 COALESCE_LOCK_WAVES_CONFIG = "tpu.assignor.coalesce.roster.lock.waves"
 COALESCE_PIPELINE_CONFIG = "tpu.assignor.coalesce.pipeline"
+# Delta epochs (ops/streaming; DEPLOYMENT.md "Delta epochs"): whether a
+# warm dispatch may scatter-apply a sparse (indices, values) lag update
+# onto the device-resident lag buffer instead of re-uploading the full
+# vector; the changed-fraction ceiling above which the dense upload is
+# used; and the number of pow2 K-ladder rungs (executable count per
+# shape bucket — warm-up drives one synthetic delta wave per rung, and
+# the megabatch's stacked delta path pads to the ladder top).  0
+# buckets disables like enabled=false.
+DELTA_ENABLED_CONFIG = "tpu.assignor.delta.enabled"
+DELTA_MAX_FRACTION_CONFIG = "tpu.assignor.delta.max.fraction"
+DELTA_BUCKETS_CONFIG = "tpu.assignor.delta.buckets"
 # SLO classes + overload control (utils/overload, served by the
 # sidecar).  Per-stream class: "tpu.assignor.slo.class.<stream_id>" =
 # critical | standard | best_effort (a wire-level params.slo_class
@@ -187,6 +198,11 @@ class AssignorConfig:
     coalesce_max_batch: int = 32
     coalesce_lock_waves: int = 1
     coalesce_pipeline: bool = True
+    # Delta epochs (ops/streaming): sparse lag updates onto the
+    # device-resident lag buffer; fraction ceiling + pow2 K ladder.
+    delta_enabled: bool = True
+    delta_max_fraction: float = 0.125
+    delta_buckets: int = 6
     # SLO classes + overload control (utils/overload): per-stream class
     # map, per-class deadline budgets (seconds), and the overload
     # detector's pressure normalizers (0 latency budget = auto).
@@ -340,6 +356,28 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
                 raise ValueError(f"{key}={value!r} must be > 0 ms")
             slo_deadline_s[klass] = secs
 
+    # Delta-epoch knobs: the fraction is a plain float in (0, 1]; the
+    # bucket count bounds the per-shape executable ladder (a typo'd
+    # 10_000 here would mint thousands of compiles, so it is capped).
+    raw_frac = consumer_group_props.get(DELTA_MAX_FRACTION_CONFIG, 0.125)
+    try:
+        delta_max_fraction = float(raw_frac)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"{DELTA_MAX_FRACTION_CONFIG}={raw_frac!r} is not a number"
+        )
+    if not 0.0 < delta_max_fraction <= 1.0:
+        raise ValueError(
+            f"{DELTA_MAX_FRACTION_CONFIG}={delta_max_fraction} must be "
+            "in (0, 1]"
+        )
+    delta_buckets = _as_int(DELTA_BUCKETS_CONFIG, 6, 0)
+    if delta_buckets > 16:
+        raise ValueError(
+            f"{DELTA_BUCKETS_CONFIG}={delta_buckets} must be <= 16 "
+            "(each rung is one compiled executable per shape bucket)"
+        )
+
     # The controller keeps this knob in ms (it normalizes a p99 that is
     # measured in ms), so convert _as_ms's seconds back out once, here.
     overload_latency_budget_ms = (
@@ -378,6 +416,11 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
         coalesce_pipeline=_as_bool(
             consumer_group_props.get(COALESCE_PIPELINE_CONFIG, True)
         ),
+        delta_enabled=_as_bool(
+            consumer_group_props.get(DELTA_ENABLED_CONFIG, True)
+        ),
+        delta_max_fraction=delta_max_fraction,
+        delta_buckets=delta_buckets,
         slo_classes=slo_classes,
         slo_deadline_s=slo_deadline_s,
         overload_latency_budget_ms=overload_latency_budget_ms,

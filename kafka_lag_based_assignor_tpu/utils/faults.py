@@ -35,6 +35,17 @@ Named fault points (every one threaded through production code):
                     (:meth:`..utils.overload.OverloadController.
                     admission`) — the service FAILS OPEN (admits) when
                     the shed decision itself faults
+``delta.diff``      the host-side lag differ (:meth:`..ops.streaming.
+                    StreamingAssignor._delta_plan` and
+                    :class:`..lag.LagDeltaTracker`) — a failure here
+                    must fall back to the dense upload within the same
+                    epoch, warm state intact, no breaker charge
+``delta.apply``     the fused delta dispatch (inline
+                    :meth:`..ops.streaming.StreamingAssignor.
+                    _dispatch_delta` and the coalescer's stacked delta
+                    staging) — fires BEFORE any donation, so a failure
+                    falls back to the dense upload within the same
+                    request budget
 ``snapshot.write``  a lifecycle snapshot save (:meth:`..utils.snapshot.
                     SnapshotStore.save`) — a failure here exercises the
                     fail-open write contract (serving continues on the
@@ -103,6 +114,8 @@ FAULT_POINTS = frozenset(
         "coalesce.gather",
         "admit.park",
         "shed.decide",
+        "delta.diff",
+        "delta.apply",
         "snapshot.write",
         "snapshot.load",
         "drain.flush",

@@ -54,6 +54,7 @@ def warmup(
     refine_iters: Optional[int] = None,
     stream_refine_iters: int = 128,
     coalesce_max_batch: int = 1,
+    delta_buckets: int = 6,
 ) -> List[Tuple[str, int, int, int, float]]:
     """Pre-compile kernels for every shape the deployment will see.
 
@@ -99,6 +100,17 @@ def warmup(
         ``stream_refine_iters`` (batch bucket and exchange budget are
         both part of the executable signature).  Recorded as
         ``("coalesce", batch_bucket, P, C, seconds)`` rows.
+      delta_buckets: > 0 additionally warms the DELTA-EPOCH executables
+        (ops/streaming "delta epochs"): one synthetic delta dispatch
+        per pow2 K rung of the ladder on the inline path (rungs whose
+        padded upload would not beat the dense payload at this shape
+        are skipped — production skips them identically), and — with
+        ``coalesce_max_batch > 1`` — one stacked delta WAVE per batch
+        bucket on the roster-locked megabatch path (which always pads
+        to the ladder top; the wave rides inside each ``coalesce``
+        job).  Must match the production ``delta_buckets`` knob; 0
+        skips (delta disabled).  Inline rungs are recorded as
+        ``("stream_delta", K, P, C, s)`` rows.
 
     Returns a list of (solver, T, P_bucket, C, seconds) for each shape
     compiled.  Failures are logged and skipped — warm-up must never take a
@@ -156,9 +168,14 @@ def warmup(
                     # rebalance ever pays it; assign_stream below then
                     # warms whichever kernel the gate selected.
                     rounds_pallas_available(run_probe=True)
+                    # delta_enabled=False pins THIS job's warm dispatches
+                    # to the DENSE executables (an enabled engine would
+                    # route its unchanged-lags warm epoch through the
+                    # K=16 delta variant and leave the dense one cold);
+                    # the delta ladder warms via its own jobs below.
                     engine = StreamingAssignor(
                         num_consumers=C, refine_iters=stream_refine_iters,
-                        refine_threshold=None,
+                        refine_threshold=None, delta_enabled=False,
                     )
                     engine.rebalance(lags1d)
                     out = engine.rebalance(lags1d)
@@ -193,6 +210,41 @@ def warmup(
                     return out
 
                 jobs.append(("stream", 1, stream_job))
+            if "stream" in solvers and delta_buckets > 0:
+                from .ops.streaming import delta_k_ladder
+
+                for K in delta_k_ladder(delta_buckets):
+                    if K > P:
+                        break
+
+                    def delta_job(lags1d=lags1d, C=C, K=K):
+                        # One synthetic delta dispatch at exactly this K
+                        # rung: seed the resident lag buffer with two
+                        # dense epochs (executables already warmed by
+                        # stream_job), then change exactly K entries so
+                        # the host differ buckets to K.  fraction=1.0
+                        # forces eligibility at any warmed shape; the
+                        # bytes gate still applies, exactly as it will
+                        # in production at this shape — an ineligible
+                        # rung dispatches the (warm) dense executable
+                        # and costs nothing new.
+                        from .ops.streaming import StreamingAssignor
+
+                        eng = StreamingAssignor(
+                            num_consumers=C,
+                            refine_iters=stream_refine_iters,
+                            refine_threshold=None,
+                            delta_max_fraction=1.0,
+                            delta_buckets=delta_buckets,
+                        )
+                        cur = lags1d.copy()
+                        eng.rebalance(cur)
+                        eng.rebalance(cur)
+                        nxt = cur.copy()
+                        nxt[:K] = nxt[:K] + 1 + (np.arange(K) % 7)
+                        return eng.rebalance(nxt)
+
+                    jobs.append(("stream_delta", K, delta_job))
             if "stream" in solvers and coalesce_max_batch > 1:
                 # Megabatch coverage: one synthetic multi-stream wave
                 # pair per batch-pow2 bucket — wave 1 compiles the
@@ -206,7 +258,10 @@ def warmup(
                         import threading
 
                         from .ops.coalesce import MegabatchCoalescer
-                        from .ops.streaming import StreamingAssignor
+                        from .ops.streaming import (
+                            StreamingAssignor,
+                            delta_k_ladder,
+                        )
 
                         rng_j = np.random.default_rng(n)
                         engines = [
@@ -214,13 +269,20 @@ def warmup(
                                 num_consumers=C,
                                 refine_iters=stream_refine_iters,
                                 refine_threshold=None,
+                                delta_max_fraction=1.0,
+                                delta_buckets=max(delta_buckets, 1),
                             )
                             for _ in range(n)
                         ]
                         for eng in engines:
                             eng.rebalance(lags1d)
+                        # The production stacked-delta K (the ladder
+                        # top); 0 keeps the delta wave dense-only.
+                        ladder = delta_k_ladder(delta_buckets)
+                        delta_k = ladder[-1] if ladder else 0
                         coal = MegabatchCoalescer(
-                            window_s=2.0, max_batch=n, lock_waves=1
+                            window_s=2.0, max_batch=n, lock_waves=1,
+                            delta_k=delta_k,
                         )
                         # Mixed SLO placement (utils/overload): the
                         # warm-up waves submit under alternating
@@ -234,13 +296,33 @@ def warmup(
 
                         out = None
                         try:
-                            for _wave in range(2):
-                                arrs = [
-                                    rng_j.integers(
-                                        0, 1000, lags1d.shape[0]
-                                    ).astype(np.int64)
-                                    for _ in engines
-                                ]
+                            # Wave 1 compiles the re-stack executable
+                            # (and locks the roster), wave 2 the locked
+                            # DENSE executable, wave 3 — every row a
+                            # small perturbation of wave 2, so every
+                            # engine submits a delta plan — the locked
+                            # DELTA executable (skipped bucket-
+                            # consistently when the stacked delta would
+                            # not beat dense at this shape, exactly as
+                            # production skips it).
+                            waves = 3 if delta_k > 0 else 2
+                            arrs = None
+                            for _wave in range(waves):
+                                if _wave < 2:
+                                    arrs = [
+                                        rng_j.integers(
+                                            0, 1000, lags1d.shape[0]
+                                        ).astype(np.int64)
+                                        for _ in engines
+                                    ]
+                                else:
+                                    arrs = [
+                                        a + np.where(
+                                            np.arange(a.shape[0]) < 8,
+                                            1, 0,
+                                        )
+                                        for a in arrs
+                                    ]
                                 errs = []
 
                                 def run(eng, arr, i=0):

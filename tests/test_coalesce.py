@@ -636,7 +636,9 @@ def test_roster_and_staging_retention_is_bounded():
             exchange_budget=1, owner=owner,
         )
         _, roster = coal._note_wave(("key", i), [sub])
-        batch = cm._ResidentBatch(("key", i), None, None, None, n_real=1)
+        batch = cm._ResidentBatch(
+            ("key", i), None, None, None, None, n_real=1
+        )
         roster.batch = batch
         batches.append(batch)
     assert len(coal._rosters) == cm._MAX_ROSTERS

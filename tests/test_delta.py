@@ -1,0 +1,675 @@
+"""Delta-epoch tests (ISSUE 8): the pow2 K ladder, inline and
+locked-megabatch differential fuzz against an always-dense twin, the
+divergence check's dense re-sync, the wire ``lag_delta`` protocol with
+its monotone base-epoch guard (stale/gapped deltas provably force
+resync), H2D byte accounting, and the host-side
+:class:`..lag.LagDeltaTracker` differ."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from kafka_lag_based_assignor_tpu.lag import LagDeltaTracker
+from kafka_lag_based_assignor_tpu.ops.coalesce import MegabatchCoalescer
+from kafka_lag_based_assignor_tpu.ops.streaming import (
+    DELTA_MIN_K,
+    StreamingAssignor,
+    delta_bucket,
+    delta_k_ladder,
+)
+from kafka_lag_based_assignor_tpu.service import (
+    AssignorService,
+    AssignorServiceClient,
+)
+from kafka_lag_based_assignor_tpu.testing import assert_valid_assignment
+from kafka_lag_based_assignor_tpu.utils import metrics
+
+
+def _counter(name, **labels):
+    return metrics.REGISTRY.counter(name, labels)
+
+
+def _engines(n, C=8, refine_iters=16, **kw):
+    kw.setdefault("refine_threshold", None)
+    return [
+        StreamingAssignor(num_consumers=C, refine_iters=refine_iters, **kw)
+        for _ in range(n)
+    ]
+
+
+def _drift(rng, lags, n):
+    """``lags`` with exactly ``n`` random entries replaced by fresh
+    values guaranteed to differ."""
+    out = lags.copy()
+    idx = rng.choice(lags.shape[0], size=n, replace=False)
+    out[idx] = out[idx] + rng.integers(1, 10**4, n)
+    return out
+
+
+# -- ladder / plan unit semantics ----------------------------------------
+
+
+def test_delta_bucket_and_ladder():
+    assert delta_bucket(0) == DELTA_MIN_K
+    assert delta_bucket(1) == DELTA_MIN_K
+    assert delta_bucket(DELTA_MIN_K) == DELTA_MIN_K
+    assert delta_bucket(DELTA_MIN_K + 1) == DELTA_MIN_K * 2
+    assert delta_bucket(100) == 128
+    assert delta_bucket(512) == 512
+    assert delta_k_ladder(3) == [16, 32, 64]
+    assert delta_k_ladder(0) == []
+
+
+def test_engine_ctor_validation():
+    with pytest.raises(ValueError):
+        StreamingAssignor(num_consumers=2, delta_max_fraction=0.0)
+    with pytest.raises(ValueError):
+        StreamingAssignor(num_consumers=2, delta_max_fraction=1.5)
+    with pytest.raises(ValueError):
+        StreamingAssignor(num_consumers=2, delta_buckets=-1)
+    # 0 buckets disables delta mode entirely.
+    eng = StreamingAssignor(num_consumers=2, delta_buckets=0)
+    assert not eng.delta_enabled
+
+
+def test_delta_plan_eligibility_boundaries():
+    """The plan declines (dense upload) on: no mirror, over-fraction,
+    over-ladder K, and a padded delta that would not beat the dense
+    payload — and pads with index 0's NEW value."""
+    rng = np.random.default_rng(3)
+    P = 1024
+    eng = StreamingAssignor(
+        num_consumers=8, refine_iters=16, refine_threshold=None,
+        delta_max_fraction=0.25, delta_buckets=3,  # kmax = 64
+    )
+    lags = rng.integers(10**4, 10**6, P).astype(np.int64)
+    payload = lags.astype(np.int32)
+    assert eng._delta_plan(lags, payload) is None  # cold: no mirror
+    eng.rebalance(lags)
+    fb = _counter("klba_delta_epochs_total", outcome="fallback")
+
+    small = _drift(rng, lags, 10)
+    plan = eng._delta_plan(small, small.astype(np.int32))
+    assert plan is not None
+    idx, vals, nbytes, n = plan
+    assert n == 10 and idx.shape == (DELTA_MIN_K,)
+    assert nbytes == idx.nbytes + vals.nbytes
+    # Padding entries: index 0, index 0's NEW value.
+    assert (idx[n:] == 0).all()
+    assert (vals[n:] == small[0]).all()
+
+    before = fb.value
+    over_k = _drift(rng, lags, 65)  # bucket 128 > kmax 64
+    assert eng._delta_plan(over_k, over_k.astype(np.int32)) is None
+    over_frac = _drift(rng, lags, 300)  # 300 > 0.25 * 1024
+    assert eng._delta_plan(over_frac, over_frac.astype(np.int32)) is None
+    assert fb.value == before + 2
+
+    # A shape-changed epoch has no usable mirror.
+    assert eng._delta_plan(lags[:512], lags[:512].astype(np.int32)) is None
+
+    # Bytes gate: at tiny P the padded K=16 delta (192 B) must not
+    # "save" over a smaller dense payload.
+    tiny = StreamingAssignor(
+        num_consumers=2, refine_iters=8, refine_threshold=None
+    )
+    tl = rng.integers(1, 1000, 16).astype(np.int64)
+    tiny.rebalance(tl)
+    t2 = tl.copy()
+    t2[0] += 5
+    assert tiny._delta_plan(t2, t2.astype(np.int32)) is None
+
+
+def test_disabled_engine_never_plans():
+    rng = np.random.default_rng(4)
+    eng = StreamingAssignor(
+        num_consumers=4, refine_iters=16, refine_threshold=None,
+        delta_enabled=False,
+    )
+    lags = rng.integers(10**4, 10**6, 512).astype(np.int64)
+    eng.rebalance(lags)
+    nxt = _drift(rng, lags, 5)
+    assert eng._delta_plan(nxt, nxt.astype(np.int32)) is None
+
+
+# -- inline differential fuzz --------------------------------------------
+
+
+def test_inline_differential_fuzz_vs_dense_twin():
+    """Seeded drift sequences interleaving delta-regime drift, dense
+    fallback (huge churn), seed_choice resync, remap churn, and reset:
+    the delta engine's choices must be bit-identical to an always-dense
+    twin at every epoch, and the delta path must actually have
+    engaged."""
+    rng = np.random.default_rng(42)
+    P, C = 768, 8
+    applied = _counter("klba_delta_epochs_total", outcome="applied")
+    a, b = _engines(2, C=C)
+    # Twin b never deltas; twin a is the system under test.
+    b.delta_enabled = False
+    applied_before = applied.value
+    lags = rng.integers(10**5, 10**7, P).astype(np.int64)
+    for step in range(40):
+        op = rng.integers(0, 10)
+        if op == 7:
+            seed = np.asarray(a._prev_choice)
+            a.seed_choice(seed)
+            b.seed_choice(seed)
+        elif op == 8:
+            ident = np.arange(C, dtype=np.int32)
+            a.remap_members(ident, C)
+            b.remap_members(ident, C)
+        elif op == 9:
+            a.reset()
+            b.reset()
+        if op <= 3:
+            lags = _drift(rng, lags, int(rng.integers(1, 24)))
+        elif op <= 6:
+            lags = _drift(rng, lags, int(rng.integers(200, 700)))
+        ca = a.rebalance(lags)
+        cb = b.rebalance(lags)
+        np.testing.assert_array_equal(ca, cb, err_msg=f"step {step}")
+        assert_valid_assignment(
+            {"m%d" % m: [("t", int(p)) for p in np.flatnonzero(ca == m)]
+             for m in range(C)},
+            P,
+        )
+    assert applied.value > applied_before + 5
+
+
+def test_divergence_check_forces_dense_resync():
+    """White-box: corrupt the host mirror so the scattered device
+    buffer disagrees with the true lags — the conservation-law check
+    must catch it, count a fallback, re-sync dense, and restore delta
+    mode on the next epoch."""
+    rng = np.random.default_rng(5)
+    P, C = 512, 4
+    eng = StreamingAssignor(
+        num_consumers=C, refine_iters=16, refine_threshold=None
+    )
+    lags = rng.integers(10**5, 10**7, P).astype(np.int64)
+    eng.rebalance(lags)
+    eng.rebalance(_drift(rng, lags, 4))
+    fb = _counter("klba_delta_epochs_total", outcome="fallback")
+    applied = _counter("klba_delta_epochs_total", outcome="applied")
+    before = fb.value
+    # Corrupt the mirror: the next diff under-reports what changed, so
+    # the scatter leaves the device buffer diverged from the true lags.
+    eng._lag_mirror[rng.choice(P, 8, replace=False)] += 1234
+    nxt = _drift(rng, np.asarray(eng._lag_mirror), 4)
+    choice = eng.rebalance(nxt)
+    assert fb.value == before + 1
+    counts = np.bincount(choice, minlength=C)
+    assert counts.max() - counts.min() <= 1  # still a valid assignment
+    # Mirror re-synced by the dense re-dispatch: next epoch deltas.
+    a_before = applied.value
+    eng.rebalance(_drift(rng, nxt, 3))
+    assert applied.value == a_before + 1
+
+
+# -- locked-megabatch differential ---------------------------------------
+
+
+def _submit_all(engines, lags_list, coal):
+    out = [None] * len(engines)
+    errs = [None] * len(engines)
+
+    def run(i):
+        try:
+            out[i] = engines[i].submit_epoch(lags_list[i], coal)
+        except Exception as exc:  # noqa: BLE001 — re-raised below
+            errs[i] = exc
+
+    threads = [
+        threading.Thread(target=run, args=(i,))
+        for i in range(len(engines))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180.0)
+        assert not t.is_alive(), "coalesced epoch did not complete"
+    for e in errs:
+        if e is not None:
+            raise e
+    return out
+
+
+def test_locked_megabatch_delta_differential():
+    """Locked waves whose rows all drift sparsely must dispatch the
+    stacked delta executable — bit-identical per row to inline dense
+    twins — and a churn event (stream leaves) must fall back through
+    the dense re-stack, then re-enter delta mode after re-locking."""
+    rng = np.random.default_rng(7)
+    G, P = 3, 512
+    inline = _engines(G, delta_enabled=False)
+    co = _engines(G)
+    coal = MegabatchCoalescer(
+        window_s=5.0, max_batch=8, lock_waves=1, pipeline=False
+    )
+    applied = _counter("klba_delta_epochs_total", outcome="applied")
+    delta_bytes = _counter("klba_h2d_bytes_total", path="delta")
+    try:
+        arrs = [
+            rng.integers(10**6, 10**8, P).astype(np.int64)
+            for _ in range(G)
+        ]
+        for g in range(G):
+            np.testing.assert_array_equal(
+                inline[g].rebalance(arrs[g]), co[g].rebalance(arrs[g])
+            )
+        a_before, b_before = applied.value, delta_bytes.value
+        for wave in range(4):
+            arrs = [_drift(rng, a, int(rng.integers(2, 12))) for a in arrs]
+            want = [inline[g].rebalance(arrs[g]) for g in range(G)]
+            got = _submit_all(co, arrs, coal)
+            for g in range(G):
+                np.testing.assert_array_equal(
+                    want[g], got[g], err_msg=f"wave {wave} row {g}"
+                )
+        # Wave 1 re-stacks (dense); waves 2-4 are locked delta waves.
+        assert applied.value >= a_before + 2 * G
+        assert delta_bytes.value > b_before
+
+        # Churn: stream 2 resets (leaves the roster) — the next wave
+        # re-stacks dense for the survivors, then re-locks and deltas.
+        inline[2].reset()
+        co[2].reset()
+        for wave in range(3):
+            arrs = [_drift(rng, a, 5) for a in arrs]
+            want = [inline[g].rebalance(arrs[g]) for g in range(G)]
+            got = _submit_all(co, arrs, coal)
+            for g in range(G):
+                np.testing.assert_array_equal(want[g], got[g])
+    finally:
+        coal.close()
+
+
+def test_megabatch_mixed_wave_stays_dense_and_exact():
+    """A locked wave where ONE row's churn exceeds its delta
+    eligibility stages dense for everyone — still bit-exact."""
+    rng = np.random.default_rng(8)
+    G, P = 2, 512
+    inline = _engines(G, delta_enabled=False)
+    co = _engines(G)
+    coal = MegabatchCoalescer(
+        window_s=5.0, max_batch=8, lock_waves=1, pipeline=False
+    )
+    try:
+        arrs = [
+            rng.integers(10**6, 10**8, P).astype(np.int64)
+            for _ in range(G)
+        ]
+        for g in range(G):
+            inline[g].rebalance(arrs[g])
+            co[g].rebalance(arrs[g])
+        for wave in range(3):
+            # Row 0 sparse, row 1 near-total churn (dense plan).
+            arrs[0] = _drift(rng, arrs[0], 4)
+            arrs[1] = _drift(rng, arrs[1], P - 10)
+            want = [inline[g].rebalance(arrs[g]) for g in range(G)]
+            got = _submit_all(co, arrs, coal)
+            for g in range(G):
+                np.testing.assert_array_equal(want[g], got[g])
+    finally:
+        coal.close()
+
+
+# -- wire protocol -------------------------------------------------------
+
+
+@pytest.fixture()
+def service():
+    with AssignorService(port=0, solve_timeout_s=60.0) as svc:
+        yield svc
+
+
+def _rows(lags):
+    return [[int(p), int(v)] for p, v in enumerate(lags)]
+
+
+def test_wire_delta_applies_and_matches_dense_twin(service):
+    """A lag_delta epoch must produce exactly the assignment the
+    equivalent dense request produces, bump lag_epoch, and count an
+    applied/clean outcome."""
+    lags = (np.arange(96) + 1) * 1000
+    with AssignorServiceClient(*service.address) as c:
+        r1 = c.stream_assign("d", "t0", _rows(lags), ["A", "B"])
+        assert r1["stream"]["lag_epoch"] == 1
+        assert r1["stream"]["resync"] is False
+        # Heat member A's partitions so the epoch actually refines.
+        hot = {p for _t, p in r1["assignments"]["A"]}
+        dense = [
+            [p, int(v) * (3 if p in hot else 1)]
+            for p, v in enumerate(lags)
+        ]
+        delta = {
+            "indices": [p for p, v in dense if p in hot],
+            "values": [int(v) for p, v in dense if p in hot],
+            "base_epoch": 1,
+        }
+        r2 = c.stream_assign("d", "t0", None, ["A", "B"], lag_delta=delta)
+        assert r2["stream"]["lag_epoch"] == 2
+        assert r2["stream"]["resync"] is False
+        assert r2["stream"]["refined"]
+        # Dense twin stream sees the identical two lag vectors.
+        c.stream_assign("d-twin", "t0", _rows(lags), ["A", "B"])
+        rt = c.stream_assign("d-twin", "t0", dense, ["A", "B"])
+        assert r2["assignments"] == rt["assignments"]
+        assert_valid_assignment(r2["assignments"], 96)
+
+
+def test_wire_delta_stale_and_gapped_base_force_resync(service):
+    """THE base-epoch guard pin: stale (already consumed), duplicate,
+    and gapped base_epoch values must each answer resync=true, serve
+    the previous assignment unchanged, NOT advance lag_epoch, and
+    count a resync outcome."""
+    lags = (np.arange(64) + 1) * 500
+    resync_c = _counter("klba_delta_epochs_total", outcome="resync")
+    with AssignorServiceClient(*service.address) as c:
+        c.stream_assign("g", "t0", _rows(lags), ["A", "B"])
+        r2 = c.stream_assign("g", "t0", _rows(lags * 2), ["A", "B"])
+        assert r2["stream"]["lag_epoch"] == 2
+        before = resync_c.value
+        for bad_base in (0, 1, 5):  # gapped-past, stale, gapped-future
+            r = c.stream_assign(
+                "g", "t0", None, ["A", "B"],
+                lag_delta={"indices": [3], "values": [1],
+                           "base_epoch": bad_base},
+            )
+            assert r["stream"]["resync"] is True
+            assert r["stream"]["lag_epoch"] == 2  # NOT advanced
+            assert r["assignments"] == r2["assignments"]
+        assert resync_c.value == before + 3
+        # A correct delta still applies after the resyncs.
+        r3 = c.stream_assign(
+            "g", "t0", None, ["A", "B"],
+            lag_delta={"indices": [3], "values": [10**6],
+                       "base_epoch": 2},
+        )
+        assert r3["stream"]["resync"] is False
+        assert r3["stream"]["lag_epoch"] == 3
+
+
+def test_wire_delta_without_base_errors_loudly(service):
+    """A delta for a stream the server holds no dense base for (new
+    stream, or state dropped by stream_reset) must error asking for a
+    dense re-send — and must not strand an engine-less stream slot."""
+    lags = (np.arange(32) + 1) * 10
+    with AssignorServiceClient(*service.address) as c:
+        with pytest.raises(RuntimeError, match="resync"):
+            c.stream_assign(
+                "nope", "t0", None, ["A", "B"],
+                lag_delta={"indices": [0], "values": [1],
+                           "base_epoch": 0},
+            )
+        # The husk was cleaned up: a dense request starts fresh.
+        r = c.stream_assign("nope", "t0", _rows(lags), ["A", "B"])
+        assert r["stream"]["cold_start"]
+        # Reset drops the base: the next delta must error again.
+        c.stream_reset("nope")
+        with pytest.raises(RuntimeError, match="resync"):
+            c.stream_assign(
+                "nope", "t0", None, ["A", "B"],
+                lag_delta={"indices": [0], "values": [1],
+                           "base_epoch": 1},
+            )
+
+
+def test_wire_delta_unknown_pid_forces_resync(service):
+    lags = (np.arange(32) + 1) * 10
+    with AssignorServiceClient(*service.address) as c:
+        r1 = c.stream_assign("p", "t0", _rows(lags), ["A", "B"])
+        r = c.stream_assign(
+            "p", "t0", None, ["A", "B"],
+            lag_delta={"indices": [999], "values": [5], "base_epoch": 1},
+        )
+        assert r["stream"]["resync"] is True
+        assert r["assignments"] == r1["assignments"]
+
+
+def test_wire_delta_validation_rejects_malformed(service):
+    lags = (np.arange(16) + 1) * 10
+    with AssignorServiceClient(*service.address) as c:
+        c.stream_assign("v", "t0", _rows(lags), ["A", "B"])
+        cases = [
+            {"indices": [1], "values": [1, 2], "base_epoch": 1},
+            {"indices": [1, 1], "values": [1, 2], "base_epoch": 1},
+            {"indices": [1], "values": [-5], "base_epoch": 1},
+            {"indices": [1], "values": [1], "base_epoch": -1},
+            {"indices": [1], "values": [1], "base_epoch": True},
+            {"indices": "nope", "values": [1], "base_epoch": 1},
+            [],
+        ]
+        for bad in cases:
+            with pytest.raises(RuntimeError):
+                c.stream_assign(
+                    "v", "t0", None, ["A", "B"], lag_delta=bad
+                )
+        # Both lags and lag_delta at once is a client bug.
+        with pytest.raises(RuntimeError, match="mutually exclusive"):
+            c.stream_assign(
+                "v", "t0", _rows(lags), ["A", "B"],
+                lag_delta={"indices": [], "values": [], "base_epoch": 1},
+            )
+        # The stream survived all of it.
+        r = c.stream_assign("v", "t0", _rows(lags), ["A", "B"])
+        assert not r["stream"]["cold_start"]
+
+
+# -- LagDeltaTracker -----------------------------------------------------
+
+
+def test_tracker_dense_then_delta_then_resync_roundtrip(service):
+    """End-to-end: the tracker sends dense first, deltas once
+    confirmed, and recovers through a server-side state loss (reset)
+    via the resync answer — bit-identical to a dense twin stream
+    throughout."""
+    rng = np.random.default_rng(11)
+    P = 64
+    lags = rng.integers(10**4, 10**6, P).astype(np.int64)
+    tracker = LagDeltaTracker()
+    with AssignorServiceClient(*service.address) as c:
+        for step in range(8):
+            lags = _drift(rng, lags, 3)
+            params = tracker.params_for(_rows(lags))
+            if step == 0:
+                assert "lags" in params
+            elif step == 4:
+                # Server lost the stream: the NEXT delta must resync.
+                # (The twin resets too — a cold re-solve can
+                # legitimately differ from a warm epoch, and the twin
+                # exists to pin lag-vector equivalence, not
+                # cold-vs-warm equivalence.)
+                c.stream_reset("trk")
+                c.stream_reset("trk-twin")
+            try:
+                r = c.stream_assign(
+                    "trk", "t0", params.get("lags"), ["A", "B"],
+                    lag_delta=params.get("lag_delta"),
+                )
+            except RuntimeError:
+                # The server lost the whole stream (reset): the delta
+                # errors asking for dense — the tracker's failure path.
+                tracker.note_failure()
+                r = None
+            else:
+                tracker.note_result(r)
+            if r is None or r["stream"]["resync"]:
+                # Tracker reverts to dense on the next epoch.
+                params = tracker.params_for(_rows(lags))
+                assert "lags" in params
+                r = c.stream_assign(
+                    "trk", "t0", params["lags"], ["A", "B"]
+                )
+                tracker.note_result(r)
+            twin = c.stream_assign("trk-twin", "t0", _rows(lags),
+                                   ["A", "B"])
+            assert r["assignments"] == twin["assignments"], step
+            if step in (1, 2, 3):
+                assert "lag_delta" in tracker.params_for(_rows(lags))
+
+
+def test_tracker_pid_set_change_and_fraction_cap():
+    t = LagDeltaTracker(max_fraction=0.25)
+    rows = [[p, p * 10] for p in range(16)]
+    assert "lags" in t.params_for(rows)
+    t.note_result({"stream": {"lag_epoch": 1, "resync": False}})
+    # Sparse change -> delta with the confirmed base epoch.
+    rows2 = [[p, p * 10 + (5 if p == 3 else 0)] for p in range(16)]
+    d = t.params_for(rows2)["lag_delta"]
+    assert d == {"indices": [3], "values": [35], "base_epoch": 1}
+    t.note_result({"stream": {"lag_epoch": 2, "resync": False}})
+    # Over the fraction cap -> dense.
+    rows3 = [[p, p * 10 + 7] for p in range(16)]
+    assert "lags" in t.params_for(rows3)
+    t.note_result({"stream": {"lag_epoch": 3, "resync": False}})
+    # Changed pid set -> dense.
+    rows4 = [[p + 1, p] for p in range(16)]
+    assert "lags" in t.params_for(rows4)
+    # A failed request drops the base -> dense.
+    t.note_failure()
+    assert "lags" in t.params_for(rows4)
+
+
+def test_tracker_validation():
+    with pytest.raises(ValueError):
+        LagDeltaTracker(max_fraction=0.0)
+    t = LagDeltaTracker()
+    # A resync answer (or one with no lag_epoch) drops the base.
+    t.params_for([[0, 1]])
+    t.note_result({"stream": {"lag_epoch": 1, "resync": True}})
+    assert "lags" in t.params_for([[0, 1]])
+
+
+# -- config knobs --------------------------------------------------------
+
+
+def test_delta_config_knobs_parse():
+    from kafka_lag_based_assignor_tpu.utils.config import parse_config
+
+    cfg = parse_config({"group.id": "g"})
+    assert cfg.delta_enabled is True
+    assert cfg.delta_max_fraction == 0.125
+    assert cfg.delta_buckets == 6
+    cfg = parse_config({
+        "group.id": "g",
+        "tpu.assignor.delta.enabled": "false",
+        "tpu.assignor.delta.max.fraction": "0.05",
+        "tpu.assignor.delta.buckets": "4",
+    })
+    assert cfg.delta_enabled is False
+    assert cfg.delta_max_fraction == 0.05
+    assert cfg.delta_buckets == 4
+    for bad in (
+        {"tpu.assignor.delta.max.fraction": 0},
+        {"tpu.assignor.delta.max.fraction": 1.5},
+        {"tpu.assignor.delta.max.fraction": "nope"},
+        {"tpu.assignor.delta.buckets": -1},
+        {"tpu.assignor.delta.buckets": 17},
+    ):
+        with pytest.raises(ValueError):
+            parse_config({"group.id": "g", **bad})
+
+
+def test_service_from_config_wires_delta_knobs():
+    """from_config must thread the delta knobs into every engine the
+    service builds AND into the coalescer's stacked-K (0 = disabled)."""
+    with AssignorService.from_config({
+        "group.id": "g",
+        "tpu.assignor.delta.max.fraction": "0.25",
+        "tpu.assignor.delta.buckets": "3",
+    }) as svc:
+        assert svc._delta_opts == {
+            "delta_enabled": True,
+            "delta_max_fraction": 0.25,
+            "delta_buckets": 3,
+        }
+        assert svc._coalescer.delta_k == DELTA_MIN_K << 2  # ladder top
+    with AssignorService.from_config(
+        {"group.id": "g", "tpu.assignor.delta.enabled": "false"}
+    ) as svc:
+        assert svc._delta_opts["delta_enabled"] is False
+        assert svc._coalescer.delta_k == 0
+        lags = [[p, p * 10] for p in range(32)]
+        with AssignorServiceClient(*svc.address) as c:
+            c.stream_assign("cfg", "t0", lags, ["A", "B"])
+        assert svc._streams["cfg"].engine.delta_enabled is False
+
+
+def test_wire_delta_after_restart_serves_resync_not_error(tmp_path):
+    """The lifecycle snapshot deliberately excludes lag vectors, so a
+    restarted sidecar has no delta base — but it DOES hold the
+    recovered choice and pid set, so a delta-mode client's first
+    post-restart epoch must be answered as a graceful ``resync: true``
+    serving the recovered previous assignment (neutral stats), not an
+    error storm."""
+    path = str(tmp_path / "snap.json")
+    lags = [[p, (p + 1) * 1000] for p in range(48)]
+    with AssignorService(
+        port=0, snapshot_path=path, snapshot_interval_s=3600.0,
+        recovery_warmup=False,
+    ) as svc:
+        with AssignorServiceClient(*svc.address) as c:
+            r1 = c.stream_assign("rs", "t0", lags, ["A", "B"])
+            assert r1["stream"]["lag_epoch"] == 1
+        assert svc.snapshot_now()["ok"]
+    with AssignorService(
+        port=0, snapshot_path=path, snapshot_interval_s=3600.0,
+        recovery_warmup=False,
+    ) as svc2:
+        with AssignorServiceClient(*svc2.address) as c:
+            r = c.stream_assign(
+                "rs", "t0", None, ["A", "B"],
+                lag_delta={"indices": [3], "values": [5],
+                           "base_epoch": 1},
+            )
+            assert r["stream"]["resync"] is True
+            assert r["assignments"] == r1["assignments"]
+            assert r["stream"]["lag_epoch"] == 0  # base starts over
+            assert_valid_assignment(r["assignments"], 48)
+            # The dense re-seed restores delta mode end to end.
+            r2 = c.stream_assign("rs", "t0", lags, ["A", "B"])
+            assert r2["stream"]["lag_epoch"] == 1
+            r3 = c.stream_assign(
+                "rs", "t0", None, ["A", "B"],
+                lag_delta={"indices": [0], "values": [7],
+                           "base_epoch": 1},
+            )
+            assert r3["stream"]["resync"] is False
+
+
+def test_wire_delta_resync_with_changed_members_errors(service):
+    """A resync-triggering delta arriving WITH a changed member set
+    must error (resend dense) rather than serve the previous choice
+    mapped onto the new member list — that early return runs before
+    the membership remap, so serving would misattribute partitions."""
+    lags = [[p, (p + 1) * 100] for p in range(32)]
+    with AssignorServiceClient(*service.address) as c:
+        c.stream_assign("mm", "t0", lags, ["A", "B"])
+        # Same C, different names + stale base: never servable.
+        with pytest.raises(RuntimeError, match="resync"):
+            c.stream_assign(
+                "mm", "t0", None, ["A", "C"],
+                lag_delta={"indices": [1], "values": [5],
+                           "base_epoch": 0},
+            )
+        # Unchanged roster + stale base: still the graceful path.
+        r = c.stream_assign(
+            "mm", "t0", None, ["A", "B"],
+            lag_delta={"indices": [1], "values": [5], "base_epoch": 0},
+        )
+        assert r["stream"]["resync"] is True
+
+
+def test_service_ctor_validates_delta_knobs():
+    """Bad delta knobs must fail the boot loudly (before the socket
+    binds), not error every stream_assign once an engine is built."""
+    for kw in (
+        {"delta_max_fraction": 0.0},
+        {"delta_max_fraction": 1.5},
+        {"delta_buckets": -1},
+    ):
+        with pytest.raises(ValueError):
+            AssignorService(port=0, **kw)

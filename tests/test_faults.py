@@ -378,6 +378,54 @@ class TestStreamLadder:
             r = c.stream_assign("co-a", "t0", rows, ["A", "B"])
             assert not r["stream"]["cold_start"]
 
+    @pytest.mark.parametrize("point", ["delta.apply", "delta.diff"])
+    def test_delta_fault_falls_back_dense_in_request(self, service, point):
+        """An injected delta failure (the differ or the fused apply)
+        must fall back to the DENSE upload inside the same request:
+        the epoch is served warm (no ladder descent, no fallback
+        incident), the warm state stays intact, no breaker is charged,
+        and the very next sparse epoch re-enters delta mode."""
+        from kafka_lag_based_assignor_tpu.utils import metrics
+
+        applied = metrics.REGISTRY.counter(
+            "klba_delta_epochs_total", {"outcome": "applied"}
+        )
+        fell = metrics.REGISTRY.counter(
+            "klba_delta_epochs_total", {"outcome": "fallback"}
+        )
+        # Flat-ish lags: sparse spikes must exercise the delta path
+        # without tripping the service guardrail on data alone.
+        lags = (10**6 + (np.arange(64) + 1) * 100).astype(np.int64)
+        opts = {"refine_threshold": None}  # every sparse epoch dispatches
+        with client_for(service) as c:
+            self._epoch(c, lags, options=opts)
+            lags[3] += 50000
+            a0 = applied.value
+            self._epoch(c, lags, options=opts)  # clean delta epoch
+            assert applied.value == a0 + 1
+            f0, a1 = fell.value, applied.value
+            lags[7] += 50000
+            with faults.injected(
+                faults.FaultInjector().plan(point, times=1)
+            ) as inj:
+                r = self._epoch(c, lags, options=opts)
+                assert inj.fired(point) == 1
+            # Served warm and dense — a routine epoch, not an incident.
+            assert r["stream"]["degraded_rung"] == "none"
+            assert r["stream"]["fallback_used"] is False
+            assert r["stream"]["shed"] is None
+            assert not r["stream"]["cold_start"]
+            assert_valid_assignment(r["assignments"], 64)
+            assert fell.value == f0 + 1
+            assert applied.value == a1  # the faulted epoch did NOT apply
+            # No breaker charge: the stream circuit never opened.
+            assert service._watchdog.state("stream") != "open"
+            # Warm state intact: the next sparse epoch deltas again.
+            lags[9] += 50000
+            r4 = self._epoch(c, lags, options=opts)
+            assert applied.value == a1 + 1
+            assert not r4["stream"]["cold_start"]
+
     def test_snapshot_discarded_on_membership_change(self, service):
         lags = (np.arange(32) + 1) * 10
         with client_for(service) as c:
@@ -633,7 +681,8 @@ def test_chaos_soak_random_schedule_bounded_p99():
 
     rng = random.Random(0xC4A05)
     points = ["device.solve", "device.compile", "stream.refine",
-              "coalesce.flush", "wire.read"]
+              "coalesce.flush", "wire.read", "delta.diff",
+              "delta.apply"]
     lags0 = (np.arange(128) + 1) * 50
     topics = {"t0": [[p, int(v)] for p, v in enumerate(lags0)]}
     subs = {"A": ["t0"], "B": ["t0"], "C": ["t0"]}

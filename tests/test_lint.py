@@ -284,3 +284,56 @@ def test_lint_bare_write_open_in_package():
         f.code == "L015"
         for f in lint.lint_source(Path("tools/x.py"), bad)
     )
+
+
+def test_lint_raw_uploads_in_warm_path_modules():
+    """L016: explicit host->device uploads (jax.device_put /
+    jnp.asarray) in ops/streaming.py and ops/coalesce.py must live
+    inside the designated dense-upload helpers so the
+    klba_h2d_bytes_total accounting stays honest."""
+    streaming = Path("kafka_lag_based_assignor_tpu/ops/streaming.py")
+    coalesce = Path("kafka_lag_based_assignor_tpu/ops/coalesce.py")
+    bad = (
+        "import jax\n"
+        "import jax.numpy as jnp\n\n"
+        "def _dispatch(lags):\n"
+        "    dev = jax.device_put(lags)\n"
+        "    return jnp.asarray(lags)\n"
+    )
+    for mod in (streaming, coalesce):
+        codes = [f.code for f in lint.lint_source(mod, bad)]
+        assert codes.count("L016") == 2, mod
+    # The designated upload sites are the sanctioned homes (top-level
+    # or nested), in both modules.
+    for site in ("_stage_upload", "_stage_delta_upload",
+                 "_cold_solve_inner"):
+        ok = bad.replace("def _dispatch", f"def {site}")
+        assert not any(
+            f.code == "L016" for f in lint.lint_source(streaming, ok)
+        ), site
+    nested = (
+        "import jax\n\n"
+        "def _flush(rows):\n"
+        "    def _stage_upload():\n"
+        "        return jax.device_put(rows)\n"
+        "    return _stage_upload\n"
+    )
+    assert not any(
+        f.code == "L016" for f in lint.lint_source(coalesce, nested)
+    )
+    # np.asarray (a D2H materialization here) is not an upload; other
+    # modules are out of scope; the waiver works.
+    d2h = "import numpy as np\n\ndef _f(x):\n    return np.asarray(x)\n"
+    assert not any(
+        f.code == "L016" for f in lint.lint_source(streaming, d2h)
+    )
+    other = Path("kafka_lag_based_assignor_tpu/ops/refine.py")
+    assert not any(
+        f.code == "L016" for f in lint.lint_source(other, bad)
+    )
+    waived = bad.replace(
+        "    dev = jax.device_put(lags)\n",
+        "    dev = jax.device_put(lags)  # noqa: L016\n",
+    )
+    waived_codes = [f.code for f in lint.lint_source(streaming, waived)]
+    assert waived_codes.count("L016") == 1

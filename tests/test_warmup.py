@@ -239,3 +239,104 @@ def test_warmup_covers_oneshot_refined_variant():
         totals_rank_bits=rb, refine_iters=16,
     )
     assert assign_batched_rounds._cache_size() == before
+
+
+def test_delta_ladder_warmup_covers_serving_path():
+    """The delta-epoch warm-up (one synthetic delta dispatch per pow2 K
+    rung, plus one stacked delta wave per batch bucket) must leave the
+    serving path compile-free: a fresh engine (and a fresh coalesced
+    pair) driving delta epochs at the warmed shape compiles NOTHING —
+    asserted via the existing compile counter."""
+    import threading
+
+    import numpy as np
+
+    from kafka_lag_based_assignor_tpu.ops.coalesce import (
+        MegabatchCoalescer,
+    )
+    from kafka_lag_based_assignor_tpu.ops.streaming import (
+        StreamingAssignor,
+    )
+    from kafka_lag_based_assignor_tpu.utils.observability import (
+        compile_count,
+        install_compile_counter,
+    )
+    from kafka_lag_based_assignor_tpu.warmup import warmup
+
+    from kafka_lag_based_assignor_tpu.utils import metrics
+
+    install_compile_counter()
+    warmup(
+        max_partitions=64, consumers=[4], solvers=("stream",),
+        coalesce_max_batch=2, delta_buckets=2,
+    )
+    applied = metrics.REGISTRY.counter(
+        "klba_delta_epochs_total", {"outcome": "applied"}
+    )
+    before = compile_count()
+    applied_before = applied.value
+
+    # Inline: a fresh production-like engine driving sparse epochs at
+    # the warmed shape (its eligible K rungs were warmed; ineligible
+    # ones fall to the — also warmed — dense executable).
+    eng = StreamingAssignor(
+        num_consumers=4, refine_iters=128, refine_threshold=None,
+        delta_max_fraction=1.0, delta_buckets=2,
+    )
+    rng = np.random.default_rng(0)
+    lags = rng.integers(0, 1000, 64).astype(np.int64)
+    eng.rebalance(lags)
+    eng.rebalance(lags)  # 0 changed -> K=16 delta
+    nxt = lags.copy()
+    nxt[:16] += 1
+    eng.rebalance(nxt)   # 16 changed -> K=16 delta
+
+    # Megabatch: a locked pair whose second wave drifts sparsely (all
+    # rows carry plans -> the stacked delta executable).
+    pair = [
+        StreamingAssignor(
+            num_consumers=4, refine_iters=128, refine_threshold=None,
+            delta_max_fraction=1.0, delta_buckets=2,
+        )
+        for _ in range(2)
+    ]
+    arrs = [rng.integers(0, 1000, 64).astype(np.int64) for _ in range(2)]
+    for e, a in zip(pair, arrs):
+        e.rebalance(a)
+    coal = MegabatchCoalescer(
+        window_s=2.0, max_batch=2, lock_waves=1, pipeline=False,
+        delta_k=32,
+    )
+    try:
+        for wave in range(3):
+            if wave < 2:
+                arrs = [a + 1 for a in arrs]  # dense (all changed)
+            else:
+                arrs = [a.copy() for a in arrs]
+                for a in arrs:
+                    a[:8] += 1  # sparse -> stacked delta wave
+            errs = []
+
+            def run(e, a):
+                try:
+                    e.submit_epoch(a, coal)
+                except Exception as exc:  # noqa: BLE001 — re-raised
+                    errs.append(exc)
+
+            ts = [
+                threading.Thread(target=run, args=(e, a))
+                for e, a in zip(pair, arrs)
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(120.0)
+            assert not errs, errs
+    finally:
+        coal.close()
+    assert compile_count() == before, (
+        "delta serving path compiled a fresh executable after warm-up"
+    )
+    # And the delta paths actually engaged: 2 inline epochs + the
+    # 2-row stacked wave.
+    assert applied.value >= applied_before + 4

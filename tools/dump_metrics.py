@@ -127,6 +127,32 @@ def main() -> int:
                 f"coalesce roster hit-rate {rate:.3f} "
                 f"({int(hits)} locked / {int(restacks)} re-stack)"
             )
+
+        # Delta-epoch view (DEPLOYMENT.md "Delta epochs"): cumulative
+        # H2D lag-payload bytes by path and the delta hit-rate — the
+        # "is the sparse-upload fast path engaging, and what is it
+        # saving" look, next to the roster line above.
+        def by_label(name: str, label: str):
+            return {
+                s["labels"].get(label, ""): s["value"]
+                for s in js.get(name, {}).get("series", [])
+            }
+
+        h2d = by_label("klba_h2d_bytes_total", "path")
+        if h2d:
+            dense_b = int(h2d.get("dense", 0))
+            delta_b = int(h2d.get("delta", 0))
+            print(f"h2d bytes: dense {dense_b} / delta {delta_b}")
+        outcomes = by_label("klba_delta_epochs_total", "outcome")
+        total = sum(outcomes.values())
+        if total:
+            applied = outcomes.get("applied", 0)
+            print(
+                f"delta epoch hit-rate {applied / total:.3f} "
+                f"({int(applied)} applied / "
+                f"{int(outcomes.get('fallback', 0))} fallback / "
+                f"{int(outcomes.get('resync', 0))} resync)"
+            )
         for s in js.get("klba_span_duration_ms", {}).get("series", []):
             span = s["labels"].get("span", "")
             if span.startswith("coalesce.") and span != "coalesce.window":

@@ -54,6 +54,17 @@ Rules:
         contains ``atomic_write`` (the helper's own implementation);
         anything else needs a ``# noqa: L015`` waiver stating why the
         write is not durable state.  Read-mode opens are untouched.
+  L016  raw host->device upload (``jax.device_put(...)`` /
+        ``jnp.asarray(...)``) in the WARM-path modules
+        (ops/streaming.py, ops/coalesce.py) outside the designated
+        dense-upload helpers (functions named ``_stage_upload`` /
+        ``_stage_delta_upload`` / ``_cold_solve_inner``): per-wave H2D
+        bytes are the binding cost the delta-epoch machinery exists to
+        cut, and ``klba_h2d_bytes_total{path=...}`` is only honest if
+        every full-vector upload flows through the counted sites.  New
+        upload code must route through (or become) a designated
+        helper, or carry a ``# noqa: L016`` waiver stating why its
+        bytes need no accounting.
 """
 
 from __future__ import annotations
@@ -187,6 +198,74 @@ def _l013_findings(rel: str, tree: ast.AST, lines: List[str]) -> List[Finding]:
                         "blocking device sync on the coalescer's "
                         "admission/dispatch path: move it to the "
                         "readback stage (or waive with `# noqa: L013`)",
+                    )
+                )
+            visit(child, child_scope)
+
+    visit(tree, False)
+    return findings
+
+
+#: L016: the counted upload sites — the only functions in the warm-path
+#: modules allowed to start a host->device transfer explicitly.
+_L016_UPLOAD_SITES = (
+    "_stage_upload", "_stage_delta_upload", "_cold_solve_inner",
+)
+
+
+def _is_upload_call(node: ast.Call) -> bool:
+    """True for ``jax.device_put(...)`` (any base) and
+    ``jnp.asarray(...)`` / ``jax.numpy.asarray(...)`` — the explicit
+    H2D entry points.  ``np.asarray`` (a D2H materialization in this
+    codebase) is deliberately not matched."""
+    func = node.func
+    if not isinstance(func, ast.Attribute):
+        return False
+    if func.attr == "device_put":
+        return True
+    if func.attr != "asarray":
+        return False
+    base = func.value
+    if isinstance(base, ast.Name):
+        return base.id == "jnp"
+    return (
+        isinstance(base, ast.Attribute)
+        and base.attr == "numpy"
+        and isinstance(base.value, ast.Name)
+        and base.value.id == "jax"
+    )
+
+
+def _l016_findings(rel: str, tree: ast.AST, lines: List[str]) -> List[Finding]:
+    """Walk with enclosing-function context (the L013 pattern): explicit
+    uploads are allowed only inside the designated dense-upload
+    helpers."""
+    findings: List[Finding] = []
+
+    def visit(node: ast.AST, in_upload_site: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_scope = in_upload_site
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child_scope = in_upload_site or any(
+                    site in child.name for site in _L016_UPLOAD_SITES
+                )
+            if (
+                isinstance(child, ast.Call)
+                and not in_upload_site
+                and _is_upload_call(child)
+                and "noqa: L016" not in lines[child.lineno - 1]
+            ):
+                findings.append(
+                    Finding(
+                        rel,
+                        child.lineno,
+                        "L016",
+                        "raw host->device upload outside the counted "
+                        "dense-upload helpers: route it through "
+                        "_stage_upload/_stage_delta_upload/"
+                        "_cold_solve_inner so "
+                        "klba_h2d_bytes_total stays honest (or waive "
+                        "with `# noqa: L016`)",
                     )
                 )
             visit(child, child_scope)
@@ -405,6 +484,11 @@ def lint_source(path: Path, source: str) -> List[Finding]:
     # the one place the async-dispatch discipline is load-bearing.
     if is_package and path.name == "coalesce.py":
         findings.extend(_l013_findings(rel, tree, lines))
+    # L016 applies to the warm-path modules: the H2D byte accounting
+    # (delta epochs) is only honest if every explicit upload routes
+    # through the designated counted helpers.
+    if is_package and path.name in ("coalesce.py", "streaming.py"):
+        findings.extend(_l016_findings(rel, tree, lines))
     if is_package:
         findings.extend(_l014_list_buffer_findings(rel, tree, lines))
         findings.extend(_l015_findings(rel, tree, lines))
