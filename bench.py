@@ -1387,6 +1387,60 @@ def config8_restart():
         svc2.stop()
 
     lat = sorted(storm_ms.values())
+
+    # Phase C (ROADMAP lifecycle (b), bench-measured): the SAME
+    # snapshot, rebooted with recovery_prestack=True — the boot
+    # rebuilds each recovered engine's device-resident state (zero-lag
+    # table build, off the serving path) so the storm's first epochs
+    # skip the inline dense rebuild and coalesce.  Measured against
+    # phase B's lazy-rebuild numbers; the verdict lands in BASELINE.md.
+    svc3 = AssignorService(
+        port=0, snapshot_path=snap_path, snapshot_interval_s=3600.0,
+        coalesce_max_batch=N, recovery_prestack=True,
+    ).start()
+    recovery3 = dict(svc3._last_recovery or {})
+    pre_choices = {
+        sid: svc3._streams[sid].engine.export_state()
+        for sid in streams if sid in svc3._streams
+    }
+    lags3 = {sid: fresh(sid) for sid in streams}
+    expected3 = {}
+    for sid, choice in pre_choices.items():
+        base = StreamingAssignor(
+            num_consumers=C, imbalance_guardrail=1.25
+        )
+        base.seed_choice(choice)
+        expected3[sid] = np.asarray(base.rebalance(lags3[sid]))
+    clients3 = {
+        sid: AssignorServiceClient(*svc3.address, timeout_s=300.0)
+        for sid in streams
+    }
+    pool3 = cf.ThreadPoolExecutor(max_workers=N)
+    storm3_ms = {}
+    mismatched3 = [0]
+
+    def storm3(sid):
+        t0 = time.perf_counter()
+        r = clients3[sid].stream_assign(
+            sid, "t0", rows(lags3[sid]), members
+        )
+        storm3_ms[sid] = (time.perf_counter() - t0) * 1000.0
+        if not np.array_equal(
+            decode(r["assignments"]), expected3[sid]
+        ):
+            mismatched3[0] += 1
+
+    try:
+        t0 = time.perf_counter()
+        list(pool3.map(storm3, streams))
+        prestack_wall_s = time.perf_counter() - t0
+    finally:
+        for cl in clients3.values():
+            cl.close()
+        pool3.shutdown(wait=True)
+        svc3.stop()
+    lat3 = sorted(storm3_ms.values())
+
     return {
         "config": "restart_storm",
         "streams": N,
@@ -1404,6 +1458,265 @@ def config8_restart():
         "mismatched_assignments": mismatched[0],
         "invalid_assignments": invalid[0],
         "post_recovery_compile_count": post_compiles,
+        # Pre-stacked reboot (phase C) vs the lazy rebuild above —
+        # the lifecycle (b) measurement.
+        "prestack_streams": recovery3.get("streams_prestacked", 0),
+        "prestack_first_epoch_p50_ms": float(
+            np.percentile(lat3, 50)
+        ) if lat3 else None,
+        "prestack_first_epoch_max_ms": (
+            float(lat3[-1]) if lat3 else None
+        ),
+        "prestack_storm_wall_s": prestack_wall_s,
+        "prestack_mismatched_assignments": mismatched3[0],
+    }
+
+
+def config10_handoff():
+    """Cross-host hand-off storm (ISSUE 9): TWO real service instances
+    sharing one object-store-shaped snapshot backend (versioned CAS +
+    epoch-fenced writer leases), driven through BOTH hand-off modes.
+    Crash: instance A dies without a drain; replacement B waits out
+    A's lease TTL, takes over with a bumped fencing token, rehydrates
+    every tenant, and answers first warm epochs bit-identical to the
+    uninterrupted baseline with zero compiles — while A's stale
+    snapshot write is REJECTED by fencing (counted; the adopted state
+    is never overwritten).  Drain: B releases the lease after its
+    final snapshot and replacement C adopts without a TTL wait.
+    Gated in main on all of the above."""
+    import concurrent.futures as cf
+    import tempfile
+
+    from kafka_lag_based_assignor_tpu.ops.streaming import (
+        StreamingAssignor,
+    )
+    from kafka_lag_based_assignor_tpu.service import (
+        AssignorService,
+        AssignorServiceClient,
+    )
+    from kafka_lag_based_assignor_tpu.testing import (
+        assert_valid_assignment,
+    )
+    from kafka_lag_based_assignor_tpu.utils import (
+        metrics as klba_metrics,
+    )
+    from kafka_lag_based_assignor_tpu.utils.observability import (
+        compile_count,
+        install_compile_counter,
+    )
+
+    install_compile_counter()
+    P, C, N = 2048, 8, 8
+    LEASE_TTL_S = 2.0
+    streams = [f"h{i}" for i in range(N)]
+    members = [f"m{j}" for j in range(C)]
+    rngs = {sid: np.random.default_rng(9000 + i)
+            for i, sid in enumerate(streams)}
+
+    def fresh(sid):
+        return rngs[sid].integers(0, 10**6, P).astype(np.int64)
+
+    def rows(arr):
+        return [[i, int(v)] for i, v in enumerate(arr)]
+
+    def decode(assignments):
+        midx = {m: j for j, m in enumerate(members)}
+        got = np.full(P, -1, np.int32)
+        for m, tps in assignments.items():
+            for _t, p in tps:
+                got[p] = midx[m]
+        return got
+
+    def fenced_count():
+        return klba_metrics.REGISTRY.counter(
+            "klba_snapshot_writes_total", {"outcome": "fenced"}
+        ).value
+
+    backend_dir = tempfile.mkdtemp(prefix="klba-handoff-")
+    svc_kw = dict(
+        snapshot_path=backend_dir, snapshot_backend="object",
+        snapshot_lease_ttl_s=LEASE_TTL_S, snapshot_lease_wait_s=30.0,
+        snapshot_interval_s=3600.0, coalesce_max_batch=N,
+    )
+
+    def oracle(choices, lag_map):
+        out = {}
+        for sid, choice in choices.items():
+            base = StreamingAssignor(
+                num_consumers=C, imbalance_guardrail=1.25
+            )
+            base.seed_choice(choice)
+            out[sid] = np.asarray(base.rebalance(lag_map[sid]))
+        return out
+
+    def storm(svc, lag_map, expected, counters):
+        """One concurrent first-epoch wave; fills counters dict."""
+        clients = {
+            sid: AssignorServiceClient(*svc.address, timeout_s=300.0)
+            for sid in streams
+        }
+        lat_ms = {}
+
+        def one(sid):
+            t0 = time.perf_counter()
+            r = clients[sid].stream_assign(
+                sid, "t0", rows(lag_map[sid]), members
+            )
+            lat_ms[sid] = (time.perf_counter() - t0) * 1000.0
+            try:
+                assert_valid_assignment(r["assignments"], P)
+            except AssertionError:
+                counters["invalid"] += 1
+            if not np.array_equal(
+                decode(r["assignments"]), expected[sid]
+            ):
+                counters["mismatched"] += 1
+            if r["stream"]["warm_restart"]:
+                counters["warm_restarts"] += 1
+
+        pool = cf.ThreadPoolExecutor(max_workers=N)
+        compiles0 = compile_count()
+        t0 = time.perf_counter()
+        try:
+            list(pool.map(one, streams))
+        finally:
+            counters["wall_s"] = time.perf_counter() - t0
+            counters["compiles"] = compile_count() - compiles0
+            for cl in clients.values():
+                cl.close()
+            pool.shutdown(wait=True)
+        lat = sorted(lat_ms.values())
+        counters["p50_ms"] = float(np.percentile(lat, 50))
+        counters["max_ms"] = float(lat[-1])
+
+    # -- Phase A: instance A serves warm traffic, snapshots, CRASHES.
+    svc_a = AssignorService(port=0, **svc_kw).start()
+    clients = {
+        sid: AssignorServiceClient(*svc_a.address, timeout_s=300.0)
+        for sid in streams
+    }
+    pool = cf.ThreadPoolExecutor(max_workers=N)
+    try:
+        for sid in streams:  # cold chains, serial
+            clients[sid].stream_assign(
+                sid, "t0", rows(fresh(sid)), members
+            )
+        for _ in range(2):  # warm the megabatch path
+            list(pool.map(
+                lambda s: clients[s].stream_assign(
+                    s, "t0", rows(fresh(s)), members
+                ),
+                streams,
+            ))
+        assert svc_a.snapshot_now()["ok"]
+        choices_a = {
+            sid: svc_a._streams[sid].engine.export_state()
+            for sid in streams
+        }
+    finally:
+        for cl in clients.values():
+            cl.close()
+        pool.shutdown(wait=True)
+        svc_a.stop()  # crash: the lease is NOT released
+
+    # -- Phase B: replacement B waits out the TTL, adopts, storms.
+    lags_b = {sid: fresh(sid) for sid in streams}
+    expected_b = oracle(choices_a, lags_b)
+    t_boot = time.perf_counter()
+    svc_b = AssignorService(port=0, **svc_kw).start()
+    boot_b_s = time.perf_counter() - t_boot
+    handoff_b = dict(svc_b._last_handoff or {})
+    recovery_b = dict(svc_b._last_recovery or {})
+    crash = {
+        "mismatched": 0, "invalid": 0, "warm_restarts": 0,
+    }
+    fenced0 = fenced_count()
+    overwrites = 0
+    try:
+        storm(svc_b, lags_b, expected_b, crash)
+        # The fenced-off predecessor tries a stale snapshot write:
+        # rejected + counted, the adopted state version unmoved.
+        version0 = svc_b._snapshot_store.backend.version()
+        stale = svc_a.snapshot_now()
+        if stale.get("ok"):
+            overwrites += 1
+        if svc_b._snapshot_store.backend.version() != version0:
+            overwrites += 1
+        # B then serves a second wave and DRAINS (releases the lease).
+        lags_b2 = {sid: fresh(sid) for sid in streams}
+        cl = {
+            sid: AssignorServiceClient(*svc_b.address, timeout_s=300.0)
+            for sid in streams
+        }
+        try:
+            for sid in streams:
+                cl[sid].stream_assign(
+                    sid, "t0", rows(lags_b2[sid]), members
+                )
+        finally:
+            for c in cl.values():
+                c.close()
+        choices_b = {
+            sid: svc_b._streams[sid].engine.export_state()
+            for sid in streams
+        }
+    finally:
+        if not svc_b.begin_drain():
+            svc_b.stop()
+        svc_b.wait_stopped(60.0)
+    fenced_stale_writes = fenced_count() - fenced0
+
+    # -- Phase C: replacement C adopts INSTANTLY after the drain.
+    lags_c = {sid: fresh(sid) for sid in streams}
+    expected_c = oracle(choices_b, lags_c)
+    t_boot = time.perf_counter()
+    svc_c = AssignorService(port=0, **svc_kw).start()
+    boot_c_s = time.perf_counter() - t_boot
+    handoff_c = dict(svc_c._last_handoff or {})
+    recovery_c = dict(svc_c._last_recovery or {})
+    drain = {
+        "mismatched": 0, "invalid": 0, "warm_restarts": 0,
+    }
+    try:
+        storm(svc_c, lags_c, expected_c, drain)
+    finally:
+        svc_c.stop()
+
+    return {
+        "config": "handoff_storm",
+        "streams": N,
+        "partitions": P,
+        "consumers": C,
+        "backend": "object",
+        "lease_ttl_s": LEASE_TTL_S,
+        "crash_handoff_mode": handoff_b.get("mode"),
+        "crash_lease_waited_ms": handoff_b.get("waited_ms"),
+        "crash_boot_wall_s": boot_b_s,
+        "crash_streams_recovered": recovery_b.get(
+            "streams_recovered", 0
+        ),
+        "crash_warm_restart_epochs": crash["warm_restarts"],
+        "crash_first_epoch_p50_ms": crash.get("p50_ms"),
+        "crash_first_epoch_max_ms": crash.get("max_ms"),
+        "crash_storm_wall_s": crash.get("wall_s"),
+        "crash_mismatched_assignments": crash["mismatched"],
+        "crash_invalid_assignments": crash["invalid"],
+        "crash_post_takeover_compiles": crash.get("compiles", -1),
+        "drain_handoff_mode": handoff_c.get("mode"),
+        "drain_lease_waited_ms": handoff_c.get("waited_ms"),
+        "drain_boot_wall_s": boot_c_s,
+        "drain_streams_recovered": recovery_c.get(
+            "streams_recovered", 0
+        ),
+        "drain_warm_restart_epochs": drain["warm_restarts"],
+        "drain_first_epoch_p50_ms": drain.get("p50_ms"),
+        "drain_first_epoch_max_ms": drain.get("max_ms"),
+        "drain_storm_wall_s": drain.get("wall_s"),
+        "drain_mismatched_assignments": drain["mismatched"],
+        "drain_invalid_assignments": drain["invalid"],
+        "drain_post_takeover_compiles": drain.get("compiles", -1),
+        "fenced_stale_writes": fenced_stale_writes,
+        "adopted_state_overwrites": overwrites,
     }
 
 
@@ -1456,7 +1769,7 @@ def main():
 
     for fn in (config1_readme, config2_zipf, config3_vmap, config4_skew,
                config5_northstar, config6_multistream, config7_overload,
-               config8_restart, config9_delta):
+               config8_restart, config9_delta, config10_handoff):
         before = klba_metrics.REGISTRY.snapshot()
         r = fn()
         deltas = klba_metrics.histogram_deltas(
@@ -1645,6 +1958,77 @@ def main():
                 f"restart_storm first_epoch_p50_ms {first_ms:.1f} > "
                 f"10x the pre-crash baseline {base_ms:.1f} — "
                 "time-to-first-warm-epoch regressed"
+            )
+        # The pre-stacked reboot (lifecycle (b) measurement) is timed,
+        # not latency-gated — but it must stay CORRECT.
+        if rs.get("prestack_mismatched_assignments", 0) > 0:
+            failures.append(
+                f"restart_storm prestacked reboot produced "
+                f"{rs['prestack_mismatched_assignments']} first-epoch "
+                "assignment(s) differing from its seeded baseline — "
+                "pre-stacking broke bit-exact recovery"
+            )
+    # Cross-host hand-off gates (every backend — fencing and recovery
+    # are protocol facts, not hardware ones): BOTH hand-off modes must
+    # adopt every stream with bit-identical, compile-free first warm
+    # epochs, every fenced stale write from the predecessor must be
+    # rejected and counted, and the adopted state must never be
+    # overwritten.
+    ho = results.get("handoff_storm", {})
+    if ho:
+        for mode in ("crash", "drain"):
+            if ho.get(f"{mode}_streams_recovered", 0) < ho.get(
+                "streams", 0
+            ):
+                failures.append(
+                    f"handoff_storm {mode} hand-off adopted "
+                    f"{ho.get(f'{mode}_streams_recovered')}/"
+                    f"{ho.get('streams')} streams — the replacement "
+                    "is not adopting the warm state"
+                )
+            if ho.get(f"{mode}_mismatched_assignments", 0) > 0:
+                failures.append(
+                    f"handoff_storm {mode} hand-off produced "
+                    f"{ho[f'{mode}_mismatched_assignments']} first-"
+                    "epoch assignment(s) differing from the "
+                    "uninterrupted baseline — takeover is not bit-exact"
+                )
+            if ho.get(f"{mode}_invalid_assignments", 0) > 0:
+                failures.append(
+                    f"handoff_storm {mode} hand-off produced "
+                    f"{ho[f'{mode}_invalid_assignments']} invalid "
+                    "assignment(s)"
+                )
+            if ho.get(f"{mode}_post_takeover_compiles", 0) != 0:
+                failures.append(
+                    f"handoff_storm {mode} hand-off compiled "
+                    f"{ho.get(f'{mode}_post_takeover_compiles')} "
+                    "executable(s) inside the first warm epochs — the "
+                    "recovered-shape warm-up is not covering takeover"
+                )
+        if ho.get("crash_handoff_mode") != "takeover_crash":
+            failures.append(
+                f"handoff_storm crash hand-off reported mode "
+                f"{ho.get('crash_handoff_mode')!r} — the lease "
+                "takeover did not see the expired predecessor"
+            )
+        if ho.get("drain_handoff_mode") != "takeover_drain":
+            failures.append(
+                f"handoff_storm drain hand-off reported mode "
+                f"{ho.get('drain_handoff_mode')!r} — the released "
+                "lease was not adopted as a drain hand-off"
+            )
+        if ho.get("fenced_stale_writes", 0) < 1:
+            failures.append(
+                "handoff_storm recorded no fenced stale write — the "
+                "predecessor's clobber attempt was not exercised or "
+                "not counted"
+            )
+        if ho.get("adopted_state_overwrites", 0) != 0:
+            failures.append(
+                f"handoff_storm adopted_state_overwrites "
+                f"{ho['adopted_state_overwrites']} != 0 — a fenced-off "
+                "instance overwrote the replacement's adopted state"
             )
     # Delta-drift gates (every backend — correctness and upload bytes
     # are config/shape facts, not hardware ones): every epoch must be

@@ -1218,6 +1218,43 @@ class StreamingAssignor:
         self._prev_choice = np.ascontiguousarray(choice, dtype=np.int32)
         self._drop_resident()
 
+    @property
+    def needs_dense_resync(self) -> bool:
+        """True when the next warm epoch must rebuild the device state
+        with a full dense upload (stale resident after seed_choice /
+        repair / remap): the sidecar's resync pacer gates exactly
+        these epochs so a restart wave cannot serialize the device
+        behind one dense mega-wave (DEPLOYMENT.md "Restarts and
+        recovery")."""
+        return self._prev_choice is not None and self._resident is None
+
+    def prestack_resident(self) -> bool:
+        """Boot-time pre-stack (ROADMAP lifecycle (b)): rebuild the
+        device-resident warm state from the seeded choice under a ZERO
+        lag vector, off the serving path.  A zero vector meets any
+        quality limit before the first exchange round, so the choice
+        comes back UNCHANGED — the next real epoch is bit-identical to
+        what the lazy inline rebuild would have produced — while the
+        resident 4-tuple (choice, table, counts, lags) is already on
+        device, making that epoch a resident (coalescible) dispatch
+        instead of an inline dense table-build.  Uses the same statics
+        as the serving warm build, so a warmed deployment compiles
+        nothing here.  Returns True when a resident was built."""
+        if self._prev_choice is None or self._resident is not None:
+            return False
+        ensure_x64()
+        P = int(self._prev_choice.shape[0])
+        lags = np.zeros(P, dtype=np.int64)
+        payload, _ = stream_payload(lags)
+        out = _warm_fused_build(
+            payload, self._prev_choice.astype(np.int32), 0.0,
+            num_consumers=self.num_consumers, iters=self.refine_iters,
+            max_pairs=min(self.num_consumers // 2, 16),
+            exchange_budget=self.refine_iters, bucket=self._bucket(P),
+        )
+        self._adopt_resident(tuple(out[1:5]), lags)
+        return True
+
     def reset(self) -> None:
         """Drop warm state (force the next rebalance to solve cold)."""
         self._prev_choice = None

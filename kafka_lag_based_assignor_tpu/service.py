@@ -699,6 +699,70 @@ class _Handler(socketserver.StreamRequestHandler):
                 return True
 
 
+#: Per-process instance sequence for lease owner ids: two services in
+#: one process (restart drills, the hand-off bench) must be
+#: distinguishable to the fencing protocol.
+_OWNER_SEQ = iter(range(1, 1 << 30))
+
+
+class _ResyncPacer:
+    """Post-restart resync-storm pacing (ROADMAP delta follow-on (c)):
+    a restart wave's first epochs all need a stale-resident DENSE
+    rebuild (full-vector upload + table build, dispatched inline —
+    a megabatch cannot absorb a per-stream state rebuild), and N
+    tenants firing at once used to serialize the device behind one
+    dense mega-wave.  This pacer caps how many such rebuilds run
+    concurrently; excess epochs wait their turn (bounded by the
+    request's own deadline budget — on timeout the epoch proceeds
+    UNPACED, fail-open: pacing must never be what fails a request).
+    Each wait is counted in ``klba_resync_paced_total``."""
+
+    def __init__(
+        self,
+        max_inflight: int,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if max_inflight <= 0:
+            raise ValueError(
+                f"max_inflight={max_inflight} must be > 0"
+            )
+        self.max_inflight = int(max_inflight)
+        self._cond = threading.Condition()
+        self._active = 0
+        self._clock = clock
+        # High-water mark of concurrent paced rebuilds — the test pin
+        # that the cap actually binds (<= max_inflight by design).
+        self.high_water = 0
+        self._m_paced = metrics.REGISTRY.counter(
+            "klba_resync_paced_total"
+        )
+
+    def acquire(self, timeout_s: Optional[float]) -> bool:
+        """Take a rebuild slot; True when one was taken (the caller
+        must :meth:`release`), False when the wait timed out and the
+        caller should proceed unpaced."""
+        deadline = self._clock() + (
+            min(timeout_s, 30.0) if timeout_s is not None else 30.0
+        )
+        with self._cond:
+            if self._active >= self.max_inflight:
+                self._m_paced.inc()
+                while self._active >= self.max_inflight:
+                    remaining = deadline - self._clock()
+                    if remaining <= 0:
+                        return False  # fail open: dispatch unpaced
+                    self._cond.wait(min(remaining, 0.05))
+            self._active += 1
+            if self._active > self.high_water:
+                self.high_water = self._active
+            return True
+
+    def release(self) -> None:
+        with self._cond:
+            self._active -= 1
+            self._cond.notify()
+
+
 class AssignorService:
     """The request processor + TCP front end."""
 
@@ -784,6 +848,37 @@ class AssignorService:
         snapshot_interval_s: float = 30.0,
         snapshot_max_age_s: float = 900.0,
         drain_timeout_s: float = 10.0,
+        # Cross-host hand-off (utils/snapshot backends; DEPLOYMENT.md
+        # "Cross-host hand-off").  snapshot_backend selects where the
+        # snapshot lives: "file" (the per-instance local file, the
+        # default), or the object-store-shaped "memory"/"object"
+        # backends whose versioned CAS + writer leases let a
+        # replacement on ANOTHER host adopt the warm state.  A lease
+        # ttl > 0 engages epoch fencing: boot acquires the writer
+        # lease (waiting up to lease_wait for a crashed predecessor's
+        # lease to expire; 0 = auto, 2x ttl + 1s), every save is
+        # save_if(token, prev_version), and a fenced-off predecessor's
+        # stale writes are rejected loudly instead of clobbering the
+        # adopted state.  Lease acquisition failure FAILS OPEN: the
+        # service serves, snapshot writes are denied and counted.
+        snapshot_backend: str = "file",
+        snapshot_lease_ttl_s: float = 0.0,
+        snapshot_lease_wait_s: float = 0.0,
+        # Post-restart resync pacing (ROADMAP delta follow-on (c)): at
+        # most this many concurrent stale-resident DENSE rebuild
+        # dispatches (the full-vector re-sync every recovered stream
+        # pays on its first post-restart epoch); excess epochs wait
+        # their turn (counted klba_resync_paced_total) so a restart
+        # wave trickles through instead of serializing the device
+        # behind one dense mega-wave.  <= 0 disables.
+        resync_max_inflight: int = 8,
+        # Pre-stack recovered rosters at boot (ROADMAP lifecycle (b)):
+        # rebuild each recovered stream's device-resident warm state
+        # from its seeded choice (zero-lag build, off the serving
+        # path) so the storm's first epochs skip the inline dense
+        # table-build and coalesce like steady-state traffic.  The
+        # restart_storm bench measures this both ways.
+        recovery_prestack: bool = False,
         # False skips the recovered-shape warm-up pass in start()
         # (tests/drills that assert recovery semantics without paying
         # compiles); production keeps it on — it is what makes the
@@ -803,6 +898,18 @@ class AssignorService:
         if int(delta_buckets) < 0:
             raise ValueError(
                 f"delta_buckets={delta_buckets} must be >= 0"
+            )
+        from .utils.snapshot import BACKEND_KINDS
+
+        if snapshot_backend not in BACKEND_KINDS:
+            raise ValueError(
+                f"snapshot_backend={snapshot_backend!r} invalid; "
+                f"choose one of {list(BACKEND_KINDS)}"
+            )
+        if float(snapshot_lease_ttl_s) < 0:
+            raise ValueError(
+                f"snapshot_lease_ttl_s={snapshot_lease_ttl_s} must be "
+                ">= 0"
             )
         self._tcp = socketserver.ThreadingTCPServer(
             (host, port), _Handler, bind_and_activate=True
@@ -916,10 +1023,42 @@ class AssignorService:
         self._recovery_warmup = bool(recovery_warmup)
         self._m_lifecycle = metrics.REGISTRY.gauge("klba_lifecycle_state")
         self._m_lifecycle.set(0)
+        # Cross-host hand-off state: the boot-time lease handshake's
+        # outcome (wire stats "lifecycle.handoff"; None until start()).
+        self._last_handoff: Optional[Dict[str, Any]] = None
+        self._lease_wait_s = (
+            float(snapshot_lease_wait_s)
+            if snapshot_lease_wait_s > 0
+            else float(snapshot_lease_ttl_s) * 2.0 + 1.0
+        )
+        self._recovery_prestack = bool(recovery_prestack)
+        self._resync_pacer = (
+            _ResyncPacer(int(resync_max_inflight), clock=clock)
+            if int(resync_max_inflight) > 0 else None
+        )
         if snapshot_path:
-            from .utils.snapshot import SnapshotStore, SnapshotWriter
+            from .utils.snapshot import (
+                SnapshotStore,
+                SnapshotWriter,
+                build_backend,
+            )
 
-            self._snapshot_store = SnapshotStore(snapshot_path)
+            self._snapshot_store = SnapshotStore(
+                backend=build_backend(snapshot_backend, snapshot_path)
+            )
+            if snapshot_lease_ttl_s > 0:
+                # The owner id must be unique per INSTANCE, not per
+                # process: the hand-off drills run two instances in
+                # one process and fencing must tell them apart.
+                import os
+
+                owner = (
+                    f"{socket.gethostname()}:{os.getpid()}:"
+                    f"{next(_OWNER_SEQ)}"
+                )
+                self._snapshot_store.attach_lease(
+                    owner, float(snapshot_lease_ttl_s)
+                )
             self._snapshot_writer = SnapshotWriter(
                 self._snapshot_store,
                 self._snapshot_sections,
@@ -1006,6 +1145,11 @@ class AssignorService:
             "snapshot_interval_s": cfg.snapshot_interval_s,
             "snapshot_max_age_s": cfg.snapshot_max_age_s,
             "drain_timeout_s": cfg.drain_timeout_s,
+            "snapshot_backend": cfg.snapshot_backend,
+            "snapshot_lease_ttl_s": cfg.snapshot_lease_ttl_s,
+            "snapshot_lease_wait_s": cfg.snapshot_lease_wait_s,
+            "resync_max_inflight": cfg.resync_max_inflight,
+            "recovery_prestack": cfg.recovery_prestack,
             "warmup_shapes": cfg.warmup_shapes or None,
             "slo_classes": cfg.slo_classes,
             "slo_deadline_s": cfg.slo_deadline_s,
@@ -1519,6 +1663,7 @@ class AssignorService:
             # snapshot ahead of the periodic cadence (debounced).
             self._mark_churn()
 
+        pace_held = False
         try:
             warm_restart = False
             if delta is not None:
@@ -1715,6 +1860,19 @@ class AssignorService:
                 with self._streams_lock:
                     if len(self._streams) <= 1:
                         coalescer = None
+            # Resync pacing (module docstring of _ResyncPacer): an
+            # epoch that must rebuild its device state with a dense
+            # full-vector upload (the post-restart first epoch, a
+            # churn-invalidated resident) takes a bounded rebuild
+            # slot; a restart wave then trickles through the device
+            # instead of serializing it behind one dense mega-wave.
+            if (
+                self._resync_pacer is not None
+                and getattr(st.engine, "needs_dense_resync", False)
+            ):
+                pace_held = self._resync_pacer.acquire(
+                    budget.remaining()
+                )
             try:
                 # Ladder rung 1: the warm-resident engine, under the
                 # stream breaker with the request's REMAINING budget.
@@ -1823,6 +1981,8 @@ class AssignorService:
             self._note_epoch(st, klass, lags)
             lag_epoch_out = st.lag_epoch
         finally:
+            if pace_held:
+                self._resync_pacer.release()
             st.lock.release()
 
         return self._stream_result(
@@ -2105,8 +2265,83 @@ class AssignorService:
                 if self._snapshot_store is not None else None
             ),
             "recovery": self._last_recovery,
+            # Cross-host hand-off surface: the writer lease (holder,
+            # token, age) and the boot-time hand-off outcome — what
+            # dump_metrics --summary prints for "who owns this state".
+            "lease": (
+                self._snapshot_store.lease_stats()
+                if self._snapshot_store is not None else None
+            ),
+            "handoff": self._last_handoff,
         }
         return out
+
+    def _acquire_writer_lease(self) -> None:
+        """The boot side of the takeover protocol: acquire the fenced
+        writer lease (fencing enabled) and record the hand-off outcome
+        for the lifecycle surface.  Never raises; a failed acquisition
+        serves with snapshot writes denied."""
+        store = self._snapshot_store
+        if store is None or not store.fencing_enabled:
+            return
+        res = store.acquire_lease(wait_s=self._lease_wait_s)
+        mode = (
+            "fresh" if res.get("previous_holder") is None
+            else "takeover_crash" if res.get("previous_expired")
+            else "takeover_drain"
+        )
+        self._last_handoff = {
+            "acquired": bool(res.get("ok")),
+            "mode": mode,
+            "token": res.get("token"),
+            "waited_ms": res.get("waited_ms"),
+            "previous_holder": res.get("previous_holder"),
+            "error": res.get("error"),
+        }
+        metrics.FLIGHT.record(
+            "lifecycle", {"event": "handoff", **self._last_handoff}
+        )
+        LOGGER.warning(
+            "writer lease %s (mode=%s, token=%s, waited %.0f ms, "
+            "previous holder %r)",
+            "acquired" if res.get("ok") else "NOT acquired", mode,
+            res.get("token"), res.get("waited_ms") or 0.0,
+            res.get("previous_holder"),
+        )
+
+    def _prestack_recovered(self) -> None:
+        """Rebuild each recovered stream's device-resident warm state
+        from its seeded choice (zero-lag table build — choice
+        unchanged, bit-exactness intact), off the serving path.
+        Best-effort per stream: a failed pre-stack leaves that stream
+        on the inline dense-rebuild path it would have taken anyway."""
+        with self._streams_lock:
+            items = list(self._streams.items())
+        built = 0
+        for sid, st in items:
+            if not st.lock.acquire(timeout=5.0):
+                continue
+            try:
+                if st.recovered and st.engine is not None:
+                    if st.engine.prestack_resident():
+                        built += 1
+            except Exception:  # noqa: BLE001 — per-stream best effort
+                LOGGER.warning(
+                    "pre-stack of recovered stream %r failed; it will "
+                    "rebuild inline on its first epoch",
+                    sid, exc_info=True,
+                )
+            finally:
+                st.lock.release()
+        if built:
+            metrics.REGISTRY.counter(
+                "klba_recovery_prestacked_total"
+            ).inc(built)
+            if self._last_recovery is not None:
+                self._last_recovery["streams_prestacked"] = built
+        LOGGER.info(
+            "pre-stacked %d/%d recovered stream(s)", built, len(items)
+        )
 
     def _recover(self) -> None:
         """Boot-time warm-restart recovery (called by :meth:`start`
@@ -2145,11 +2380,22 @@ class AssignorService:
             overload = load.sections.get("overload")
             if overload is not None:
                 self._overload.restore_state(overload)
-            recovered, discarded = self._rehydrate_streams(
+            recovered, discarded, weight = self._rehydrate_streams(
                 load.sections.get("streams") or {}, np
             )
             info["streams_recovered"] = recovered
             info["streams_discarded"] = discarded
+            if recovered:
+                # Recovery-aware shed ladder (ROADMAP lifecycle (c)):
+                # every recovered stream will fire its next epoch at
+                # once — seed the depth EWMA with that stampede's
+                # weighted depth NOW, so a restart under live overload
+                # re-escalates on the FIRST admission decision instead
+                # of waiting one evaluation interval while the queue
+                # melts.  The EWMA decays through the normal hysteresis
+                # if the stampede never materializes.
+                self._overload.seed_recovery_depth(weight)
+                info["seeded_depth"] = weight
         info["duration_ms"] = (metrics.REGISTRY.clock() - t0) * 1000.0
         self._last_recovery = info
         metrics.REGISTRY.gauge("klba_recovery_duration_ms").set(
@@ -2164,12 +2410,15 @@ class AssignorService:
 
     def _rehydrate_streams(
         self, bodies: Dict[str, Any], np
-    ) -> Tuple[int, int]:
+    ) -> Tuple[int, int, float]:
         """Seed one engine per snapshot stream; a malformed or
         unservable stream record is discarded ALONE (counted), never an
-        exception into the boot path.  Returns (recovered, discarded).
-        """
+        exception into the boot path.  Returns ``(recovered,
+        discarded, weighted_depth)`` — the weight sum (CLASS_WEIGHTS
+        over the recovered streams' classes) seeds the overload
+        controller's depth EWMA for the restart stampede."""
         recovered = discarded = 0
+        weight = 0.0
         m_rec = metrics.REGISTRY.counter(
             "klba_recovery_streams_total", {"outcome": "recovered"}
         )
@@ -2225,6 +2474,7 @@ class AssignorService:
                     self._streams[str(sid)] = st
                 self._recovery_shapes.append((int(pids.shape[0]), C))
                 recovered += 1
+                weight += CLASS_WEIGHTS.get(klass, 1.0)
                 m_rec.inc()
             except Exception:  # noqa: BLE001 — discard THIS stream only
                 LOGGER.warning(
@@ -2233,7 +2483,7 @@ class AssignorService:
                 )
                 discarded += 1
                 m_disc.inc()
-        return recovered, discarded
+        return recovered, discarded, weight
 
     def begin_drain(self) -> bool:
         """Initiate a graceful drain (idempotent): stop admissions,
@@ -2296,9 +2546,15 @@ class AssignorService:
                 )
         # 3. Final snapshot: the state the restart rehydrates from
         #    (merge-aware: a lock-held stream keeps its previous
-        #    record instead of vanishing from the file).
+        #    record instead of vanishing from the file).  The writer
+        #    lease is released AFTER it lands, so a replacement
+        #    adopts instantly (drain-initiated hand-off) instead of
+        #    waiting out the TTL; a crash (stop()) never releases —
+        #    the TTL expiry is what fences a dead holder.
         if self._snapshot_writer is not None:
             self._final_snapshot()
+        if self._snapshot_store is not None:
+            self._snapshot_store.release_lease()
         # 4. Close the listener; the process may now exit.
         self._close_listener()
         if self._coalescer is not None:
@@ -2349,11 +2605,27 @@ class AssignorService:
         install_compile_counter()
         metrics.install_log_request_ids()
         if self._snapshot_store is not None:
+            # Takeover handshake FIRST (DEPLOYMENT.md "Cross-host
+            # hand-off"): acquire the writer lease — waiting out a
+            # crashed predecessor's TTL, or adopting instantly after a
+            # drain released it — so the fencing epoch turns over
+            # BEFORE the state is read.  From this point every stale
+            # write from the predecessor is rejected by the backend.
+            # Fail-open: an unacquirable lease still boots (writes
+            # denied, serving untouched).
+            self._acquire_writer_lease()
             # Warm-restart recovery BEFORE the warm-up and the accept
             # loop: rehydrated streams contribute their shapes to the
             # warm-up below, so the restart stampede's first warm
             # epochs compile nothing (the restart_storm bench gate).
             self._recover()
+            if self._recovery_prestack:
+                # Pre-stack recovered rosters (ROADMAP lifecycle (b)):
+                # rebuild each recovered engine's device-resident
+                # state off the serving path so the storm's first
+                # epochs dispatch like steady-state (coalescible)
+                # warm traffic instead of inline dense table-builds.
+                self._prestack_recovered()
         coalesce_batch = (
             self._coalescer.max_batch if self._coalescer is not None else 1
         )
@@ -2718,6 +2990,41 @@ def main() -> None:
         help="graceful-drain window for in-flight requests and "
              "coalescer waves (default 10000)",
     )
+    parser.add_argument(
+        "--snapshot-backend", default="file",
+        choices=["file", "memory", "object"], metavar="KIND",
+        help="where the snapshot lives: 'file' (per-instance local "
+             "file), 'memory', or 'object' (object-store-shaped, "
+             "versioned CAS — enables cross-host hand-off; the path "
+             "is then the store directory)",
+    )
+    parser.add_argument(
+        "--snapshot-lease-ttl-ms", type=float, default=0.0,
+        metavar="MS",
+        help="epoch-fenced writer lease TTL; > 0 engages fencing "
+             "(boot acquires the lease, saves carry its token, a "
+             "fenced-off predecessor's writes are rejected); 0 "
+             "disables (default)",
+    )
+    parser.add_argument(
+        "--snapshot-lease-wait-ms", type=float, default=0.0,
+        metavar="MS",
+        help="how long boot waits for a crashed predecessor's lease "
+             "to expire before serving WITHOUT it (writes denied); "
+             "0 = auto (2x ttl + 1s)",
+    )
+    parser.add_argument(
+        "--resync-max-inflight", type=int, default=8, metavar="N",
+        help="cap on concurrent post-restart dense resync rebuilds "
+             "(excess epochs wait, counted klba_resync_paced_total); "
+             "0 disables pacing (default 8)",
+    )
+    parser.add_argument(
+        "--recovery-prestack", action="store_true",
+        help="pre-stack recovered rosters at boot (device-resident "
+             "rebuild off the serving path) so the restart storm's "
+             "first epochs coalesce like steady-state traffic",
+    )
     opts = parser.parse_args()
     service = AssignorService(
         opts.host, opts.port, warmup_shapes=opts.warmup,
@@ -2733,6 +3040,13 @@ def main() -> None:
         snapshot_interval_s=max(opts.snapshot_interval_ms, 1.0) / 1000.0,
         snapshot_max_age_s=max(opts.snapshot_max_age_ms, 1.0) / 1000.0,
         drain_timeout_s=max(opts.drain_timeout_ms, 0.0) / 1000.0,
+        snapshot_backend=opts.snapshot_backend,
+        snapshot_lease_ttl_s=max(opts.snapshot_lease_ttl_ms, 0.0)
+        / 1000.0,
+        snapshot_lease_wait_s=max(opts.snapshot_lease_wait_ms, 0.0)
+        / 1000.0,
+        resync_max_inflight=opts.resync_max_inflight,
+        recovery_prestack=opts.recovery_prestack,
     )
     # SIGTERM/SIGINT drain gracefully: admissions stop with a
     # structured retry-after reject, in-flight waves flush, the final

@@ -113,6 +113,31 @@ SNAPSHOT_PATH_CONFIG = "tpu.assignor.snapshot.path"
 SNAPSHOT_INTERVAL_CONFIG = "tpu.assignor.snapshot.interval.ms"
 SNAPSHOT_MAX_AGE_CONFIG = "tpu.assignor.snapshot.max.age.ms"
 DRAIN_TIMEOUT_CONFIG = "tpu.assignor.drain.timeout.ms"
+# Cross-host hand-off (utils/snapshot backends; DEPLOYMENT.md
+# "Cross-host hand-off").  ``snapshot.backend`` selects where the
+# snapshot lives: "file" (per-instance local file, the default) or the
+# object-store-shaped "memory" / "object" backends with versioned CAS
+# writes.  A ``snapshot.lease.ttl.ms`` > 0 engages epoch-fenced writer
+# leases: boot acquires the lease (waiting up to
+# ``snapshot.lease.wait.ms`` for a crashed predecessor's lease to
+# expire; 0 = auto, 2x ttl + 1 s), every save is conditioned on the
+# fencing token, and a fenced-off predecessor's writes are rejected
+# instead of clobbering the replacement's adopted state.
+SNAPSHOT_BACKEND_CONFIG = "tpu.assignor.snapshot.backend"
+SNAPSHOT_LEASE_TTL_CONFIG = "tpu.assignor.snapshot.lease.ttl.ms"
+SNAPSHOT_LEASE_WAIT_CONFIG = "tpu.assignor.snapshot.lease.wait.ms"
+# Post-restart resync pacing: at most this many concurrent
+# stale-resident dense rebuild dispatches (the full-vector re-sync a
+# recovered stream pays on its first post-restart epoch); excess
+# epochs wait their turn (counted ``klba_resync_paced_total``).  0
+# disables pacing.
+RESYNC_MAX_INFLIGHT_CONFIG = "tpu.assignor.resync.max.inflight"
+# Pre-stack recovered rosters at boot (ROADMAP lifecycle (b)): rebuild
+# each recovered stream's device-resident state from its seeded choice
+# off the serving path, so the restart storm's first epochs coalesce
+# like steady-state traffic instead of dispatching inline dense
+# table-builds.
+RECOVERY_PRESTACK_CONFIG = "tpu.assignor.recovery.prestack"
 # "P:C[:T][,P:C[:T]...]" — shapes to pre-compile at configure() time
 # (consumer startup, NOT on the rebalance critical path): each entry warms
 # the kernels for max_partitions P / num_consumers C / a topic batch of T
@@ -217,6 +242,14 @@ class AssignorConfig:
     snapshot_interval_s: float = 30.0
     snapshot_max_age_s: float = 900.0
     drain_timeout_s: float = 10.0
+    # Cross-host hand-off: backend kind + epoch-fenced writer lease
+    # (ttl 0 = fencing off) + boot lease wait (0 = auto).
+    snapshot_backend: str = "file"
+    snapshot_lease_ttl_s: float = 0.0
+    snapshot_lease_wait_s: float = 0.0
+    # Post-restart resync pacing + boot-time roster pre-stacking.
+    resync_max_inflight: int = 8
+    recovery_prestack: bool = False
     # (max_partitions, num_consumers) shapes to pre-compile at configure().
     warmup_shapes: list = field(default_factory=list)
     consumer_group_props: Dict[str, Any] = field(default_factory=dict)
@@ -328,6 +361,23 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
         raise ValueError(f"{SNAPSHOT_MAX_AGE_CONFIG} must be > 0 ms")
     drain_timeout_s = _as_ms(DRAIN_TIMEOUT_CONFIG, 10_000.0)
 
+    # Cross-host hand-off knobs: backend kind validated against the
+    # roster utils/snapshot ships (a typo'd backend fails at
+    # configure() time, not at the first snapshot write).
+    from .snapshot import BACKEND_KINDS
+
+    snapshot_backend = str(
+        consumer_group_props.get(SNAPSHOT_BACKEND_CONFIG, "file")
+    )
+    if snapshot_backend not in BACKEND_KINDS:
+        raise ValueError(
+            f"{SNAPSHOT_BACKEND_CONFIG}={snapshot_backend!r} invalid; "
+            f"choose one of {list(BACKEND_KINDS)}"
+        )
+    snapshot_lease_ttl_s = _as_ms(SNAPSHOT_LEASE_TTL_CONFIG, 0.0)
+    snapshot_lease_wait_s = _as_ms(SNAPSHOT_LEASE_WAIT_CONFIG, 0.0)
+    resync_max_inflight = _as_int(RESYNC_MAX_INFLIGHT_CONFIG, 8, 0)
+
     # SLO class map + per-class deadline budgets: prefix-keyed entries,
     # validated against the class roster (utils/overload) so a typo'd
     # class fails at configure() time, not mid-stampede.
@@ -430,6 +480,13 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
         snapshot_interval_s=snapshot_interval_s,
         snapshot_max_age_s=snapshot_max_age_s,
         drain_timeout_s=drain_timeout_s,
+        snapshot_backend=snapshot_backend,
+        snapshot_lease_ttl_s=snapshot_lease_ttl_s,
+        snapshot_lease_wait_s=snapshot_lease_wait_s,
+        resync_max_inflight=resync_max_inflight,
+        recovery_prestack=_as_bool(
+            consumer_group_props.get(RECOVERY_PRESTACK_CONFIG, False)
+        ),
         warmup_shapes=warmup_shapes,
         consumer_group_props=consumer_group_props,
         metadata_consumer_props=metadata_consumer_props,

@@ -54,6 +54,22 @@ Named fault points (every one threaded through production code):
                     SnapshotStore.load`) — a failure here exercises the
                     fail-open recovery contract (counted cold start,
                     never an exception into the serving path)
+``snapshot.cas``    a conditional (versioned) backend write
+                    (:meth:`..utils.snapshot.SnapshotBackend.write_if`)
+                    — fires as a simulated CAS RACE: the write loses
+                    cleanly (CASConflict), the store retries once per
+                    its contract, serving is never taken down
+``snapshot.lease``  writer-lease acquire/renew/release
+                    (:class:`..utils.snapshot.SnapshotBackend`) — a
+                    boot that cannot acquire the lease serves anyway
+                    with snapshot writes denied (fail-open takeover)
+``backend.partition``  entry of EVERY snapshot-backend operation — an
+                    unreachable remote store: saves count errors,
+                    loads count cold starts, assignment never stops
+``backend.latency`` same entry, latency mode — a slow remote link:
+                    the operation proceeds after the injected delay
+                    (pair with ``latency`` plans; a ``raise`` plan
+                    here behaves like ``backend.partition``)
 ``drain.flush``     the graceful drain's coalescer quiesce
                     (:meth:`..ops.coalesce.MegabatchCoalescer.drain`)
                     — a failure here must not stop the drain from
@@ -118,6 +134,10 @@ FAULT_POINTS = frozenset(
         "delta.apply",
         "snapshot.write",
         "snapshot.load",
+        "snapshot.cas",
+        "snapshot.lease",
+        "backend.partition",
+        "backend.latency",
         "drain.flush",
         "lag.begin",
         "lag.end",

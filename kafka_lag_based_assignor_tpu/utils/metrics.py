@@ -773,7 +773,12 @@ class FlightRecorder:
                     self.dump_dir,
                     f"flight-{seq % self.keep_files}.json",
                 )
-                atomic_write_bytes(
+                # noqa: L017 below — a flight dump is per-instance
+                # post-mortem evidence, never adoptable warm state: no
+                # replacement reads it back, so backend CAS/fencing
+                # has nothing to police here (atomicity via L015's
+                # helper is all it needs).
+                atomic_write_bytes(  # noqa: L017
                     path,
                     json.dumps(
                         payload, indent=2, sort_keys=True

@@ -327,6 +327,22 @@ class OverloadController:
                 float(weighted_depth) - self._ewma_depth
             )
 
+    def seed_recovery_depth(self, weighted_depth: float) -> None:
+        """Recovery-aware ladder seed (ROADMAP lifecycle (c)): a
+        restarting sidecar knows every recovered stream will fire its
+        next epoch at once — seed the depth EWMA with that stampede's
+        weighted depth (never DOWNWARD: a restored snapshot may carry
+        a higher live reading) and force the next admission decision
+        to re-evaluate, so a restart under a live stampede
+        re-escalates on the FIRST post-boot decision instead of
+        waiting one evaluation interval.  If the stampede never
+        materializes the EWMA decays through the normal hysteresis."""
+        with self._lock:
+            self._ewma_depth = max(
+                self._ewma_depth, float(weighted_depth)
+            )
+            self._last_eval = None
+
     def _windowed_p99(self) -> Optional[float]:
         """p99 of the stream.epoch observations made since the previous
         evaluation (bucket-wise delta) — None when nothing new."""

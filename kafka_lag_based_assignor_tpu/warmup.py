@@ -194,6 +194,16 @@ def warmup(
                     # warmed code even if the two variants ever drift.
                     engine.seed_choice(np.asarray(out))
                     engine.rebalance(lags1d)
+                    # Pre-stacked recovery (service recovery_prestack /
+                    # --recovery-prestack) replays seed_choice ->
+                    # prestack_resident (zero-lag table build) -> a
+                    # dense RESIDENT dispatch.  Both executables are
+                    # compiled by the epochs above today; driven
+                    # explicitly so the prestacked boot path stays
+                    # pinned warm even if the variants ever drift.
+                    engine.seed_choice(np.asarray(out))
+                    engine.prestack_resident()
+                    engine.rebalance(lags1d)
                     # assign_stream downcasts the upload to int32 when the
                     # lag range allows; ALSO warm the wide-lag (int64)
                     # variants of both the stream kernel and the fused

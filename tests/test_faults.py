@@ -668,6 +668,146 @@ class TestLifecycleFaults:
             svc.stop()
 
 
+class TestBackendFaults:
+    """The cross-host hand-off fault points (``backend.partition`` /
+    ``backend.latency`` / ``snapshot.cas`` / ``snapshot.lease``) under
+    the same chaos invariant: a backend outage may cost a snapshot, a
+    lease, or a cold start — NEVER a serving-path error (assignment
+    fails open)."""
+
+    MEMBERS = ["C0", "C1", "C2", "C3"]
+
+    def _rows(self, seed):
+        arr = np.random.default_rng(seed).integers(0, 10**6, 256)
+        return [[i, int(v)] for i, v in enumerate(arr)]
+
+    def _service(self, name, **kw):
+        kw.setdefault("snapshot_backend", "memory")
+        kw.setdefault("snapshot_interval_s", 3600.0)
+        kw.setdefault("recovery_warmup", False)
+        return AssignorService(port=0, snapshot_path=name, **kw).start()
+
+    def test_backend_partition_save_keeps_serving(self, tmp_path):
+        svc = self._service(str(tmp_path / "part"))
+        try:
+            with client_for(svc) as c:
+                c.stream_assign("s1", "t0", self._rows(1), self.MEMBERS)
+                with faults.injected(
+                    faults.FaultInjector(0).plan(
+                        "backend.partition", times=0
+                    )
+                ):
+                    # The remote store is unreachable: writes fail
+                    # open (counted errors), assignment never stops.
+                    assert not svc.snapshot_now()["ok"]
+                    r = c.stream_assign(
+                        "s1", "t0", self._rows(2), self.MEMBERS
+                    )
+                    assert_valid_assignment(r["assignments"], 256)
+                # Partition healed: the next write lands.
+                assert svc.snapshot_now()["ok"]
+        finally:
+            svc.stop()
+
+    def test_backend_partition_load_cold_starts_and_serves(
+        self, tmp_path
+    ):
+        name = str(tmp_path / "part-load")
+        svc = self._service(name)
+        try:
+            with client_for(svc) as c:
+                c.stream_assign("s1", "t0", self._rows(1), self.MEMBERS)
+            assert svc.snapshot_now()["ok"]
+        finally:
+            svc.stop()
+        with faults.injected(
+            faults.FaultInjector(0).plan("backend.partition", times=0)
+        ):
+            svc2 = self._service(name)
+        try:
+            assert svc2._last_recovery["outcome"] == "cold"
+            with client_for(svc2) as c:
+                r = c.stream_assign(
+                    "s1", "t0", self._rows(3), self.MEMBERS
+                )
+                assert r["stream"]["cold_start"]
+                assert_valid_assignment(r["assignments"], 256)
+        finally:
+            svc2.stop()
+
+    def test_lease_fault_at_boot_fails_open_to_serving(self, tmp_path):
+        """An injected lease-channel failure during the boot
+        handshake: the service serves anyway; snapshot writes are
+        denied (no lease) while the channel stays down, and the
+        per-save re-acquisition restores coverage once it heals —
+        never an error into the accept loop."""
+        name = str(tmp_path / "lease")
+        with faults.injected(
+            faults.FaultInjector(0).plan("snapshot.lease", times=0)
+        ):
+            svc = self._service(
+                name, snapshot_lease_ttl_s=30.0,
+                snapshot_lease_wait_s=0.2,
+            )
+            try:
+                assert not svc._last_handoff["acquired"]
+                with client_for(svc) as c:
+                    r = c.stream_assign(
+                        "s1", "t0", self._rows(1), self.MEMBERS
+                    )
+                    assert_valid_assignment(r["assignments"], 256)
+                # Channel still down: the save's re-acquisition also
+                # fails, the write is denied — serving untouched.
+                denied = svc.snapshot_now()
+                assert not denied["ok"]
+                assert denied.get("denied") == "no_lease"
+            except BaseException:
+                svc.stop()
+                raise
+        try:
+            # The lease channel healed: the next save re-acquires and
+            # the instance regains snapshot coverage without a restart.
+            assert svc.snapshot_now()["ok"]
+            assert svc._snapshot_store.lease_stats()["held"]
+        finally:
+            svc.stop()
+
+    def test_backend_latency_slows_but_succeeds(self, tmp_path):
+        svc = self._service(str(tmp_path / "slow"))
+        try:
+            with faults.injected(
+                faults.FaultInjector(0).plan(
+                    "backend.latency", mode="latency", times=1,
+                    delay_s=0.05,
+                )
+            ):
+                assert svc.snapshot_now()["ok"]
+        finally:
+            svc.stop()
+
+    def test_cas_race_storm_never_breaks_serving(self, tmp_path):
+        svc = self._service(
+            str(tmp_path / "cas"), snapshot_lease_ttl_s=30.0,
+        )
+        try:
+            assert svc._last_handoff["acquired"]
+            with client_for(svc) as c:
+                c.stream_assign("s1", "t0", self._rows(1), self.MEMBERS)
+                with faults.injected(
+                    faults.FaultInjector(0).plan("snapshot.cas", times=0)
+                ):
+                    # Every conditional write loses its CAS: the save
+                    # fails open (counted), serving is untouched.
+                    assert not svc.snapshot_now()["ok"]
+                    r = c.stream_assign(
+                        "s1", "t0", self._rows(2), self.MEMBERS
+                    )
+                    assert_valid_assignment(r["assignments"], 256)
+                assert svc.snapshot_now()["ok"]
+        finally:
+            svc.stop()
+
+
 # -- the seeded chaos soak (slow tier) -----------------------------------
 
 
@@ -683,6 +823,13 @@ def test_chaos_soak_random_schedule_bounded_p99():
     points = ["device.solve", "device.compile", "stream.refine",
               "coalesce.flush", "wire.read", "delta.diff",
               "delta.apply"]
+    # The snapshot-backend channel faults alongside the serving
+    # faults: the soak's service snapshots (fenced, memory backend)
+    # every epoch, so partition/CAS/lease/latency failures race live
+    # traffic — they may cost snapshots, never assignments.
+    backend_points = ["backend.partition", "backend.latency",
+                      "snapshot.cas", "snapshot.lease",
+                      "snapshot.write"]
     lags0 = (np.arange(128) + 1) * 50
     topics = {"t0": [[p, int(v)] for p, v in enumerate(lags0)]}
     subs = {"A": ["t0"], "B": ["t0"], "C": ["t0"]}
@@ -690,7 +837,10 @@ def test_chaos_soak_random_schedule_bounded_p99():
     wire_kills = 0
     deadline = time.monotonic() + 30.0
     with AssignorService(
-        port=0, solve_timeout_s=2.0, breaker_cooldown_s=0.5
+        port=0, solve_timeout_s=2.0, breaker_cooldown_s=0.5,
+        snapshot_path="chaos-soak-mem", snapshot_backend="memory",
+        snapshot_lease_ttl_s=5.0, snapshot_interval_s=3600.0,
+        recovery_warmup=False,
     ) as svc:
         c = client_for(svc)
         # A second live stream keeps the soak's stream epochs routed
@@ -718,11 +868,30 @@ def test_chaos_soak_random_schedule_bounded_p99():
                         times=rng.randrange(1, 3),
                         delay_s=rng.choice([0.05, 3.0]),
                     )
+            for point in backend_points:
+                if rng.random() < 0.3:
+                    # The backend channel never hangs unboundedly in
+                    # this schedule (its calls are synchronous on the
+                    # snapshot_now below, outside the request path);
+                    # raise = partition/race, latency = slow link.
+                    inj.plan(
+                        point,
+                        mode=(
+                            "latency" if point == "backend.latency"
+                            else "raise"
+                        ),
+                        times=rng.randrange(1, 3),
+                        delay_s=0.02,
+                    )
             drift = lags0 + np.asarray(
                 [rng.randrange(0, 5000) for _ in range(128)]
             )
             t0 = time.perf_counter()
             with faults.injected(inj):
+                # A fenced snapshot write races every epoch's traffic:
+                # partition/CAS/lease faults may fail it (fail-open,
+                # counted) — the serving assertions below never see it.
+                svc.snapshot_now()
                 try:
                     if epoch % 2:
                         r = c.request(

@@ -286,6 +286,64 @@ def test_lint_bare_write_open_in_package():
     )
 
 
+def test_lint_snapshot_persistence_outside_backend_layer():
+    """L017: package code may not call ``atomic_write_bytes`` outside
+    utils/snapshot.py — snapshot-shaped durable state must flow
+    through the SnapshotBackend interface so CAS + writer fencing
+    police every write."""
+    pkg = Path("kafka_lag_based_assignor_tpu/utils/state.py")
+    bad = (
+        "from .snapshot import atomic_write_bytes\n\n"
+        "def persist(path, data):\n"
+        "    atomic_write_bytes(path, data)\n"
+    )
+    assert any(f.code == "L017" for f in lint.lint_source(pkg, bad))
+    # Dotted addressing counts too.
+    dotted = (
+        "from . import snapshot\n\n"
+        "def persist(path, data):\n"
+        "    snapshot.atomic_write_bytes(path, data)\n"
+    )
+    assert any(f.code == "L017" for f in lint.lint_source(pkg, dotted))
+    # The backend layer itself is exempt (file-level).
+    snap_mod = Path("kafka_lag_based_assignor_tpu/utils/snapshot.py")
+    assert not any(
+        f.code == "L017" for f in lint.lint_source(snap_mod, bad)
+    )
+    # An out-of-module backend implementation is the sanctioned
+    # extension point (enclosing-function-aware, nested included).
+    backend_fn = bad.replace("def persist", "def _my_snapshot_backend")
+    assert not any(
+        f.code == "L017" for f in lint.lint_source(pkg, backend_fn)
+    )
+    nested = (
+        "from .snapshot import atomic_write_bytes\n\n"
+        "def build_snapshot_backend(path):\n"
+        "    def write(data):\n"
+        "        atomic_write_bytes(path, data)\n"
+        "    return write\n"
+    )
+    assert not any(
+        f.code == "L017" for f in lint.lint_source(pkg, nested)
+    )
+    # A waiver silences; tests/tools scaffolding is out of scope.
+    waived = bad.replace(
+        "atomic_write_bytes(path, data)",
+        "atomic_write_bytes(path, data)  # noqa: L017",
+    )
+    assert not any(
+        f.code == "L017" for f in lint.lint_source(pkg, waived)
+    )
+    assert not any(
+        f.code == "L017"
+        for f in lint.lint_source(Path("tests/x.py"), bad)
+    )
+    assert not any(
+        f.code == "L017"
+        for f in lint.lint_source(Path("tools/x.py"), bad)
+    )
+
+
 def test_lint_raw_uploads_in_warm_path_modules():
     """L016: explicit host->device uploads (jax.device_put /
     jnp.asarray) in ops/streaming.py and ops/coalesce.py must live

@@ -182,6 +182,49 @@ def test_controller_rung_actions_by_class():
             assert d_be.action == "reject" and d_std.action == "degrade"
 
 
+def test_recovery_seed_escalates_on_first_decision():
+    """Recovery-aware shed ladder (ROADMAP lifecycle (c)): a seeded
+    depth EWMA makes the FIRST post-boot admission decision escalate —
+    no evaluation-interval wait while the restart stampede queues."""
+    ctl, clock = _controller()  # depth_high = 4.0
+    ctl.seed_recovery_depth(16.0)  # pressure 4.0 -> rung 4
+    d = ctl.admission("best_effort")
+    assert ctl.rung() == 4
+    assert d.action == "reject"
+    # The seed decays through the NORMAL hysteresis if the stampede
+    # never materializes: depth readings of 0 walk the EWMA down and
+    # the ladder steps down one rung per cooldown.
+    for _ in range(40):
+        ctl.note_depth(0.0)
+    clock.t += 1.1
+    ctl.admission("standard")
+    assert ctl.rung() == 3
+
+
+def test_recovery_seed_never_lowers_a_live_reading():
+    ctl, _clock = _controller()
+    for _ in range(30):
+        ctl.note_depth(40.0)  # live EWMA -> ~40
+    ctl.seed_recovery_depth(2.0)  # a SMALLER seed must not regress it
+    with ctl._lock:
+        assert ctl._ewma_depth > 30.0
+
+
+def test_recovery_seed_forces_reevaluation():
+    """The seed clears the rate limiter: even inside eval_interval_s
+    the next admission re-evaluates (the whole point is acting on the
+    first decision)."""
+    clock = FakeClock()
+    ctl = OverloadController(
+        latency_budget_ms=100.0, depth_high=4.0, cooldown_s=1.0,
+        eval_interval_s=60.0, clock=clock,
+    )
+    ctl.admission("standard")  # consumes the rate limiter slot
+    ctl.seed_recovery_depth(16.0)
+    assert ctl.admission("best_effort").action == "reject"
+    assert ctl.rung() == 4
+
+
 def test_controller_deescalates_one_rung_per_cooldown():
     ctl, clock = _controller()
     ctl._ewma_depth = 100.0  # pressure 25 -> rung 4

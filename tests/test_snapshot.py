@@ -38,10 +38,16 @@ from kafka_lag_based_assignor_tpu.testing import assert_valid_assignment
 from kafka_lag_based_assignor_tpu.utils import faults, metrics
 from kafka_lag_based_assignor_tpu.utils.overload import ShedReject
 from kafka_lag_based_assignor_tpu.utils.snapshot import (
+    BACKEND_KINDS,
     SNAPSHOT_VERSION,
+    CASConflict,
+    FsObjectBackend,
+    InMemoryBackend,
+    LeaseHeld,
     SnapshotStore,
     SnapshotWriter,
     atomic_write_bytes,
+    build_backend,
     section_crc,
 )
 
@@ -710,3 +716,742 @@ class TestKillRestartSoak:
                 assert_valid_assignment(r["assignments"], P)
         finally:
             svc.stop()
+
+
+# -- snapshot backends: CAS + fenced writer leases (ISSUE 9) --------------
+
+
+def fake_wall(start=1000.0):
+    """Injectable wall clock for lease-expiry tests: [now], advance by
+    mutating clock[0]."""
+    clock = [start]
+    return clock, (lambda: clock[0])
+
+
+class TestBackends:
+    def test_build_backend_kinds(self, tmp_path):
+        for kind in BACKEND_KINDS:
+            b = build_backend(kind, str(tmp_path / f"b-{kind}"))
+            assert b.kind == kind
+        with pytest.raises(ValueError, match="unknown snapshot backend"):
+            build_backend("s3", str(tmp_path / "x"))
+
+    def test_cas_conflict_loses_cleanly(self, tmp_path):
+        b = InMemoryBackend(str(tmp_path / "cas"))
+        assert b.write_if(b"one", prev_version=0) == 1
+        # The losing writer's data NEVER lands.
+        with pytest.raises(CASConflict):
+            b.write_if(b"racer", prev_version=0)
+        data, version = b.read()
+        assert (data, version) == (b"one", 1)
+        # Unconditional (legacy) writes keep working.
+        assert b.write_if(b"two") == 2
+
+    def test_lease_tokens_monotone_across_expiry_and_release(
+        self, tmp_path
+    ):
+        clock, wall = fake_wall()
+        b = InMemoryBackend(str(tmp_path / "lease"), wall_clock=wall)
+        la = b.acquire_lease("A", ttl_s=5.0)
+        assert la.token == 1
+        # A live foreign lease blocks acquisition.
+        with pytest.raises(LeaseHeld):
+            b.acquire_lease("B", ttl_s=5.0)
+        # Expiry: B takes over with a HIGHER token.
+        clock[0] += 6.0
+        lb = b.acquire_lease("B", ttl_s=5.0)
+        assert lb.token == 2
+        # Release does NOT reset the fencing epoch: the next token is
+        # still higher than every token ever minted (a drained
+        # predecessor's stale token can never collide with a
+        # successor's).
+        b.release_lease(lb)
+        lc = b.acquire_lease("C", ttl_s=5.0)
+        assert lc.token == 3
+        assert b.lease_state()["fence_token"] == 3
+
+    def test_fenced_writer_rejected_loudly_and_counted(self, tmp_path):
+        clock, wall = fake_wall()
+        name = str(tmp_path / "fence")
+        store_a = SnapshotStore(
+            backend=InMemoryBackend(name, wall_clock=wall),
+            wall_clock=wall,
+        )
+        store_a.attach_lease("A", ttl_s=5.0)
+        assert store_a.acquire_lease()["ok"]
+        assert store_a.save({"overload": {"rung": 1}})["ok"]
+        # Crash-equivalent: A never releases; B takes over on expiry.
+        clock[0] += 6.0
+        store_b = SnapshotStore(
+            backend=InMemoryBackend(name, wall_clock=wall),
+            wall_clock=wall,
+        )
+        store_b.attach_lease("B", ttl_s=5.0)
+        res = store_b.acquire_lease()
+        assert res["ok"] and res["previous_holder"] == "A"
+        assert res["previous_expired"]
+        assert store_b.save({"overload": {"rung": 2}})["ok"]
+        # The fenced-off predecessor's write is REJECTED and counted;
+        # the adopted state is untouched.
+        before = counter_value(
+            "klba_snapshot_writes_total", outcome="fenced"
+        )
+        info = store_a.save({"overload": {"rung": 9}})
+        assert not info["ok"] and info.get("fenced")
+        assert counter_value(
+            "klba_snapshot_writes_total", outcome="fenced"
+        ) == before + 1
+        assert store_b.load().sections == {"overload": {"rung": 2}}
+
+    def test_fencing_without_lease_denies_writes(self, tmp_path):
+        """With the lease held by a LIVE foreign owner, a store that
+        never acquired it has its writes denied (the per-save
+        re-acquisition keeps failing on LeaseHeld) — and loads stay
+        lease-free (recovery may always LOOK)."""
+        name = str(tmp_path / "nl")
+        holder = SnapshotStore(backend=InMemoryBackend(name))
+        holder.attach_lease("holder", ttl_s=1e9)
+        assert holder.acquire_lease()["ok"]
+        store = SnapshotStore(backend=InMemoryBackend(name))
+        store.attach_lease("A", ttl_s=5.0)
+        before = counter_value(
+            "klba_snapshot_writes_total", outcome="no_lease"
+        )
+        info = store.save({"overload": {"rung": 1}})
+        assert not info["ok"] and info["denied"] == "no_lease"
+        assert counter_value(
+            "klba_snapshot_writes_total", outcome="no_lease"
+        ) == before + 1
+        assert store.load().outcome == "missing"
+
+    def test_lease_expiry_mid_write_now(self, tmp_path):
+        """The failure-matrix row: a lease that EXPIRES mid-cadence.
+        Unsuperseded, the write still lands (the token, not the clock,
+        is the authority — and the save renews the lease); superseded,
+        the write is fenced and the adopted state is intact."""
+        clock, wall = fake_wall()
+        name = str(tmp_path / "expiry")
+        store_a = SnapshotStore(
+            backend=InMemoryBackend(name, wall_clock=wall),
+            wall_clock=wall,
+        )
+        store_a.attach_lease("A", ttl_s=5.0)
+        assert store_a.acquire_lease()["ok"]
+        # Expired but unclaimed: save succeeds AND renews.
+        clock[0] += 6.0
+        assert store_a.save({"s": {"v": 1}})["ok"]
+        lease = store_a.backend.read_lease()
+        assert lease.owner == "A" and lease.expires_at > clock[0]
+        # Expired AND superseded: fenced, adopted state intact.
+        clock[0] += 6.0
+        store_b = SnapshotStore(
+            backend=InMemoryBackend(name, wall_clock=wall),
+            wall_clock=wall,
+        )
+        store_b.attach_lease("B", ttl_s=5.0)
+        assert store_b.acquire_lease()["ok"]
+        assert store_b.save({"s": {"v": 2}})["ok"]
+        info = store_a.save({"s": {"v": 99}})
+        assert not info["ok"] and info.get("fenced")
+        assert store_b.load().sections == {"s": {"v": 2}}
+
+    def test_injected_cas_race_retries_once_then_fails_open(
+        self, tmp_path
+    ):
+        store = SnapshotStore(
+            backend=InMemoryBackend(str(tmp_path / "casf"))
+        )
+        store.attach_lease("A", ttl_s=30.0)
+        assert store.acquire_lease()["ok"]
+        before = counter_value("klba_snapshot_cas_conflicts_total")
+        # One injected race: the retry (fresh version read) wins.
+        with faults.injected(
+            faults.FaultInjector(0).plan("snapshot.cas", times=1)
+        ):
+            assert store.save({"s": {"v": 1}})["ok"]
+        assert counter_value(
+            "klba_snapshot_cas_conflicts_total"
+        ) == before + 1
+        # A race storm (every attempt loses): the save fails OPEN as a
+        # counted error — serving is never taken down.
+        err_before = counter_value(
+            "klba_snapshot_writes_total", outcome="error"
+        )
+        with faults.injected(
+            faults.FaultInjector(0).plan("snapshot.cas", times=0)
+        ):
+            info = store.save({"s": {"v": 2}})
+        assert not info["ok"] and not info.get("fenced")
+        assert counter_value(
+            "klba_snapshot_writes_total", outcome="error"
+        ) == err_before + 1
+        assert store.load().sections == {"s": {"v": 1}}
+
+    def test_partitioned_backend_fails_open(self, tmp_path):
+        store = SnapshotStore(
+            backend=InMemoryBackend(str(tmp_path / "part"))
+        )
+        assert store.save({"s": {"v": 1}})["ok"]
+        err_before = counter_value(
+            "klba_snapshot_writes_total", outcome="error"
+        )
+        with faults.injected(
+            faults.FaultInjector(0).plan("backend.partition", times=0)
+        ):
+            assert not store.save({"s": {"v": 2}})["ok"]
+            assert store.load().outcome == "cold"
+        assert counter_value(
+            "klba_snapshot_writes_total", outcome="error"
+        ) == err_before + 1
+        # Partition heals: the state written before it is intact.
+        assert store.load().sections == {"s": {"v": 1}}
+
+    def test_object_backend_round_trip_and_generations(self, tmp_path):
+        d = str(tmp_path / "obj")
+        store = SnapshotStore(backend=FsObjectBackend(d))
+        for i in range(4):
+            assert store.save({"s": {"v": i}})["ok"]
+        # A SECOND instance (fresh process equivalent) reads the same
+        # state through the directory.
+        other = SnapshotStore(backend=FsObjectBackend(d))
+        assert other.load().sections == {"s": {"v": 3}}
+        # Old generations are GC'd to the keep window.
+        objects = [
+            f for f in os.listdir(d) if f.startswith("snapshot.v")
+        ]
+        assert len(objects) <= FsObjectBackend.KEEP_OBJECTS
+
+    def test_object_backend_torn_write_fails_open(self, tmp_path):
+        d = str(tmp_path / "torn")
+        store = SnapshotStore(backend=FsObjectBackend(d))
+        assert store.save({"s": {"v": 1}})["ok"]
+        version = store.backend.version()
+        obj = os.path.join(d, f"snapshot.v{version}")
+        data = open(obj, "rb").read()
+        # Torn object (truncated mid-document): a counted cold start,
+        # never an exception; the meta/version channel is intact.
+        atomic_write_bytes(obj, data[: len(data) // 2])
+        assert store.load().outcome == "cold"
+        assert store.backend.version() == version
+        # Meta pointing at a MISSING object: a counted missing load.
+        os.unlink(obj)
+        assert store.load().outcome == "missing"
+        # The next save heals both.
+        assert store.save({"s": {"v": 2}})["ok"]
+        assert store.load().sections == {"s": {"v": 2}}
+
+    def test_fs_mutex_breaks_stale_and_release_is_ownership_safe(
+        self, tmp_path
+    ):
+        from kafka_lag_based_assignor_tpu.utils.snapshot import (
+            _FsMutex,
+        )
+
+        lock = str(tmp_path / "lock")
+        # A stale lock (holder crashed mid-RMW) is broken and
+        # acquired.
+        with open(lock, "w") as f:  # noqa: test scaffolding
+            f.write("dead-holder")
+        os.utime(lock, (time.time() - 60.0, time.time() - 60.0))
+        m = _FsMutex(lock, time.time, timeout_s=1.0, stale_s=5.0)
+        m.__enter__()
+        assert open(lock).read() == m._token
+        # Release verifies ownership: if a peer broke us as stale and
+        # a successor holds the path, our exit leaves the LIVE lock
+        # alone.
+        with open(lock, "w") as f:  # noqa: successor's lock
+            f.write("successor")
+        m.__exit__(None, None, None)
+        assert open(lock).read() == "successor"
+        os.unlink(lock)
+        # Normal enter/exit cleans up after itself.
+        with _FsMutex(lock, time.time):
+            assert os.path.exists(lock)
+        assert not os.path.exists(lock)
+
+    def test_file_backend_fencing_is_cross_instance(self, tmp_path):
+        """Two FileBackend INSTANCES on one path (two processes on one
+        host) share the fencing state through the sidecar meta: a live
+        foreign lease blocks, expiry takes over with a bumped token,
+        and the stale instance's writes are fenced."""
+        from kafka_lag_based_assignor_tpu.utils.snapshot import (
+            FencedWriter,
+            FileBackend,
+        )
+
+        clock, wall = fake_wall()
+        p = str(tmp_path / "snap.json")
+        ba = FileBackend(p, wall_clock=wall)
+        bb = FileBackend(p, wall_clock=wall)
+        la = ba.acquire_lease("A", ttl_s=5.0)
+        with pytest.raises(LeaseHeld):
+            bb.acquire_lease("B", ttl_s=5.0)
+        assert ba.write_if(b"{}", token=la.token) == 1
+        clock[0] += 6.0
+        lb = bb.acquire_lease("B", ttl_s=5.0)
+        assert lb.token == la.token + 1
+        with pytest.raises(FencedWriter):
+            ba.write_if(b"stale", token=la.token)
+        # The RMW lock file never lingers between operations.
+        assert "snap.json.lock" not in os.listdir(tmp_path)
+
+    def test_unreadable_file_is_cold_not_missing(self, tmp_path):
+        """A real I/O fault (here: the path is a directory) must load
+        as a logged COLD start, never masquerade as the clean
+        'missing' of a fresh install."""
+        path = str(tmp_path / "snapdir")
+        os.makedirs(path)
+        result = SnapshotStore(path).load()
+        assert result.outcome == "cold"
+        assert result.reason
+
+    def test_save_reacquires_lease_after_failed_boot_acquire(
+        self, tmp_path
+    ):
+        """A boot whose lease acquisition failed (backend blip) must
+        not run uncovered forever: the next save re-tries the
+        acquisition and regains snapshot coverage."""
+        store = SnapshotStore(
+            backend=InMemoryBackend(str(tmp_path / "reacq"))
+        )
+        store.attach_lease("A", ttl_s=30.0)
+        with faults.injected(
+            faults.FaultInjector(0).plan("snapshot.lease", times=1)
+        ):
+            assert not store.acquire_lease(wait_s=0.0)["ok"]
+        assert store._lease is None
+        # The backend healed: the very next save acquires and writes.
+        assert store.save({"s": {"v": 1}})["ok"]
+        assert store._lease is not None
+        assert store.load().sections == {"s": {"v": 1}}
+
+    def test_file_backend_sidecar_only_with_fencing(self, tmp_path):
+        # Unfenced: exactly the round-12 one-file layout.
+        p = str(tmp_path / "snap.json")
+        store = SnapshotStore(p)
+        assert store.save({"s": {"v": 1}})["ok"]
+        assert sorted(os.listdir(tmp_path)) == ["snap.json"]
+        # Fencing engaged: the sidecar meta appears and fences a
+        # second instance's stale writes cross-store.
+        store.attach_lease("A", ttl_s=30.0)
+        assert store.acquire_lease()["ok"]
+        assert store.save({"s": {"v": 2}})["ok"]
+        assert "snap.json.meta" in os.listdir(tmp_path)
+        assert json.loads(open(p).read())["sections"]["s"]["body"] == {
+            "v": 2
+        }
+
+
+class TestConcurrentWriterSoak:
+    def test_two_instance_concurrent_writers_never_overwrite_adopted(
+        self, tmp_path
+    ):
+        """Two stores hammer one backend concurrently — the CURRENT
+        lease holder (B) and a fenced-off predecessor (A).  The
+        adopted state is NEVER overwritten: every observable snapshot
+        is one of B's, A's attempts all land in the fenced counter,
+        and the object version advances exactly once per B success."""
+        clock, wall = fake_wall()
+        name = str(tmp_path / "soak")
+        store_a = SnapshotStore(
+            backend=InMemoryBackend(name, wall_clock=wall),
+            wall_clock=wall,
+        )
+        store_a.attach_lease("A", ttl_s=5.0)
+        assert store_a.acquire_lease()["ok"]
+        assert store_a.save({"who": {"writer": "A"}})["ok"]
+        clock[0] += 6.0  # A crashed; its lease expires
+        store_b = SnapshotStore(
+            backend=InMemoryBackend(name, wall_clock=wall),
+            wall_clock=wall,
+        )
+        store_b.attach_lease("B", ttl_s=1e9)
+        assert store_b.acquire_lease()["ok"]
+        assert store_b.save({"who": {"writer": "B"}})["ok"]
+        version0 = store_b.backend.version()
+
+        fenced_before = counter_value(
+            "klba_snapshot_writes_total", outcome="fenced"
+        )
+        rounds = 40
+        b_ok = [0]
+        observed = []
+        stop = threading.Event()
+
+        def hammer(store, marker, ok_cell):
+            for i in range(rounds):
+                info = store.save(
+                    {"who": {"writer": marker, "i": i}}
+                )
+                if info["ok"] and ok_cell is not None:
+                    ok_cell[0] += 1
+
+        def reader():
+            while not stop.is_set():
+                result = store_b.load()
+                if result.sections:
+                    observed.append(result.sections["who"]["writer"])
+
+        threads = [
+            threading.Thread(target=hammer, args=(store_a, "A", None)),
+            threading.Thread(target=hammer, args=(store_b, "B", b_ok)),
+            threading.Thread(target=reader),
+        ]
+        for t in threads:
+            t.start()
+        threads[0].join(timeout=30.0)
+        threads[1].join(timeout=30.0)
+        stop.set()
+        threads[2].join(timeout=30.0)
+
+        # Every A attempt was fenced; zero adopted-state overwrites.
+        assert counter_value(
+            "klba_snapshot_writes_total", outcome="fenced"
+        ) == fenced_before + rounds
+        assert b_ok[0] == rounds
+        assert store_b.backend.version() == version0 + rounds
+        assert store_b.load().sections["who"]["writer"] == "B"
+        assert observed and set(observed) == {"B"}
+
+
+# -- service end-to-end: cross-host takeover ------------------------------
+
+
+class TestTakeover:
+    def _warm_service(self, name, streams, **kw):
+        """Boot a memory-backend fenced service, serve two epochs per
+        stream, snapshot; returns (service, {sid: choice})."""
+        svc = service_for(
+            name, snapshot_backend="memory",
+            snapshot_lease_ttl_s=kw.pop("lease_ttl_s", 0.4),
+            snapshot_lease_wait_s=kw.pop("lease_wait_s", 10.0), **kw,
+        )
+        with AssignorServiceClient(*svc.address) as c:
+            for i, sid in enumerate(streams):
+                c.stream_assign(sid, "t0", rows(lags_case(i)), MEMBERS)
+                c.stream_assign(
+                    sid, "t0", rows(lags_case(50 + i)), MEMBERS
+                )
+        assert svc.snapshot_now()["ok"]
+        choices = {
+            sid: svc._streams[sid].engine.export_state()
+            for sid in streams
+        }
+        return svc, choices
+
+    def test_crash_takeover_bit_exact_and_fenced_predecessor(
+        self, tmp_path
+    ):
+        name = str(tmp_path / "crash")
+        streams = ("s1", "s2")
+        svc_a, choices = self._warm_service(name, streams)
+        svc_a.stop()  # crash: the lease is NOT released
+
+        next_lags = {
+            sid: lags_case(700 + i) for i, sid in enumerate(streams)
+        }
+        expected = {}
+        for sid in streams:
+            base = StreamingAssignor(
+                num_consumers=C, imbalance_guardrail=1.25
+            )
+            base.seed_choice(choices[sid])
+            expected[sid] = np.asarray(base.rebalance(next_lags[sid]))
+
+        svc_b = service_for(
+            name, snapshot_backend="memory",
+            snapshot_lease_ttl_s=0.4, snapshot_lease_wait_s=10.0,
+        )
+        try:
+            handoff = svc_b._last_handoff
+            assert handoff["acquired"]
+            assert handoff["mode"] == "takeover_crash"
+            assert handoff["previous_holder"] is not None
+            assert svc_b._last_recovery["streams_recovered"] == 2
+            # The fenced-off predecessor can never write a stale
+            # snapshot over the replacement's adopted state.
+            before = counter_value(
+                "klba_snapshot_writes_total", outcome="fenced"
+            )
+            stale = svc_a.snapshot_now()
+            assert not stale["ok"] and stale.get("fenced")
+            assert counter_value(
+                "klba_snapshot_writes_total", outcome="fenced"
+            ) == before + 1
+            # The replacement answers first epochs bit-identical to
+            # the uninterrupted baseline.
+            with AssignorServiceClient(*svc_b.address) as c:
+                for sid in streams:
+                    r = c.stream_assign(
+                        sid, "t0", rows(next_lags[sid]), MEMBERS
+                    )
+                    assert r["stream"]["warm_restart"]
+                    got = choice_from(r["assignments"], MEMBERS, P)
+                    np.testing.assert_array_equal(got, expected[sid])
+                # The lifecycle surface reports the hand-off.
+                lc = c.request("stats")["lifecycle"]
+                assert lc["lease"]["held"]
+                assert lc["handoff"]["mode"] == "takeover_crash"
+        finally:
+            svc_b.stop()
+
+    def test_drain_handoff_adopts_instantly(self, tmp_path):
+        name = str(tmp_path / "drain")
+        svc_a, _ = self._warm_service(
+            name, ("s1",), lease_ttl_s=30.0, drain_timeout_s=5.0
+        )
+        assert svc_a.begin_drain()
+        assert svc_a.wait_stopped(15.0)
+        svc_b = service_for(
+            name, snapshot_backend="memory",
+            snapshot_lease_ttl_s=30.0, snapshot_lease_wait_s=10.0,
+        )
+        try:
+            handoff = svc_b._last_handoff
+            # The drain RELEASED the lease: no TTL wait, and the mode
+            # says hand-off, not crash.
+            assert handoff["mode"] == "takeover_drain"
+            assert handoff["waited_ms"] < 5_000.0
+            assert svc_b._last_recovery["streams_recovered"] == 1
+            with AssignorServiceClient(*svc_b.address) as c:
+                r = c.stream_assign(
+                    "s1", "t0", rows(lags_case(9)), MEMBERS
+                )
+                assert r["stream"]["warm_restart"]
+        finally:
+            svc_b.stop()
+
+    def test_unacquirable_lease_fails_open_to_serving(self, tmp_path):
+        """A backend whose lease cannot be acquired (the predecessor
+        is alive and well) must never block serving: the late boot
+        serves cold with snapshot writes denied."""
+        name = str(tmp_path / "contend")
+        svc_a, _ = self._warm_service(name, ("s1",), lease_ttl_s=30.0)
+        try:
+            svc_b = service_for(
+                name, snapshot_backend="memory",
+                snapshot_lease_ttl_s=30.0, snapshot_lease_wait_s=0.2,
+            )
+            try:
+                assert not svc_b._last_handoff["acquired"]
+                with AssignorServiceClient(*svc_b.address) as c:
+                    assert c.ping()
+                    r = c.stream_assign(
+                        "x", "t0", rows(lags_case(3)), MEMBERS
+                    )
+                    assert_valid_assignment(r["assignments"], P)
+                denied = svc_b.snapshot_now()
+                assert not denied["ok"]
+                assert denied.get("denied") == "no_lease"
+            finally:
+                svc_b.stop()
+        finally:
+            svc_a.stop()
+
+    def test_recovery_seeds_overload_depth_ewma(self, tmp_path):
+        """ROADMAP lifecycle (c): the boot seeds the depth EWMA from
+        the recovered-stream count, so a restart under a live stampede
+        escalates on the FIRST admission decision."""
+        name = str(tmp_path / "seed")
+        svc_a, _ = self._warm_service(name, ("s1", "s2", "s3"))
+        svc_a.stop()
+        svc_b = service_for(
+            name, snapshot_backend="memory",
+            snapshot_lease_ttl_s=0.4, snapshot_lease_wait_s=10.0,
+            overload_depth_high=1.0,
+        )
+        try:
+            rec = svc_b._last_recovery
+            assert rec["streams_recovered"] == 3
+            # 3 standard-class streams x weight 2.0.
+            assert rec["seeded_depth"] == pytest.approx(6.0)
+            snap = svc_b._overload.snapshot()
+            assert snap["ewma_depth"] == pytest.approx(6.0)
+            # First post-boot decision: with depth_high=1 the seeded
+            # pressure (6.0) pins the ladder at its deepest rung
+            # IMMEDIATELY — a best_effort arrival is shed, no
+            # evaluation-interval wait.
+            decision = svc_b._overload.admission("best_effort")
+            assert decision.action == "reject"
+            assert svc_b._overload.rung() == 4
+        finally:
+            svc_b.stop()
+
+
+# -- post-restart resync pacing -------------------------------------------
+
+
+class TestResyncPacing:
+    def test_restart_wave_is_paced_not_serialized(self, tmp_path):
+        """ROADMAP delta follow-on (c): a restart wave's dense
+        re-syncs are capped at resync_max_inflight concurrent
+        rebuilds; excess epochs wait (counted) instead of the whole
+        wave serializing the device behind one dense mega-wave."""
+        name = str(tmp_path / "pace")
+        streams = [f"s{i}" for i in range(6)]
+        svc_a = service_for(name, snapshot_backend="memory")
+        with AssignorServiceClient(*svc_a.address) as c:
+            for i, sid in enumerate(streams):
+                c.stream_assign(sid, "t0", rows(lags_case(i)), MEMBERS)
+        assert svc_a.snapshot_now()["ok"]
+        svc_a.stop()
+
+        svc_b = service_for(
+            name, snapshot_backend="memory", resync_max_inflight=2
+        )
+        try:
+            assert svc_b._last_recovery["streams_recovered"] == len(
+                streams
+            )
+            paced0 = counter_value("klba_resync_paced_total")
+            results = {}
+            errors = []
+
+            def storm(sid, i):
+                cl = AssignorServiceClient(
+                    *svc_b.address, timeout_s=120.0
+                )
+                try:
+                    results[sid] = cl.stream_assign(
+                        sid, "t0", rows(lags_case(600 + i)), MEMBERS
+                    )
+                except Exception as exc:  # noqa: BLE001 — verdict
+                    errors.append(exc)
+                finally:
+                    cl._close_quietly()
+
+            threads = [
+                threading.Thread(target=storm, args=(sid, i))
+                for i, sid in enumerate(streams)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60.0)
+            assert not errors, errors
+            assert len(results) == len(streams)
+            for sid in streams:
+                assert results[sid]["stream"]["warm_restart"]
+                assert_valid_assignment(
+                    results[sid]["assignments"], P
+                )
+            # The cap BOUND the concurrency, and at least one epoch
+            # actually waited its turn.
+            assert svc_b._resync_pacer.high_water <= 2
+            assert counter_value("klba_resync_paced_total") > paced0
+        finally:
+            svc_b.stop()
+
+    def test_pacing_disabled_with_zero_cap(self, tmp_path):
+        svc = service_for(
+            str(tmp_path / "nopace"), snapshot_backend="memory",
+            resync_max_inflight=0,
+        )
+        try:
+            assert svc._resync_pacer is None
+        finally:
+            svc.stop()
+
+    def test_prestack_builds_residents_off_serving_path(self, tmp_path):
+        """ROADMAP lifecycle (b): recovery_prestack rebuilds each
+        recovered engine's device-resident state at boot — the storm's
+        first epochs then need no dense rebuild (and the first answer
+        stays bit-identical to the lazily-rebuilt path's)."""
+        name = str(tmp_path / "prestack")
+        streams = ("s1", "s2")
+        svc_a = service_for(name, snapshot_backend="memory")
+        with AssignorServiceClient(*svc_a.address) as c:
+            for i, sid in enumerate(streams):
+                c.stream_assign(sid, "t0", rows(lags_case(i)), MEMBERS)
+        assert svc_a.snapshot_now()["ok"]
+        choices = {
+            sid: svc_a._streams[sid].engine.export_state()
+            for sid in streams
+        }
+        svc_a.stop()
+
+        next_lags = {
+            sid: lags_case(800 + i) for i, sid in enumerate(streams)
+        }
+        expected = {}
+        for sid in streams:
+            base = StreamingAssignor(
+                num_consumers=C, imbalance_guardrail=1.25
+            )
+            base.seed_choice(choices[sid])
+            expected[sid] = np.asarray(base.rebalance(next_lags[sid]))
+
+        svc_b = service_for(
+            name, snapshot_backend="memory", recovery_prestack=True
+        )
+        try:
+            assert svc_b._last_recovery["streams_prestacked"] == 2
+            for sid in streams:
+                engine = svc_b._streams[sid].engine
+                assert engine._resident is not None
+                assert not engine.needs_dense_resync
+            with AssignorServiceClient(*svc_b.address) as c:
+                for sid in streams:
+                    r = c.stream_assign(
+                        sid, "t0", rows(next_lags[sid]), MEMBERS
+                    )
+                    assert r["stream"]["warm_restart"]
+                    got = choice_from(r["assignments"], MEMBERS, P)
+                    np.testing.assert_array_equal(got, expected[sid])
+        finally:
+            svc_b.stop()
+
+
+# -- config / from_config wiring ------------------------------------------
+
+
+class TestHandoffConfig:
+    def test_parse_config_handoff_knobs(self):
+        from kafka_lag_based_assignor_tpu.utils.config import (
+            parse_config,
+        )
+
+        cfg = parse_config({
+            "group.id": "g",
+            "tpu.assignor.snapshot.path": "/tmp/x",
+            "tpu.assignor.snapshot.backend": "object",
+            "tpu.assignor.snapshot.lease.ttl.ms": "15000",
+            "tpu.assignor.snapshot.lease.wait.ms": "45000",
+            "tpu.assignor.resync.max.inflight": "4",
+            "tpu.assignor.recovery.prestack": "true",
+        })
+        assert cfg.snapshot_backend == "object"
+        assert cfg.snapshot_lease_ttl_s == pytest.approx(15.0)
+        assert cfg.snapshot_lease_wait_s == pytest.approx(45.0)
+        assert cfg.resync_max_inflight == 4
+        assert cfg.recovery_prestack is True
+        with pytest.raises(ValueError, match="snapshot.backend"):
+            parse_config({
+                "group.id": "g",
+                "tpu.assignor.snapshot.backend": "s3",
+            })
+
+    def test_from_config_wires_handoff_knobs(self, tmp_path):
+        svc = AssignorService.from_config(
+            {
+                "group.id": "g",
+                "tpu.assignor.snapshot.path": str(tmp_path / "ho"),
+                "tpu.assignor.snapshot.backend": "memory",
+                "tpu.assignor.snapshot.lease.ttl.ms": "30000",
+                "tpu.assignor.resync.max.inflight": "3",
+            },
+            port=0,
+        )
+        try:
+            assert svc._snapshot_store.backend.kind == "memory"
+            assert svc._snapshot_store.fencing_enabled
+            assert svc._resync_pacer.max_inflight == 3
+        finally:
+            svc.stop()
+
+    def test_invalid_backend_kind_fails_boot(self, tmp_path):
+        with pytest.raises(ValueError, match="snapshot_backend"):
+            AssignorService(
+                port=0, snapshot_path=str(tmp_path / "x"),
+                snapshot_backend="s3",
+            )

@@ -214,6 +214,46 @@ def main() -> int:
                     f"discarded={rec.get('streams_discarded')} "
                     f"in {rec.get('duration_ms', 0):.1f} ms"
                 )
+            # Cross-host hand-off view: who holds the fenced writer
+            # lease (and for how long), the last hand-off's outcome,
+            # and whether any fenced/paced events fired — the "is this
+            # instance the legitimate owner of the warm state" look
+            # (DEPLOYMENT.md "Cross-host hand-off").
+            lease = lifecycle.get("lease")
+            if lease and lease.get("enabled"):
+                holder = lease.get("holder")
+                if holder is None:
+                    print("lease: no current holder")
+                else:
+                    age = lease.get("holder_age_s")
+                    age_txt = (
+                        f"{age:.1f}s" if age is not None else "?"
+                    )
+                    print(
+                        f"lease: holder={holder} "
+                        f"token={lease.get('holder_token')} "
+                        f"age={age_txt} held_by_me="
+                        f"{lease.get('held')}"
+                    )
+            handoff = lifecycle.get("handoff")
+            if handoff:
+                print(
+                    f"last hand-off: mode={handoff.get('mode')} "
+                    f"acquired={handoff.get('acquired')} "
+                    f"waited={handoff.get('waited_ms', 0):.0f} ms "
+                    f"from={handoff.get('previous_holder')}"
+                )
+            writes = by_label("klba_snapshot_writes_total", "outcome")
+            fenced = int(writes.get("fenced", 0))
+            denied = int(writes.get("no_lease", 0))
+            if fenced or denied:
+                print(
+                    f"fenced snapshot writes: {fenced} rejected, "
+                    f"{denied} denied without lease"
+                )
+            paced = counter_total("klba_resync_paced_total")
+            if paced:
+                print(f"resync epochs paced: {int(paced)}")
         return 0
     print(json.dumps(result["json"], indent=2, sort_keys=True))
     return 0

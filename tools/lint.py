@@ -65,6 +65,19 @@ Rules:
         upload code must route through (or become) a designated
         helper, or carry a ``# noqa: L016`` waiver stating why its
         bytes need no accounting.
+  L017  snapshot persistence outside the backend layer: package code
+        may not call ``atomic_write_bytes`` outside utils/snapshot.py
+        — snapshot payloads (and any other durable state that could be
+        adopted by a replacement instance) must flow through the
+        ``SnapshotBackend`` interface so versioned CAS and writer
+        fencing actually police EVERY write (a raw atomic write from
+        a fenced-off instance would silently clobber the adopted
+        state).  Allowed inside functions whose name contains
+        ``snapshot_backend`` (an out-of-module backend implementation
+        is the legitimate extension point); anything else needs a
+        ``# noqa: L017`` waiver stating why the write is not
+        snapshot-shaped state.  Raw write-mode opens of snapshot
+        payloads are already L015's territory.
 """
 
 from __future__ import annotations
@@ -330,6 +343,53 @@ def _l015_findings(rel: str, tree: ast.AST, lines: List[str]) -> List[Finding]:
     return findings
 
 
+def _is_atomic_write_call(node: ast.Call) -> bool:
+    """True for ``atomic_write_bytes(...)`` however addressed
+    (bare name or any dotted base)."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr == "atomic_write_bytes"
+    if isinstance(func, ast.Name):
+        return func.id == "atomic_write_bytes"
+    return False
+
+
+def _l017_findings(rel: str, tree: ast.AST, lines: List[str]) -> List[Finding]:
+    """Walk with enclosing-function context (the L013 pattern):
+    ``atomic_write_bytes`` calls in package code outside
+    utils/snapshot.py are allowed only inside a function implementing
+    a snapshot backend."""
+    findings: List[Finding] = []
+
+    def visit(node: ast.AST, in_backend: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_scope = in_backend
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child_scope = in_backend or "snapshot_backend" in child.name
+            if (
+                isinstance(child, ast.Call)
+                and not in_backend
+                and _is_atomic_write_call(child)
+                and "noqa: L017" not in lines[child.lineno - 1]
+            ):
+                findings.append(
+                    Finding(
+                        rel,
+                        child.lineno,
+                        "L017",
+                        "snapshot persistence outside the backend "
+                        "layer: go through the SnapshotBackend "
+                        "interface (utils/snapshot) so CAS + writer "
+                        "fencing police the write (or waive with "
+                        "`# noqa: L017`)",
+                    )
+                )
+            visit(child, child_scope)
+
+    visit(tree, False)
+    return findings
+
+
 _UNBOUNDED_QUEUE_TYPES = ("Queue", "LifoQueue", "PriorityQueue")
 
 
@@ -492,6 +552,11 @@ def lint_source(path: Path, source: str) -> List[Finding]:
     if is_package:
         findings.extend(_l014_list_buffer_findings(rel, tree, lines))
         findings.extend(_l015_findings(rel, tree, lines))
+    # L017 applies to package code OUTSIDE utils/snapshot.py (the
+    # backend layer owns the raw atomic write; everyone else must go
+    # through a SnapshotBackend so fencing polices the write).
+    if is_package and path.name != "snapshot.py":
+        findings.extend(_l017_findings(rel, tree, lines))
     # The two clock-owning modules: stopwatch/span live there, so direct
     # perf_counter use is their implementation, not a violation.
     clock_exempt = path.name in ("metrics.py", "observability.py")
