@@ -1720,6 +1720,400 @@ def config10_handoff():
     }
 
 
+def config11_scrub():
+    """Corruption-storm probe (ISSUE 11): seeded bit-flips into every
+    resident buffer class (choice / counts / lags), on BOTH the
+    single-stream inline path and a locked megabatch row, against a
+    real sidecar.  What must hold (gated in main, every backend):
+    every injected corruption is detected within one serving epoch
+    (dispatch-input digest / delta conservation) or one scrub pass
+    (idle-state audit), every quarantined stream heals BIT-EXACT vs an
+    uncorrupted twin seeded from the same host truth, zero invalid
+    (count-imbalanced) assignments are ever served, the measured storm
+    round compiles nothing (the rehearsal round pays any first-touch
+    compiles), and the per-epoch host-side digest verification costs
+    < 1% of the warm no-op epoch."""
+    import concurrent.futures as cf
+
+    from kafka_lag_based_assignor_tpu.ops.streaming import (
+        StreamingAssignor,
+    )
+    from kafka_lag_based_assignor_tpu.service import (
+        AssignorService,
+        AssignorServiceClient,
+    )
+    from kafka_lag_based_assignor_tpu.testing import (
+        assert_valid_assignment,
+    )
+    from kafka_lag_based_assignor_tpu.utils import faults
+    from kafka_lag_based_assignor_tpu.utils import metrics as m
+    from kafka_lag_based_assignor_tpu.utils import scrub as scrub_mod
+    from kafka_lag_based_assignor_tpu.utils.observability import (
+        compile_count,
+        install_compile_counter,
+    )
+
+    install_compile_counter()
+    P, C, N = 2048, 8, 4
+    members = [f"m{j}" for j in range(C)]
+    # Deterministic detection: every epoch must DISPATCH the warm
+    # resident path (the host no-op gate would defer detection to the
+    # scrubber) and a guardrail trip would cold-resolve the corruption
+    # away before the digest sees it.
+    OPTS = {"guardrail": None, "refine_threshold": None}
+    rng = np.random.default_rng(0x5C12B)
+    BUFFERS = ("choice", "counts", "lags")
+
+    def rows(arr):
+        return [[i, int(v)] for i, v in enumerate(arr)]
+
+    def fresh():
+        return rng.integers(0, 10**6, P).astype(np.int64)
+
+    def q_total(outcome):
+        return sum(
+            c.value
+            for c in m.REGISTRY.series("klba_quarantine_total")
+            if c.labels.get("outcome") == outcome
+        )
+
+    def decode(assignments):
+        midx = {mm: j for j, mm in enumerate(members)}
+        got = np.full(P, -1, np.int32)
+        for mm, tps in assignments.items():
+            for _t, p in tps:
+                got[p] = midx[mm]
+        return got
+
+    injected = [0]
+    detected = [0]
+    invalid = [0]
+    heal_mismatch = [0]
+    late_detections = [0]
+    seedseq = iter(range(100, 200))
+
+    def twin_expect(prev, lags):
+        twin = StreamingAssignor(
+            num_consumers=C, refine_threshold=None,
+        )
+        twin.seed_choice(prev)
+        return np.asarray(twin.rebalance(lags))
+
+    # ---- Phase A: single-stream inline lanes ------------------------
+    # Short breaker cooldown: the storm drives MANY corruption events
+    # back-to-back on purpose, and escalation correctly trips the
+    # stream breaker on un-forgiven strikes — each lane below also
+    # serves FORGIVE_AFTER clean epochs so its strikes read as
+    # isolated events, the scenario the per-lane gates score.
+    svc_a = AssignorService(
+        port=0, coalesce_max_batch=1, scrub_interval_ms=3600_000.0,
+        breaker_cooldown_s=0.5,
+    ).start()
+    ca = AssignorServiceClient(*svc_a.address, timeout_s=300.0)
+
+    def epoch_a(check=True):
+        r = ca.stream_assign("a0", "t0", rows(fresh()), members,
+                             options=OPTS)
+        if check:
+            try:
+                assert_valid_assignment(r["assignments"], P)
+            except AssertionError:
+                invalid[0] += 1
+        return r
+
+    def storm_a(record=True):
+        for buffer in BUFFERS:
+            inj = faults.FaultInjector(seed=next(seedseq)).plan(
+                f"device.corrupt.{buffer}", mode="raise", times=1
+            )
+            with faults.injected(inj):
+                epoch_a()  # the corruption lands at this adopt
+            if record:
+                injected[0] += inj.fired(f"device.corrupt.{buffer}")
+            engine = svc_a._streams["a0"].engine
+            if buffer == "lags":
+                # The resident lag buffer is consulted by delta
+                # dispatches only — the SCRUBBER is the detection lane
+                # for an idle stream: one pass must quarantine it.
+                q0 = q_total("quarantined")
+                svc_a._scrubber.scrub_once()
+                if record:
+                    if q_total("quarantined") - q0 >= 1:
+                        detected[0] += 1
+                    else:
+                        late_detections[0] += 1
+            else:
+                # Dispatch-input digest: the FIRST epoch over the
+                # corrupt buffer serves kept_previous (never the
+                # corrupt state) — detection within one serving epoch.
+                r = epoch_a()
+                if record:
+                    if r["stream"]["degraded_rung"] == "kept_previous":
+                        detected[0] += 1
+                    else:
+                        late_detections[0] += 1
+            # Heal: bit-exact vs a twin seeded from host truth.
+            prev = np.array(engine._prev_choice, copy=True)
+            heal_lags = fresh()
+            r = ca.stream_assign("a0", "t0", rows(heal_lags), members,
+                                 options=OPTS)
+            try:
+                assert_valid_assignment(r["assignments"], P)
+            except AssertionError:
+                invalid[0] += 1
+            if record and not np.array_equal(
+                decode(r["assignments"]), twin_expect(prev, heal_lags)
+            ):
+                heal_mismatch[0] += 1
+            # Strike forgiveness (utils/scrub.FORGIVE_AFTER): the next
+            # lane's corruption must read as an isolated event, not
+            # the continuation of this one.
+            epoch_a()
+            epoch_a()
+
+    epoch_a()  # cold chain
+    epoch_a()  # warm resident
+    # Rehearsal until compile-quiet: the quarantine/heal machinery has
+    # one-time lazy paths (gather/convert utilities) whose first touch
+    # depends on scheduling — the measured round starts only once a
+    # whole rehearsal round compiled nothing new.
+    for _ in range(3):
+        c0 = compile_count()
+        storm_a(record=False)
+        if compile_count() == c0:
+            break
+    compiles_a0 = compile_count()
+    storm_a()  # measured
+    compiles_a = compile_count() - compiles_a0
+
+    # Digest-overhead measurement: the per-epoch HOST cost of the
+    # integrity gate (digest fetch + comparison) against the measured
+    # warm no-op epoch — the device-side reductions are fused into a
+    # dispatch that is upload/readback-bound (the <1%-of-noop gate).
+    # Standalone state on purpose: the service engine's mirror is not
+    # guaranteed here (a degraded-ladder epoch under extreme host load
+    # legitimately leaves it unset), and the check's cost does not
+    # depend on whose digest it is.
+    from kafka_lag_based_assignor_tpu.ops.streaming import (
+        _warm_fused_build,
+    )
+    from kafka_lag_based_assignor_tpu.ops.batched import stream_payload
+
+    probe_lags = fresh()
+    payload, _ = stream_payload(probe_lags)
+    dig_out = _warm_fused_build(
+        payload, (np.arange(P) % C).astype(np.int32), -1.0,
+        num_consumers=C, iters=128, max_pairs=min(C // 2, 16),
+        exchange_budget=128,
+        bucket=StreamingAssignor(num_consumers=C)._bucket(P),
+    )
+    # The digest rides the narrow readback's device fetch (ONE
+    # device_get for both — ops/streaming), so the marginal per-epoch
+    # host cost is the comparison over the already-fetched int64[4].
+    digest_host = np.asarray(dig_out[8])
+    lag_sum = int(probe_lags.sum(dtype=np.int64))
+    reps = 5000
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        scrub_mod.digest_failures(digest_host, P, lag_sum)
+    digest_check_ms = (time.perf_counter() - t0) / reps * 1000.0
+    # Warm no-op epoch at the NORTH-STAR scale (P=100k, C=1000, no-op
+    # threshold path) — the same denominator the round-8 registry
+    # budget (<1%, measured 0.75%) was written against, so the two
+    # overhead bars read off one definition.
+    noop_rng = np.random.default_rng(8)
+    noop_lags_ns = noop_rng.integers(1, 10**6, size=100_000)
+    eng_noop = StreamingAssignor(
+        num_consumers=1000, refine_iters=64, refine_threshold=1000.0
+    )
+    eng_noop.rebalance(noop_lags_ns)
+    eng_noop.rebalance(noop_lags_ns)
+    noop_ms = []
+    for _ in range(30):
+        t0 = time.perf_counter()
+        eng_noop.rebalance(noop_lags_ns)
+        noop_ms.append((time.perf_counter() - t0) * 1000.0)
+    noop_p50 = float(np.percentile(noop_ms, 50))
+    ca.close()
+    svc_a.stop()
+
+    # ---- Phase B: locked megabatch rows -----------------------------
+    # Generous admission window: the probe's determinism (one wave =
+    # one locked flush) must not hinge on four client threads landing
+    # within the serving default's 0.5 ms.
+    svc_b = AssignorService(
+        port=0, coalesce_max_batch=N, coalesce_window_ms=500.0,
+        scrub_interval_ms=3600_000.0, breaker_cooldown_s=0.5,
+    ).start()
+    streams = [f"b{i}" for i in range(N)]
+    clients = {
+        sid: AssignorServiceClient(*svc_b.address, timeout_s=300.0)
+        for sid in streams
+    }
+    pool = cf.ThreadPoolExecutor(max_workers=N)
+    last = {sid: fresh() for sid in streams}
+    eviction_deltas = []  # one entry per choice/counts locked-row event
+
+    def wave(small_drift=False, check=True):
+        for sid in streams:
+            if small_drift:
+                nxt = last[sid].copy()
+                idx = np.random.default_rng(
+                    7000 + int(sid[1:])
+                ).choice(P, 16, replace=False)
+                nxt[idx] += 13
+            else:
+                nxt = fresh()
+            last[sid] = nxt
+
+        def one(sid):
+            r = clients[sid].stream_assign(
+                sid, "t0", rows(last[sid]), members, options=OPTS
+            )
+            if check:
+                try:
+                    assert_valid_assignment(r["assignments"], P)
+                except AssertionError:
+                    invalid[0] += 1
+            return sid, r
+
+        return dict(pool.map(one, streams))
+
+    def quarantined_sids():
+        return [
+            sid for sid in streams
+            if svc_b._streams[sid].engine.quarantined
+        ]
+
+    def storm_b(record=True):
+        for buffer in BUFFERS:
+            inv0 = m.REGISTRY.counter(
+                "klba_coalesce_roster_invalidations_total"
+            ).value
+            inj = faults.FaultInjector(seed=next(seedseq)).plan(
+                f"device.corrupt.{buffer}", mode="raise", times=1
+            )
+            with faults.injected(inj):
+                wave()  # locked wave; flip lands at its readback
+            if record:
+                injected[0] += inj.fired(f"device.corrupt.{buffer}")
+            if buffer == "lags":
+                # The stacked lag buffer is consumed by the locked
+                # DELTA wave: the corrupt row diverges from its host
+                # lag sum and re-syncs dense in-request (served, no
+                # failure) — detection is the resync count.  If wave
+                # scheduling broke the roster first, the wave re-stages
+                # DENSE and the corruption is structurally replaced by
+                # host truth the same epoch — verify that with a full
+                # audit (detected-or-neutralized within one epoch
+                # either way; a surviving divergence scores late).
+                q0 = q_total("resynced")
+                wave(small_drift=True)
+                if record:
+                    if q_total("resynced") - q0 >= 1:
+                        detected[0] += 1
+                    else:
+                        clean = True
+                        for sid in streams:
+                            st = svc_b._streams[sid]
+                            with st.lock:
+                                _aud, fails = scrub_mod.audit_engine(
+                                    st.engine
+                                )
+                            clean = clean and not fails
+                        if clean:
+                            detected[0] += 1
+                        else:
+                            late_detections[0] += 1
+            else:
+                results = wave()
+                kept = [
+                    sid for sid, r in results.items()
+                    if r["stream"]["degraded_rung"] == "kept_previous"
+                ]
+                if record:
+                    if len(kept) == 1:
+                        detected[0] += 1
+                    else:
+                        late_detections[0] += 1
+                    # Evicted exactly once per corruption event.
+                    eviction_deltas.append(int(
+                        m.REGISTRY.counter(
+                            "klba_coalesce_roster_invalidations_total"
+                        ).value - inv0
+                    ))
+                # Heal the quarantined row bit-exact before re-locking.
+                bad = quarantined_sids()
+                for sid in bad:
+                    prev = np.array(
+                        svc_b._streams[sid].engine._prev_choice,
+                        copy=True,
+                    )
+                    heal_lags = fresh()
+                    last[sid] = heal_lags
+                    r = clients[sid].stream_assign(
+                        sid, "t0", rows(heal_lags), members,
+                        options=OPTS,
+                    )
+                    try:
+                        assert_valid_assignment(r["assignments"], P)
+                    except AssertionError:
+                        invalid[0] += 1
+                    if record and not np.array_equal(
+                        decode(r["assignments"]),
+                        twin_expect(prev, heal_lags),
+                    ):
+                        heal_mismatch[0] += 1
+            wave()  # re-stack / settle
+            wave()  # re-lock
+
+    for sid in streams:  # cold chains, serial
+        clients[sid].stream_assign(
+            sid, "t0", rows(last[sid]), members, options=OPTS
+        )
+    wave()  # re-stack + lock
+    wave()  # locked
+    wave(small_drift=True)  # locked delta executable
+    # Rehearsal until compile-quiet (see phase A).
+    for _ in range(5):
+        c0 = compile_count()
+        storm_b(record=False)
+        if compile_count() == c0:
+            break
+    compiles_b0 = compile_count()
+    storm_b()  # measured
+    compiles_b = compile_count() - compiles_b0
+
+    for cl in clients.values():
+        cl.close()
+    pool.shutdown(wait=True)
+    svc_b.stop()
+
+    return {
+        "config": "corruption_storm",
+        "partitions": P,
+        "consumers": C,
+        "streams_locked": N,
+        "injected": injected[0],
+        "detected": detected[0],
+        "late_detections": late_detections[0],
+        "invalid_assignments": invalid[0],
+        "heal_mismatches": heal_mismatch[0],
+        "roster_eviction_events": len(eviction_deltas),
+        "roster_eviction_max": max(eviction_deltas, default=0),
+        "roster_eviction_min": min(eviction_deltas, default=0),
+        "storm_compile_count": compiles_a + compiles_b,
+        "digest_check_ms": digest_check_ms,
+        "warm_noop_p50_ms": noop_p50,
+        "digest_overhead_ratio": (
+            digest_check_ms / noop_p50 if noop_p50 > 0 else None
+        ),
+        "quarantined_total": q_total("quarantined"),
+        "healed_total": q_total("healed"),
+        "resynced_total": q_total("resynced"),
+    }
+
+
 def main():
     # A wedged accelerator tunnel must degrade the benchmark, not hang it
     # (the framework's own watchdog philosophy, SURVEY §5 failure row):
@@ -1769,7 +2163,8 @@ def main():
 
     for fn in (config1_readme, config2_zipf, config3_vmap, config4_skew,
                config5_northstar, config6_multistream, config7_overload,
-               config8_restart, config9_delta, config10_handoff):
+               config8_restart, config9_delta, config10_handoff,
+               config11_scrub):
         before = klba_metrics.REGISTRY.snapshot()
         r = fn()
         deltas = klba_metrics.histogram_deltas(
@@ -2063,6 +2458,69 @@ def main():
                 f"delta_drift upload_reduction_x {red:.1f} < "
                 f"{dd.get('reduction_target_x', 10.0)}x — the delta "
                 "path is not cutting per-epoch H2D bytes"
+            )
+    # Corruption-storm gates (every backend — state integrity is
+    # config, not hardware): every injected corruption detected within
+    # one serving epoch / one scrub pass, bit-exact heals, zero
+    # invalid served assignments, zero measured-round compiles, and a
+    # per-epoch digest-verification cost under 1% of the warm no-op
+    # epoch (the round-8 instrumentation budget's definition).
+    cs = results.get("corruption_storm", {})
+    if cs:
+        if cs.get("injected", 0) != 6:
+            failures.append(
+                f"corruption_storm measured round injected "
+                f"{cs.get('injected')} corruption(s) != 6 — the drill "
+                "did not land every buffer class on both paths"
+            )
+        if cs.get("detected", 0) != cs.get("injected", 0) or cs.get(
+            "late_detections", 0
+        ) > 0:
+            failures.append(
+                f"corruption_storm detected {cs.get('detected')}/"
+                f"{cs.get('injected')} injected corruption(s) within "
+                f"one epoch/scrub pass ({cs.get('late_detections')} "
+                "late) — the integrity plane is missing corruption"
+            )
+        if cs.get("heal_mismatches", 0) > 0:
+            failures.append(
+                f"corruption_storm produced {cs['heal_mismatches']} "
+                "healed stream(s) differing from the uncorrupted twin "
+                "— quarantine healing is not bit-exact"
+            )
+        if cs.get("invalid_assignments", 0) > 0:
+            failures.append(
+                f"corruption_storm served {cs['invalid_assignments']} "
+                "invalid (count-imbalanced) assignment(s) while "
+                "corruption was active"
+            )
+        if cs.get("storm_compile_count", 0) != 0:
+            failures.append(
+                f"corruption_storm compiled "
+                f"{cs['storm_compile_count']} executable(s) in the "
+                "measured round — the rehearsal/warm-up is not "
+                "covering the quarantine/heal paths"
+            )
+        # Every locked-row choice/counts event must evict the roster
+        # exactly once (no event may skip the eviction or double it).
+        if (
+            cs.get("roster_eviction_events", 0) != 2
+            or cs.get("roster_eviction_max", 0) != 1
+            or cs.get("roster_eviction_min", 0) != 1
+        ):
+            failures.append(
+                f"corruption_storm locked-row evictions min/max "
+                f"{cs.get('roster_eviction_min')}/"
+                f"{cs.get('roster_eviction_max')} over "
+                f"{cs.get('roster_eviction_events')} event(s) — a "
+                "locked-row quarantine is not evict-and-relock-"
+                "exactly-once"
+            )
+        ratio = cs.get("digest_overhead_ratio")
+        if ratio is not None and ratio >= 0.01:
+            failures.append(
+                f"corruption_storm digest_overhead_ratio {ratio:.3%} "
+                ">= 1% of the warm no-op epoch"
             )
     for msg in failures:
         log(f"bench: REGRESSION GATE FAILED: {msg}")

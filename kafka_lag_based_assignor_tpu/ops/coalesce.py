@@ -170,11 +170,16 @@ import jax
 import jax.numpy as jnp
 
 from ..utils import faults, metrics, observability
+from ..utils import scrub as scrub_mod
 from ..utils.overload import record_shed
 from ..utils.watchdog import SolveRejected
 from .batched import _narrow_choice
 from .refine import refine_rounds_resident
-from .streaming import _DELTA_ENTRY_BYTES, _warm_fused_resident
+from .streaming import (
+    _DELTA_ENTRY_BYTES,
+    _state_digest,
+    _warm_fused_resident,
+)
 
 LOGGER = logging.getLogger(__name__)
 
@@ -218,9 +223,12 @@ def _epoch_rows(
 
     Returns ``(narrow [N, B], choice int32 [N, B], row_tab [N, C, M],
     counts [N, C], lags int64 [N, B], totals [N, C], rounds [N],
-    exchanges [N])`` — the widened lag rows ride along device-resident
-    so a locked batch can carry them and accept stacked deltas
-    (:func:`_megabatch_fused_locked_delta`)."""
+    exchanges [N], digest int64 [N, 4])`` — the widened lag rows ride
+    along device-resident so a locked batch can carry them and accept
+    stacked deltas (:func:`_megabatch_fused_locked_delta`), and each
+    row's fused integrity digest
+    (:func:`..ops.streaming._state_digest`) lets the readback verify
+    every row against its submitter's host truth (utils/scrub)."""
 
     def one(lags_b, choice_b, tab_b, counts_b, limit):
         B = choice_b.shape[0]
@@ -232,6 +240,11 @@ def _epoch_rows(
         totals = jnp.where(
             slot_ok, lags64[jnp.clip(tab_b, 0, B - 1)], 0
         ).sum(axis=1)
+        # Input-side digest (see ..streaming._refine_core): audits the
+        # resident row the wave STARTED from, so a corrupted locked
+        # row is detected on its first dispatch deterministically —
+        # the refine could silently repair the very entry it moved.
+        digest = _state_digest(lags64, choice_b, counts_b, num_consumers)
         choice_b, tab_b, counts_b, totals, rounds, ex = (
             refine_rounds_resident(
                 lags64, choice_b, tab_b, counts_b, totals,
@@ -241,7 +254,8 @@ def _epoch_rows(
             )
         )
         narrow = _narrow_choice(choice_b, num_consumers)
-        return narrow, choice_b, tab_b, counts_b, lags64, totals, rounds, ex
+        return (narrow, choice_b, tab_b, counts_b, lags64, totals,
+                rounds, ex, digest)
 
     return jax.vmap(one)(lags, choice, row_tab, cnt, limits)
 
@@ -373,6 +387,17 @@ class _ResidentBatch:
     @property
     def n_pad(self) -> int:
         return self.choice.shape[0]
+
+    def adopt_resident_buffers(self, choice, row_tab, counts, lags):
+        """THE locked-wave swap site: install a flush's stacked
+        successors (caller holds ``self.lock``).  Single-sourced — and
+        the only place outside construction these fields may be
+        assigned (lint L018) — so the scrubber's host-mirror truth can
+        never drift from the device through an unaudited write."""
+        self.choice = choice
+        self.row_tab = row_tab
+        self.counts = counts
+        self.lags = lags
 
 
 class ResidentRow:
@@ -1277,11 +1302,10 @@ class MegabatchCoalescer:
                             exchange_budget=s0.exchange_budget,
                         )
                     (narrow, choice_b, tab_b, counts_b, lags_b, totals,
-                     rounds, ex) = out
-                    batch.choice = choice_b
-                    batch.row_tab = tab_b
-                    batch.counts = counts_b
-                    batch.lags = lags_b
+                     rounds, ex, digest) = out
+                    batch.adopt_resident_buffers(
+                        choice_b, tab_b, counts_b, lags_b
+                    )
         except Exception:
             self._poison(batch)  # donated state is unrecoverable
             slot.ready.set()
@@ -1299,6 +1323,7 @@ class MegabatchCoalescer:
                         counts_np = np.asarray(counts_b)
                         rounds_np = np.asarray(rounds)
                         ex_np = np.asarray(ex)
+                        digest_np = np.asarray(digest)
                 for s in rows:
                     r = s.resident.row
                     if s.future.done():
@@ -1320,7 +1345,16 @@ class MegabatchCoalescer:
                             "sum; re-syncing the row dense"
                         )
                         self._m_delta_fallback.inc()
+                        scrub_mod.record_quarantine(
+                            ["lags"], "resynced", source="delta_wave"
+                        )
                         self._resolve_single(s)
+                        continue
+                    if self._row_digest_failed(s, digest_np[r], batch):
+                        if delta_wave:
+                            # The planned epoch's one outcome: never
+                            # applied (the row was quarantined).
+                            self._m_delta_fallback.inc()
                         continue
                     if delta_wave:
                         # Counted HERE, after the divergence check, so
@@ -1335,6 +1369,12 @@ class MegabatchCoalescer:
                         rounds=int(rounds_np[r]),
                         exchanges=int(ex_np[r]),
                     ))
+                # Chaos injection (device.corrupt.*) at the readback
+                # boundary: flip a seeded bit in one locked row's
+                # freshly adopted stacked buffer — the integrity plane
+                # (next wave's digest, or the scrubber's row audit)
+                # must detect it.
+                self._corrupt_resident_rows(batch, rows)
             except Exception:  # noqa: BLE001 — per-row outcome below
                 LOGGER.warning(
                     "locked megabatch readback failed; poisoning the "
@@ -1351,6 +1391,88 @@ class MegabatchCoalescer:
                 slot.ready.set()
 
         return readback
+
+    def _row_digest_failed(
+        self, s: EpochSubmission, digest_row, batch
+    ) -> bool:
+        """Per-row integrity gate of a megabatch readback: compare the
+        row's fused device digest against its submitter's host truth
+        (utils/scrub).  On a mismatch the row's result is NEVER served:
+        its future fails with :class:`CorruptStateDetected` (the
+        submitter's engine quarantines and the service serves through
+        the degraded ladder), and the roster is evicted exactly once —
+        batchmates keep their results this wave and re-stack + re-lock
+        on the next (the arrays freeze, so their handles stay
+        materializable).  Returns True when the row was quarantined."""
+        fails = scrub_mod.digest_failures(
+            digest_row, s.payload.shape[0], s.lag_sum
+        )
+        if not fails:
+            return False
+        LOGGER.warning(
+            "megabatch row digest FAILED (%s); quarantining the row "
+            "and evicting the roster", ",".join(fails),
+        )
+        if batch is not None:
+            self._invalidate(batch.shape_key, batch)
+        if not s.future.done():
+            s.future.set_exception(scrub_mod.CorruptStateDetected(
+                f"megabatch row digest mismatch ({','.join(fails)}); "
+                "row quarantined — the roster re-stacks and the "
+                "stream heals from host truth",
+                fails,
+            ))
+        return True
+
+    def _corrupt_resident_rows(
+        self, batch: _ResidentBatch, rows: List[EpochSubmission]
+    ) -> None:
+        """Chaos injection site (fault points ``device.corrupt.*``) for
+        LOCKED megabatch rows: when a drill's plan fires, one seeded
+        bit of the named stacked buffer is flipped in one real row —
+        the submitting engine's host mirror is deliberately left
+        intact, so the batch silently diverges exactly like a real
+        device memory fault.  Zero-cost off (one global load)."""
+        if faults.active() is None:
+            return
+        plan = scrub_mod.corruption_plan(limit=batch.n_real)
+        if not plan:
+            return
+        with batch.lock:
+            if not batch.valid or batch.poisoned:
+                return
+            arrays = {
+                "choice": batch.choice,
+                "counts": batch.counts,
+                "lags": batch.lags,
+            }
+            for buffer, seed in plan:
+                rng = np.random.default_rng(seed)
+                # Pick the victim AMONG this wave's submissions so the
+                # flip's real-prefix limit always comes from the row's
+                # OWN payload — a roster row with no submitter here
+                # would otherwise be scoped by an unrelated stream's
+                # length and could flip only padding (undetectable by
+                # design, a false bench failure).
+                sub = rows[int(rng.integers(len(rows)))]
+                r = sub.resident.row
+                limit = (
+                    None if buffer == "counts"
+                    else sub.payload.shape[0]
+                )
+                arr = arrays[buffer]
+                flipped = scrub_mod.flip_bit(
+                    np.asarray(arr[r]), seed + 1, limit=limit
+                )
+                arrays[buffer] = arr.at[r].set(flipped)
+                LOGGER.warning(
+                    "injected device.corrupt.%s bit flip into locked "
+                    "row %d (seed %d)", buffer, r, seed,
+                )
+            batch.adopt_resident_buffers(
+                arrays["choice"], batch.row_tab, arrays["counts"],
+                arrays["lags"],
+            )
 
     def _dispatch_restack(
         self,
@@ -1398,7 +1520,8 @@ class MegabatchCoalescer:
         planned = sum(1 for s in rows if s.delta_idx is not None)
         if planned:
             self._m_delta_fallback.inc(planned)
-        narrow, choice_b, tab_b, counts_b, lags_b, totals, rounds, ex = out
+        (narrow, choice_b, tab_b, counts_b, lags_b, totals, rounds, ex,
+         digest) = out
         batch: Optional[_ResidentBatch] = None
         handles: Optional[List[ResidentRow]] = None
         if lock_now:
@@ -1423,8 +1546,11 @@ class MegabatchCoalescer:
                     counts_np = np.asarray(counts_b)
                     rounds_np = np.asarray(rounds)
                     ex_np = np.asarray(ex)
+                    digest_np = np.asarray(digest)
                 for i, s in enumerate(rows):
                     if s.future.done():
+                        continue
+                    if self._row_digest_failed(s, digest_np[i], batch):
                         continue
                     # Unlocked waves slice per-row resident successors
                     # out of the batch output (the 4N gathers the locked
@@ -1502,7 +1628,9 @@ class MegabatchCoalescer:
                     exchange_budget=s.exchange_budget,
                 )
                 (narrow, choice_p, row_tab, counts, lags_p, totals,
-                 rounds, ex) = out
+                 rounds, ex, digest) = out
+                if self._row_digest_failed(s, np.asarray(digest), None):
+                    return
                 s.future.set_result(
                     EpochResult(
                         narrow=np.asarray(narrow),

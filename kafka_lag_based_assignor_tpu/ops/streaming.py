@@ -97,6 +97,7 @@ import jax
 import jax.numpy as jnp
 
 from ..utils import faults, metrics
+from ..utils import scrub as scrub_mod
 from ..utils.observability import count_constrained_bound
 from ..utils.watchdog import capture_abandon_check
 from .batched import _narrow_choice, _stream_device, assign_stream, stream_payload
@@ -163,6 +164,35 @@ def _pad_choice(choice, B: int):
     return jnp.pad(choice.astype(jnp.int32), (0, B - P), constant_values=-1)
 
 
+def _state_digest(lags_p, choice_p, counts, num_consumers: int):
+    """Device-computed integrity digest of the resident state — int64[4]
+    ``[counts_sum, range_violations, lags_sum, counts_vs_choice_L1]``
+    (see :mod:`..utils.scrub` for the host truths each slot must
+    match).  Fused into every refine dispatch: a few reductions plus
+    one bincount scatter on buffers the executable already holds —
+    ~free next to the sort/while-loop work, per the FlashSinkhorn
+    IO-bound framing (the dispatch is upload/readback-bound anyway)."""
+    C = num_consumers
+    in_range = (choice_p >= 0) & (choice_p < C)
+    viol = ((choice_p < -1) | (choice_p >= C)).sum(dtype=jnp.int64)
+    cnt = (
+        jnp.zeros(C, jnp.int64)
+        .at[jnp.where(in_range, choice_p, C)]
+        .add(1, mode="drop")
+    )
+    mismatch = jnp.abs(cnt - counts.astype(jnp.int64)).sum(
+        dtype=jnp.int64
+    )
+    return jnp.stack(
+        [
+            counts.sum(dtype=jnp.int64),
+            viol,
+            lags_p.sum(dtype=jnp.int64),
+            mismatch,
+        ]
+    )
+
+
 def _refine_core(
     lags_p, choice_p, row_tab, counts, totals, limit, P: int,
     num_consumers: int, iters: int, max_pairs, exchange_budget: int,
@@ -171,14 +201,26 @@ def _refine_core(
     """Shared tail of every fused refine executable: the resident round
     loop plus the narrowed host-facing output.  Returns
     (narrow choice[P], choice int32[B], row_tab, counts, lags int64[B],
-    totals int64[C], rounds int32, exchanges int32) — everything after
-    the first element stays device-resident with the caller; the padded
-    lag vector rides along as the fourth resident buffer so the NEXT
-    epoch can scatter-apply a sparse delta instead of re-uploading it
-    (:func:`_warm_fused_delta`).  ``bulk`` selects the warm engine's
-    anti-ranked bulk-swap rounds (see
+    totals int64[C], rounds int32, exchanges int32, digest int64[4]) —
+    everything after the first element stays device-resident with the
+    caller; the padded lag vector rides along as the fourth resident
+    buffer so the NEXT epoch can scatter-apply a sparse delta instead
+    of re-uploading it (:func:`_warm_fused_delta`), and the digest is
+    the epoch's fused integrity check (:func:`_state_digest` — the
+    readback compares it against host truth, utils/scrub).  ``bulk``
+    selects the warm engine's anti-ranked bulk-swap rounds (see
     :func:`..ops.refine.refine_rounds_resident`) with a 4-way partner
     fan per heavy consumer; cold chains keep the parity selection."""
+    # The digest audits the state the epoch STARTED from — the
+    # long-lived resident buffers (post-scatter for delta epochs) —
+    # not the refine's output: the exchange rounds rewrite the choice
+    # entries they move, so a corrupted input row can be silently
+    # repaired by the very dispatch that consumed it, and an
+    # output-side digest would read clean exactly when detection
+    # matters (nondeterministically, by whether the round loop touched
+    # the flipped row).  Input-side, any divergence is caught on the
+    # FIRST dispatch over the corrupt buffer, deterministically.
+    digest = _state_digest(lags_p, choice_p, counts, num_consumers)
     choice_p, row_tab, counts, totals, rounds, ex = refine_rounds_resident(
         lags_p, choice_p, row_tab, counts, totals,
         num_consumers=num_consumers, iters=iters, max_pairs=max_pairs,
@@ -186,7 +228,8 @@ def _refine_core(
         bulk_transfer=bulk, fan=8 if bulk else 1,
     )
     narrow = _narrow_choice(choice_p[:P], num_consumers)
-    return narrow, choice_p, row_tab, counts, lags_p, totals, rounds, ex
+    return (narrow, choice_p, row_tab, counts, lags_p, totals, rounds,
+            ex, digest)
 
 
 @functools.partial(
@@ -507,6 +550,12 @@ class StreamingAssignor:
         # the base the delta differ diffs against.  None whenever the
         # resident state is stale (the mirror lives and dies with it).
         self._lag_mirror: Optional[np.ndarray] = None
+        # Quarantine state (utils/scrub): the buffer classes the last
+        # failed integrity check named, None while healthy.  Armed by
+        # :meth:`quarantine_resident`; cleared (and counted as a heal)
+        # when the next dispatch rebuilds the resident state from host
+        # truth and adopts fresh successors.
+        self._quarantined: Optional[list] = None
         self.last_stats = StreamingStats()
 
     def rebalance(self, lags: np.ndarray) -> np.ndarray:
@@ -701,9 +750,106 @@ class StreamingAssignor:
     def _adopt_resident(self, resident, lags: np.ndarray) -> None:
         """Install a dispatch's resident successors and mirror the lag
         vector they were computed under (copied: the caller's array may
-        be mutated between epochs)."""
+        be mutated between epochs).  A quarantined engine reaching this
+        point has HEALED: the successors were rebuilt from host truth
+        (the digest on the way in verified them), counted per buffer.
+        The ``device.corrupt.*`` chaos points fire here — the readback
+        boundary — so drills can silently flip bits in the freshly
+        adopted buffers (host mirror left intact) and exercise the
+        whole detect/quarantine/heal plane."""
+        if self._quarantined is not None:
+            scrub_mod.record_quarantine(
+                self._quarantined, "healed", source="rebuild"
+            )
+            self._quarantined = None
+        resident = self._corrupt_resident(resident, lags.shape[0])
         self._resident = resident
         self._lag_mirror = np.array(lags, dtype=np.int64, copy=True)
+
+    def _corrupt_resident(self, resident, P: int):
+        """Chaos injection site (fault points ``device.corrupt.choice``
+        / ``.counts`` / ``.lags``): when a drill's plan fires, one
+        seeded bit of the named freshly-adopted device buffer is
+        flipped — the host mirror is deliberately NOT updated, so the
+        device state silently diverges exactly like a real memory
+        fault.  Zero-cost off (one global load); locked-roster handles
+        are skipped (the coalescer owns that injection site)."""
+        if faults.active() is None or getattr(
+            resident, "materialize", None
+        ) is not None:
+            return resident
+        plan = scrub_mod.corruption_plan(limit=P)
+        if not plan:
+            return resident
+        slot = {"choice": 0, "counts": 2, "lags": 3}
+        bufs = list(resident)
+        for buffer, seed in plan:
+            i = slot[buffer]
+            host = scrub_mod.flip_bit(
+                np.asarray(bufs[i]), seed,
+                limit=None if buffer == "counts" else P,
+            )
+            # noqa-justification: this re-upload is injected corruption
+            # (drill machinery), not a counted lag payload — the H2D
+            # byte series must not see it.
+            bufs[i] = jax.device_put(host)  # noqa: L016
+            LOGGER.warning(
+                "injected device.corrupt.%s bit flip (seed %d)",
+                buffer, seed,
+            )
+        return tuple(bufs)
+
+    def quarantine_resident(
+        self, buffers, source: str = "scrub", record: bool = True
+    ) -> None:
+        """Quarantine the device-resident warm state: an integrity
+        check (per-epoch digest, scrubber audit, or a megabatch row
+        check) found it diverged from host truth.  The resident
+        buffers and the lag mirror are dropped TOGETHER; the host
+        previous-choice vector stays — it is the truth the next
+        dispatch rebuilds from, bit-exact by the same contract
+        :meth:`seed_choice` recovery replays — and the heal is counted
+        when that rebuild's successors are adopted.  ``record=False``
+        skips the quarantine/heal accounting entirely (the warm-up's
+        heal-path replay must not make every boot look like a real
+        corruption event in ``klba_quarantine_total``)."""
+        self._quarantined = list(buffers) if record else None
+        self._drop_resident()
+        if record:
+            scrub_mod.record_quarantine(
+                buffers, "quarantined", source=source
+            )
+
+    @property
+    def quarantined(self) -> bool:
+        """True between a failed integrity check and the healing
+        rebuild (the sidecar's stats surface reads this)."""
+        return self._quarantined is not None
+
+    def _verify_digest(
+        self, digest, P: int, lag_sum: Optional[int], source: str
+    ) -> None:
+        """Compare a dispatch's fused device digest against host truth
+        (utils/scrub.digest_failures).  A mismatch quarantines this
+        engine (the corrupt successors are never adopted) and raises
+        :class:`..utils.scrub.CorruptStateDetected` — a
+        ``SolveRejected`` subtype, so the service serves the request
+        through the degraded ladder (kept_previous / host snake) and
+        no breaker is charged; repeated failures escalate there."""
+        fails = scrub_mod.digest_failures(digest, P, lag_sum)
+        if not fails:
+            return
+        LOGGER.warning(
+            "resident-state digest FAILED (%s) on the %s path; "
+            "quarantining", ",".join(fails), source,
+        )
+        self.quarantine_resident(fails, source=source)
+        raise scrub_mod.CorruptStateDetected(
+            f"resident-state digest mismatch ({','.join(fails)}) on "
+            f"the {source} path; stream quarantined — serving falls "
+            "back to host truth and the state heals on the next epoch",
+            fails,
+        )
 
     def _cold_solve(self, lags: np.ndarray) -> np.ndarray:
         """Fresh greedy solve + quality refinement (unbounded-churn path;
@@ -754,8 +900,15 @@ class StreamingAssignor:
                     iters=self.cold_refine_iters, max_pairs=None,
                     bucket=self._bucket(P), wide=(mode == "wide"),
                 )
+                narrow_np, digest_np = jax.device_get(
+                    (narrow, resident[7])
+                )
+                self._verify_digest(
+                    digest_np, P, int(lags.sum(dtype=np.int64)),
+                    source="cold",
+                )
                 self._adopt_resident(tuple(resident[:4]), lags)
-                return np.asarray(narrow).astype(np.int32)
+                return narrow_np.astype(np.int32)
             observe_pack_shift(("stream", lags.shape, C), (shift, rb))
             with metrics.span("stream.h2d"):
                 # ONE upload, shared by both kernels.
@@ -769,8 +922,12 @@ class StreamingAssignor:
             iters=self.cold_refine_iters, max_pairs=None,
             bucket=self._bucket(P),
         )
+        narrow_np, digest_np = jax.device_get((narrow, resident[7]))
+        self._verify_digest(
+            digest_np, P, int(lags.sum(dtype=np.int64)), source="cold"
+        )
         self._adopt_resident(tuple(resident[:4]), lags)
-        return np.asarray(narrow).astype(np.int32)
+        return narrow_np.astype(np.int32)
 
     def _quality_limit(self, bound: float, total_lag: float) -> float:
         """Device-side early-exit target for the fused refine: peak
@@ -828,6 +985,10 @@ class StreamingAssignor:
         limit = self._quality_limit(
             stats.imbalance_bound, float(lags.sum(dtype=np.float64))
         )
+        # Host truth for the epoch's fused integrity digest (and the
+        # delta paths' conservation check): the int64 lag sum,
+        # wrap-consistent with the device reductions.
+        lag_sum = int(lags.sum(dtype=np.int64))
         payload, _ = stream_payload(lags)
         resident = self._resident
         # The resident state is either the engine's own (choice, row_tab,
@@ -883,7 +1044,7 @@ class StreamingAssignor:
                                 delta[1][: delta[3]]
                                 if delta is not None else None
                             ),
-                            lag_sum=int(lags.sum(dtype=np.int64)),
+                            lag_sum=lag_sum,
                         )
                     ).result()
                 except DeadlineReroute:
@@ -894,6 +1055,17 @@ class StreamingAssignor:
                     # other rerouted laggards, leaving the flusher
                     # admission-only.
                     pass
+                except scrub_mod.CorruptStateDetected as exc:
+                    # The wave's readback digest-checked THIS stream's
+                    # row and found it diverged (utils/scrub): the
+                    # coalescer already evicted the roster (one
+                    # invalidation, one re-stack, re-lock); quarantine
+                    # the engine side too — the handle points into the
+                    # frozen corrupt batch and must never be reused —
+                    # and let the rejection reach the service's
+                    # degraded ladder (kept_previous / snake).
+                    self.quarantine_resident(exc.buffers, source="wave")
+                    raise
                 else:
                     self._adopt_resident(r.resident, lags)
                     self._fill_stats_from_device(
@@ -938,14 +1110,20 @@ class StreamingAssignor:
                     # from the mirror — re-sync dense on the delta's
                     # own successors (assignment validity is preserved
                     # by construction; only quality could be off).
-                    if int(np.asarray(out[5]).sum()) != int(
-                        lags.sum(dtype=np.int64)
-                    ):
+                    if int(np.asarray(out[5]).sum()) != lag_sum:
                         LOGGER.warning(
                             "delta epoch diverged from the host lag "
                             "sum; re-syncing with a dense upload"
                         )
                         self._m_delta["fallback"].inc()
+                        # Quarantine-plane accounting (utils/scrub):
+                        # the graceful in-request lane of the same
+                        # integrity story — the lag state diverged and
+                        # was rebuilt from host truth, just without a
+                        # failed request.
+                        scrub_mod.record_quarantine(
+                            ["lags"], "resynced", source="delta"
+                        )
                         self._m_h2d_dense.inc(payload.nbytes)
                         out = _warm_fused_resident(
                             payload, out[1], out[2], out[3], limit,
@@ -972,10 +1150,22 @@ class StreamingAssignor:
                 num_consumers=C, iters=budget, max_pairs=pairs,
                 exchange_budget=budget, bucket=B,
             )
-        narrow, choice_p, row_tab, counts, lags_p, totals, rounds, ex = out
+        (narrow, choice_p, row_tab, counts, lags_p, totals, rounds, ex,
+         digest) = out
+        # ONE device fetch for the answer AND its digest: the narrow
+        # readback blocks on the dispatch anyway, so the integrity
+        # check's marginal per-epoch cost is the 32-byte ride-along
+        # plus a few host comparisons (the bench's <1%-of-noop gate).
+        narrow_np, digest_np = jax.device_get((narrow, digest))
+        # THE per-epoch integrity gate (utils/scrub): the fused digest
+        # must match host truth before the successors are adopted or
+        # the answer served — a mismatch quarantines the stream and the
+        # request falls back to the degraded ladder, never the corrupt
+        # buffer.
+        self._verify_digest(digest_np, P, lag_sum, source="epoch")
         self._adopt_resident((choice_p, row_tab, counts, lags_p), lags)
         self._fill_stats_from_device(stats, totals, counts, rounds, ex)
-        return np.asarray(narrow).astype(np.int32)
+        return narrow_np.astype(np.int32)
 
     def _delta_plan(self, lags: np.ndarray, payload):
         """Build this epoch's padded (idx, vals) delta against the host
@@ -1252,6 +1442,7 @@ class StreamingAssignor:
             max_pairs=min(self.num_consumers // 2, 16),
             exchange_budget=self.refine_iters, bucket=self._bucket(P),
         )
+        self._verify_digest(out[8], P, 0, source="prestack")
         self._adopt_resident(tuple(out[1:5]), lags)
         return True
 

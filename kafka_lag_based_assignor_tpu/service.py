@@ -202,6 +202,7 @@ from .assignor import LagBasedPartitionAssignor
 from .models.greedy import assign_greedy, host_fallback_for
 from .types import TopicPartitionLag
 from .utils import faults, metrics
+from .utils import scrub as scrub_lib
 from .utils.config import VALID_SOLVERS
 from .utils.observability import (
     RebalanceStats,
@@ -273,6 +274,17 @@ _LIFECYCLE_STATES = ("serving", "draining", "stopped")
 # at a 30 s cadence is a ~30 min trend window — enough slope signal for
 # the horizon projection without unbounded growth (lint L014).
 STREAM_HISTORY = 64
+
+# Takeover-warming TTL (ROADMAP lifecycle (e)): a recovered stream's
+# standing pressure is normally released when its first post-boot
+# epoch serves — but a snapshot can carry a stream whose consumer
+# group was decommissioned between snapshot and restart, and a weight
+# that nothing will ever release must not pin the admission window at
+# rung-1 scale for the life of the process.  Any share still parked
+# this long after recovery is expired wholesale (checked on the
+# admission path, where the held window actually costs something).
+# 300 s is ~10 lag-read cadences — far past any real warm-up.
+TAKEOVER_WARMING_TTL_S = 300.0
 
 
 def _counter_total(name: str) -> int:
@@ -517,6 +529,14 @@ class _Stream:
         # dense.
         self.lag_epoch = 0
         self.last_lags = None  # np.int64[P] in st.pids order
+        # Resident-state quarantine strikes (utils/scrub): forgiven
+        # only after FORGIVE_AFTER consecutive clean epochs (a
+        # corrupt -> heal -> corrupt flip-flop must still escalate);
+        # at ESCALATE_AFTER each further failure also charges the
+        # stream breaker — a device that keeps corrupting state is
+        # sidelined like one that keeps raising.
+        self.scrub_strikes = 0
+        self.clean_epochs = 0
 
 
 def _stream_ring() -> metrics.FlightRecorder:
@@ -879,6 +899,17 @@ class AssignorService:
         # table-build and coalesce like steady-state traffic.  The
         # restart_storm bench measures this both ways.
         recovery_prestack: bool = False,
+        # Resident-state scrubber (utils/scrub; DEPLOYMENT.md "State
+        # integrity"): background cadence for auditing idle streams'
+        # device-resident buffers (choice/row_tab/counts/lags) against
+        # their host mirrors.  Off the serving path: each pass is
+        # deadline-budgeted, only idle streams are audited (the stream
+        # lock is taken non-blocking), and the whole pass is skipped
+        # while the overload ladder is at rung >= 2.  A failed audit
+        # quarantines the stream (resident dropped; the next epoch
+        # rebuilds bit-exact from host truth) and repeated failures
+        # escalate to the stream breaker.  <= 0 disables.
+        scrub_interval_ms: float = 30_000.0,
         # False skips the recovered-shape warm-up pass in start()
         # (tests/drills that assert recovery semantics without paying
         # compiles); production keeps it on — it is what makes the
@@ -1032,6 +1063,25 @@ class AssignorService:
             else float(snapshot_lease_ttl_s) * 2.0 + 1.0
         )
         self._recovery_prestack = bool(recovery_prestack)
+        # Takeover-warming ledger (ROADMAP lifecycle (e)): per-stream
+        # CLASS_WEIGHTS parked as the overload controller's STANDING
+        # pressure while a recovered/adopted stream has not yet served
+        # its first post-boot epoch.  Guarded by _streams_lock;
+        # released stream by stream (first epoch / reset / discard /
+        # poison) so the admission window returns to full scale
+        # exactly when the takeover warm-up has drained — or wholesale
+        # at the TTL (a dead stream in the snapshot must not pin the
+        # window forever; see TAKEOVER_WARMING_TTL_S).
+        self._takeover_warming: Dict[str, float] = {}
+        self._takeover_deadline: Optional[float] = None
+        if scrub_interval_ms and float(scrub_interval_ms) > 0:
+            self._scrubber = scrub_lib.StateScrubber(
+                targets=self._scrub_targets,
+                interval_s=float(scrub_interval_ms) / 1000.0,
+                suppress=lambda: self._overload.rung() >= 2,
+            )
+        else:
+            self._scrubber = None
         self._resync_pacer = (
             _ResyncPacer(int(resync_max_inflight), clock=clock)
             if int(resync_max_inflight) > 0 else None
@@ -1150,6 +1200,7 @@ class AssignorService:
             "snapshot_lease_wait_s": cfg.snapshot_lease_wait_s,
             "resync_max_inflight": cfg.resync_max_inflight,
             "recovery_prestack": cfg.recovery_prestack,
+            "scrub_interval_ms": cfg.scrub_interval_s * 1000.0,
             "warmup_shapes": cfg.warmup_shapes or None,
             "slo_classes": cfg.slo_classes,
             "slo_deadline_s": cfg.slo_deadline_s,
@@ -1289,6 +1340,9 @@ class AssignorService:
             # the last recovery's outcome (DEPLOYMENT.md "Restarts
             # and recovery"; tools/dump_metrics.py --summary).
             result["lifecycle"] = self.lifecycle_stats()
+            # Resident-state scrubber coverage + quarantine counts
+            # (DEPLOYMENT.md "State integrity"); None when disabled.
+            result["scrub"] = self.scrub_stats()
             return result, None
         if method == "metrics":
             # The registry, both ways: structured JSON for programmatic
@@ -1444,6 +1498,7 @@ class AssignorService:
                 self._snapshots.pop(sid, None)
             if dropped:
                 self._mark_churn()
+                self._release_takeover(sid)
             return {"dropped": dropped}, None
         if method == "recommend":
             # The elasticity loop (utils/overload.recommend_payload):
@@ -1586,6 +1641,7 @@ class AssignorService:
         with self._inflight_lock:
             depth_now = self._inflight_weight
         self._overload.note_depth(depth_now)
+        self._expire_takeover_warming()
         decision = None
         try:
             decision = self._overload.admission(klass)
@@ -1813,7 +1869,13 @@ class AssignorService:
                 # surfaced as a warm restart (same wire field as the
                 # poisoned-snapshot recovery) so the restart stampede
                 # is visible per stream; a drift-discarded stream
-                # reports a plain cold start instead.
+                # reports a plain cold start instead.  The standing-
+                # pressure share is NOT released here: the epoch has
+                # not run yet, and a fail-fast outcome (breaker open,
+                # budget spent) would leave the device state cold —
+                # the release rides the SUCCESS path below, so the
+                # hold genuinely lasts until the warming dispatch
+                # landed.
                 warm_restart = st.engine._prev_choice is not None
                 st.recovered = False
             _apply_stream_opts(st.engine, opts)
@@ -1901,6 +1963,22 @@ class AssignorService:
                         budget_total_s=budget.total_s,
                     )
                 s = st.engine.last_stats
+                # An adopted stream's WARMING dispatch succeeded: its
+                # takeover share releases now (ROADMAP lifecycle (e)).
+                # Steady state pays one empty-dict check.
+                if self._takeover_warming:
+                    self._release_takeover(sid)
+                # Strike forgiveness (utils/scrub): only a RUN of
+                # clean epochs clears the quarantine strikes —
+                # escalation targets devices corrupting state faster
+                # than the heal path restores it, and a flip-flop
+                # serves one clean healing epoch between detections.
+                st.clean_epochs += 1
+                if (
+                    st.scrub_strikes
+                    and st.clean_epochs >= scrub_lib.FORGIVE_AFTER
+                ):
+                    st.scrub_strikes = 0
             except SolveRejected as rej:
                 # FAIL-FAST rejection (breaker open / probe in flight /
                 # budget spent): nothing ever ran, so the warm engine is
@@ -1921,6 +1999,15 @@ class AssignorService:
                 # budget or inflate the series operators page on.
                 from .ops.coalesce import DeadlineShed
 
+                if isinstance(rej, scrub_lib.CorruptStateDetected):
+                    # A resident-state integrity check failed mid-
+                    # request (per-epoch digest or a megabatch row
+                    # check): the engine already quarantined itself —
+                    # host truth intact, corrupt buffer never served —
+                    # so this request degrades below and the NEXT epoch
+                    # heals bit-exact.  Count the strike (repeats
+                    # escalate to the stream breaker).
+                    self._note_quarantine(sid, st, rej.buffers)
                 deadline_shed = isinstance(rej, DeadlineShed)
                 if deadline_shed and _keepable(prev, lags.shape[0], C):
                     choice, s = _serve_previous(prev, lags, C)
@@ -1960,6 +2047,7 @@ class AssignorService:
                 with self._streams_lock:
                     self._streams.pop(sid, None)
                 self._mark_churn()
+                self._release_takeover(sid)
                 if not self._host_fallback:
                     raise
                 LOGGER.warning(
@@ -2131,6 +2219,122 @@ class AssignorService:
                 self._streams[sid] = nst
         self._mark_churn()
         return choice, fresh.last_stats, "cold_device", False
+
+    # -- resident-state scrubbing (utils/scrub) ----------------------------
+
+    def _scrub_targets(self) -> List[Tuple[str, Callable[[], str]]]:
+        """The scrubber's audit jobs: one per live stream.  Each
+        auditor takes the stream lock NON-blocking (idle streams only
+        — the scrubber must never park behind a serving epoch), audits
+        the full resident state against the host mirror, and
+        quarantines on a mismatch."""
+        with self._streams_lock:
+            items = list(self._streams.items())
+        return [
+            (sid, lambda sid=sid, st=st: self._audit_stream(sid, st))
+            for sid, st in items
+        ]
+
+    def _audit_stream(self, sid: str, st: _Stream) -> str:
+        if not st.lock.acquire(blocking=False):
+            return "busy"
+        try:
+            with self._streams_lock:
+                if self._streams.get(sid) is not st:
+                    return "skipped"  # reset/poisoned while we queued
+            if st.engine is None:
+                return "skipped"
+            audited, fails = scrub_lib.audit_engine(st.engine)
+            if not audited:
+                return "skipped"
+            if fails:
+                for buffer in fails:
+                    metrics.REGISTRY.counter(
+                        "klba_scrub_failures_total", {"buffer": buffer}
+                    ).inc()
+                LOGGER.warning(
+                    "scrub audit of stream %r FAILED (%s); "
+                    "quarantining", sid, ",".join(fails),
+                )
+                st.engine.quarantine_resident(fails, source="scrub")
+                self._note_quarantine(sid, st, fails)
+            return "audited"
+        finally:
+            st.lock.release()
+
+    def _note_quarantine(
+        self, sid: str, st: _Stream, buffers: List[str]
+    ) -> None:
+        """Strike accounting for one quarantined stream (caller holds
+        ``st.lock``): repeated failures escalate to the stream breaker
+        (utils/watchdog.trip_breaker) — a single cosmic-ray flip
+        heals silently, a device corrupting state faster than the heal
+        path restores it gets sidelined."""
+        st.clean_epochs = 0
+        st.scrub_strikes += 1
+        if st.scrub_strikes >= scrub_lib.ESCALATE_AFTER:
+            # Direct trip (not a failure count): the healing epoch
+            # between strikes succeeds and would reset a consecutive-
+            # failure counter, so counting could never sideline the
+            # corrupt/heal flip-flop this escalation targets.
+            self._watchdog.trip_breaker("stream")
+            scrub_lib.record_quarantine(
+                buffers, "escalated", stream_id=sid, source="strikes"
+            )
+
+    def scrub_stats(self) -> Optional[Dict[str, Any]]:
+        """The wire ``stats.scrub`` section (tools/dump_metrics.py
+        --summary prints it next to the lifecycle rows)."""
+        if self._scrubber is None:
+            return None
+        out = self._scrubber.stats()
+        with self._streams_lock:
+            items = list(self._streams.items())
+        quarantined = 0
+        for _sid, st in items:
+            engine = st.engine
+            if engine is not None and getattr(
+                engine, "quarantined", False
+            ):
+                quarantined += 1
+        out["quarantined_streams"] = quarantined
+        return out
+
+    # -- takeover warming (ROADMAP lifecycle (e)) --------------------------
+
+    def _release_takeover(self, sid: Any) -> None:
+        """One adopted stream finished warming (first post-boot epoch
+        served, reset, discarded, or poisoned): release its share of
+        the standing takeover pressure so the admission window steps
+        back to full scale exactly when the warm-up drains."""
+        with self._streams_lock:
+            weight = self._takeover_warming.pop(sid, None)
+        if weight:
+            self._overload.release_standing_pressure(weight)
+
+    def _expire_takeover_warming(self) -> None:
+        """TTL backstop, checked on the admission path (one dict-empty
+        test per request while shares remain): shares whose streams
+        never reconnected are released wholesale so one decommissioned
+        consumer group in the snapshot cannot hold the admission
+        window at rung-1 scale for the life of the process."""
+        if not self._takeover_warming or (
+            self._takeover_deadline is None
+            or self._clock() < self._takeover_deadline
+        ):
+            return
+        with self._streams_lock:
+            stale, self._takeover_warming = (
+                dict(self._takeover_warming), {}
+            )
+        total = sum(stale.values())
+        if total:
+            LOGGER.warning(
+                "takeover warm-up TTL expired with %d stream(s) never "
+                "seen (%s); releasing their standing pressure",
+                len(stale), sorted(stale),
+            )
+            self._overload.release_standing_pressure(total)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -2396,6 +2600,19 @@ class AssignorService:
                 # if the stampede never materializes.
                 self._overload.seed_recovery_depth(weight)
                 info["seeded_depth"] = weight
+                # Lease-aware shedding during the takeover window
+                # (ROADMAP lifecycle (e)): the recovered streams'
+                # class weight also parks as STANDING pressure — the
+                # depth EWMA above decays with traffic, but a
+                # replacement serving cold streams must hold the
+                # admission window at rung-1 scale until every
+                # adopted stream actually finished warming, or the
+                # takeover stampede coalesces into giant cold waves.
+                self._overload.add_standing_pressure(weight)
+                self._takeover_deadline = (
+                    self._clock() + TAKEOVER_WARMING_TTL_S
+                )
+                info["standing_pressure"] = weight
         info["duration_ms"] = (metrics.REGISTRY.clock() - t0) * 1000.0
         self._last_recovery = info
         metrics.REGISTRY.gauge("klba_recovery_duration_ms").set(
@@ -2472,6 +2689,13 @@ class AssignorService:
                     if len(self._streams) >= MAX_STREAMS:
                         raise ValueError("stream cap reached")
                     self._streams[str(sid)] = st
+                    # Takeover-warming ledger (ROADMAP lifecycle (e)):
+                    # this stream's class weight stays parked as
+                    # standing pressure until its first post-boot
+                    # epoch serves (released per stream).
+                    self._takeover_warming[str(sid)] = (
+                        CLASS_WEIGHTS.get(klass, 1.0)
+                    )
                 self._recovery_shapes.append((int(pids.shape[0]), C))
                 recovered += 1
                 weight += CLASS_WEIGHTS.get(klass, 1.0)
@@ -2594,6 +2818,8 @@ class AssignorService:
         if self._thread is not None:
             self._tcp.shutdown()
         self._tcp.server_close()
+        if self._scrubber is not None:
+            self._scrubber.close()
         if self._metrics_http is not None:
             self._metrics_http.stop()
             self._metrics_http = None
@@ -2682,6 +2908,8 @@ class AssignorService:
                 return self
             if self._snapshot_writer is not None:
                 self._snapshot_writer.start()
+            if self._scrubber is not None:
+                self._scrubber.start()
             if self._metrics_port is not None:
                 from .utils.metrics_http import MetricsHTTPServer
 
@@ -3020,6 +3248,13 @@ def main() -> None:
              "0 disables pacing (default 8)",
     )
     parser.add_argument(
+        "--scrub-interval-ms", type=float, default=30_000.0,
+        metavar="MS",
+        help="resident-state scrubber cadence (background audit of "
+             "device buffers vs host truth; quarantine + bit-exact "
+             "heal on mismatch); <= 0 disables (default 30000)",
+    )
+    parser.add_argument(
         "--recovery-prestack", action="store_true",
         help="pre-stack recovered rosters at boot (device-resident "
              "rebuild off the serving path) so the restart storm's "
@@ -3047,6 +3282,7 @@ def main() -> None:
         / 1000.0,
         resync_max_inflight=opts.resync_max_inflight,
         recovery_prestack=opts.recovery_prestack,
+        scrub_interval_ms=opts.scrub_interval_ms,
     )
     # SIGTERM/SIGINT drain gracefully: admissions stop with a
     # structured retry-after reject, in-flight waves flush, the final

@@ -132,6 +132,15 @@ SNAPSHOT_LEASE_WAIT_CONFIG = "tpu.assignor.snapshot.lease.wait.ms"
 # epochs wait their turn (counted ``klba_resync_paced_total``).  0
 # disables pacing.
 RESYNC_MAX_INFLIGHT_CONFIG = "tpu.assignor.resync.max.inflight"
+# Resident-state scrubber cadence (utils/scrub; DEPLOYMENT.md "State
+# integrity"): how often the background auditor round-robins idle
+# streams' device-resident buffers against their host mirrors.  Each
+# pass is deadline-budgeted and skipped while the overload ladder is
+# at rung >= 2; a failed audit quarantines the stream (the next epoch
+# rebuilds bit-exact from host truth) and repeated failures escalate
+# to the stream breaker.  0 disables the background scrubber (the
+# per-epoch fused digests stay on either way).
+SCRUB_INTERVAL_CONFIG = "tpu.assignor.scrub.interval.ms"
 # Pre-stack recovered rosters at boot (ROADMAP lifecycle (b)): rebuild
 # each recovered stream's device-resident state from its seeded choice
 # off the serving path, so the restart storm's first epochs coalesce
@@ -250,6 +259,8 @@ class AssignorConfig:
     # Post-restart resync pacing + boot-time roster pre-stacking.
     resync_max_inflight: int = 8
     recovery_prestack: bool = False
+    # Resident-state scrubber cadence (utils/scrub); 0 disables.
+    scrub_interval_s: float = 30.0
     # (max_partitions, num_consumers) shapes to pre-compile at configure().
     warmup_shapes: list = field(default_factory=list)
     consumer_group_props: Dict[str, Any] = field(default_factory=dict)
@@ -377,6 +388,7 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
     snapshot_lease_ttl_s = _as_ms(SNAPSHOT_LEASE_TTL_CONFIG, 0.0)
     snapshot_lease_wait_s = _as_ms(SNAPSHOT_LEASE_WAIT_CONFIG, 0.0)
     resync_max_inflight = _as_int(RESYNC_MAX_INFLIGHT_CONFIG, 8, 0)
+    scrub_interval_s = _as_ms(SCRUB_INTERVAL_CONFIG, 30_000.0)
 
     # SLO class map + per-class deadline budgets: prefix-keyed entries,
     # validated against the class roster (utils/overload) so a typo'd
@@ -484,6 +496,7 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
         snapshot_lease_ttl_s=snapshot_lease_ttl_s,
         snapshot_lease_wait_s=snapshot_lease_wait_s,
         resync_max_inflight=resync_max_inflight,
+        scrub_interval_s=scrub_interval_s,
         recovery_prestack=_as_bool(
             consumer_group_props.get(RECOVERY_PRESTACK_CONFIG, False)
         ),

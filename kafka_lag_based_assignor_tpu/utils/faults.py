@@ -46,6 +46,19 @@ Named fault points (every one threaded through production code):
                     staging) — fires BEFORE any donation, so a failure
                     falls back to the dense upload within the same
                     request budget
+``device.corrupt.choice`` / ``device.corrupt.counts`` /
+``device.corrupt.lags``  seeded BIT-FLIP injection into the named
+                    device-resident buffer at a readback boundary
+                    (:meth:`..ops.streaming.StreamingAssignor.
+                    _adopt_resident` and the megabatch coalescer's
+                    locked readback) — unlike every other point, a
+                    firing plan does not raise into the caller: the
+                    buffer is silently corrupted (host mirror left
+                    intact) so the integrity plane (per-epoch fused
+                    digests + the utils/scrub auditor) must DETECT the
+                    divergence, quarantine the stream/row, and heal it
+                    bit-exact from host truth.  Use ``raise`` plans;
+                    the seed picks the flipped element and bit
 ``snapshot.write``  a lifecycle snapshot save (:meth:`..utils.snapshot.
                     SnapshotStore.save`) — a failure here exercises the
                     fail-open write contract (serving continues on the
@@ -132,6 +145,9 @@ FAULT_POINTS = frozenset(
         "shed.decide",
         "delta.diff",
         "delta.apply",
+        "device.corrupt.choice",
+        "device.corrupt.counts",
+        "device.corrupt.lags",
         "snapshot.write",
         "snapshot.load",
         "snapshot.cas",

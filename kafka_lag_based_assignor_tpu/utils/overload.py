@@ -125,6 +125,17 @@ def class_rank(klass: str) -> int:
     return _CLASS_RANK[klass]
 
 
+def _held_window_scale(rung: int, standing: float) -> float:
+    """THE takeover window-hold rule, in one place (admission decisions
+    AND the operator snapshot read it): while any standing takeover
+    pressure is parked, the admission window is held at rung-1 scale
+    even at rung 0."""
+    scale = _WINDOW_SCALE[rung]
+    if standing > 0:
+        return min(scale, _WINDOW_SCALE[1])
+    return scale
+
+
 #: Get-or-create cache for the shed counters (sheds happen on the
 #: overloaded hot path, where a label-dict registry lookup per event is
 #: the wrong cost).  Plain dict: get/set are GIL-atomic, and a racing
@@ -302,6 +313,15 @@ class OverloadController:
         self._breaker_open = breaker_open or (lambda: False)
         self._lock = threading.Lock()
         self._ewma_depth = 0.0
+        # Standing pressure (ROADMAP lifecycle (e) — lease-aware
+        # shedding during the takeover window): a constant term the
+        # sidecar parks here for adopted-but-still-cold streams after
+        # a takeover/restart.  Unlike the depth EWMA it does NOT decay
+        # — it is released stream by stream as each recovered stream
+        # serves its first (warming) epoch — and while any of it is
+        # outstanding the admission window is held at rung-1 scale, so
+        # a replacement serving cold streams cannot stampede itself.
+        self._standing = 0.0
         self._rung = 0
         self._pressure = 0.0
         self._p99_ms: Optional[float] = None
@@ -343,6 +363,32 @@ class OverloadController:
             )
             self._last_eval = None
 
+    def add_standing_pressure(self, weight: float) -> None:
+        """Park ``weight`` (a CLASS_WEIGHTS sum) as standing takeover
+        pressure and force the next admission decision to re-evaluate
+        (see the ``_standing`` comment)."""
+        if weight <= 0:
+            return
+        with self._lock:
+            self._standing += float(weight)
+            self._last_eval = None
+
+    def release_standing_pressure(self, weight: float) -> None:
+        """Release ``weight`` of the parked takeover pressure (one
+        adopted stream finished warming — its first epoch served, it
+        was reset, or it was discarded).  Clamped at zero and forces a
+        re-evaluation, so the ladder can step down through the normal
+        hysteresis the moment the warm-up drains."""
+        if weight <= 0:
+            return
+        with self._lock:
+            self._standing = max(0.0, self._standing - float(weight))
+            self._last_eval = None
+
+    def standing_pressure(self) -> float:
+        with self._lock:
+            return self._standing
+
     def _windowed_p99(self) -> Optional[float]:
         """p99 of the stream.epoch observations made since the previous
         evaluation (bucket-wise delta) — None when nothing new."""
@@ -376,7 +422,16 @@ class OverloadController:
             self._p99_ms *= 0.8
             if self._p99_ms < 1.0:
                 self._p99_ms = None
-        depth_pressure = self._ewma_depth / self.depth_high
+        # Standing takeover pressure is a FLOOR under the depth signal,
+        # not an addend: seed_recovery_depth already parks the same
+        # recovered weight in the EWMA, and summing the two would read
+        # every restart one rung harsher than the round-11 recovery
+        # seeding was designed for.  max() keeps the ladder where the
+        # seed put it while the EWMA decays, and hands over to live
+        # traffic smoothly as adopted streams warm.
+        depth_pressure = (
+            max(self._ewma_depth, self._standing) / self.depth_high
+        )
         lat_pressure = (
             (self._p99_ms / self.latency_budget_ms)
             if self._p99_ms is not None else 0.0
@@ -435,6 +490,7 @@ class OverloadController:
             self._evaluate_locked(now)
             rung = self._rung
             pressure = self._pressure
+            standing = self._standing
         rank = _CLASS_RANK[klass]
         action = "admit"
         if rung >= 4 and rank >= 1:
@@ -445,7 +501,15 @@ class OverloadController:
             action = "degrade"
         retry_ms = int(min(5000.0, max(100.0, self.cooldown_s * 1000.0
                                        * max(pressure, 1.0))))
-        return _Decision(action, rung, retry_ms)
+        decision = _Decision(action, rung, retry_ms)
+        # Takeover window (ROADMAP lifecycle (e)): while adopted
+        # streams are still warming, hold the megabatch admission
+        # window at rung-1 scale even at rung 0 — smaller waves until
+        # the replacement's cold streams have all served once, so the
+        # post-takeover stampede trickles instead of parking whole
+        # fleets behind one giant cold wave.
+        decision.window_scale = _held_window_scale(rung, standing)
+        return decision
 
     def note_shed(
         self, klass: str, rung_name: str, served: str,
@@ -511,8 +575,11 @@ class OverloadController:
                 "rung_index": self._rung,
                 "pressure": round(self._pressure, 4),
                 "ewma_depth": round(self._ewma_depth, 4),
+                "standing_pressure": round(self._standing, 4),
                 "p99_ms": self._p99_ms,
-                "window_scale": _WINDOW_SCALE[self._rung],
+                "window_scale": _held_window_scale(
+                    self._rung, self._standing
+                ),
                 "latency_budget_ms": self.latency_budget_ms,
                 "depth_high": self.depth_high,
             }

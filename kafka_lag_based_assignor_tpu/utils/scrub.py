@@ -1,0 +1,417 @@
+"""Resident-state scrubber: continuous integrity auditing, quarantine,
+and bit-exact self-healing of long-lived device state.
+
+Rounds 10-14 moved the whole steady state onto long-lived donated
+device buffers — per-stream ``(choice, row_tab, counts, lags)`` and the
+roster-locked megabatch's stacked batch — that survive for hours across
+thousands of epochs.  Until this module the only integrity guard was
+the delta path's lag-sum conservation check: a silently corrupted
+choice or counts buffer on the dense path would serve invalid
+assignments until churn happened to rebuild it.  Two complementary
+defenses close that hole:
+
+**Per-epoch fused digest** — every fused executable
+(:mod:`..ops.streaming` / :mod:`..ops.coalesce`) additionally emits a
+cheap device-computed ``int64[4]`` digest, fused into the dispatch the
+epoch already pays (the FlashSinkhorn IO-aware argument: the dispatch
+is upload/readback-bound, a few extra reductions are ~free):
+
+====  ======================  =========================================
+slot  value                   host truth it must match
+====  ======================  =========================================
+0     ``counts.sum()``        P — every partition owned exactly once
+1     range violations        0 — no choice entry outside [-1, C)
+2     ``lags.sum()``          the host lag sum (conservation law —
+                              refine permutes ownership, never mass)
+3     |bincount(choice) -     0 — the choice vector and the counts
+      counts| L1 distance     buffer tell the same story
+====  ======================  =========================================
+
+The readback compares the digest against host truth on BOTH the
+single-stream and locked-wave paths (:func:`digest_failures`); a
+mismatch quarantines the stream/row.
+
+**Background scrubber** — :class:`StateScrubber` round-robins idle
+streams (and, through their handles, locked megabatch rows) on a
+configurable cadence (``tpu.assignor.scrub.interval.ms``), OFF the
+serving path: each pass is deadline-budgeted, skipped entirely while
+the overload ladder is at rung >= 2 (an overloaded sidecar has no
+spare device bandwidth for audits), and audits the full resident state
+against the host mirror (:func:`audit_engine`): the device choice
+buffer vs the engine's previous choice, the counts buffer vs its
+bincount, the resident lag buffer vs the host lag mirror, and the row
+table's segments vs the choice vector.
+
+**Quarantine / self-heal** — a failed check (digest or audit) marks
+the stream quarantined: the in-flight request is served via the
+existing degraded ladder (``kept_previous`` or host snake — NEVER the
+corrupt buffer; :class:`CorruptStateDetected` is a
+:class:`..utils.watchdog.SolveRejected` subtype, so the service knows
+the warm HOST state is intact and no breaker is charged), the resident
+state is rebuilt bit-exact from host truth by the next dispatch
+(exactly the ``seed_choice`` contract recovery replays — the host
+previous-choice vector is the source of truth, the device state a
+cache), megabatch rows evict-and-relock exactly once (one roster
+invalidation, one re-stack wave), and REPEATED failures on one stream
+escalate to the stream breaker
+(:meth:`..utils.watchdog.Watchdog.trip_breaker` — a direct trip: the
+healing epoch between strikes succeeds, so consecutive-failure
+counting could never fire on exactly this pattern).
+
+**Chaos surface** — fault points ``device.corrupt.choice`` /
+``device.corrupt.counts`` / ``device.corrupt.lags`` inject seeded
+bit-flips into the resident buffers at readback boundaries
+(:func:`corruption_plan` / :func:`flip_bit`), so the whole plane is
+drill-testable: the ``corruption_storm`` bench probe gates detection
+latency, bit-exact healing, and zero invalid served assignments.
+
+Telemetry: ``klba_scrub_passes_total``,
+``klba_scrub_streams_audited_total``,
+``klba_scrub_failures_total{buffer}``,
+``klba_scrub_skipped_total{reason}``, ``klba_scrub_duration_ms``,
+``klba_quarantine_total{buffer,outcome}`` (outcome = ``quarantined`` |
+``healed`` | ``resynced`` | ``escalated``), and ``scrub`` /
+``quarantine`` flight records.  See DEPLOYMENT.md "State integrity".
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import faults, metrics
+from .watchdog import SolveRejected
+
+LOGGER = logging.getLogger(__name__)
+
+#: The digest vector's length (int64[4]; see the module docstring).
+DIGEST_LEN = 4
+
+#: The three corrupted-buffer fault points, by buffer class.
+CORRUPT_POINTS = {
+    "choice": "device.corrupt.choice",
+    "counts": "device.corrupt.counts",
+    "lags": "device.corrupt.lags",
+}
+
+#: Quarantine outcomes (the ``klba_quarantine_total`` label values).
+QUARANTINE_OUTCOMES = ("quarantined", "healed", "resynced", "escalated")
+
+#: Quarantine strikes on ONE stream before each further failure is
+#: also charged to the stream breaker (utils/watchdog.trip_breaker):
+#: a single cosmic-ray flip heals silently, a device that keeps
+#: corrupting state is as dead as one that keeps raising.
+ESCALATE_AFTER = 2
+
+#: Consecutive CLEAN served epochs that forgive a stream's strikes.
+#: Deliberately more than one: a corrupt -> heal -> corrupt flip-flop
+#: serves a clean healing epoch between every detection, and resetting
+#: on each of those would make the repeating pattern — exactly the
+#: failing-hardware signature escalation exists for — never escalate.
+FORGIVE_AFTER = 3
+
+
+class CorruptStateDetected(SolveRejected):
+    """A resident-state integrity check failed: the dispatch's output
+    (or the audited device state) does not match host truth, so the
+    answer must NOT be served.  Subtypes :class:`SolveRejected`
+    deliberately — by the time this raises the engine has already
+    QUARANTINED itself (resident dropped, host previous-choice intact),
+    so the service's fail-fast handler serves ``kept_previous`` (or the
+    host snake) and no breaker is charged; the next epoch rebuilds the
+    device state bit-exact from host truth.  ``buffers`` names the
+    buffer classes that failed (``choice`` / ``counts`` / ``lags`` /
+    ``row_tab``)."""
+
+    def __init__(self, message: str, buffers: Sequence[str]):
+        super().__init__(message)
+        self.buffers = list(buffers)
+
+
+def digest_failures(
+    digest: Any, expected_p: int, expected_lag_sum: Optional[int]
+) -> List[str]:
+    """Compare a dispatch's device digest against host truth; returns
+    the failed buffer classes (empty = clean).  ``expected_lag_sum``
+    None skips the lag-checksum slot (callers without a host sum)."""
+    d = np.asarray(digest)
+    fails: List[str] = []
+    if int(d[0]) != int(expected_p):
+        fails.append("counts")
+    if int(d[1]) != 0 or int(d[3]) != 0:
+        fails.append("choice")
+    if expected_lag_sum is not None and int(d[2]) != int(expected_lag_sum):
+        fails.append("lags")
+    return fails
+
+
+def record_quarantine(
+    buffers: Sequence[str],
+    outcome: str,
+    stream_id: Optional[str] = None,
+    source: Optional[str] = None,
+) -> None:
+    """Account one quarantine-plane event with ONE schema no matter
+    which layer detected it (per-epoch digest, scrubber audit, or the
+    coalescer's row check): ``klba_quarantine_total{buffer,outcome}``
+    plus a ``quarantine`` flight record.  Runs only on failure/heal
+    paths, so the registry's own get-or-create is plenty."""
+    for buffer in buffers:
+        metrics.REGISTRY.counter(
+            "klba_quarantine_total",
+            {"buffer": buffer, "outcome": outcome},
+        ).inc()
+    metrics.FLIGHT.record(
+        "quarantine",
+        {
+            "buffers": list(buffers),
+            "outcome": outcome,
+            "stream_id": stream_id,
+            "source": source,
+        },
+    )
+
+
+# -- chaos: seeded bit-flip injection -------------------------------------
+
+
+def corruption_plan(limit: Optional[int] = None) -> List[Tuple[str, int]]:
+    """Consult the three ``device.corrupt.*`` fault points; returns
+    ``[(buffer, seed), ...]`` for each point whose plan fires at this
+    call site (empty when no injector is active — the steady state pays
+    one global load per point).  The seed is derived from the
+    injector's own seed and the point's call count, so the same drill
+    schedule replays the same flips.  ``limit`` is folded in so two
+    sites with different bounds still diverge deterministically."""
+    inj = faults.active()
+    if inj is None:
+        return []
+    plan: List[Tuple[str, int]] = []
+    for buffer, point in CORRUPT_POINTS.items():
+        try:
+            faults.fire(point)
+        except faults.FaultError:
+            seed = (
+                inj.seed * 1_000_003
+                + inj.calls(point) * 97
+                + (int(limit) if limit else 0)
+            )
+            plan.append((buffer, seed))
+    return plan
+
+
+def flip_bit(arr: np.ndarray, seed: int, limit: Optional[int] = None):
+    """One seeded single-bit flip in ``arr`` (a host copy is returned;
+    the caller re-uploads it).  ``limit`` bounds the flipped index to
+    the REAL (un-padded) prefix — corruption of padding is harmless by
+    construction, so drills flip where it matters."""
+    rng = np.random.default_rng(seed)
+    out = np.array(arr, copy=True)
+    flat = out.reshape(-1)
+    hi = flat.size if limit is None else min(int(limit), flat.size)
+    i = int(rng.integers(max(hi, 1)))
+    bit = int(rng.integers(8 * out.dtype.itemsize - 1))
+    flat[i] = np.bitwise_xor(
+        flat[i], out.dtype.type(np.int64(1) << bit)
+    )
+    return out
+
+
+# -- the host-truth audit -------------------------------------------------
+
+
+def audit_engine(engine) -> Tuple[bool, List[str]]:
+    """Audit one streaming engine's FULL resident state against its
+    host mirror; returns ``(audited, failed_buffers)``.
+
+    ``audited`` False means there was nothing to check (cold engine,
+    stale resident, host state mid-repair) — not a pass.  The caller
+    must hold whatever lock serializes the engine against concurrent
+    epochs (the sidecar audits under the stream lock, idle streams
+    only).  A locked-roster handle materializes its row (one gather per
+    buffer — the ``coalesce.gather`` fault point fires there, so drills
+    exercise this path too)."""
+    prev = getattr(engine, "_prev_choice", None)
+    resident = getattr(engine, "_resident", None)
+    if prev is None or resident is None:
+        return False, []
+    C = int(engine.num_consumers)
+    P = int(prev.shape[0])
+    if P == 0 or int(prev.min()) < 0 or int(prev.max()) >= C:
+        # Host state mid-repair (orphans) — the resident is stale or
+        # about to be dropped; nothing trustworthy to diff against.
+        return False, []
+    materialize = getattr(resident, "materialize", None)
+    bufs = materialize() if materialize is not None else resident
+    choice_d = np.asarray(bufs[0])
+    row_tab = np.asarray(bufs[1])
+    counts_d = np.asarray(bufs[2])
+    lags_d = np.asarray(bufs[3])
+    fails: List[str] = []
+    if choice_d.shape[0] < P or not np.array_equal(choice_d[:P], prev):
+        fails.append("choice")
+    expected_counts = np.bincount(prev, minlength=C).astype(counts_d.dtype)
+    if not np.array_equal(counts_d, expected_counts):
+        fails.append("counts")
+    mirror = getattr(engine, "_lag_mirror", None)
+    if mirror is not None and (
+        lags_d.shape[0] < P
+        or not np.array_equal(lags_d[:P], mirror.astype(lags_d.dtype))
+    ):
+        fails.append("lags")
+    # Row table: every consumer's occupied slots must name rows the
+    # host choice actually assigns to that consumer (the table is what
+    # the fused totals derivation gathers through — a corrupt segment
+    # silently mis-weights the quality loop).
+    M = row_tab.shape[1]
+    slot_ok = np.arange(M)[None, :] < expected_counts[:, None]
+    rows = row_tab[slot_ok]
+    owners = np.repeat(np.arange(C), expected_counts.clip(max=M))
+    if (
+        rows.size != owners.size
+        or np.any(rows < 0)
+        or np.any(rows >= P)
+        or not np.array_equal(prev[rows], owners)
+    ):
+        fails.append("row_tab")
+    return True, fails
+
+
+# -- the background scrubber ----------------------------------------------
+
+
+class StateScrubber:
+    """Round-robin background auditor (module docstring).
+
+    ``targets`` returns the current audit jobs as ``(stream_id,
+    auditor)`` pairs; each ``auditor()`` performs ONE audit attempt and
+    returns ``"audited"`` | ``"busy"`` (lock contended / not idle) |
+    ``"skipped"`` (nothing to audit) — the auditor owns locking and
+    quarantine handling, so this class stays free of engine imports.
+    ``suppress`` True skips the whole pass (the sidecar wires the
+    overload ladder's rung >= 2 here).  Each pass walks at most one
+    full rotation and stops early when ``budget_s`` is spent — the
+    scrubber must never become the load it is auditing for."""
+
+    def __init__(
+        self,
+        targets: Callable[[], List[Tuple[str, Callable[[], str]]]],
+        interval_s: float,
+        budget_s: float = 0.25,
+        suppress: Optional[Callable[[], bool]] = None,
+        clock: Optional[Callable[[], float]] = None,
+    ):
+        if interval_s <= 0:
+            raise ValueError(f"interval_s={interval_s} must be > 0")
+        if budget_s <= 0:
+            raise ValueError(f"budget_s={budget_s} must be > 0")
+        self._targets = targets
+        self.interval_s = float(interval_s)
+        self.budget_s = float(budget_s)
+        self._suppress = suppress or (lambda: False)
+        self._clock = clock or metrics.REGISTRY.clock
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._cursor = 0
+        self.last_pass_at: Optional[float] = None
+        self._m_passes = metrics.REGISTRY.counter("klba_scrub_passes_total")
+        self._m_audited = metrics.REGISTRY.counter(
+            "klba_scrub_streams_audited_total"
+        )
+        # Construction baselines: the registry series are process-wide
+        # (two services per process is routine in tests and drills),
+        # so the per-instance stats() view reports deltas — the same
+        # policy as the service's requests/errors counters.
+        self._base_passes = self._m_passes.value
+        self._base_audited = self._m_audited.value
+        self._m_skipped = {
+            r: metrics.REGISTRY.counter(
+                "klba_scrub_skipped_total", {"reason": r}
+            )
+            for r in ("overload", "busy", "error")
+        }
+        self._m_duration = metrics.REGISTRY.histogram(
+            "klba_scrub_duration_ms"
+        )
+
+    def start(self) -> "StateScrubber":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="klba-scrub", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.scrub_once()
+            except Exception:  # noqa: BLE001 — the auditor must survive
+                LOGGER.warning("scrub pass crashed", exc_info=True)
+                self._m_skipped["error"].inc()
+
+    def scrub_once(self) -> Dict[str, int]:
+        """One deadline-budgeted pass (also the drill/test entry point);
+        returns ``{audited, busy, suppressed}`` counts."""
+        if self._suppress():
+            # Overload rung >= 2: the device has no spare bandwidth for
+            # audits — integrity resumes when the ladder steps down.
+            self._m_skipped["overload"].inc()
+            return {"audited": 0, "busy": 0, "suppressed": 1}
+        started = self._clock()
+        deadline = started + self.budget_s
+        jobs = self._targets()
+        audited = busy = attempted = 0
+        n = len(jobs)
+        for k in range(n):
+            if self._clock() >= deadline:
+                break
+            sid, auditor = jobs[(self._cursor + k) % n]
+            attempted += 1
+            try:
+                outcome = auditor()
+            except Exception:  # noqa: BLE001 — one bad audit, not the pass
+                LOGGER.warning(
+                    "scrub audit of stream %r failed", sid, exc_info=True
+                )
+                self._m_skipped["error"].inc()
+                continue
+            if outcome == "audited":
+                audited += 1
+                self._m_audited.inc()
+            elif outcome == "busy":
+                busy += 1
+                self._m_skipped["busy"].inc()
+        if n:
+            # Round-robin: the next pass resumes where the budget cut
+            # this one off, so a large fleet still gets full coverage
+            # across passes instead of re-auditing the same prefix.
+            self._cursor = (self._cursor + attempted) % n
+        self.last_pass_at = self._clock()
+        self._m_passes.inc()
+        self._m_duration.observe((self.last_pass_at - started) * 1000.0)
+        metrics.FLIGHT.record(
+            "scrub", {"targets": n, "audited": audited, "busy": busy}
+        )
+        return {"audited": audited, "busy": busy, "suppressed": 0}
+
+    def stats(self) -> Dict[str, Any]:
+        """The operator surface (wire ``stats.scrub`` /
+        tools/dump_metrics.py --summary)."""
+        last = self.last_pass_at
+        return {
+            "interval_ms": self.interval_s * 1000.0,
+            "last_pass_age_s": (
+                self._clock() - last if last is not None else None
+            ),
+            "passes": self._m_passes.value - self._base_passes,
+            "streams_audited": (
+                self._m_audited.value - self._base_audited
+            ),
+        }

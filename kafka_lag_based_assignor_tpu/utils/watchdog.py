@@ -351,6 +351,23 @@ class Watchdog:
         if tripped:
             note_breaker_trip(key)
 
+    def trip_breaker(self, key: str) -> None:
+        """External failure-domain evidence against ``key``'s breaker:
+        open it NOW for a full cooldown (half-open probe recovery
+        applies as usual).  Used by the resident-state scrubber
+        (utils/scrub): repeated quarantines on one stream mean the
+        device is corrupting state faster than the heal path restores
+        it — as dead as a device that keeps raising.  A direct trip,
+        deliberately NOT a consecutive-failure increment: every
+        corrupt/heal cycle contains a successful healing epoch that
+        would reset that counter, so threshold counting could never
+        sideline exactly the repeating pattern escalation exists
+        for."""
+        with self._lock:
+            tripped = self._trip(self._breaker(key))
+        if tripped:
+            note_breaker_trip(key)
+
     # -- the watched call --------------------------------------------------
 
     def call(

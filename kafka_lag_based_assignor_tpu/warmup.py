@@ -204,6 +204,19 @@ def warmup(
                     engine.seed_choice(np.asarray(out))
                     engine.prestack_resident()
                     engine.rebalance(lags1d)
+                    # Quarantine -> heal replay (utils/scrub): a failed
+                    # integrity check drops the resident state and the
+                    # next epoch rebuilds it from the host previous
+                    # choice — the same table-BUILD executable the
+                    # repair/seed_choice epochs above compiled, driven
+                    # explicitly so the heal path stays pinned warm
+                    # even if the variants ever drift.
+                    # record=False: a synthetic drill must not show up
+                    # in the production quarantine counters per boot.
+                    engine.quarantine_resident(
+                        ["choice"], source="warmup", record=False
+                    )
+                    engine.rebalance(lags1d)
                     # assign_stream downcasts the upload to int32 when the
                     # lag range allows; ALSO warm the wide-lag (int64)
                     # variants of both the stream kernel and the fused

@@ -823,6 +823,15 @@ def test_chaos_soak_random_schedule_bounded_p99():
     points = ["device.solve", "device.compile", "stream.refine",
               "coalesce.flush", "wire.read", "delta.diff",
               "delta.apply"]
+    # Resident-state corruption points (utils/scrub): a firing plan
+    # silently flips one seeded bit in a device-resident buffer at a
+    # readback boundary instead of raising — the integrity plane
+    # (per-epoch fused digests, the delta conservation check, the
+    # guardrail's cold re-solve) must keep every SERVED assignment
+    # count-balanced while corruption is active, which is exactly the
+    # assert_valid_assignment invariant below.
+    corrupt_points = ["device.corrupt.choice", "device.corrupt.counts",
+                      "device.corrupt.lags"]
     # The snapshot-backend channel faults alongside the serving
     # faults: the soak's service snapshots (fenced, memory backend)
     # every epoch, so partition/CAS/lease/latency failures race live
@@ -868,6 +877,10 @@ def test_chaos_soak_random_schedule_bounded_p99():
                         times=rng.randrange(1, 3),
                         delay_s=rng.choice([0.05, 3.0]),
                     )
+            for point in corrupt_points:
+                if rng.random() < 0.3:
+                    inj.plan(point, mode="raise",
+                             times=rng.randrange(1, 3))
             for point in backend_points:
                 if rng.random() < 0.3:
                     # The backend channel never hangs unboundedly in

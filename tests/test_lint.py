@@ -395,3 +395,81 @@ def test_lint_raw_uploads_in_warm_path_modules():
     )
     waived_codes = [f.code for f in lint.lint_source(streaming, waived)]
     assert waived_codes.count("L016") == 1
+
+
+def test_lint_resident_buffer_assignment_outside_audited_helper():
+    """L018: in the warm-path modules, the resident-state fields
+    (engine ``_resident`` / ``_lag_mirror``; the coalescer's
+    ``_ResidentBatch`` members) may only be assigned inside audited
+    helpers — a function whose name contains ``resident`` or an
+    ``__init__`` — so the scrubber's host-mirror truth cannot drift
+    from the device through an unaudited write site."""
+    stream_mod = Path("kafka_lag_based_assignor_tpu/ops/streaming.py")
+    coalesce_mod = Path("kafka_lag_based_assignor_tpu/ops/coalesce.py")
+    bad = (
+        "class Engine:\n"
+        "    def refresh(self, bufs):\n"
+        "        self._resident = bufs\n"
+    )
+    assert any(
+        f.code == "L018" for f in lint.lint_source(stream_mod, bad)
+    )
+    mirror = bad.replace("self._resident", "self._lag_mirror")
+    assert any(
+        f.code == "L018" for f in lint.lint_source(stream_mod, mirror)
+    )
+    # Audited helpers (name contains 'resident') and __init__ pass.
+    ok = bad.replace("def refresh", "def _adopt_resident")
+    assert not any(
+        f.code == "L018" for f in lint.lint_source(stream_mod, ok)
+    )
+    init = bad.replace("def refresh", "def __init__")
+    assert not any(
+        f.code == "L018" for f in lint.lint_source(stream_mod, init)
+    )
+    # _ResidentBatch member names are policed in the coalescer only.
+    batch = (
+        "def swap(batch, c, t, n, l):\n"
+        "    batch.choice = c\n"
+        "    batch.row_tab = t\n"
+        "    batch.counts = n\n"
+        "    batch.lags = l\n"
+    )
+    found = [
+        f for f in lint.lint_source(coalesce_mod, batch)
+        if f.code == "L018"
+    ]
+    assert len(found) == 4
+    assert not any(
+        f.code == "L018" for f in lint.lint_source(stream_mod, batch)
+    )
+    batch_ok = batch.replace("def swap", "def adopt_resident_buffers")
+    assert not any(
+        f.code == "L018"
+        for f in lint.lint_source(coalesce_mod, batch_ok)
+    )
+    # Tuple unpacking is not an unpoliced route around the rule.
+    unpacked = (
+        "def swap(batch, c, l):\n"
+        "    batch.choice, batch.lags = c, l\n"
+    )
+    assert sum(
+        1 for f in lint.lint_source(coalesce_mod, unpacked)
+        if f.code == "L018"
+    ) == 2
+    # Waiver + out-of-scope files.
+    waived = bad.replace(
+        "self._resident = bufs",
+        "self._resident = bufs  # noqa: L018",
+    )
+    assert not any(
+        f.code == "L018" for f in lint.lint_source(stream_mod, waived)
+    )
+    other_mod = Path("kafka_lag_based_assignor_tpu/service.py")
+    assert not any(
+        f.code == "L018" for f in lint.lint_source(other_mod, bad)
+    )
+    assert not any(
+        f.code == "L018"
+        for f in lint.lint_source(Path("tests/x.py"), bad)
+    )

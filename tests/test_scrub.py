@@ -1,0 +1,547 @@
+"""Resident-state scrubber tests (ISSUE 11): the per-epoch fused
+integrity digest (detect corrupt choice/counts/lags deterministically
+on the first dispatch over them, quarantine, serve through the
+degraded ladder, heal bit-exact from host truth), the host-truth
+auditor over every resident buffer (row table included), the
+background :class:`StateScrubber` (cadence, round-robin budget,
+overload suppression), breaker escalation on repeated failures, the
+takeover-window standing pressure (ROADMAP lifecycle (e)), and the
+``tpu.assignor.scrub.interval.ms`` knob."""
+
+import numpy as np
+import pytest
+
+from kafka_lag_based_assignor_tpu.ops.streaming import StreamingAssignor
+from kafka_lag_based_assignor_tpu.service import (
+    AssignorService,
+    AssignorServiceClient,
+)
+from kafka_lag_based_assignor_tpu.testing import assert_valid_assignment
+from kafka_lag_based_assignor_tpu.utils import faults, metrics
+from kafka_lag_based_assignor_tpu.utils import scrub as scrub_mod
+from kafka_lag_based_assignor_tpu.utils.overload import OverloadController
+from kafka_lag_based_assignor_tpu.utils.scrub import (
+    CorruptStateDetected,
+    StateScrubber,
+    audit_engine,
+    digest_failures,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    yield
+    faults.deactivate()
+
+
+def _quarantine_total(outcome):
+    return sum(
+        c.value
+        for c in metrics.REGISTRY.series("klba_quarantine_total")
+        if c.labels.get("outcome") == outcome
+    )
+
+
+def _engine(C=8, **kw):
+    kw.setdefault("refine_threshold", None)
+    return StreamingAssignor(num_consumers=C, **kw)
+
+
+def _lags(rng, P=512):
+    return rng.integers(0, 10**6, P).astype(np.int64)
+
+
+def _corrupt(engine, buffer, seed=7):
+    """Run one epoch with the named device.corrupt.* plan armed, so the
+    corruption lands in the freshly adopted resident buffers."""
+    inj = faults.FaultInjector(seed=seed).plan(
+        f"device.corrupt.{buffer}", mode="raise", times=1
+    )
+    rng = np.random.default_rng(seed + 1000)
+    with faults.injected(inj):
+        engine.rebalance(_lags(rng))
+    assert inj.fired(f"device.corrupt.{buffer}") == 1
+    return engine
+
+
+# -- digest unit semantics ------------------------------------------------
+
+
+def test_digest_failures_slot_mapping():
+    clean = np.array([100, 0, 555, 0], dtype=np.int64)
+    assert digest_failures(clean, 100, 555) == []
+    assert digest_failures(clean, 99, 555) == ["counts"]
+    assert digest_failures(np.array([100, 1, 555, 0]), 100, 555) == [
+        "choice"
+    ]
+    assert digest_failures(np.array([100, 0, 555, 2]), 100, 555) == [
+        "choice"
+    ]
+    assert digest_failures(clean, 100, 554) == ["lags"]
+    # No host lag sum -> the lag slot is skipped, others still checked.
+    assert digest_failures(clean, 100, None) == []
+    many = digest_failures(np.array([99, 1, 1, 1]), 100, 555)
+    assert set(many) == {"counts", "choice", "lags"}
+
+
+def test_clean_epochs_audit_clean_and_digest_passes():
+    rng = np.random.default_rng(0)
+    e = _engine()
+    for _ in range(4):
+        e.rebalance(_lags(rng))
+    audited, fails = audit_engine(e)
+    assert audited and fails == []
+    assert not e.quarantined
+
+
+# -- corruption detection, quarantine, bit-exact heal ---------------------
+
+
+@pytest.mark.parametrize("buffer", ["choice", "counts"])
+def test_dispatch_detects_corruption_and_heals_bit_exact(buffer):
+    """A corrupted choice/counts buffer is detected on the FIRST
+    dispatch that consumes it (input-side digest — deterministic, the
+    refine loop could silently repair an output-side check), the
+    in-flight epoch raises the fail-fast CorruptStateDetected (warm
+    HOST state intact), and the next epoch heals bit-exact: identical
+    output to a twin engine seeded with the same host truth."""
+    rng = np.random.default_rng(3)
+    e = _engine()
+    e.rebalance(_lags(rng))
+    e.rebalance(_lags(rng))
+    _corrupt(e, buffer)
+    q_before = _quarantine_total("quarantined")
+    h_before = _quarantine_total("healed")
+    prev = np.array(e._prev_choice, copy=True)
+    detect_lags = _lags(np.random.default_rng(77))
+    with pytest.raises(CorruptStateDetected) as exc:
+        e.rebalance(detect_lags)
+    assert buffer in exc.value.buffers
+    assert e.quarantined
+    assert _quarantine_total("quarantined") - q_before >= 1
+    # Host truth untouched by the failed epoch.
+    np.testing.assert_array_equal(e._prev_choice, prev)
+    # Heal: the next epoch rebuilds from host truth, bit-exact vs a
+    # twin seeded with the same previous choice.
+    heal_lags = _lags(np.random.default_rng(78))
+    healed = e.rebalance(heal_lags)
+    assert not e.quarantined
+    assert _quarantine_total("healed") - h_before >= 1
+    twin = _engine()
+    twin.seed_choice(prev)
+    np.testing.assert_array_equal(healed, twin.rebalance(heal_lags))
+
+
+def test_lags_corruption_detected_by_audit_and_delta_conservation():
+    """The resident lag buffer is consulted only by delta dispatches,
+    so a flipped lag bit is caught by (a) the scrubber's audit against
+    the host mirror, and (b) a delta epoch's conservation check —
+    which re-syncs dense in-request (counted ``resynced``) instead of
+    failing the epoch."""
+    rng = np.random.default_rng(5)
+    e = _engine(delta_max_fraction=1.0)
+    base = _lags(rng)
+    e.rebalance(base)
+    e.rebalance(base.copy())
+    _corrupt(e, "lags")
+    audited, fails = audit_engine(e)
+    assert audited and fails == ["lags"]
+    resynced_before = _quarantine_total("resynced")
+    # A small drift goes delta: scatter onto the corrupt buffer, the
+    # device lag sum diverges from host truth, dense re-sync follows.
+    drift = np.array(e._lag_mirror, copy=True)
+    drift[:8] += 17
+    out = e.rebalance(drift)
+    assert _quarantine_total("resynced") - resynced_before == 1
+    # Served result is the healthy dense answer: bit-exact vs a twin.
+    audited, fails = audit_engine(e)
+    assert audited and fails == []
+    assert out.shape[0] == base.shape[0]
+
+
+def test_row_tab_corruption_detected_by_audit():
+    rng = np.random.default_rng(9)
+    e = _engine()
+    e.rebalance(_lags(rng))
+    e.rebalance(_lags(rng))
+    import jax
+
+    choice, row_tab, counts, lags = e._resident
+    tab = np.asarray(row_tab).copy()
+    tab[0, 0] = tab[0, 0] + 1 if tab[0, 0] + 1 < 512 else tab[0, 0] - 1
+    # White-box corruption: bypass the injector, poke the table row.
+    e._resident = (choice, jax.device_put(tab), counts, lags)  # noqa: L018
+    audited, fails = audit_engine(e)
+    assert audited and "row_tab" in fails
+
+
+def test_audit_skips_cold_and_stale_engines():
+    e = _engine()
+    assert audit_engine(e) == (False, [])  # cold: nothing to audit
+    rng = np.random.default_rng(1)
+    e.rebalance(_lags(rng))
+    e.rebalance(_lags(rng))
+    e.seed_choice(np.array(e._prev_choice))  # stale resident
+    assert audit_engine(e) == (False, [])
+
+
+# -- the background scrubber ----------------------------------------------
+
+
+def test_scrubber_round_robin_budget_and_suppression():
+    audits = []
+
+    def auditor(name):
+        return lambda: (audits.append(name), "audited")[1]
+
+    targets = lambda: [(n, auditor(n)) for n in "abcd"]  # noqa: E731
+    clock = [0.0]
+
+    def fake_clock():
+        clock[0] += 0.1  # every tick costs 0.1s against the budget
+        return clock[0]
+
+    suppressed = [False]
+    s = StateScrubber(
+        targets, interval_s=1.0, budget_s=0.25,
+        suppress=lambda: suppressed[0], clock=fake_clock,
+    )
+    out = s.scrub_once()
+    # Budget 0.25s at 0.1s/tick: only ~2 targets fit per pass.
+    assert 1 <= out["audited"] <= 3
+    first = list(audits)
+    s.scrub_once()
+    # Round-robin: the next pass resumes past the first pass's prefix.
+    assert audits[len(first)] != first[0]
+    suppressed[0] = True
+    out = s.scrub_once()
+    assert out == {"audited": 0, "busy": 0, "suppressed": 1}
+    assert s.stats()["passes"] >= 2
+
+
+def test_scrubber_interval_validation():
+    with pytest.raises(ValueError):
+        StateScrubber(lambda: [], interval_s=0.0)
+    with pytest.raises(ValueError):
+        StateScrubber(lambda: [], interval_s=1.0, budget_s=0.0)
+
+
+# -- service integration --------------------------------------------------
+
+
+def _rows(arr):
+    return [[i, int(v)] for i, v in enumerate(arr)]
+
+
+def test_service_detects_serves_degraded_and_heals():
+    """End-to-end through the sidecar: corrupt -> the next epoch is
+    served kept_previous (fail-fast ladder, valid assignment, stream
+    not poisoned) -> the epoch after heals bit-exact vs a twin seeded
+    with the served choice."""
+    rng = np.random.default_rng(0)
+    P, C = 256, 4
+    members = ["A", "B", "C", "D"]
+    with AssignorService(port=0, scrub_interval_ms=3600_000.0) as svc:
+        c = AssignorServiceClient(*svc.address, timeout_s=120.0)
+        # guardrail None: a guardrail trip would cold-resolve and
+        # silently discard the corruption before detection.
+        opts = {"guardrail": None, "refine_threshold": None}
+        c.stream_assign("s0", "t0", _rows(_lags(rng, P)), members,
+                        options=opts)
+        c.stream_assign("s0", "t0", _rows(_lags(rng, P)), members,
+                        options=opts)
+        inj = faults.FaultInjector(seed=4).plan(
+            "device.corrupt.choice", mode="raise", times=1
+        )
+        with faults.injected(inj):
+            c.stream_assign("s0", "t0", _rows(_lags(rng, P)), members,
+                            options=opts)
+        assert inj.fired("device.corrupt.choice") == 1
+        served_prev = np.array(
+            svc._streams["s0"].engine._prev_choice, copy=True
+        )
+        r = c.stream_assign("s0", "t0", _rows(_lags(rng, P)), members,
+                            options=opts)
+        # Served through the ladder, never the corrupt buffer.
+        assert r["stream"]["degraded_rung"] == "kept_previous"
+        assert r["stream"]["fallback_used"]
+        assert_valid_assignment(r["assignments"], P)
+        assert svc._streams["s0"].scrub_strikes == 1
+        # Heal epoch: warm, bit-exact vs the twin, stream intact.
+        heal = _lags(rng, P)
+        r2 = c.stream_assign("s0", "t0", _rows(heal), members,
+                             options=opts)
+        assert r2["stream"]["degraded_rung"] == "none"
+        assert not r2["stream"]["cold_start"]
+        twin = StreamingAssignor(num_consumers=C, refine_threshold=None)
+        twin.seed_choice(served_prev)
+        expect = twin.rebalance(heal)
+        midx = {m: j for j, m in enumerate(members)}
+        got = np.full(P, -1, np.int32)
+        for m, tps in r2["assignments"].items():
+            for _t, p in tps:
+                got[p] = midx[m]
+        np.testing.assert_array_equal(got, expect)
+        c.close()
+
+
+def test_service_scrubber_audits_idle_stream_and_quarantines():
+    """The background auditor catches corruption on an IDLE stream —
+    no serving epoch needed — and the stream heals on its next epoch."""
+    rng = np.random.default_rng(2)
+    P = 256
+    with AssignorService(port=0, scrub_interval_ms=3600_000.0) as svc:
+        c = AssignorServiceClient(*svc.address, timeout_s=120.0)
+        opts = {"guardrail": None, "refine_threshold": None}
+        c.stream_assign("s0", "t0", _rows(_lags(rng, P)), ["A", "B"],
+                        options=opts)
+        c.stream_assign("s0", "t0", _rows(_lags(rng, P)), ["A", "B"],
+                        options=opts)
+        inj = faults.FaultInjector(seed=6).plan(
+            "device.corrupt.counts", mode="raise", times=1
+        )
+        with faults.injected(inj):
+            c.stream_assign("s0", "t0", _rows(_lags(rng, P)),
+                            ["A", "B"], options=opts)
+        q_before = _quarantine_total("quarantined")
+        out = svc._scrubber.scrub_once()
+        assert out["audited"] == 1
+        assert _quarantine_total("quarantined") - q_before >= 1
+        st = svc._streams["s0"]
+        assert st.engine.quarantined
+        assert svc.scrub_stats()["quarantined_streams"] == 1
+        r = c.stream_assign("s0", "t0", _rows(_lags(rng, P)),
+                            ["A", "B"], options=opts)
+        assert r["stream"]["degraded_rung"] == "none"
+        assert not st.engine.quarantined
+        c.close()
+
+
+def test_repeated_corruption_escalates_to_stream_breaker():
+    """Strike accounting: a corrupt -> heal -> corrupt flip-flop is
+    NOT forgiven by the single clean healing epoch in between — the
+    second strike TRIPS the stream breaker directly (at the DEFAULT
+    failure threshold: the healing epochs between strikes succeed, so
+    consecutive-failure counting could never fire), and subsequent
+    epochs fail fast to kept_previous."""
+    rng = np.random.default_rng(8)
+    P = 256
+    esc_before = _quarantine_total("escalated")
+    with AssignorService(
+        port=0, breaker_cooldown_s=60.0,
+        scrub_interval_ms=3600_000.0,
+    ) as svc:
+        c = AssignorServiceClient(*svc.address, timeout_s=120.0)
+        opts = {"guardrail": None, "refine_threshold": None}
+        c.stream_assign("s0", "t0", _rows(_lags(rng, P)), ["A", "B"],
+                        options=opts)
+        for strike in (1, 2):
+            inj = faults.FaultInjector(seed=40 + strike).plan(
+                "device.corrupt.choice", mode="raise", times=1
+            )
+            with faults.injected(inj):
+                # This epoch adopts (and corrupts) fresh state.
+                c.stream_assign("s0", "t0", _rows(_lags(rng, P)),
+                                ["A", "B"], options=opts)
+            # Detection epoch: served kept_previous, strike counted.
+            r = c.stream_assign("s0", "t0", _rows(_lags(rng, P)),
+                                ["A", "B"], options=opts)
+            assert r["stream"]["degraded_rung"] == "kept_previous"
+            assert svc._streams["s0"].scrub_strikes == strike
+        assert _quarantine_total("escalated") - esc_before >= 1
+        assert svc._watchdog.state("stream") == "open"
+        # Breaker open: fail-fast kept_previous, warm state intact.
+        r = c.stream_assign("s0", "t0", _rows(_lags(rng, P)),
+                            ["A", "B"], options=opts)
+        assert r["stream"]["degraded_rung"] == "kept_previous"
+        c.close()
+
+
+def test_strikes_forgiven_after_clean_run():
+    rng = np.random.default_rng(12)
+    P = 128
+    with AssignorService(port=0, scrub_interval_ms=3600_000.0) as svc:
+        c = AssignorServiceClient(*svc.address, timeout_s=120.0)
+        opts = {"guardrail": None, "refine_threshold": None}
+        c.stream_assign("s0", "t0", _rows(_lags(rng, P)), ["A", "B"],
+                        options=opts)
+        inj = faults.FaultInjector(seed=30).plan(
+            "device.corrupt.choice", mode="raise", times=1
+        )
+        with faults.injected(inj):
+            c.stream_assign("s0", "t0", _rows(_lags(rng, P)),
+                            ["A", "B"], options=opts)
+        c.stream_assign("s0", "t0", _rows(_lags(rng, P)), ["A", "B"],
+                        options=opts)
+        st = svc._streams["s0"]
+        assert st.scrub_strikes == 1
+        for _ in range(scrub_mod.FORGIVE_AFTER):
+            c.stream_assign("s0", "t0", _rows(_lags(rng, P)),
+                            ["A", "B"], options=opts)
+        assert st.scrub_strikes == 0
+        c.close()
+
+
+def test_scrub_suppressed_under_overload_rung2():
+    with AssignorService(port=0, scrub_interval_ms=3600_000.0) as svc:
+        svc._overload.restore_state(
+            {"rung": 2, "pressure": 3.0, "ewma_depth": 0.0}
+        )
+        out = svc._scrubber.scrub_once()
+        assert out["suppressed"] == 1
+
+
+# -- takeover-window standing pressure (ROADMAP lifecycle (e)) ------------
+
+
+def test_standing_pressure_holds_window_at_rung1_scale():
+    clock = [0.0]
+    ctl = OverloadController(
+        latency_budget_ms=1000.0, depth_high=8.0,
+        clock=lambda: clock[0], eval_interval_s=0.0,
+    )
+    assert ctl.admission("standard").window_scale == 1.0
+    ctl.add_standing_pressure(4.0)
+    d = ctl.admission("standard")
+    assert d.action == "admit"  # pressure 0.5 < rung-1 threshold
+    assert d.window_scale == 0.5  # but the window is HELD at rung-1
+    assert ctl.snapshot()["standing_pressure"] == 4.0
+    assert ctl.snapshot()["window_scale"] == 0.5
+    # Partial release keeps the hold; full release restores the window.
+    ctl.release_standing_pressure(2.0)
+    assert ctl.admission("standard").window_scale == 0.5
+    ctl.release_standing_pressure(2.0)
+    assert ctl.admission("standard").window_scale == 1.0
+    assert ctl.snapshot()["standing_pressure"] == 0.0
+
+
+def test_standing_pressure_feeds_ladder_and_never_goes_negative():
+    clock = [0.0]
+    ctl = OverloadController(
+        latency_budget_ms=1000.0, depth_high=8.0,
+        clock=lambda: clock[0], eval_interval_s=0.0,
+    )
+    ctl.add_standing_pressure(16.0)  # pressure 2.0 -> rung 2
+    d = ctl.admission("best_effort")
+    assert d.rung == 2 and d.action == "degrade"
+    ctl.release_standing_pressure(100.0)
+    assert ctl.standing_pressure() == 0.0
+
+
+def test_takeover_under_load_sheds_until_warmup_drains(tmp_path):
+    """Service e2e (ROADMAP lifecycle (e)): a replacement adopting
+    streams from a snapshot parks their class weight as standing
+    pressure — the admission window is held at rung-1 scale while the
+    adopted streams are still cold — and releases it stream by stream
+    as each serves its first epoch."""
+    rng = np.random.default_rng(21)
+    P = 128
+    members = ["A", "B"]
+    snap = str(tmp_path / "snap.json")
+    svc = AssignorService(
+        port=0, snapshot_path=snap, snapshot_interval_s=3600.0,
+        scrub_interval_ms=0.0,
+    ).start()
+    c = AssignorServiceClient(*svc.address, timeout_s=120.0)
+    lag_vecs = {}
+    for sid in ("s0", "s1"):
+        lag_vecs[sid] = _lags(rng, P)
+        c.stream_assign(sid, "t0", _rows(lag_vecs[sid]), members)
+    assert svc.snapshot_now()["ok"]
+    c.close()
+    svc.stop()
+
+    svc2 = AssignorService(
+        port=0, snapshot_path=snap, snapshot_interval_s=3600.0,
+        recovery_warmup=False, scrub_interval_ms=0.0,
+    ).start()
+    try:
+        snap2 = svc2._overload.snapshot()
+        assert snap2["standing_pressure"] == pytest.approx(4.0)  # 2x std
+        assert snap2["window_scale"] == 0.5  # held at rung-1 scale
+        c2 = AssignorServiceClient(*svc2.address, timeout_s=120.0)
+        r = c2.stream_assign("s0", "t0", _rows(lag_vecs["s0"]), members)
+        assert r["stream"]["warm_restart"]
+        assert svc2._overload.standing_pressure() == pytest.approx(2.0)
+        # A reset releases an adopted stream that never served.
+        c2.stream_reset("s1")
+        assert svc2._overload.standing_pressure() == 0.0
+        assert svc2._overload.snapshot()["window_scale"] == 1.0
+        c2.close()
+    finally:
+        svc2.stop()
+
+
+# -- knobs ----------------------------------------------------------------
+
+
+def test_scrub_interval_config_knob():
+    from kafka_lag_based_assignor_tpu.utils.config import parse_config
+
+    cfg = parse_config(
+        {"group.id": "g", "tpu.assignor.scrub.interval.ms": "5000"}
+    )
+    assert cfg.scrub_interval_s == 5.0
+    assert parse_config({"group.id": "g"}).scrub_interval_s == 30.0
+    cfg = parse_config(
+        {"group.id": "g", "tpu.assignor.scrub.interval.ms": 0}
+    )
+    assert cfg.scrub_interval_s == 0.0
+    svc = AssignorService.from_config(
+        {"group.id": "g", "tpu.assignor.scrub.interval.ms": 0}
+    )
+    assert svc._scrubber is None
+    svc.stop()
+    svc = AssignorService.from_config({"group.id": "g"})
+    assert svc._scrubber is not None
+    assert svc._scrubber.interval_s == 30.0
+    svc.stop()
+
+
+def test_takeover_warming_ttl_expires_unseen_streams(tmp_path):
+    """TTL backstop: a snapshot can carry a stream whose consumer
+    group was decommissioned before the restart — its parked share
+    must not hold the admission window at rung-1 scale forever.  Past
+    TAKEOVER_WARMING_TTL_S the remaining shares are released wholesale
+    on the next admission."""
+    from kafka_lag_based_assignor_tpu import service as service_mod
+
+    rng = np.random.default_rng(33)
+    P = 128
+    members = ["A", "B"]
+    snap = str(tmp_path / "snap.json")
+    svc = AssignorService(
+        port=0, snapshot_path=snap, snapshot_interval_s=3600.0,
+        scrub_interval_ms=0.0,
+    ).start()
+    c = AssignorServiceClient(*svc.address, timeout_s=120.0)
+    vecs = {}
+    for sid in ("s0", "dead"):
+        vecs[sid] = _lags(rng, P)
+        c.stream_assign(sid, "t0", _rows(vecs[sid]), members)
+    assert svc.snapshot_now()["ok"]
+    c.close()
+    svc.stop()
+
+    now = [10_000.0]
+    svc2 = AssignorService(
+        port=0, snapshot_path=snap, snapshot_interval_s=3600.0,
+        recovery_warmup=False, scrub_interval_ms=0.0,
+        clock=lambda: now[0],
+    ).start()
+    try:
+        assert svc2._overload.standing_pressure() == pytest.approx(4.0)
+        c2 = AssignorServiceClient(*svc2.address, timeout_s=120.0)
+        c2.stream_assign("s0", "t0", _rows(vecs["s0"]), members)
+        assert svc2._overload.standing_pressure() == pytest.approx(2.0)
+        # "dead" never reconnects; within the TTL its share holds...
+        c2.stream_assign("s0", "t0", _rows(vecs["s0"]), members)
+        assert svc2._overload.standing_pressure() == pytest.approx(2.0)
+        # ...and past the TTL the next admission expires it wholesale.
+        now[0] += service_mod.TAKEOVER_WARMING_TTL_S + 1.0
+        c2.stream_assign("s0", "t0", _rows(vecs["s0"]), members)
+        assert svc2._overload.standing_pressure() == 0.0
+        assert svc2._overload.snapshot()["window_scale"] == 1.0
+        c2.close()
+    finally:
+        svc2.stop()

@@ -64,12 +64,12 @@ def main() -> int:
             args.host, args.port, timeout_s=args.timeout
         ) as client:
             result = client.request("metrics", {"view": view})
-            # The summary's lifecycle rows (state, snapshot age, last
-            # recovery) come from the stats surface, not the registry.
-            lifecycle = (
-                client.request("stats").get("lifecycle")
-                if args.summary else None
-            )
+            # The summary's lifecycle + scrub rows (state, snapshot
+            # age, last recovery, scrub coverage) come from the stats
+            # surface, not the registry.
+            stats = client.request("stats") if args.summary else {}
+            lifecycle = stats.get("lifecycle")
+            scrub = stats.get("scrub")
     except OSError as exc:
         print(
             f"cannot reach sidecar at {args.host}:{args.port}: {exc}",
@@ -254,6 +254,40 @@ def main() -> int:
             paced = counter_total("klba_resync_paced_total")
             if paced:
                 print(f"resync epochs paced: {int(paced)}")
+
+        # State-integrity view (DEPLOYMENT.md "State integrity"):
+        # scrub coverage (streams audited / pass interval), last-scrub
+        # age, and the per-buffer quarantine totals — the "is the
+        # long-lived device state being watched, and has anything
+        # rotted" look, next to the lifecycle rows above.
+        if scrub:
+            age = scrub.get("last_pass_age_s")
+            age_txt = (
+                f"{age:.1f}s ago" if age is not None else "never"
+            )
+            print(
+                f"scrub: {int(scrub.get('streams_audited', 0))} "
+                f"audits over {int(scrub.get('passes', 0))} passes "
+                f"(every {scrub.get('interval_ms', 0) / 1000.0:.0f}s), "
+                f"last pass {age_txt}, "
+                f"{int(scrub.get('quarantined_streams', 0))} stream(s) "
+                "quarantined now"
+            )
+        elif lifecycle:
+            print("scrub: disabled (tpu.assignor.scrub.interval.ms=0)")
+        quarantines = js.get("klba_quarantine_total", {}).get(
+            "series", []
+        )
+        if quarantines:
+            total = 0
+            for s in quarantines:
+                total += s["value"]
+                print(
+                    f"quarantine buffer={s['labels'].get('buffer')} "
+                    f"outcome={s['labels'].get('outcome')}: "
+                    f"{int(s['value'])}"
+                )
+            print(f"quarantine total: {int(total)}")
         return 0
     print(json.dumps(result["json"], indent=2, sort_keys=True))
     return 0

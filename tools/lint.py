@@ -78,6 +78,21 @@ Rules:
         ``# noqa: L017`` waiver stating why the write is not
         snapshot-shaped state.  Raw write-mode opens of snapshot
         payloads are already L015's territory.
+  L018  resident-buffer assignment outside an audited helper: in the
+        warm-path modules (ops/streaming.py, ops/coalesce.py) the
+        device-resident state fields — ``_resident`` / ``_lag_mirror``
+        on the engine, and the ``choice`` / ``row_tab`` / ``counts`` /
+        ``lags`` members of the coalescer's ``_ResidentBatch`` — may
+        only be assigned inside audited helper functions (a function
+        whose name contains ``resident``, e.g. ``_adopt_resident`` /
+        ``_drop_resident`` / ``adopt_resident_buffers``, or an
+        ``__init__``).  The resident-state scrubber (utils/scrub)
+        audits these buffers against host-mirror truth; an unaudited
+        write site could install device state the mirror never saw —
+        exactly the silent drift the scrubber exists to catch — or
+        drop a mirror without its buffer.  Waivable with
+        ``# noqa: L018`` stating why the write cannot go through an
+        audited helper.
 """
 
 from __future__ import annotations
@@ -390,6 +405,80 @@ def _l017_findings(rel: str, tree: ast.AST, lines: List[str]) -> List[Finding]:
     return findings
 
 
+#: L018: resident-state fields whose assignment must stay inside
+#: audited helpers.  Engine-side fields apply to both warm-path
+#: modules; the batch-member names only to the coalescer (where the
+#: stacked _ResidentBatch lives — "lags" etc. are too generic to
+#: police in streaming.py, whose engine keeps them inside _resident).
+_L018_ENGINE_FIELDS = frozenset({"_resident", "_lag_mirror"})
+_L018_BATCH_FIELDS = frozenset({"choice", "row_tab", "counts", "lags"})
+
+
+def _l018_findings(
+    rel: str, tree: ast.AST, lines: List[str], batch_fields: bool
+) -> List[Finding]:
+    """Walk with enclosing-function context (the L013 pattern):
+    resident-buffer field assignments are allowed only inside audited
+    helpers — a function whose name contains ``resident`` or an
+    ``__init__`` (construction is the one write that cannot pre-date a
+    mirror)."""
+    fields = set(_L018_ENGINE_FIELDS)
+    if batch_fields:
+        fields |= _L018_BATCH_FIELDS
+    findings: List[Finding] = []
+
+    def targets_of(node) -> list:
+        if isinstance(node, ast.Assign):
+            raw = node.targets
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            raw = [node.target]
+        else:
+            return []
+        # Flatten tuple/list unpacking: `a.choice, a.lags = c, l` must
+        # not be an unpoliced route around the invariant.
+        flat: list = []
+        for target in raw:
+            if isinstance(target, (ast.Tuple, ast.List)):
+                flat.extend(target.elts)
+            else:
+                flat.append(target)
+        return flat
+
+    def visit(node: ast.AST, in_helper: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_scope = in_helper
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child_scope = (
+                    in_helper
+                    or "resident" in child.name
+                    or child.name == "__init__"
+                )
+            if not in_helper:
+                for target in targets_of(child):
+                    if (
+                        isinstance(target, ast.Attribute)
+                        and target.attr in fields
+                        and "noqa: L018" not in lines[child.lineno - 1]
+                    ):
+                        findings.append(
+                            Finding(
+                                rel,
+                                child.lineno,
+                                "L018",
+                                f"resident-buffer field .{target.attr} "
+                                "assigned outside an audited helper: "
+                                "route it through an *resident* helper "
+                                "so the scrubber's host-mirror truth "
+                                "cannot drift from the device (or "
+                                "waive with `# noqa: L018`)",
+                            )
+                        )
+            visit(child, child_scope)
+
+    visit(tree, False)
+    return findings
+
+
 _UNBOUNDED_QUEUE_TYPES = ("Queue", "LifoQueue", "PriorityQueue")
 
 
@@ -549,6 +638,15 @@ def lint_source(path: Path, source: str) -> List[Finding]:
     # through the designated counted helpers.
     if is_package and path.name in ("coalesce.py", "streaming.py"):
         findings.extend(_l016_findings(rel, tree, lines))
+        # L018: the resident-state scrubber's host-mirror truth is
+        # only as good as the discipline around who may install or
+        # drop resident buffers.
+        findings.extend(
+            _l018_findings(
+                rel, tree, lines,
+                batch_fields=path.name == "coalesce.py",
+            )
+        )
     if is_package:
         findings.extend(_l014_list_buffer_findings(rel, tree, lines))
         findings.extend(_l015_findings(rel, tree, lines))
