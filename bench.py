@@ -2114,6 +2114,260 @@ def config11_scrub():
     }
 
 
+def config12_federated():
+    """Federated-partition probe (ISSUE 12): three sidecars, each
+    holding one shard of a global lag instance, converge a global
+    assignment by exchanging only duals/marginals (federated/), then
+    survive a full peer partition and heal.  What must hold (gated in
+    main, every backend — the protocol is config, not hardware): the
+    converged global assignment's quality is within 5% of the
+    single-leader Sinkhorn solve on the concatenated instance; under a
+    FULL partition every sidecar keeps serving valid (count-balanced)
+    local assignments with zero request errors and zero warm-loop
+    compiles; after heal, peers re-converge within the bounded round
+    budget; an on-wire audit finds zero raw-lag byte windows in any
+    ``peer_sync`` payload; and stale/fenced duals are rejected and
+    counted, never blended."""
+    import socket as socket_mod
+
+    from kafka_lag_based_assignor_tpu.federated import wire
+    from kafka_lag_based_assignor_tpu.models.sinkhorn import (
+        assign_topic_sinkhorn,
+    )
+    from kafka_lag_based_assignor_tpu.ops import fedsolve
+    from kafka_lag_based_assignor_tpu.ops.packing import pad_topic_rows
+    from kafka_lag_based_assignor_tpu.service import (
+        AssignorService,
+        AssignorServiceClient,
+    )
+    from kafka_lag_based_assignor_tpu.utils import faults
+    from kafka_lag_based_assignor_tpu.utils import metrics as m
+    from kafka_lag_based_assignor_tpu.utils.observability import (
+        compile_count,
+        install_compile_counter,
+    )
+
+    install_compile_counter()
+    P, C, N = 2048, 8, 3
+    MAX_ROUNDS = 16
+    members = [f"m{j}" for j in range(C)]
+    rng = np.random.default_rng(0xFED12)
+    shards = [
+        rng.integers(0, 10**6, P).astype(np.int64) for _ in range(N)
+    ]
+
+    def rows(arr):
+        return [[i, int(v)] for i, v in enumerate(arr)]
+
+    def stale_total(reason):
+        return sum(
+            c.value
+            for c in m.REGISTRY.series("klba_peer_stale_duals_total")
+            if c.labels.get("reason") == reason
+        )
+
+    # Full-mesh topology on pre-allocated ports (the coordinator needs
+    # every peer's address at construction).
+    socks = [socket_mod.socket() for _ in range(N)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    ids = [f"dc{i}" for i in range(N)]
+    svcs, clients = [], []
+    for i in range(N):
+        peer_spec = ",".join(
+            f"{ids[j]}=127.0.0.1:{ports[j]}"
+            for j in range(N) if j != i
+        )
+        svc = AssignorService(
+            port=ports[i], coalesce_max_batch=1,
+            scrub_interval_ms=0.0, breaker_cooldown_s=0.5,
+            federation_self_id=ids[i], federation_peers=peer_spec,
+            federation_rounds=MAX_ROUNDS,
+            federation_sync_timeout_s=300.0,
+        ).start()
+        svcs.append(svc)
+        clients.append(
+            AssignorServiceClient(*svc.address, timeout_s=600.0)
+        )
+
+    def fed(i):
+        return clients[i].federated_assign(
+            "t0", rows(shards[i]), members
+        )
+
+    def decode_totals(resp, shard):
+        midx = {mm: j for j, mm in enumerate(members)}
+        got = np.full(P, -1, np.int32)
+        for mm, tps in resp["assignments"].items():
+            for _t, p in tps:
+                got[p] = midx[mm]
+        assert int(got.min()) >= 0
+        counts = np.bincount(got, minlength=C)
+        balanced = int(counts.max() - counts.min()) <= 1
+        totals = np.bincount(
+            got, weights=shard.astype(np.float64), minlength=C
+        )
+        return balanced, totals
+
+    # ---- Rehearsal: registration + every ladder rung compiles here,
+    # repeated until compile-quiet, so the measured phases below can
+    # gate on ZERO fresh executables.
+    for _ in range(2):
+        for i in range(N):
+            fed(i)
+    with faults.injected(
+        faults.FaultInjector(1).plan("peer.partition", times=0)
+    ):
+        fed(0)  # last_good_global rung
+        svcs[0]._federation._last_good = None
+        fed(0)  # local_only rung (stateless rounds solve)
+    for svc in svcs:
+        svc._watchdog.reset()
+    for _ in range(4):
+        quiet = compile_count()
+        for i in range(N):
+            fed(i)
+        if compile_count() == quiet:
+            break
+
+    # ---- Phase A: converged global quality vs the single leader.
+    errors_before = [svc.errors for svc in svcs]
+    compiles_a = compile_count()
+    responses = [fed(i) for i in range(N)]
+    compiles_a = compile_count() - compiles_a
+    global_rungs = [r["federation"]["rung"] for r in responses]
+    converge_rounds = max(
+        r["federation"]["rounds"] for r in responses
+    )
+    fed_totals = np.zeros(C)
+    invalid = 0
+    for resp, shard in zip(responses, shards):
+        balanced, totals = decode_totals(resp, shard)
+        invalid += 0 if balanced else 1
+        fed_totals += totals
+    fed_q = float(fed_totals.max() / fed_totals.mean())
+    full = np.concatenate(shards)
+    lags_p, pids_p, valid = pad_topic_rows(full)
+    _, _, leader_totals = assign_topic_sinkhorn(
+        lags_p, pids_p, valid, num_consumers=C
+    )
+    leader_totals = np.asarray(leader_totals, np.float64)
+    leader_q = float(leader_totals.max() / leader_totals.mean())
+    log(
+        f"federated: global quality {fed_q:.5f} vs leader "
+        f"{leader_q:.5f} in <= {converge_rounds} rounds"
+    )
+
+    # ---- Phase B: FULL peer partition — every sidecar keeps serving
+    # valid local assignments, zero request errors, zero compiles.
+    partition_rungs = []
+    compiles_b = compile_count()
+    with faults.injected(
+        faults.FaultInjector(2).plan("peer.partition", times=0)
+    ):
+        for wave in range(3):
+            for i in range(N):
+                if wave == 1 and i == 0:
+                    # One lane exercises the BOTTOM rung too: with the
+                    # dual cache dropped, partition must degrade to
+                    # exactly the single-cluster solve.
+                    svcs[0]._federation._last_good = None
+                r = fed(i)
+                partition_rungs.append(r["federation"]["rung"])
+                balanced, _ = decode_totals(r, shards[i])
+                invalid += 0 if balanced else 1
+    compiles_b = compile_count() - compiles_b
+    partition_errors = sum(
+        svc.errors - before
+        for svc, before in zip(svcs, errors_before)
+    )
+
+    # ---- Phase C: heal — breakers close, peers re-converge within
+    # the bounded round budget.
+    for svc in svcs:
+        svc._watchdog.reset()
+    heal_rungs, heal_rounds = [], 0
+    for i in range(N):
+        r = fed(i)
+        heal_rungs.append(r["federation"]["rung"])
+        heal_rounds = max(heal_rounds, r["federation"]["rounds"])
+
+    # ---- Phase D: on-wire audit — real protocol payloads (request
+    # AND response, built by the audited serializer like every peer
+    # byte) must contain no window of ANY shard's raw lag vector.
+    fed_b = svcs[1]._federation
+    total = sum(int(s.sum()) for s in shards)
+    scale = max(float(total), 1.0) / C
+    A, B = fedsolve.initial_duals(C)
+    req = wire.sync_request(
+        "bench-audit", 1, 1, C, scale=scale, duals_a=A, duals_b=B,
+    )
+    resp = fed_b.serve_sync(req)
+    wire_leaks = 0
+    for payload in (wire.encode(req), wire.encode(resp)):
+        for shard in shards:
+            try:
+                wire.assert_lag_free(payload, shard)
+            except AssertionError as exc:
+                wire_leaks += 1
+                log(f"federated: WIRE LEAK: {exc}")
+    marginals_served = "marginals" in resp
+
+    # ---- Phase E: stale + fenced duals rejected and counted.
+    stale_before = stale_total("stale_epoch")
+    fenced_before = stale_total("fenced")
+    fed_b.serve_sync(wire.sync_request(
+        "bench-stale", 9, 0, C, scale=1.0, phase="hello",
+    ))
+    stale_resp = fed_b.serve_sync(wire.sync_request(
+        "bench-stale", 2, 0, C, scale=1.0, phase="hello",
+    ))
+    fed_b.serve_sync(wire.sync_request(
+        "bench-fence", 1, 0, C, scale=1.0, phase="hello",
+        fence_token=8,
+    ))
+    fenced_resp = fed_b.serve_sync(wire.sync_request(
+        "bench-fence", 2, 0, C, scale=1.0, phase="hello",
+        fence_token=3,
+    ))
+    stale_rejected = stale_total("stale_epoch") - stale_before
+    fenced_rejected = stale_total("fenced") - fenced_before
+
+    for c in clients:
+        c.close()
+    for svc in svcs:
+        svc.stop()
+
+    return {
+        "config": "federated_partition",
+        "sidecars": N,
+        "partitions_per_shard": P,
+        "consumers": C,
+        "max_rounds": MAX_ROUNDS,
+        "global_rungs": global_rungs,
+        "converge_rounds": converge_rounds,
+        "quality_global": round(fed_q, 5),
+        "quality_leader": round(leader_q, 5),
+        "quality_vs_leader": round(fed_q / leader_q, 5),
+        "invalid_assignments": invalid,
+        "partition_rungs": partition_rungs,
+        "partition_errors": partition_errors,
+        "partition_compile_count": compiles_b,
+        "global_compile_count": compiles_a,
+        "heal_rungs": heal_rungs,
+        "heal_rounds": heal_rounds,
+        "wire_leaks": wire_leaks,
+        "wire_marginals_served": marginals_served,
+        "stale_rejected": int(stale_rejected),
+        "fenced_rejected": int(fenced_rejected),
+        "stale_answer": stale_resp.get("rejected"),
+        "fenced_answer": fenced_resp.get("rejected"),
+    }
+
+
 def main():
     # A wedged accelerator tunnel must degrade the benchmark, not hang it
     # (the framework's own watchdog philosophy, SURVEY §5 failure row):
@@ -2164,7 +2418,7 @@ def main():
     for fn in (config1_readme, config2_zipf, config3_vmap, config4_skew,
                config5_northstar, config6_multistream, config7_overload,
                config8_restart, config9_delta, config10_handoff,
-               config11_scrub):
+               config11_scrub, config12_federated):
         before = klba_metrics.REGISTRY.snapshot()
         r = fn()
         deltas = klba_metrics.histogram_deltas(
@@ -2521,6 +2775,88 @@ def main():
             failures.append(
                 f"corruption_storm digest_overhead_ratio {ratio:.3%} "
                 ">= 1% of the warm no-op epoch"
+            )
+    # Federated-partition gates (every backend — the exchange protocol
+    # and its ladder are config facts, not hardware ones): converged
+    # global quality within 5% of the single leader, valid local
+    # assignments with zero errors and zero compiles through a FULL
+    # partition, bounded re-convergence after heal, zero raw-lag bytes
+    # on the peer wire, and stale/fenced duals rejected + counted.
+    fp = results.get("federated_partition", {})
+    if fp:
+        if any(r != "global" for r in fp.get("global_rungs", ["x"])):
+            failures.append(
+                f"federated_partition rungs {fp.get('global_rungs')} "
+                "— not every sidecar converged a global assignment "
+                "with all peers reachable"
+            )
+        q = fp.get("quality_vs_leader")
+        if q is None or q > 1.05:
+            failures.append(
+                f"federated_partition quality_vs_leader {q} > 1.05 — "
+                "the dual-exchange assignment lost more than 5% to "
+                "the single-leader solve"
+            )
+        if fp.get("invalid_assignments", 0) > 0:
+            failures.append(
+                f"federated_partition served "
+                f"{fp['invalid_assignments']} invalid (count-"
+                "imbalanced) local assignment(s)"
+            )
+        if fp.get("partition_errors", 0) > 0:
+            failures.append(
+                f"federated_partition saw {fp['partition_errors']} "
+                "request error(s) during the full peer partition — "
+                "the ladder is not failing open"
+            )
+        if fp.get("partition_compile_count", 0) != 0:
+            failures.append(
+                f"federated_partition compiled "
+                f"{fp['partition_compile_count']} executable(s) "
+                "during the partition — a degradation rung is not "
+                "covered by the rehearsal/warm-up"
+            )
+        bad_rungs = [
+            r for r in fp.get("partition_rungs", [])
+            if r not in ("last_good_global", "local_only")
+        ]
+        if bad_rungs:
+            failures.append(
+                f"federated_partition served rung(s) {bad_rungs} "
+                "during the full partition — a partitioned peer set "
+                "must degrade, not claim convergence"
+            )
+        if any(r != "global" for r in fp.get("heal_rungs", ["x"])):
+            failures.append(
+                f"federated_partition heal rungs "
+                f"{fp.get('heal_rungs')} — peers did not re-converge "
+                "after the partition healed"
+            )
+        if fp.get("heal_rounds", 99) > fp.get("max_rounds", 16):
+            failures.append(
+                f"federated_partition re-converged in "
+                f"{fp.get('heal_rounds')} rounds > the "
+                f"{fp.get('max_rounds')}-round budget"
+            )
+        if fp.get("wire_leaks", 1) != 0:
+            failures.append(
+                f"federated_partition found {fp.get('wire_leaks')} "
+                "raw-lag byte window(s) in peer_sync payloads — the "
+                "privacy contract is broken"
+            )
+        if not fp.get("wire_marginals_served", False):
+            failures.append(
+                "federated_partition wire audit got no marginals — "
+                "the audited exchange response was not exercised"
+            )
+        if fp.get("stale_rejected", 0) < 1 or fp.get(
+            "fenced_rejected", 0
+        ) < 1:
+            failures.append(
+                f"federated_partition stale/fenced rejections "
+                f"{fp.get('stale_rejected')}/"
+                f"{fp.get('fenced_rejected')} — regressed or fenced "
+                "duals are not being rejected and counted"
             )
     for msg in failures:
         log(f"bench: REGRESSION GATE FAILED: {msg}")

@@ -593,10 +593,16 @@ class MegabatchCoalescer:
         self.delta_k = int(delta_k)
         # Overload backpressure: the shed ladder's rung-1 action scales
         # the admission window down (smaller waves, lower parked
-        # latency — batch efficiency yields before latency).  A plain
-        # float write/read (GIL-atomic); the service sets it per its
-        # overload controller's rung.
+        # latency — batch efficiency yields before latency).  Plain
+        # GIL-atomic writes/reads; the service sets them per its
+        # overload controller's rung.  ``_window_scales`` is PER CLASS
+        # (rank-ordered: critical/standard/best_effort — ROADMAP
+        # overload (b)): each parked submission's admission deadline
+        # uses its own class's scale, so the critical window stays
+        # wide while best_effort shrinks.  ``_window_scale`` mirrors
+        # the standard class (legacy single-scale surface + gauge).
         self._window_scale = 1.0
+        self._window_scales = (1.0, 1.0, 1.0)
         # EWMA of a megabatch flush's dispatch->readback wall time: the
         # deadline-admission estimate of "can this row survive a full
         # flush".  Starts at 0 (no rerouting until measured).
@@ -673,16 +679,34 @@ class MegabatchCoalescer:
     # -- submission --------------------------------------------------------
 
     def set_window_scale(self, scale: float) -> None:
-        """Overload backpressure hook: scale the admission window to
-        ``window_s * scale`` (clamped to [0.05, 1.0]) — rung 1 of the
-        shed ladder.  Safe from any thread."""
+        """Overload backpressure hook, legacy single-scale form: scale
+        EVERY class's admission window to ``window_s * scale``
+        (clamped to [0.05, 1.0]).  Safe from any thread."""
         scale = min(max(float(scale), 0.05), 1.0)
-        if scale == self._window_scale:
+        self.set_window_scales((scale, scale, scale))
+
+    def set_window_scales(self, scales) -> None:
+        """Per-class window scales (rank order: critical, standard,
+        best_effort — utils/overload's ``_Decision.window_scales``):
+        each parked submission's admission deadline is computed with
+        ITS class's scale, so rung-1 backpressure shrinks best_effort
+        waves while critical epochs keep their full coalescing window.
+        Safe from any thread."""
+        scales = tuple(
+            min(max(float(s), 0.05), 1.0) for s in scales
+        )
+        if len(scales) != 3:
+            raise ValueError("window scales must be a (crit, std, be) triple")
+        if scales == self._window_scales:
             # Called on every admitted request (service admission path):
             # the steady state at rung 0 must not pay the gauge lock.
             return
-        self._window_scale = scale
-        self._m_window_scale.set(scale)
+        self._window_scales = scales
+        self._window_scale = scales[1]
+        self._m_window_scale.set(scales[1])
+        # Wake the flusher: a shrunk class window may already be due.
+        with self._cond:
+            self._cond.notify_all()
 
     def submit(self, sub: EpochSubmission) -> Future:
         """Enqueue one epoch; returns the future its flush resolves.
@@ -822,14 +846,23 @@ class MegabatchCoalescer:
                     # scaled down under overload (shed ladder rung 1);
                     # a full shape group (or roster wave)
                     # short-circuits.
+                    # Per-class deadlines (ROADMAP overload (b)): each
+                    # parked submission's window uses ITS class's
+                    # scale, so the wave flushes at the EARLIEST class
+                    # deadline — recomputed per wakeup because a newly
+                    # parked best_effort row (or a scale change) can
+                    # tighten it below the oldest row's.
                     with metrics.span("coalesce.window"):
-                        deadline = (
-                            self._pending[0].enqueued_at
-                            + self.window_s * self._window_scale
-                        )
                         while not self._closed:
                             if self._flush_ready():
                                 break
+                            scales = self._window_scales
+                            deadline = min(
+                                s.enqueued_at + self.window_s * scales[
+                                    s.rank if 0 <= s.rank < 3 else 1
+                                ]
+                                for s in self._pending
+                            )
                             remaining = deadline - self._clock()
                             if remaining <= 0:
                                 break

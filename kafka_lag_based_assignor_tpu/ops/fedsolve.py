@@ -1,0 +1,265 @@
+"""Federated Sinkhorn building blocks: dual-seeded rounds over lag shards.
+
+The Sinkhorn quality solver (:mod:`..models.sinkhorn`) keeps its whole
+iteration state in two f32[C] dual-like vectors ``(A, B)`` and consumes
+only two marginal statistics of the implicit plan per step — which is
+exactly the structure Federated Sinkhorn (arXiv:2502.07021, PAPERS.md —
+pattern only) exploits: N parties each holding a SHARD of the row axis
+can run the identical global iteration by exchanging their local
+marginal contributions, because both marginals are plain sums over rows
+
+    load_j   = sum_shards  load_j^(s)
+    colsum_j = sum_shards  colsum_j^(s)
+
+and the dual update depends on the rows only through those sums.  Raw
+per-partition lags never have to leave a shard: everything on the wire
+is C-dimensional (consumer-axis) aggregates plus three scalars.
+
+This module is the device math of the federated plane
+(:mod:`..federated` owns the protocol, robustness, and caching):
+
+* :func:`shard_summary` — the handshake scalars (total lag, valid
+  count) whose global sums fix the shared normalization ``scale =
+  max(total_global, 1) / C`` and the balanced count marginal ``cap =
+  n_global / C``.  Every peer must use the SAME scale or the duals
+  describe different units; the coordinator exchanges these first.
+* :func:`shard_dedup` — the host-side dedup aggregation of one shard
+  under an EXPLICIT (global) scale; same log-bucketing cap as the
+  single-leader path so iteration cost stays bounded per shard.
+* :func:`shard_marginals` — one fused pass producing this shard's
+  ``(load, colsum)`` contribution under the current duals (the payload
+  of a ``peer_sync`` response).
+* :func:`dual_step` — ONE step of the damped mirror/Sinkhorn update on
+  globally summed marginals: the same arithmetic as
+  ``models.sinkhorn._sinkhorn_duals_jit``'s loop body, factored to one
+  step so the exchange loop can interleave network rounds.  Feeding it
+  the marginals of a single full shard reproduces the single-leader
+  trajectory (pinned by tests/test_federated.py).
+* :func:`initial_duals` — the shared deterministic starting point
+  (zero A, hash-seeded B0): every peer starts identically, so peers
+  applying the same summed marginals hold bit-identical duals without
+  ever exchanging them authoritatively.
+* :func:`round_local_shard` — the dual-seeded rounding: integral,
+  locally count-balanced assignment of THIS shard's partitions, steered
+  by the global duals, with the OTHER shards' converged loads as a
+  fixed base offset so the exchange refinement balances the GLOBAL
+  peaks with local moves only.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..models.sinkhorn import (
+    _DEDUP_CAP,
+    _quantize_tail,
+    _require_concrete,
+    _round_parallel,
+)
+from .plan_stats import noise, plan_stats
+
+#: Default cap on refine pair width for the dual-seeded local round —
+#: the same bound the single-leader Sinkhorn path uses.
+_MAX_PAIRS = 64
+
+#: Convergence tolerance of the exchange loop (same as the leader's).
+DUAL_TOL = 2e-5
+
+
+def shard_summary(lags, valid) -> Tuple[int, int]:
+    """Host scalars of one shard: ``(total_lag, n_valid)``.  Their
+    global sums fix the shared scale/cap every peer must agree on."""
+    lags_np = np.asarray(lags)
+    valid_np = np.asarray(valid)
+    return int(lags_np[valid_np].sum()), int(valid_np.sum())
+
+
+def shard_dedup(lags, valid, scale: float):
+    """Dedup one shard's rows onto the unique-lag-value axis under an
+    explicit GLOBAL scale (``models.sinkhorn._dedup_weights`` derives
+    the scale from the local rows, which a shard must not do — its
+    local total is not the normalization the global duals live in).
+    Returns ``(ws_u, count_u, wsum_u)`` f32, pow2-padded."""
+    from .packing import pad_bucket
+
+    lags_np = np.asarray(lags)
+    valid_np = np.asarray(valid)
+    vals = lags_np[valid_np]
+    uniq, counts = np.unique(vals, return_counts=True)
+    if len(uniq) > _DEDUP_CAP:
+        vals_r, cnts_r, vsums_r = _quantize_tail(uniq, counts)
+    else:
+        vals_r = uniq.astype(np.float64)
+        cnts_r = counts.astype(np.float64)
+        vsums_r = vals_r * cnts_r
+    scale = max(float(scale), 1e-9)
+    U = max(len(vals_r), 1)
+    U_pad = pad_bucket(U)
+    ws_u = np.zeros(U_pad, np.float32)
+    count_u = np.zeros(U_pad, np.float32)
+    wsum_u = np.zeros(U_pad, np.float32)
+    ws_u[: len(vals_r)] = vals_r / scale
+    count_u[: len(vals_r)] = cnts_r
+    wsum_u[: len(vals_r)] = vsums_r / scale
+    return ws_u, count_u, wsum_u
+
+
+@jax.jit
+def _shard_marginals_jit(ws_u, count_u, wsum_u, A, B):
+    return plan_stats(ws_u, count_u, wsum_u, A, B, need="both")
+
+
+def shard_marginals(ws_u, count_u, wsum_u, A, B):
+    """This shard's marginal contribution under duals ``(A, B)``:
+    ``(load f32[C], colsum f32[C])`` — the exchanged payload.  Padding
+    rows carry count=wsum=0 and contribute exactly nothing, so shards
+    of different (padded) sizes sum correctly."""
+    from .dispatch import ensure_x64
+
+    ensure_x64()
+    load, colsum = _shard_marginals_jit(ws_u, count_u, wsum_u, A, B)
+    return np.asarray(load), np.asarray(colsum)
+
+
+@functools.partial(jax.jit, static_argnames=("num_consumers",))
+def _dual_step_jit(A, B, load, colsum, cap, step_scale, prev_spread,
+                   num_consumers: int, eta: float = 8.0):
+    del num_consumers  # shape key only (cache hygiene across C)
+    eta32 = jnp.float32(eta)
+    spread = jnp.max(load) - jnp.min(load)
+    grew = spread > prev_spread
+    step_scale = jnp.where(
+        grew,
+        step_scale * jnp.float32(0.5),
+        jnp.minimum(step_scale * jnp.float32(1.2), jnp.float32(1.0)),
+    )
+    A = A + eta32 * step_scale * (load - jnp.mean(load))
+    upd = jnp.log(cap / (colsum + jnp.float32(1e-9)))
+    B = B + upd
+    delta = jnp.maximum(spread, jnp.max(jnp.abs(upd)))
+    return A, B, step_scale, spread, delta
+
+
+def dual_step(A, B, load_sum, colsum_sum, cap: float, step_scale: float,
+              prev_spread: float):
+    """One damped mirror/Sinkhorn step on globally summed marginals.
+
+    The ``load`` half-step uses the CURRENT duals' load marginal and the
+    ``colsum`` half-step re-reads the column marginal — the leader's
+    loop computes the colsum AFTER moving A, which one network exchange
+    per step cannot afford; the federated loop instead applies both
+    half-steps from the same round's marginals.  The trajectory differs
+    from the leader's by one half-step of lag but converges to the same
+    fixpoint (the bench gate pins quality within 5% of the leader).
+
+    Returns ``(A, B, step_scale, spread, delta)`` with ``spread``/
+    ``delta`` as Python floats (the convergence test is host-side, in
+    the exchange loop between network rounds).
+    """
+    A2, B2, s2, spread, delta = _dual_step_jit(
+        jnp.asarray(A), jnp.asarray(B),
+        jnp.asarray(load_sum, dtype=jnp.float32),
+        jnp.asarray(colsum_sum, dtype=jnp.float32),
+        jnp.float32(cap), jnp.float32(step_scale),
+        jnp.float32(prev_spread), num_consumers=int(np.asarray(A).shape[0]),
+    )
+    return (
+        np.asarray(A2), np.asarray(B2), float(s2), float(spread),
+        float(delta),
+    )
+
+
+def initial_duals(num_consumers: int):
+    """The shared deterministic dual seed: zero ``A`` plus the same
+    hash-noise ``B0`` the single-leader iteration uses for symmetry
+    breaking — every peer computes it locally and identically."""
+    C = int(num_consumers)
+    A0 = np.zeros(C, np.float32)
+    B0 = np.asarray(
+        noise(jnp.zeros((C,), jnp.int32), jnp.arange(C, dtype=jnp.int32))
+    )
+    return A0, B0
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_consumers", "refine_iters")
+)
+def _round_local_jit(lags, valid, ws, A, B, base_totals,
+                     num_consumers: int, refine_iters: int):
+    from .packing import table_rows
+    from .refine import build_choice_tables, refine_rounds_resident
+
+    C = int(num_consumers)
+    P = lags.shape[0]
+    n_valid = jnp.sum(valid.astype(jnp.int32))
+    floor_cap = n_valid // C
+    extras = n_valid - floor_cap * C
+    choice = _round_parallel(lags, ws, valid, A, B, C, floor_cap, extras)
+    row_tab, r_counts, r_totals = build_choice_tables(
+        lags, valid, choice, C, table_rows(P, C)
+    )
+    # The other shards' converged loads ride as a FIXED per-consumer
+    # base: local exchanges then minimize the GLOBAL peak (local totals
+    # + base) — a consumer hot on remote shards sheds local load even
+    # when locally light.
+    s_choice, _, s_counts, s_totals, _, _ = refine_rounds_resident(
+        lags, choice, row_tab, r_counts,
+        r_totals + base_totals.astype(r_totals.dtype),
+        num_consumers=C, iters=refine_iters,
+        max_pairs=min(C // 2, _MAX_PAIRS),
+    )
+    return s_choice, s_counts, s_totals - base_totals.astype(r_totals.dtype)
+
+
+def round_local_shard(lags, num_consumers: int, A, B,
+                      scale: float, base_load,
+                      refine_iters: Optional[int] = None):
+    """Dual-seeded integral rounding of ONE shard (host entry point).
+
+    ``lags`` are the UNPADDED local rows (sorted-pid order; padding to
+    the pow2 bucket happens here so the jit cache stays bounded as P
+    drifts); ``A``/``B`` the converged GLOBAL duals; ``scale`` the
+    shared global normalization; ``base_load`` f32[C] the summed load
+    marginal of every OTHER shard (ws units) — converted to lag units
+    and held fixed while the local exchange refinement balances global
+    peaks.  Locally count-balanced by construction (capacities
+    floor/ceil of the LOCAL row count).
+
+    Returns ``(choice int32[P] — input order — counts int32[C],
+    local_totals[C] in lag units)``.
+    """
+    from .dispatch import ensure_x64
+    from .packing import pad_topic_rows
+
+    ensure_x64()
+    P = int(np.asarray(lags).shape[0])
+    lags_p, _, valid = pad_topic_rows(np.asarray(lags, dtype=np.int64))
+    if refine_iters is None:
+        # Auto budget, scaled with the shard: the parallel argmax
+        # rounding leaves O(P) repair work that max_pairs exchanges per
+        # round must absorb — 64 rounds that suffice at P=512 leave a
+        # 1.4x peak at P=2048 (measured; 256 recovers 1.0001).  Pow2 by
+        # construction (P_pad is), so the executable count stays one
+        # per (P_pad, C) bucket.
+        refine_iters = min(1024, max(128, int(lags_p.shape[0]) // 8))
+    _require_concrete(lags_p, valid, "round_local_shard")
+    lags_j = jnp.asarray(lags_p)
+    valid_j = jnp.asarray(valid)
+    ws = (
+        jnp.where(valid_j, lags_j, 0).astype(jnp.float64)
+        / jnp.float64(max(float(scale), 1e-9))
+    ).astype(jnp.float32)
+    base_totals = jnp.asarray(
+        np.asarray(base_load, dtype=np.float64) * max(float(scale), 1e-9)
+    ).astype(jnp.int64)
+    choice, counts, totals = _round_local_jit(
+        lags_j, valid_j, ws, jnp.asarray(A), jnp.asarray(B), base_totals,
+        num_consumers=int(num_consumers), refine_iters=int(refine_iters),
+    )
+    return np.asarray(choice)[:P], np.asarray(counts), np.asarray(totals)

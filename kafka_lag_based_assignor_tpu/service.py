@@ -196,6 +196,7 @@ import socket
 import socketserver
 import threading
 import time
+from contextlib import contextmanager
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .assignor import LagBasedPartitionAssignor
@@ -263,8 +264,18 @@ _KNOWN_METHODS = frozenset(
     {
         "ping", "stats", "metrics", "assign", "stream_assign",
         "stream_reset", "stream_flight", "recommend", "drain",
+        "peer_sync", "federation", "federated_assign",
     }
 )
+
+# Wire encodings for the dense lag payload (DEPLOYMENT.md "Delta
+# epochs" — resync-storm compression): ``params.encoding`` selects how
+# ``params.lags`` is carried.  "zlib" = base64(zlib(JSON rows)) — the
+# post-restart dense resync wave re-sends every stream's full vector
+# at once, and those payloads compress ~5-10x.  An UNKNOWN encoding is
+# answered with a structured error naming the supported set so the
+# client can fall back to plain JSON (the client helper does).
+_LAG_ENCODINGS = ("zlib",)
 
 # Lifecycle states (the klba_lifecycle_state gauge exports the index).
 _LIFECYCLE_STATES = ("serving", "draining", "stopped")
@@ -459,6 +470,98 @@ def _parse_lag_delta(delta: Any):
             "params.lag_delta.indices contains duplicate partition ids"
         )
     return d_pids, d_vals, base
+
+
+def _decode_wire_lags(params: Dict[str, Any]):
+    """Resolve ``params.lags`` honoring ``params.encoding`` (module
+    docstring "Delta epochs" — resync-storm compression).  Returns the
+    plain ``[[pid, lag], ...]`` rows.  ``encoding: "zlib"`` carries the
+    rows as base64(zlib(JSON)) — the post-restart dense resync wave
+    compresses ~5-10x — counted both ways in
+    ``klba_wire_lag_bytes_total{encoding=zlib|plain}`` so the ratio
+    reads off one counter pair.  Unknown encodings are a structured
+    client error naming the supported set (the client helper falls
+    back to plain JSON on it)."""
+    rows = params.get("lags")
+    enc = params.get("encoding")
+    if enc is None or rows in (None, []):
+        return rows or []
+    if enc not in _LAG_ENCODINGS:
+        raise ValueError(
+            f"unknown encoding {enc!r}; supported: "
+            f"{list(_LAG_ENCODINGS)} — resend params.lags as plain JSON"
+        )
+    if not isinstance(rows, str):
+        raise ValueError(
+            "params.lags must be a base64 string when params.encoding "
+            "is set"
+        )
+    import base64
+    import zlib
+
+    try:
+        blob = base64.b64decode(rows.encode("ascii"), validate=True)
+    except (ValueError, UnicodeEncodeError) as exc:
+        raise ValueError(f"params.lags is not valid base64: {exc}")
+    # Bounded inflate: the wire line cap must hold for the DECODED
+    # payload too, or a small compressed bomb would bypass it.
+    d = zlib.decompressobj()
+    try:
+        plain = d.decompress(blob, MAX_LINE_BYTES + 1)
+    except zlib.error as exc:
+        raise ValueError(f"params.lags failed to decompress: {exc}")
+    if len(plain) > MAX_LINE_BYTES or d.unconsumed_tail:
+        raise ValueError(
+            f"decoded lag payload exceeds {MAX_LINE_BYTES} bytes"
+        )
+    metrics.REGISTRY.counter(
+        "klba_wire_lag_bytes_total", {"encoding": "zlib"}
+    ).inc(len(blob))
+    metrics.REGISTRY.counter(
+        "klba_wire_lag_bytes_total", {"encoding": "plain"}
+    ).inc(len(plain))
+    decoded = json.loads(plain)
+    if not isinstance(decoded, list):
+        raise ValueError("decoded params.lags must be a JSON list")
+    return decoded
+
+
+def encode_lags_zlib(rows) -> str:
+    """Client half of the ``encoding: "zlib"`` wire shape (the JVM shim
+    mirrors this): base64(zlib(JSON rows))."""
+    import base64
+    import zlib
+
+    return base64.b64encode(
+        zlib.compress(json.dumps(rows).encode())
+    ).decode("ascii")
+
+
+def _parse_lag_rows(rows):
+    """THE dense-lag row validation both solve surfaces share
+    (``stream_assign`` and ``federated_assign``): non-empty, no
+    negative lags (the reference's formula clamps at 0 — a negative is
+    a client bug), no duplicate pids.  Returns ``(pids_sorted int64[P],
+    lags int64[P])`` in ascending-pid order (the row-order contract
+    warm state is keyed on)."""
+    import numpy as np
+
+    if not rows:
+        raise ValueError("params.lags must be a non-empty list")
+    pids = np.fromiter(
+        (int(p) for p, _ in rows), np.int64, count=len(rows)
+    )
+    lags_in = np.fromiter(
+        (int(lag) for _, lag in rows), np.int64, count=len(rows)
+    )
+    if lags_in.size and int(lags_in.min()) < 0:
+        raise ValueError("params.lags contains negative lag values")
+    order = np.argsort(pids, kind="stable")
+    pids_sorted = pids[order]
+    lags = lags_in[order]
+    if pids_sorted.size and (np.diff(pids_sorted) == 0).any():
+        raise ValueError("params.lags contains duplicate partition ids")
+    return pids_sorted, lags
 
 
 def _serve_previous(prev, lags, C: int):
@@ -910,6 +1013,23 @@ class AssignorService:
         # rebuilds bit-exact from host truth) and repeated failures
         # escalate to the stream breaker.  <= 0 disables.
         scrub_interval_ms: float = 30_000.0,
+        # Federated multi-cluster assignment (federated/;
+        # DEPLOYMENT.md "Federated assignment"): this sidecar's stable
+        # peer id plus the peer sidecars ("id=host:port,..." or a
+        # parsed PeerSpec list).  With both set, the sidecar answers
+        # ``peer_sync`` over its local lag shard and serves
+        # ``federated_assign`` by running synchronized dual-exchange
+        # rounds against every peer inside the request's deadline
+        # budget — only consumer-axis duals/marginals cross the wire,
+        # never raw lags.  Per-peer circuit breakers ride the service
+        # watchdog (keys ``peer:<id>``); any incomplete round degrades
+        # last-good-global -> local-only (today's single-cluster
+        # behavior), bounded by federation_max_staleness_s.
+        federation_self_id: Optional[str] = None,
+        federation_peers: Any = None,
+        federation_rounds: int = 16,
+        federation_sync_timeout_s: float = 2.0,
+        federation_max_staleness_s: float = 300.0,
         # False skips the recovered-shape warm-up pass in start()
         # (tests/drills that assert recovery semantics without paying
         # compiles); production keeps it on — it is what makes the
@@ -1117,6 +1237,36 @@ class AssignorService:
         else:
             self._snapshot_store = None
             self._snapshot_writer = None
+        # Federated peer coordination (federated/peers): built only
+        # when configured — a single-cluster sidecar pays nothing.
+        # The per-peer breakers live on the SERVICE watchdog (keys
+        # ``peer:<id>``), so ``stats.breakers`` shows sidelined peers
+        # next to sidelined solvers; the fencing token is read lazily
+        # from the snapshot store's writer lease, so a fenced-off
+        # predecessor's sync requests are rejected by its peers with
+        # the same token that fences its snapshot writes.
+        if federation_self_id:
+            from .federated import FederationCoordinator, parse_peer_specs
+
+            specs = federation_peers or []
+            if isinstance(specs, str):
+                specs = parse_peer_specs(specs)
+            self._federation = FederationCoordinator(
+                self_id=str(federation_self_id),
+                peers=list(specs),
+                watchdog=self._watchdog,
+                max_rounds=int(federation_rounds),
+                sync_timeout_s=float(federation_sync_timeout_s),
+                max_staleness_s=float(federation_max_staleness_s),
+                fence_token=self._federation_fence_token,
+                clock=clock,
+            )
+        else:
+            if federation_peers:
+                raise ValueError(
+                    "federation_peers requires federation_self_id"
+                )
+            self._federation = None
 
     @property
     def requests_served(self) -> int:
@@ -1201,6 +1351,11 @@ class AssignorService:
             "resync_max_inflight": cfg.resync_max_inflight,
             "recovery_prestack": cfg.recovery_prestack,
             "scrub_interval_ms": cfg.scrub_interval_s * 1000.0,
+            "federation_self_id": cfg.federation_self_id,
+            "federation_peers": cfg.federation_peers or None,
+            "federation_rounds": cfg.federation_rounds,
+            "federation_sync_timeout_s": cfg.federation_sync_timeout_s,
+            "federation_max_staleness_s": cfg.federation_max_staleness_s,
             "warmup_shapes": cfg.warmup_shapes or None,
             "slo_classes": cfg.slo_classes,
             "slo_deadline_s": cfg.slo_deadline_s,
@@ -1343,6 +1498,12 @@ class AssignorService:
             # Resident-state scrubber coverage + quarantine counts
             # (DEPLOYMENT.md "State integrity"); None when disabled.
             result["scrub"] = self.scrub_stats()
+            # Federated peer coordination (DEPLOYMENT.md "Federated
+            # assignment"); None when not configured.
+            result["federation"] = (
+                self._federation.status()
+                if self._federation is not None else None
+            )
             return result, None
         if method == "metrics":
             # The registry, both ways: structured JSON for programmatic
@@ -1559,6 +1720,49 @@ class AssignorService:
                 "records": records,
                 "cleared": cleared,
             }, None
+        if method == "peer_sync":
+            # Peer-coordination surface (federated/; DEPLOYMENT.md
+            # "Federated assignment"): answer a peer's dual-exchange
+            # round over this sidecar's registered local lag shard.
+            # Every response is built by the audited federated/wire
+            # serializer — consumer-axis aggregates only, never raw
+            # lags (lint L019 confines construction there).
+            if self._federation is None:
+                raise ValueError(
+                    "federation is not configured on this sidecar"
+                )
+            return self._federation.serve_sync(
+                req.get("params") or {}
+            ), None
+        if method == "federation":
+            # Operator surface: peer link states (breaker, last
+            # outcome, epoch/fence ledger), the degradation rung, and
+            # the last-good dual cache's age.
+            if self._federation is None:
+                return {"enabled": False}, None
+            out = self._federation.status()
+            out["enabled"] = True
+            return out, None
+        if method == "federated_assign":
+            params = req.get("params") or {}
+            klass = self._slo.resolve(None, params.get("slo_class"))
+            self._reject_if_draining(klass)
+            budget = _DeadlineBudget(
+                self._slo.budget_s(klass, self._watchdog.timeout_s),
+                clock=self._clock,
+            )
+            result = self._federated_assign(params, budget, klass)
+            rung = result["federation"]["rung"]
+            metrics.REGISTRY.counter(
+                "klba_ladder_rung_total",
+                {"method": "federated_assign", "rung": rung},
+            ).inc()
+            if rung != "global":
+                metrics.FLIGHT.auto_dump(
+                    "ladder",
+                    {"method": "federated_assign", "rung": rung},
+                )
+            return result, budget
         raise ValueError(f"unknown method {method!r}")
 
     def _stream_assign(
@@ -1576,7 +1780,7 @@ class AssignorService:
         if not isinstance(sid, str) or not sid:
             raise ValueError("params.stream_id must be a non-empty string")
         topic = params.get("topic", "t0")
-        rows = params.get("lags") or []
+        rows = _decode_wire_lags(params)
         delta_params = params.get("lag_delta")
         members = params.get("members") or []
         if not isinstance(members, list) or not members:
@@ -1600,44 +1804,58 @@ class AssignorService:
             pids_sorted = None
         else:
             delta = None
-            if not rows:
-                raise ValueError("params.lags must be a non-empty list")
-            pids = np.fromiter(
-                (int(p) for p, _ in rows), np.int64, count=len(rows)
-            )
-            lags_in = np.fromiter(
-                (int(lag) for _, lag in rows), np.int64, count=len(rows)
-            )
-            if lags_in.size and int(lags_in.min()) < 0:
-                # Every kernel documents lags >= 0 as a precondition (the
-                # packed sort keys, the int32 downcast, and the quality
-                # stats all assume it), and the reference's lag formula
-                # clamps at 0 (LagBasedPartitionAssignor.java:376-404) —
-                # so a negative lag at the wire is a client-side
-                # computation bug, rejected loudly rather than silently
-                # producing undefined ordering.
-                raise ValueError("params.lags contains negative lag values")
-            order = np.argsort(pids, kind="stable")
-            pids_sorted = pids[order]
-            lags = lags_in[order]
-            if pids_sorted.size and (
-                np.diff(pids_sorted) == 0
-            ).any():
-                raise ValueError(
-                    "params.lags contains duplicate partition ids"
-                )
+            # Shared validation with federated_assign (_parse_lag_rows):
+            # non-negative lags (every kernel documents lags >= 0 as a
+            # precondition and the reference's lag formula clamps at 0,
+            # LagBasedPartitionAssignor.java:376-404), unique pids,
+            # ascending-pid row order.
+            pids_sorted, lags = _parse_lag_rows(rows)
 
-        # Overload admission (utils/overload): the shed ladder decides
-        # this request's fate BEFORE any solver state is touched.  The
-        # decision path itself is a fault point (shed.decide) — if it
-        # faults, the service FAILS OPEN and admits: overload control
-        # must never be what takes healthy traffic down.
-        # Feed the CURRENT in-flight depth before deciding: rejected
-        # requests return before the post-admission accounting below,
-        # so without this feed an all-shed class mix would freeze the
-        # depth EWMA at its stampede peak and the ladder could never
-        # step down (livelock) — every arrival, admitted or not, must
-        # let the controller see the true (decaying) depth.
+        # Overload admission: shared with federated_assign (see
+        # _admit_solve_work) — the shed ladder decides this request's
+        # fate BEFORE any solver state is touched; the degrade rung's
+        # meaning stays with each surface.
+        decision = self._admit_solve_work(klass, stream_id=sid)
+
+        with self._inflight(klass):
+            return self._stream_assign_admitted(
+                params, budget, klass, decision,
+                sid, topic, lags, pids_sorted, members_sorted, C, opts,
+                delta=delta,
+            )
+
+    @contextmanager
+    def _inflight(self, klass: str):
+        """The weighted in-flight depth bracket both solve surfaces
+        share: add this request's class weight, feed the controller
+        the new depth, and ALWAYS release on exit."""
+        weight = CLASS_WEIGHTS.get(klass, 1.0)
+        with self._inflight_lock:
+            self._inflight_weight += weight
+            depth = self._inflight_weight
+        self._overload.note_depth(depth)
+        try:
+            yield
+        finally:
+            with self._inflight_lock:
+                self._inflight_weight -= weight
+
+    def _admit_solve_work(
+        self, klass: str, stream_id: Optional[str] = None
+    ):
+        """THE overload admission both solve surfaces share
+        (``stream_assign`` and ``federated_assign``): feed the CURRENT
+        in-flight depth before deciding (rejected requests return
+        before the post-admission accounting, so without this feed an
+        all-shed class mix would freeze the depth EWMA at its stampede
+        peak and the ladder could never step down — livelock), expire
+        takeover-warming shares, decide FAIL-OPEN (the shed.decide
+        fault point — or a genuine controller bug — must never take
+        healthy traffic down), apply the per-class admission window
+        scales, and raise the structured reject.  Returns the decision
+        (None when the decision path failed open); what the "degrade"
+        action means stays with each caller — the cheap answer differs
+        per surface."""
         with self._inflight_lock:
             depth_now = self._inflight_weight
         self._overload.note_depth(depth_now)
@@ -1646,42 +1864,25 @@ class AssignorService:
         try:
             decision = self._overload.admission(klass)
         except Exception:
-            # ANY failure in the decision path — the injected
-            # shed.decide fault or a genuine controller bug — fails
-            # OPEN: overload control must never be what takes healthy
-            # traffic down (the documented contract, DEPLOYMENT.md
-            # "Overload and SLOs").
             LOGGER.warning(
                 "overload admission decision failed; failing open "
                 "(admit)", exc_info=True,
             )
         if decision is not None:
             if self._coalescer is not None:
-                # Rung 1+ shrinks the megabatch admission window —
-                # batch efficiency yields before latency.
-                self._coalescer.set_window_scale(decision.window_scale)
+                # Rung 1+ shrinks the megabatch admission window PER
+                # CLASS — best_effort waves go small first, the
+                # critical window stays wide (ROADMAP overload (b)).
+                self._coalescer.set_window_scales(decision.window_scales)
             if decision.action == "reject":
                 self._overload.note_shed(
-                    klass, decision.rung_name, "rejected", stream_id=sid
+                    klass, decision.rung_name, "rejected",
+                    stream_id=stream_id,
                 )
                 raise ShedReject(
                     klass, decision.rung_name, decision.retry_after_ms
                 )
-
-        weight = CLASS_WEIGHTS.get(klass, 1.0)
-        with self._inflight_lock:
-            self._inflight_weight += weight
-            depth = self._inflight_weight
-        self._overload.note_depth(depth)
-        try:
-            return self._stream_assign_admitted(
-                params, budget, klass, decision,
-                sid, topic, lags, pids_sorted, members_sorted, C, opts,
-                delta=delta,
-            )
-        finally:
-            with self._inflight_lock:
-                self._inflight_weight -= weight
+        return decision
 
     def _stream_assign_admitted(
         self, params, budget, klass, decision,
@@ -2220,6 +2421,131 @@ class AssignorService:
         self._mark_churn()
         return choice, fresh.last_stats, "cold_device", False
 
+    # -- federated assignment (federated/; DEPLOYMENT.md) ------------------
+
+    def _federation_fence_token(self) -> Optional[int]:
+        """The fencing token stamped on peer-bound payloads: the
+        snapshot writer lease's token when fencing is engaged, else
+        None — one token fences both the snapshot writes AND the peer
+        syncs of a replaced instance."""
+        store = self._snapshot_store
+        if store is None or not store.fencing_enabled:
+            return None
+        return store.lease_token
+
+    def _federated_assign(
+        self, params: Dict[str, Any], budget: _DeadlineBudget, klass: str
+    ) -> Dict[str, Any]:
+        """One federated epoch: register the local shard, run the
+        dual-exchange rounds inside the remaining budget, and serve the
+        LOCAL shard's slice of the converged global assignment — or
+        degrade down the federation ladder, bottoming out at exactly
+        the single-cluster stateless solve.  The request rides the same
+        overload admission + weighted in-flight depth accounting as
+        ``stream_assign``, so slow peer rounds feed the controller's
+        pressure signals like any other long-running work."""
+        if self._federation is None:
+            raise ValueError(
+                "federation is not configured on this sidecar"
+            )
+        topic = params.get("topic", "t0")
+        members = params.get("members") or []
+        if not isinstance(members, list) or not members:
+            raise ValueError("params.members must be a non-empty list")
+        members_sorted = sorted(str(m) for m in members)
+        if len(set(members_sorted)) != len(members_sorted):
+            raise ValueError("params.members contains duplicates")
+        C = len(members_sorted)
+        rows = _decode_wire_lags(params)
+        pids_sorted, lags = _parse_lag_rows(rows)
+
+        # Overload admission, shared with stream_assign (the
+        # "peer-round cost feeds the controller" contract); on THIS
+        # surface a degrade skips the peer rounds entirely — the
+        # local-only rung is the cheap answer, since no previous
+        # choice exists to keep on a stateless solve.
+        decision = self._admit_solve_work(klass)
+        force_local = False
+        if decision is not None and decision.action == "degrade":
+            self._overload.note_shed(
+                klass, decision.rung_name, "local_only"
+            )
+            force_local = True
+
+        with self._inflight(klass):
+            if force_local:
+                fed = {
+                    "rung": "local_only", "choice": None, "rounds": 0,
+                    "peers_ok": 0, "staleness_s": None,
+                    "converged": False,
+                }
+            else:
+                fed = self._federation.assign(
+                    lags, C, budget.remaining
+                )
+            if fed["choice"] is not None:
+                choice = fed["choice"]
+                s = _host_choice_stats(
+                    choice, lags, C, None, cold_start=True
+                )
+                choice_l = list(choice)
+                pids_l = pids_sorted.tolist()
+                assignments: Dict[str, List[List[Any]]] = {
+                    m: [] for m in members_sorted
+                }
+                for row, consumer in enumerate(choice_l):
+                    assignments[members_sorted[int(consumer)]].append(
+                        [topic, pids_l[row]]
+                    )
+                stats_out = {
+                    "max_mean_imbalance": s.max_mean_imbalance,
+                    "imbalance_bound": s.imbalance_bound,
+                    "quality_ratio": s.quality_ratio,
+                    "count_spread": s.count_spread,
+                }
+            else:
+                # Rung local_only: today's single-cluster behavior,
+                # unchanged — the stateless device solve with the host
+                # greedy as its degraded rung, inside what is left of
+                # the SAME deadline budget.
+                rows_plain = [
+                    [int(p), int(v)]
+                    for p, v in zip(pids_sorted, lags)
+                ]
+                assignments, rb_stats = _solve(
+                    {topic: rows_plain},
+                    {m: [topic] for m in members_sorted},
+                    "rounds",
+                    watchdog=self._watchdog,
+                    host_fallback=self._host_fallback,
+                    deadline=budget,
+                )
+                stats_out = json.loads(rb_stats.to_json())
+            fed_out = {
+                "rung": fed["rung"],
+                "rounds": fed["rounds"],
+                "converged": fed["converged"],
+                "peers_ok": fed["peers_ok"],
+                "staleness_s": fed["staleness_s"],
+                "epoch": self._federation.local_epoch,
+            }
+            metrics.FLIGHT.record(
+                "federation_assign",
+                {
+                    "rung": fed["rung"],
+                    "rounds": fed["rounds"],
+                    "converged": fed["converged"],
+                    "num_partitions": int(lags.shape[0]),
+                    "num_members": C,
+                    "slo_class": klass,
+                },
+            )
+            return {
+                "assignments": assignments,
+                "federation": fed_out,
+                "stats": stats_out,
+            }
+
     # -- resident-state scrubbing (utils/scrub) ----------------------------
 
     def _scrub_targets(self) -> List[Tuple[str, Callable[[], str]]]:
@@ -2290,6 +2616,11 @@ class AssignorService:
         out = self._scrubber.stats()
         with self._streams_lock:
             items = list(self._streams.items())
+        # Scrub-coverage SLO (ROADMAP state-integrity (b)): a scrubber
+        # that stopped making audit progress WHILE streams are live is
+        # wedged — flagged by presence here and in dump_metrics
+        # --summary, not only visible as counters that stopped moving.
+        out["wedged"] = bool(out.get("stalled")) and bool(items)
         quarantined = 0
         for _sid, st in items:
             engine = st.engine
@@ -2405,11 +2736,19 @@ class AssignorService:
                 }
             finally:
                 st.lock.release()
-        return {
+        sections = {
             "streams": streams,
             "breakers": self._watchdog.export_state(),
             "overload": self._overload.export_state(),
         }
+        if self._federation is not None:
+            # Federation state must survive restarts: the monotone
+            # local epoch (peers reject a regressed replacement as
+            # stale), the per-peer ledger, and the last-good-global
+            # duals — all fenced by the same writer tokens as every
+            # other section (DEPLOYMENT.md "Federated assignment").
+            sections["federation"] = self._federation.export_state()
+        return sections
 
     def snapshot_now(self) -> Dict[str, Any]:
         """One synchronous snapshot write (operator action / drills);
@@ -2584,6 +2923,9 @@ class AssignorService:
             overload = load.sections.get("overload")
             if overload is not None:
                 self._overload.restore_state(overload)
+            federation = load.sections.get("federation")
+            if federation is not None and self._federation is not None:
+                self._federation.restore_state(federation)
             recovered, discarded, weight = self._rehydrate_streams(
                 load.sections.get("streams") or {}, np
             )
@@ -2823,6 +3165,8 @@ class AssignorService:
         if self._metrics_http is not None:
             self._metrics_http.stop()
             self._metrics_http = None
+        if self._federation is not None:
+            self._federation.close()
 
     def start(self) -> "AssignorService":
         # Process-wide telemetry hooks, BEFORE the warm-up builds the
@@ -3082,25 +3426,76 @@ class AssignorServiceClient:
         members: List[str],
         options: Optional[Dict[str, Any]] = None,
         lag_delta: Optional[Dict[str, Any]] = None,
+        encoding: Optional[str] = None,
     ) -> Dict[str, Any]:
         """One warm-start epoch; returns the raw result dict
         (``assignments`` + ``stream`` stats).  Pass ``lag_delta``
         (and ``lags=None``) to send a sparse delta epoch — see the
         module docstring "Delta epochs" and
         :class:`..lag.LagDeltaTracker`, which produces both shapes
-        from consecutive lag reads."""
+        from consecutive lag reads.  ``encoding="zlib"`` compresses a
+        DENSE lag payload on the wire (the post-restart resync storm's
+        full-vector re-sends shrink ~5-10x); a server that does not
+        know the encoding answers a structured error and the request
+        falls back to plain JSON transparently."""
         params: Dict[str, Any] = {
             "stream_id": stream_id,
             "topic": topic,
             "members": members,
         }
         if lags is not None:
-            params["lags"] = lags
+            if encoding == "zlib":
+                params["lags"] = encode_lags_zlib(lags)
+                params["encoding"] = "zlib"
+            else:
+                params["lags"] = lags
         if lag_delta is not None:
             params["lag_delta"] = lag_delta
         if options is not None:
             params["options"] = options
-        return self.request("stream_assign", params)
+        try:
+            return self.request("stream_assign", params)
+        except ShedReject:
+            # A shed is the server's decision, not an encoding
+            # problem — resending plain would just double the load the
+            # ladder is shedding.
+            raise
+        except RuntimeError:
+            if params.get("encoding") is None:
+                raise
+            # Fallback to plain JSON: a round-16+ server answers
+            # "unknown encoding" for encodings it lacks, and a server
+            # PREDATING params.encoding fails parsing the base64
+            # string with some other ValueError — either way the one
+            # recovery is an uncompressed resend (a genuine non-
+            # encoding error simply re-raises identically from the
+            # plain attempt, one extra round trip on an already-failed
+            # epoch).
+            params.pop("encoding")
+            params["lags"] = lags
+            return self.request("stream_assign", params)
+
+    def federated_assign(
+        self,
+        topic: str,
+        lags: List[Tuple[int, int]],
+        members: List[str],
+        slo_class: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """One federated epoch (DEPLOYMENT.md "Federated assignment"):
+        the server converges a global assignment with its peers and
+        answers its LOCAL shard's slice; the ``federation`` section
+        reports the degradation rung actually served."""
+        params: Dict[str, Any] = {
+            "topic": topic, "lags": lags, "members": members,
+        }
+        if slo_class is not None:
+            params["slo_class"] = slo_class
+        return self.request("federated_assign", params)
+
+    def federation(self) -> Dict[str, Any]:
+        """The federation operator surface (peer links, rung, cache)."""
+        return self.request("federation")
 
     def stream_reset(self, stream_id: str) -> bool:
         return self.request("stream_reset", {"stream_id": stream_id})[
@@ -3255,6 +3650,35 @@ def main() -> None:
              "heal on mismatch); <= 0 disables (default 30000)",
     )
     parser.add_argument(
+        "--federation-self-id", default=None, metavar="ID",
+        help="this sidecar's stable federation peer id (enables the "
+             "federated assignment plane; DEPLOYMENT.md 'Federated "
+             "assignment')",
+    )
+    parser.add_argument(
+        "--federation-peers", default=None, metavar="ID=HOST:PORT,...",
+        help="peer sidecars for federated assignment "
+             "('id=host:port,id=host:port'); requires "
+             "--federation-self-id",
+    )
+    parser.add_argument(
+        "--federation-rounds", type=int, default=16, metavar="N",
+        help="max dual-exchange rounds per federated_assign "
+             "(default 16)",
+    )
+    parser.add_argument(
+        "--federation-sync-timeout-ms", type=float, default=2_000.0,
+        metavar="MS",
+        help="per-peer sync RPC deadline (also bounded by the request "
+             "budget; default 2000)",
+    )
+    parser.add_argument(
+        "--federation-max-staleness-ms", type=float, default=300_000.0,
+        metavar="MS",
+        help="how old the last-good-global dual cache may be and "
+             "still serve the middle federation rung (default 300000)",
+    )
+    parser.add_argument(
         "--recovery-prestack", action="store_true",
         help="pre-stack recovered rosters at boot (device-resident "
              "rebuild off the serving path) so the restart storm's "
@@ -3283,6 +3707,18 @@ def main() -> None:
         resync_max_inflight=opts.resync_max_inflight,
         recovery_prestack=opts.recovery_prestack,
         scrub_interval_ms=opts.scrub_interval_ms,
+        federation_self_id=opts.federation_self_id,
+        federation_peers=opts.federation_peers,
+        federation_rounds=opts.federation_rounds,
+        # No silent clamp: a non-positive timeout fails the boot (the
+        # coordinator validates), like the config-key path — a 1 ms
+        # floor would time out every exchange and present a sidecar
+        # that "works" but never federates.
+        federation_sync_timeout_s=opts.federation_sync_timeout_ms
+        / 1000.0,
+        federation_max_staleness_s=max(
+            opts.federation_max_staleness_ms, 0.0
+        ) / 1000.0,
     )
     # SIGTERM/SIGINT drain gracefully: admissions stop with a
     # structured retry-after reject, in-flight waves flush, the final

@@ -147,6 +147,22 @@ SCRUB_INTERVAL_CONFIG = "tpu.assignor.scrub.interval.ms"
 # like steady-state traffic instead of dispatching inline dense
 # table-builds.
 RECOVERY_PRESTACK_CONFIG = "tpu.assignor.recovery.prestack"
+# Federated multi-cluster assignment (federated/; DEPLOYMENT.md
+# "Federated assignment").  ``federation.self.id`` is this sidecar's
+# stable peer identity (empty/unset disables the whole plane);
+# ``federation.peers`` lists the peer sidecars as
+# "id=host:port,id=host:port".  ``federation.rounds`` bounds the
+# dual-exchange rounds per federated_assign; ``sync.timeout.ms`` is
+# the per-peer RPC deadline (also bounded by the request budget);
+# ``max.staleness.ms`` bounds how old the last-good-global dual cache
+# may be and still serve the middle degradation rung.
+FEDERATION_SELF_ID_CONFIG = "tpu.assignor.federation.self.id"
+FEDERATION_PEERS_CONFIG = "tpu.assignor.federation.peers"
+FEDERATION_ROUNDS_CONFIG = "tpu.assignor.federation.rounds"
+FEDERATION_SYNC_TIMEOUT_CONFIG = "tpu.assignor.federation.sync.timeout.ms"
+FEDERATION_MAX_STALENESS_CONFIG = (
+    "tpu.assignor.federation.max.staleness.ms"
+)
 # "P:C[:T][,P:C[:T]...]" — shapes to pre-compile at configure() time
 # (consumer startup, NOT on the rebalance critical path): each entry warms
 # the kernels for max_partitions P / num_consumers C / a topic batch of T
@@ -261,6 +277,14 @@ class AssignorConfig:
     recovery_prestack: bool = False
     # Resident-state scrubber cadence (utils/scrub); 0 disables.
     scrub_interval_s: float = 30.0
+    # Federated multi-cluster assignment (federated/): peer identity,
+    # peer set (validated "id=host:port" list), round/timeout bounds,
+    # and the last-good dual cache's staleness window.
+    federation_self_id: Optional[str] = None
+    federation_peers: str = ""
+    federation_rounds: int = 16
+    federation_sync_timeout_s: float = 2.0
+    federation_max_staleness_s: float = 300.0
     # (max_partitions, num_consumers) shapes to pre-compile at configure().
     warmup_shapes: list = field(default_factory=list)
     consumer_group_props: Dict[str, Any] = field(default_factory=dict)
@@ -390,6 +414,37 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
     resync_max_inflight = _as_int(RESYNC_MAX_INFLIGHT_CONFIG, 8, 0)
     scrub_interval_s = _as_ms(SCRUB_INTERVAL_CONFIG, 30_000.0)
 
+    # Federation knobs: the peer list is PARSED here so a typo'd spec
+    # fails at configure() time, not at the first peer round.
+    raw_self_id = consumer_group_props.get(FEDERATION_SELF_ID_CONFIG, "")
+    federation_self_id = (
+        str(raw_self_id) if raw_self_id not in (None, "") else None
+    )
+    federation_peers = str(
+        consumer_group_props.get(FEDERATION_PEERS_CONFIG, "") or ""
+    )
+    if federation_peers:
+        if federation_self_id is None:
+            raise ValueError(
+                f"{FEDERATION_PEERS_CONFIG} requires "
+                f"{FEDERATION_SELF_ID_CONFIG}"
+            )
+        from ..federated.peers import parse_peer_specs
+
+        try:
+            parse_peer_specs(federation_peers)
+        except ValueError as exc:
+            raise ValueError(f"{FEDERATION_PEERS_CONFIG}: {exc}")
+    federation_rounds = _as_int(FEDERATION_ROUNDS_CONFIG, 16, 1)
+    federation_sync_timeout_s = _as_ms(
+        FEDERATION_SYNC_TIMEOUT_CONFIG, 2_000.0
+    )
+    if federation_sync_timeout_s <= 0:
+        raise ValueError(f"{FEDERATION_SYNC_TIMEOUT_CONFIG} must be > 0 ms")
+    federation_max_staleness_s = _as_ms(
+        FEDERATION_MAX_STALENESS_CONFIG, 300_000.0
+    )
+
     # SLO class map + per-class deadline budgets: prefix-keyed entries,
     # validated against the class roster (utils/overload) so a typo'd
     # class fails at configure() time, not mid-stampede.
@@ -497,6 +552,11 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
         snapshot_lease_wait_s=snapshot_lease_wait_s,
         resync_max_inflight=resync_max_inflight,
         scrub_interval_s=scrub_interval_s,
+        federation_self_id=federation_self_id,
+        federation_peers=federation_peers,
+        federation_rounds=federation_rounds,
+        federation_sync_timeout_s=federation_sync_timeout_s,
+        federation_max_staleness_s=federation_max_staleness_s,
         recovery_prestack=_as_bool(
             consumer_group_props.get(RECOVERY_PRESTACK_CONFIG, False)
         ),

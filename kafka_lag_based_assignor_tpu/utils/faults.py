@@ -83,6 +83,26 @@ Named fault points (every one threaded through production code):
                     the operation proceeds after the injected delay
                     (pair with ``latency`` plans; a ``raise`` plan
                     here behaves like ``backend.partition``)
+``peer.partition``  entry of a federation peer RPC
+                    (:meth:`..federated.peers._PeerLink.request`) — an
+                    unreachable peer: the exchange round is abandoned
+                    and the sidecar degrades down the federation
+                    ladder (last-good-global duals, then local-only)
+``peer.slow_link``  same entry, latency mode — a slow inter-cluster
+                    link: the RPC proceeds after the injected delay,
+                    bounded by the per-peer sync timeout AND the
+                    request's remaining deadline budget (pair with
+                    ``latency`` plans)
+``peer.sync``       inside the breaker-wrapped peer exchange
+                    (:meth:`..federated.peers.FederationCoordinator.
+                    _sync_once`) — a protocol-level sync failure:
+                    charged to that peer's circuit breaker
+                    (consecutive failures trip it)
+``peer.stale_duals``  the initiator's response validation (same
+                    method) — a firing plan makes the peer's answer
+                    count as STALE state: dropped and counted in
+                    ``klba_peer_stale_duals_total``, never averaged
+                    into the global marginals
 ``drain.flush``     the graceful drain's coalescer quiesce
                     (:meth:`..ops.coalesce.MegabatchCoalescer.drain`)
                     — a failure here must not stop the drain from
@@ -148,6 +168,10 @@ FAULT_POINTS = frozenset(
         "device.corrupt.choice",
         "device.corrupt.counts",
         "device.corrupt.lags",
+        "peer.partition",
+        "peer.slow_link",
+        "peer.sync",
+        "peer.stale_duals",
         "snapshot.write",
         "snapshot.load",
         "snapshot.cas",

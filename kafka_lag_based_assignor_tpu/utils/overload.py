@@ -112,10 +112,26 @@ RUNGS = (
     "degrade_standard",
 )
 
-#: Coalescer admission-window scale per rung: rung 1 is "shrink the
-#: admission window" (smaller waves, lower parked latency); deeper
-#: rungs keep shrinking — batch efficiency yields before latency does.
+#: Coalescer admission-window scale per rung — the STANDARD class's
+#: table (back-compat anchor: the unlabeled ``window_scale`` fields
+#: and the legacy single-scale coalescer hook read this one): rung 1
+#: is "shrink the admission window" (smaller waves, lower parked
+#: latency); deeper rungs keep shrinking — batch efficiency yields
+#: before latency does.
 _WINDOW_SCALE = (1.0, 0.5, 0.25, 0.25, 0.1)
+
+#: Per-CLASS window tables (ROADMAP overload (b)), indexed by class
+#: rank then rung: rung 1's shrink lands class-by-class — the critical
+#: window stays WIDE (a critical epoch keeps its full coalescing
+#: opportunity; its latency is protected by placement order and the
+#: deadline triage, not by starving its batches) while best_effort
+#: shrinks hardest (it is the traffic the ladder is about to degrade
+#: anyway, so its waves go small first).
+_WINDOW_SCALE_BY_RANK = (
+    (1.0, 1.0, 0.5, 0.5, 0.25),   # critical
+    _WINDOW_SCALE,                # standard
+    (1.0, 0.25, 0.1, 0.1, 0.05),  # best_effort
+)
 
 #: Pressure thresholds: rung i engages at pressure >= _THRESHOLDS[i-1].
 _THRESHOLDS = (1.0, 1.5, 2.5, 4.0)
@@ -125,15 +141,25 @@ def class_rank(klass: str) -> int:
     return _CLASS_RANK[klass]
 
 
-def _held_window_scale(rung: int, standing: float) -> float:
+def _held_window_scale(rung: int, standing: float, rank: int = 1) -> float:
     """THE takeover window-hold rule, in one place (admission decisions
     AND the operator snapshot read it): while any standing takeover
     pressure is parked, the admission window is held at rung-1 scale
-    even at rung 0."""
-    scale = _WINDOW_SCALE[rung]
+    even at rung 0 — per CLASS, so the hold also leaves the critical
+    window wide."""
+    table = _WINDOW_SCALE_BY_RANK[rank]
+    scale = table[rung]
     if standing > 0:
-        return min(scale, _WINDOW_SCALE[1])
+        return min(scale, table[1])
     return scale
+
+
+def _held_window_scales(rung: int, standing: float) -> Tuple[float, ...]:
+    """All three classes' held window scales, rank order."""
+    return tuple(
+        _held_window_scale(rung, standing, rank)
+        for rank in range(len(SLO_CLASSES))
+    )
 
 
 #: Get-or-create cache for the shed counters (sheds happen on the
@@ -265,14 +291,20 @@ class _Decision:
     while the request runs)."""
 
     __slots__ = ("action", "rung", "rung_name", "retry_after_ms",
-                 "window_scale")
+                 "window_scale", "window_scales")
 
     def __init__(self, action: str, rung: int, retry_after_ms: int):
         self.action = action  # "admit" | "degrade" | "reject"
         self.rung = rung
         self.rung_name = RUNGS[rung]
         self.retry_after_ms = retry_after_ms
+        # window_scale stays the STANDARD class's scale (back-compat
+        # reads); window_scales is the per-class (rank-ordered) triple
+        # the coalescer actually applies (ROADMAP overload (b)).
         self.window_scale = _WINDOW_SCALE[rung]
+        self.window_scales = tuple(
+            t[rung] for t in _WINDOW_SCALE_BY_RANK
+        )
 
 
 class OverloadController:
@@ -507,8 +539,11 @@ class OverloadController:
         # window at rung-1 scale even at rung 0 — smaller waves until
         # the replacement's cold streams have all served once, so the
         # post-takeover stampede trickles instead of parking whole
-        # fleets behind one giant cold wave.
+        # fleets behind one giant cold wave.  Applied per class: the
+        # critical table's rung-1 scale is 1.0, so critical waves stay
+        # full-width through both the hold and rung 1.
         decision.window_scale = _held_window_scale(rung, standing)
+        decision.window_scales = _held_window_scales(rung, standing)
         return decision
 
     def note_shed(
@@ -580,6 +615,12 @@ class OverloadController:
                 "window_scale": _held_window_scale(
                     self._rung, self._standing
                 ),
+                "window_scales": {
+                    klass: _held_window_scale(
+                        self._rung, self._standing, rank
+                    )
+                    for rank, klass in enumerate(SLO_CLASSES)
+                },
                 "latency_budget_ms": self.latency_budget_ms,
                 "depth_high": self.depth_high,
             }

@@ -317,6 +317,21 @@ class StateScrubber:
         self._thread: Optional[threading.Thread] = None
         self._cursor = 0
         self.last_pass_at: Optional[float] = None
+        # Scrub-coverage SLO (ROADMAP state-integrity (b)): the last
+        # instant the scrubber made PROGRESS — audited at least one
+        # stream, or legitimately had nothing to audit.  A pass that
+        # only hit busy locks (or was suppressed/crashed) does not
+        # count: ``stalled`` flips once progress is older than
+        # ``stall_after_s`` (3 intervals — one slow pass is noise,
+        # three is a wedge), so a wedged scrubber is visible by
+        # PRESENCE (a flag + the klba_scrub_last_pass_age_s gauge),
+        # not by the absence of audit counters.
+        self._started_at = (clock or metrics.REGISTRY.clock)()
+        self.last_progress_at = self._started_at
+        self.stall_after_s = 3.0 * float(interval_s)
+        self._m_last_age = metrics.REGISTRY.gauge(
+            "klba_scrub_last_pass_age_s"
+        )
         self._m_passes = metrics.REGISTRY.counter("klba_scrub_passes_total")
         self._m_audited = metrics.REGISTRY.counter(
             "klba_scrub_streams_audited_total"
@@ -394,6 +409,11 @@ class StateScrubber:
             # across passes instead of re-auditing the same prefix.
             self._cursor = (self._cursor + attempted) % n
         self.last_pass_at = self._clock()
+        if audited > 0 or n == 0:
+            # Progress for the coverage SLO: streams were audited, or
+            # there was genuinely nothing to audit (an idle sidecar is
+            # not a wedged scrubber).
+            self.last_progress_at = self.last_pass_at
         self._m_passes.inc()
         self._m_duration.observe((self.last_pass_at - started) * 1000.0)
         metrics.FLIGHT.record(
@@ -403,12 +423,20 @@ class StateScrubber:
 
     def stats(self) -> Dict[str, Any]:
         """The operator surface (wire ``stats.scrub`` /
-        tools/dump_metrics.py --summary)."""
+        tools/dump_metrics.py --summary).  Reading it refreshes the
+        ``klba_scrub_last_pass_age_s`` gauge (age is a pull-time
+        quantity), and ``stalled`` is the coverage-SLO flag: no audit
+        progress for > 3 intervals — the CALLER (service.scrub_stats)
+        combines it with "streams are live" into ``wedged``."""
+        now = self._clock()
         last = self.last_pass_at
+        age = now - (last if last is not None else self._started_at)
+        self._m_last_age.set(age)
         return {
             "interval_ms": self.interval_s * 1000.0,
-            "last_pass_age_s": (
-                self._clock() - last if last is not None else None
+            "last_pass_age_s": age,
+            "stalled": (
+                now - self.last_progress_at > self.stall_after_s
             ),
             "passes": self._m_passes.value - self._base_passes,
             "streams_audited": (

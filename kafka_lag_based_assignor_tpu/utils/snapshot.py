@@ -901,6 +901,15 @@ class SnapshotStore:
     def fencing_enabled(self) -> bool:
         return self._lease_owner is not None
 
+    @property
+    def lease_token(self) -> Optional[int]:
+        """The held writer lease's fencing token (None when fencing is
+        off or the lease was not acquired) — what the federation plane
+        stamps on peer-bound payloads so a fenced-off predecessor's
+        sync requests are rejected by its peers too."""
+        with self._lock:
+            return self._lease.token if self._lease is not None else None
+
     def attach_lease(self, owner: str, ttl_s: float) -> None:
         """Engage epoch fencing: every subsequent save requires the
         lease acquired via :meth:`acquire_lease` and is a

@@ -1,0 +1,843 @@
+"""Federated multi-cluster assignment tests (DEPLOYMENT.md "Federated
+assignment"): the audited wire serializer's privacy contract, the
+dual-exchange math's parity with the single-leader Sinkhorn solve, the
+coordinator's degradation ladder under every ``peer.*`` fault point
+(the chaos suite), monotone epoch / fencing rejection, snapshot
+persistence of the dual cache, and the satellite surfaces that ride
+this round (zlib resync encoding, scrub-coverage SLO, per-class
+admission windows)."""
+
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kafka_lag_based_assignor_tpu.federated import wire
+from kafka_lag_based_assignor_tpu.federated.peers import (
+    FederationCoordinator,
+    PeerSpec,
+    parse_peer_specs,
+)
+from kafka_lag_based_assignor_tpu.ops import fedsolve
+from kafka_lag_based_assignor_tpu.service import (
+    AssignorService,
+    AssignorServiceClient,
+    encode_lags_zlib,
+)
+from kafka_lag_based_assignor_tpu.utils import faults, metrics
+
+C = 4
+SHARD_P = 128
+MEMBERS = [f"m{i}" for i in range(C)]
+
+
+def _counter(name, labels=None):
+    return metrics.REGISTRY.counter(name, labels or {}).value
+
+
+def _shard(seed, p=SHARD_P):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 1_000_000, size=p).astype(np.int64)
+
+
+def _rows(lags):
+    return [[int(i), int(v)] for i, v in enumerate(lags)]
+
+
+def _free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _assert_balanced(result, members=None):
+    members = members or MEMBERS
+    sizes = [len(result["assignments"][m]) for m in members]
+    assert max(sizes) - min(sizes) <= 1, sizes
+    return sizes
+
+
+# -- wire serializer (L019's audited single point) -------------------------
+
+
+class TestWire:
+    def test_request_roundtrip_is_whitelisted(self):
+        params = wire.sync_request(
+            "a", 3, 1, C, scale=10.0,
+            duals_a=np.zeros(C, np.float32),
+            duals_b=np.ones(C, np.float32),
+            fence_token=7,
+        )
+        assert set(params) <= wire._REQUEST_KEYS
+        assert params["duals"]["B"] == [1.0] * C
+
+    def test_partition_axis_vector_rejected(self):
+        # The shape audit: a P-length vector cannot ride under an
+        # allowed key — only C-length consumer-axis aggregates may.
+        with pytest.raises(wire.PayloadViolation):
+            wire.sync_request(
+                "a", 1, 1, C, scale=1.0,
+                duals_a=np.zeros(SHARD_P), duals_b=np.zeros(SHARD_P),
+            )
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(wire.PayloadViolation):
+            wire._check_payload(
+                {"lags": [1, 2, 3]}, wire._REQUEST_KEYS, C
+            )
+
+    def test_unknown_reject_reason(self):
+        with pytest.raises(wire.PayloadViolation):
+            wire.sync_reject("a", "nope", 1, C)
+
+    def test_assert_lag_free_catches_leak(self):
+        lags = _shard(1)
+        leaky = json.dumps(
+            {"oops": [int(v) for v in lags[:8]]}
+        ).encode()
+        with pytest.raises(AssertionError):
+            wire.assert_lag_free(leaky, lags)
+
+    def test_real_payloads_are_lag_free(self):
+        lags = _shard(2)
+        scale = max(float(lags.sum()), 1.0) / C
+        w = fedsolve.shard_dedup(lags, np.ones(lags.shape[0], bool),
+                                 scale)
+        A, B = fedsolve.initial_duals(C)
+        load, colsum = fedsolve.shard_marginals(*w, A, B)
+        req = wire.sync_request(
+            "a", 1, 1, C, scale=scale, duals_a=A, duals_b=B,
+        )
+        resp = wire.sync_response(
+            "b", 1, 1, C, total_lag=int(lags.sum()),
+            n_valid=lags.shape[0], load=load, colsum=colsum,
+        )
+        wire.assert_lag_free(wire.encode(req), lags)
+        wire.assert_lag_free(wire.encode(resp), lags)
+
+    def test_parse_peer_specs(self):
+        specs = parse_peer_specs("a=h1:7531, b=h2:7532")
+        assert specs == [PeerSpec("a", "h1", 7531),
+                         PeerSpec("b", "h2", 7532)]
+        for bad in ("a", "a=h1", "a=h1:x", "a=h1:7531,a=h2:2"):
+            with pytest.raises(ValueError):
+                parse_peer_specs(bad)
+
+
+# -- dual-exchange math vs the single leader -------------------------------
+
+
+def _run_exchange(shards, max_rounds=24, refine_iters=32):
+    """Host-side reference of the coordinator's exchange loop."""
+    total = sum(int(s.sum()) for s in shards)
+    n = sum(int(s.shape[0]) for s in shards)
+    scale = max(float(total), 1.0) / C
+    cap = float(n) / C
+    weights = [
+        fedsolve.shard_dedup(s, np.ones(s.shape[0], bool), scale)
+        for s in shards
+    ]
+    A, B = fedsolve.initial_duals(C)
+    step, prev = 1.0, float("inf")
+    for _ in range(max_rounds):
+        margs = [fedsolve.shard_marginals(*w, A, B) for w in weights]
+        load = sum(np.asarray(m[0], np.float64) for m in margs)
+        col = sum(np.asarray(m[1], np.float64) for m in margs)
+        A, B, step, spread, delta = fedsolve.dual_step(
+            A, B, load, col, cap, step, prev
+        )
+        prev = spread  # the damping test carries the SPREAD
+        if delta <= fedsolve.DUAL_TOL:
+            break
+    margs = [fedsolve.shard_marginals(*w, A, B) for w in weights]
+    all_load = sum(np.asarray(m[0], np.float64) for m in margs)
+    totals = np.zeros(C)
+    choices = []
+    for i, s in enumerate(shards):
+        remote = all_load - np.asarray(margs[i][0], np.float64)
+        ch, _, _ = fedsolve.round_local_shard(
+            s, C, A, B, scale, remote, refine_iters=refine_iters
+        )
+        choices.append(ch)
+        cnts = np.bincount(ch, minlength=C)
+        assert cnts.max() - cnts.min() <= 1  # local count balance
+        totals += np.bincount(
+            ch, weights=s.astype(np.float64), minlength=C
+        )
+    return choices, totals
+
+
+class TestFedsolve:
+    def test_three_shard_quality_within_5pct_of_leader(self):
+        from kafka_lag_based_assignor_tpu.models.sinkhorn import (
+            assign_topic_sinkhorn,
+        )
+        from kafka_lag_based_assignor_tpu.ops.packing import (
+            pad_topic_rows,
+        )
+
+        shards = [_shard(seed) for seed in (11, 12, 13)]
+        _, fed_totals = _run_exchange(shards)
+        full = np.concatenate(shards)
+        lags_p, pids_p, valid = pad_topic_rows(full)
+        _, _, leader_totals = assign_topic_sinkhorn(
+            lags_p, pids_p, valid, num_consumers=C
+        )
+        leader_totals = np.asarray(leader_totals, np.float64)
+        fed_q = fed_totals.max() / fed_totals.mean()
+        leader_q = leader_totals.max() / leader_totals.mean()
+        assert fed_q <= leader_q * 1.05, (fed_q, leader_q)
+
+    def test_single_shard_matches_leader_trajectory(self):
+        """With ONE shard the summed marginals are the leader's own, so
+        the exchange loop must land at comparable quality."""
+        shard = _shard(21)
+        _, totals = _run_exchange([shard])
+        q = totals.max() / totals.mean()
+        assert q < 1.01
+
+    def test_marginals_sum_equals_whole(self):
+        """Shard marginal sums == the undivided vector's marginals
+        (the federation identity): splitting the rows cannot change
+        what the duals see."""
+        full = _shard(31)
+        scale = max(float(full.sum()), 1.0) / C
+        A, B = fedsolve.initial_duals(C)
+        w_full = fedsolve.shard_dedup(
+            full, np.ones(full.shape[0], bool), scale
+        )
+        l_full, c_full = fedsolve.shard_marginals(*w_full, A, B)
+        parts = np.split(full, [40, 90])
+        l_sum = np.zeros(C, np.float64)
+        c_sum = np.zeros(C, np.float64)
+        for p in parts:
+            w = fedsolve.shard_dedup(p, np.ones(p.shape[0], bool),
+                                     scale)
+            lo, co = fedsolve.shard_marginals(*w, A, B)
+            l_sum += lo
+            c_sum += co
+        np.testing.assert_allclose(l_sum, l_full, rtol=1e-4)
+        np.testing.assert_allclose(c_sum, c_full, rtol=1e-4)
+
+
+# -- two-sidecar service fixture -------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def duo():
+    """Two federated sidecars in one process (a <-> b), generous sync
+    timeouts (first exchanges compile), tight breaker policy so trip
+    tests are cheap."""
+    ports = _free_ports(2)
+    ids = ("a", "b")
+    svcs = []
+    for i in range(2):
+        j = 1 - i
+        svc = AssignorService(
+            port=ports[i],
+            coalesce_max_batch=1,
+            scrub_interval_ms=0,
+            breaker_failures=2,
+            breaker_cooldown_s=0.2,
+            slo_deadline_s={"best_effort": 2.0},
+            federation_self_id=ids[i],
+            federation_peers=f"{ids[j]}=127.0.0.1:{ports[j]}",
+            federation_rounds=8,
+            federation_sync_timeout_s=60.0,
+        )
+        svc.start()
+        svcs.append(svc)
+    clients = [
+        AssignorServiceClient("127.0.0.1", p, timeout_s=180.0)
+        for p in ports
+    ]
+    shards = {"a": _shard(41), "b": _shard(42)}
+    yield {
+        "svcs": dict(zip(ids, svcs)),
+        "clients": dict(zip(ids, clients)),
+        "shards": shards,
+    }
+    for c in clients:
+        c.close()
+    for s in svcs:
+        s.stop()
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate(request):
+    """Faults off and breakers closed around every test in this
+    module (the injector and the watchdog are process-global)."""
+    faults.deactivate()
+    yield
+    faults.deactivate()
+    if "duo" in request.fixturenames:
+        duo = request.getfixturevalue("duo")
+        for svc in duo["svcs"].values():
+            svc._watchdog.reset()
+
+
+def _fed_assign(duo, sid, **kw):
+    return duo["clients"][sid].federated_assign(
+        "t0", _rows(duo["shards"][sid]), MEMBERS, **kw
+    )
+
+
+def _warm_federation(duo):
+    """Both sidecars registered + one converged pass each."""
+    _fed_assign(duo, "a")
+    _fed_assign(duo, "b")
+    return _fed_assign(duo, "a")
+
+
+class TestFederatedService:
+    def test_converges_global(self, duo):
+        r = _warm_federation(duo)
+        assert r["federation"]["rung"] == "global"
+        assert 1 <= r["federation"]["rounds"] <= 8
+        _assert_balanced(r)
+
+    def test_status_surfaces(self, duo):
+        _warm_federation(duo)
+        status = duo["clients"]["a"].federation()
+        assert status["enabled"] is True
+        assert status["rung"] == "global"
+        assert "b" in status["peers"]
+        assert status["peers"]["b"]["epoch_seen"] >= 1
+        stats = duo["clients"]["a"].request("stats")
+        assert stats["federation"]["self_id"] == "a"
+        assert "peer:b" in stats["breakers"]
+
+    def test_partition_serves_local_only_no_errors(self, duo):
+        """Chaos: peer.partition — every peer RPC fails, yet the
+        sidecar keeps serving VALID count-balanced local assignments
+        with zero request errors (fail-open to single-cluster
+        behavior; cache intentionally bypassed by expiring it)."""
+        svc = duo["svcs"]["a"]
+        svc._federation._last_good = None  # force past rung 2
+        errors_before = svc.errors
+        with faults.injected(
+            faults.FaultInjector(7).plan("peer.partition", times=0)
+        ):
+            r = _fed_assign(duo, "a")
+        assert r["federation"]["rung"] == "local_only"
+        _assert_balanced(r)
+        assert svc.errors == errors_before
+
+    def test_partition_with_fresh_cache_serves_last_good(self, duo):
+        _warm_federation(duo)
+        with faults.injected(
+            faults.FaultInjector(7).plan("peer.partition", times=0)
+        ):
+            r = _fed_assign(duo, "a")
+        assert r["federation"]["rung"] == "last_good_global"
+        assert r["federation"]["staleness_s"] is not None
+        _assert_balanced(r)
+
+    def test_stale_cache_falls_to_local_only(self, duo):
+        _warm_federation(duo)
+        fed = duo["svcs"]["a"]._federation
+        with fed._cache_lock:
+            fed._last_good["at"] -= fed.max_staleness_s + 1.0
+        with faults.injected(
+            faults.FaultInjector(7).plan("peer.partition", times=0)
+        ):
+            r = _fed_assign(duo, "a")
+        assert r["federation"]["rung"] == "local_only"
+        _assert_balanced(r)
+
+    def test_heal_reconverges_within_bounded_rounds(self, duo):
+        with faults.injected(
+            faults.FaultInjector(7).plan("peer.partition", times=0)
+        ):
+            _fed_assign(duo, "a")
+        duo["svcs"]["a"]._watchdog.reset()  # close the peer breaker
+        r = _fed_assign(duo, "a")
+        assert r["federation"]["rung"] == "global"
+        assert r["federation"]["rounds"] <= 8
+
+    def test_stale_duals_dropped_and_counted(self, duo):
+        """Chaos: peer.stale_duals — the peer's answer is treated as
+        stale state: counted, dropped, never averaged in (the round
+        aborts to the ladder instead of blending)."""
+        _warm_federation(duo)
+        before = _counter(
+            "klba_peer_stale_duals_total", {"reason": "injected"}
+        )
+        with faults.injected(
+            faults.FaultInjector(7).plan("peer.stale_duals", times=0)
+        ):
+            r = _fed_assign(duo, "a")
+        assert r["federation"]["rung"] != "global"
+        _assert_balanced(r)
+        assert _counter(
+            "klba_peer_stale_duals_total", {"reason": "injected"}
+        ) > before
+
+    def test_slow_link_round_is_deadline_bounded(self, duo):
+        """Chaos: peer.slow_link — a slow inter-cluster link cannot
+        hold the request past its class budget: the exchange degrades
+        inside the deadline and the answer still serves."""
+        _warm_federation(duo)
+        started = time.monotonic()
+        with faults.injected(
+            faults.FaultInjector(7).plan(
+                "peer.slow_link", mode="latency", times=0,
+                delay_s=0.45,
+            )
+        ):
+            r = _fed_assign(duo, "a", slo_class="best_effort")
+        elapsed = time.monotonic() - started
+        _assert_balanced(r)
+        # 2 s best_effort budget: the rounds that fit, then the
+        # ladder — never the full 8-round exchange at 0.45 s/call.
+        assert elapsed < 8.0, elapsed
+
+    def test_sync_fault_charges_peer_breaker(self, duo):
+        """Chaos: peer.sync — protocol-level sync failures charge that
+        peer's circuit breaker; enough of them trip it."""
+        svc = duo["svcs"]["a"]
+        svc._watchdog.reset()
+        with faults.injected(
+            faults.FaultInjector(7).plan("peer.sync", times=0)
+        ):
+            _fed_assign(duo, "a")
+            _fed_assign(duo, "a")
+        stats = svc._watchdog.stats()["peer:b"]
+        assert (
+            stats["consecutive_failures"] >= 1
+            or stats["state"] == "open"
+        )
+
+    def test_server_rejects_regressed_epoch(self, duo):
+        fed = duo["svcs"]["b"]._federation
+        fed.register_local_shard(duo["shards"]["b"], C)
+        hi = wire.sync_request("x", 9, 0, C, scale=1.0, phase="hello")
+        assert "rejected" not in fed.serve_sync(hi)
+        before = _counter(
+            "klba_peer_stale_duals_total", {"reason": "stale_epoch"}
+        )
+        lo = wire.sync_request("x", 3, 0, C, scale=1.0, phase="hello")
+        out = fed.serve_sync(lo)
+        assert out["rejected"] == "stale_epoch"
+        assert _counter(
+            "klba_peer_stale_duals_total", {"reason": "stale_epoch"}
+        ) == before + 1
+
+    def test_server_rejects_fenced_token(self, duo):
+        fed = duo["svcs"]["b"]._federation
+        fed.register_local_shard(duo["shards"]["b"], C)
+        hi = wire.sync_request(
+            "y", 1, 0, C, scale=1.0, phase="hello", fence_token=5
+        )
+        assert "rejected" not in fed.serve_sync(hi)
+        lo = wire.sync_request(
+            "y", 2, 0, C, scale=1.0, phase="hello", fence_token=3
+        )
+        out = fed.serve_sync(lo)
+        assert out["rejected"] == "fenced"
+
+    def test_server_rejects_unregistered_and_mismatch(self):
+        fed = FederationCoordinator("solo", [])
+        out = fed.serve_sync(
+            wire.sync_request("z", 1, 0, C, scale=1.0, phase="hello")
+        )
+        assert out["rejected"] == "unavailable"
+        fed.register_local_shard(_shard(5), C)
+        out = fed.serve_sync(
+            wire.sync_request("z", 2, 0, C + 1, scale=1.0,
+                              phase="hello")
+        )
+        assert out["rejected"] == "mismatch"
+
+    def test_on_wire_payloads_are_lag_free(self, duo):
+        """The privacy gate, against REAL protocol traffic: request
+        and response payloads for an actual shard contain no window of
+        its raw lag vector."""
+        fed_b = duo["svcs"]["b"]._federation
+        lags = duo["shards"]["b"]
+        _warm_federation(duo)
+        scale = max(float(
+            sum(int(s.sum()) for s in duo["shards"].values())
+        ), 1.0) / C
+        A, B = fedsolve.initial_duals(C)
+        # A distinct sender id: bumping the real peer "a"'s epoch
+        # ledger here would make its later genuine syncs read stale.
+        req = wire.sync_request(
+            "wire-audit", 1, 1, C, scale=scale, duals_a=A, duals_b=B,
+        )
+        resp = fed_b.serve_sync(req)
+        assert "marginals" in resp
+        wire.assert_lag_free(wire.encode(req), lags)
+        wire.assert_lag_free(wire.encode(resp), lags)
+
+    def test_epoch_bumps_only_on_changed_shard(self, duo):
+        fed = duo["svcs"]["a"]._federation
+        lags = duo["shards"]["a"]
+        e1 = fed.register_local_shard(lags, C)
+        e2 = fed.register_local_shard(lags, C)
+        assert e2 == e1
+        e3 = fed.register_local_shard(lags + 1, C)
+        assert e3 == e1 + 1
+        fed.register_local_shard(lags, C)  # restore for later tests
+
+    def test_degrade_rung_skips_peer_rounds(self, duo):
+        """Overload integration: a degraded admission answers
+        local-only WITHOUT paying peer rounds (the shed is counted)."""
+        svc = duo["svcs"]["a"]
+        ctl = svc._overload
+        for _ in range(30):
+            # Seeded so that after the request's own zero-depth feed
+            # (one 0.7x EWMA decay) pressure lands in [1.5, 2.5):
+            # rung 2 (degrade_best_effort), below the rung-3 reject.
+            ctl.note_depth(ctl.depth_high * 3.4)
+        ctl._last_eval = None
+        try:
+            r = _fed_assign(duo, "a", slo_class="best_effort")
+            assert r["federation"]["rung"] == "local_only"
+            assert r["federation"]["rounds"] == 0
+        finally:
+            for _ in range(50):
+                ctl.note_depth(0.0)
+            ctl._rung = 0
+            ctl._last_eval = None
+
+    def test_coordinator_state_roundtrip(self, duo):
+        _warm_federation(duo)
+        fed = duo["svcs"]["a"]._federation
+        state = json.loads(json.dumps(fed.export_state()))
+        fresh = FederationCoordinator(
+            "a", [PeerSpec("b", "127.0.0.1", 1)],
+        )
+        fresh.restore_state(state)
+        assert fresh.local_epoch == fed.local_epoch
+        assert fresh._links["b"].max_epoch_seen >= 1
+        with fresh._cache_lock:
+            cached = fresh._last_good
+        assert cached is not None and cached["C"] == C
+        # Restored duals serve the last_good_global rung.
+        out = fresh.assign(
+            duo["shards"]["a"], C, lambda: 30.0, refine_iters=64
+        )
+        assert out["rung"] == "last_good_global"
+        counts = np.bincount(out["choice"], minlength=C)
+        assert counts.max() - counts.min() <= 1
+
+    def test_restore_discards_malformed(self):
+        fresh = FederationCoordinator("a", [])
+        fresh.restore_state({"epoch": "x", "last_good": 3})
+        fresh.restore_state("garbage")
+        assert fresh.local_epoch == 0
+
+    def test_peer_sync_without_federation_errors(self):
+        with AssignorService(port=0, coalesce_max_batch=1,
+                             scrub_interval_ms=0) as svc:
+            with AssignorServiceClient(*svc.address) as c:
+                with pytest.raises(RuntimeError, match="not configured"):
+                    c.request("peer_sync", {"peer_id": "x"})
+                assert c.federation() == {"enabled": False}
+
+    def test_peers_require_self_id(self):
+        with pytest.raises(ValueError, match="federation_self_id"):
+            AssignorService(
+                port=0, federation_peers="a=127.0.0.1:1"
+            )
+
+    def test_from_config_wiring(self):
+        from kafka_lag_based_assignor_tpu.utils.config import (
+            parse_config,
+        )
+
+        cfg = parse_config({
+            "group.id": "g",
+            "tpu.assignor.federation.self.id": "west",
+            "tpu.assignor.federation.peers": "east=h:7531",
+            "tpu.assignor.federation.rounds": 4,
+            "tpu.assignor.federation.sync.timeout.ms": 500,
+            "tpu.assignor.federation.max.staleness.ms": 60000,
+        })
+        assert cfg.federation_self_id == "west"
+        assert cfg.federation_rounds == 4
+        assert cfg.federation_sync_timeout_s == 0.5
+        assert cfg.federation_max_staleness_s == 60.0
+        with pytest.raises(ValueError, match="federation"):
+            parse_config({
+                "group.id": "g",
+                "tpu.assignor.federation.peers": "east=h:7531",
+            })
+        with pytest.raises(ValueError, match="peer spec"):
+            parse_config({
+                "group.id": "g",
+                "tpu.assignor.federation.self.id": "west",
+                "tpu.assignor.federation.peers": "east",
+            })
+
+
+# -- partition/heal soak (slow) --------------------------------------------
+
+
+@pytest.mark.slow
+def test_partition_heal_soak(duo):
+    """Two sidecars: converge, a full partition window (every epoch
+    still serves a valid count-balanced assignment, zero request
+    errors), then heal — peers re-converge to rung global within the
+    bounded round budget and stale/fenced state never blended in."""
+    _warm_federation(duo)
+    svc_a = duo["svcs"]["a"]
+    errors_before = {
+        sid: duo["svcs"][sid].errors for sid in ("a", "b")
+    }
+    # Partition window: every peer RPC fails for both sidecars.
+    with faults.injected(
+        faults.FaultInjector(13).plan("peer.partition", times=0)
+    ):
+        for i in range(6):
+            for sid in ("a", "b"):
+                r = _fed_assign(duo, sid)
+                assert r["federation"]["rung"] in (
+                    "last_good_global", "local_only"
+                )
+                _assert_balanced(r)
+            svc_a._watchdog.reset()
+            duo["svcs"]["b"]._watchdog.reset()
+    for sid in ("a", "b"):
+        assert duo["svcs"][sid].errors == errors_before[sid]
+    # Heal: breakers closed, next epochs re-converge.
+    for svc in duo["svcs"].values():
+        svc._watchdog.reset()
+    for sid in ("a", "b"):
+        r = _fed_assign(duo, sid)
+        assert r["federation"]["rung"] == "global"
+        assert r["federation"]["rounds"] <= 8
+        _assert_balanced(r)
+
+
+# -- satellite: zlib resync encoding ---------------------------------------
+
+
+class TestLagEncoding:
+    def test_zlib_roundtrip_matches_plain(self):
+        lags = [[p, int(v)] for p, v in enumerate(_shard(51, 64))]
+        with AssignorService(port=0, coalesce_max_batch=1,
+                             scrub_interval_ms=0) as svc:
+            with AssignorServiceClient(*svc.address) as c:
+                plain = c.stream_assign(
+                    "s-plain", "t0", lags, MEMBERS
+                )
+                z_before = _counter(
+                    "klba_wire_lag_bytes_total", {"encoding": "zlib"}
+                )
+                p_before = _counter(
+                    "klba_wire_lag_bytes_total", {"encoding": "plain"}
+                )
+                packed = c.stream_assign(
+                    "s-zlib", "t0", lags, MEMBERS, encoding="zlib"
+                )
+                assert packed["assignments"] == plain["assignments"]
+                z_bytes = _counter(
+                    "klba_wire_lag_bytes_total", {"encoding": "zlib"}
+                ) - z_before
+                p_bytes = _counter(
+                    "klba_wire_lag_bytes_total", {"encoding": "plain"}
+                ) - p_before
+                assert 0 < z_bytes < p_bytes  # it actually compressed
+
+    def test_unknown_encoding_is_structured_client_error(self):
+        with AssignorService(port=0, coalesce_max_batch=1,
+                             scrub_interval_ms=0) as svc:
+            with AssignorServiceClient(*svc.address) as c:
+                with pytest.raises(RuntimeError, match="unknown encoding"):
+                    c.request("stream_assign", {
+                        "stream_id": "s", "members": MEMBERS,
+                        "lags": "AAAA", "encoding": "lz4",
+                    })
+
+    def test_client_falls_back_to_plain_on_unknown_encoding(self):
+        """An older server that answers 'unknown encoding' gets ONE
+        plain-JSON resend, transparently."""
+        lags = [[0, 10], [1, 20]]
+        calls = []
+
+        class OldServerClient(AssignorServiceClient):
+            def __init__(self):  # no socket
+                self._lock = threading.Lock()
+
+            def request(self, method, params=None):
+                calls.append(dict(params))
+                if params.get("encoding") is not None:
+                    raise RuntimeError(
+                        "unknown encoding 'zlib'; supported: []"
+                    )
+                return {"ok": True}
+
+        c = OldServerClient()
+        out = c.stream_assign("s", "t0", lags, MEMBERS,
+                              encoding="zlib")
+        assert out == {"ok": True}
+        assert len(calls) == 2
+        assert calls[0]["encoding"] == "zlib"
+        assert "encoding" not in calls[1]
+        assert calls[1]["lags"] == lags
+
+    def test_bad_base64_and_bomb_guard(self):
+        import base64
+        import zlib
+
+        with AssignorService(port=0, coalesce_max_batch=1,
+                             scrub_interval_ms=0) as svc:
+            with AssignorServiceClient(*svc.address) as c:
+                with pytest.raises(RuntimeError, match="base64"):
+                    c.request("stream_assign", {
+                        "stream_id": "s", "members": MEMBERS,
+                        "lags": "!!!", "encoding": "zlib",
+                    })
+                bomb = base64.b64encode(
+                    zlib.compress(b"[" + b"0," * 30_000_000 + b"0]")
+                ).decode()
+                with pytest.raises(RuntimeError, match="exceeds"):
+                    c.request("stream_assign", {
+                        "stream_id": "s", "members": MEMBERS,
+                        "lags": bomb, "encoding": "zlib",
+                    })
+
+    def test_encode_helper_roundtrip(self):
+        import base64
+        import zlib
+
+        rows = [[0, 5], [3, 9]]
+        blob = encode_lags_zlib(rows)
+        assert json.loads(
+            zlib.decompress(base64.b64decode(blob))
+        ) == rows
+
+
+# -- satellite: scrub-coverage SLO -----------------------------------------
+
+
+class TestScrubCoverageSLO:
+    def test_stall_flag_and_gauge(self):
+        from kafka_lag_based_assignor_tpu.utils.scrub import (
+            StateScrubber,
+        )
+
+        clock = {"t": 100.0}
+        jobs = [("s0", lambda: "busy")]
+        scrubber = StateScrubber(
+            targets=lambda: list(jobs),
+            interval_s=10.0,
+            clock=lambda: clock["t"],
+        )
+        out = scrubber.stats()
+        assert out["stalled"] is False
+        # Busy-only passes make no progress; 3 intervals later the
+        # coverage SLO flips — the wedge is visible by presence.
+        for _ in range(4):
+            clock["t"] += 10.0
+            scrubber.scrub_once()
+        assert scrubber.stats()["stalled"] is True
+        assert metrics.REGISTRY.gauge(
+            "klba_scrub_last_pass_age_s"
+        ).value >= 0.0
+        # An audited pass clears the stall.
+        jobs[0] = ("s0", lambda: "audited")
+        scrubber.scrub_once()
+        assert scrubber.stats()["stalled"] is False
+        # No targets at all is an idle sidecar, not a wedge.
+        jobs.clear()
+        clock["t"] += 100.0
+        scrubber.scrub_once()
+        assert scrubber.stats()["stalled"] is False
+
+    def test_service_wedged_needs_live_streams(self):
+        with AssignorService(port=0, coalesce_max_batch=1,
+                             scrub_interval_ms=60_000.0) as svc:
+            out = svc.scrub_stats()
+            assert out["wedged"] is False  # stalled maybe-false, no streams
+            with AssignorServiceClient(*svc.address) as c:
+                c.stream_assign(
+                    "sw", "t0",
+                    [[p, 100 * p] for p in range(8)],
+                    MEMBERS,
+                )
+            # Force the stall clock back: progress is now ancient.
+            svc._scrubber.last_progress_at -= 10_000.0
+            out = svc.scrub_stats()
+            assert out["stalled"] is True
+            assert out["wedged"] is True
+            assert svc._dispatch("stats", {})[0]["scrub"]["wedged"]
+
+
+# -- satellite: per-class admission windows --------------------------------
+
+
+class TestPerClassWindows:
+    def test_rung1_scales_by_class(self):
+        from kafka_lag_based_assignor_tpu.utils.overload import (
+            _held_window_scales,
+        )
+
+        crit, std, be = _held_window_scales(1, 0.0)
+        assert crit == 1.0       # critical window stays wide
+        assert std == 0.5
+        assert be < std          # best_effort shrinks hardest
+        assert _held_window_scales(0, 0.0) == (1.0, 1.0, 1.0)
+        # The takeover hold also lands per class.
+        held = _held_window_scales(0, 4.0)
+        assert held[0] == 1.0 and held[1] == 0.5
+
+    def test_decision_carries_triple(self):
+        from kafka_lag_based_assignor_tpu.utils.overload import (
+            OverloadController,
+        )
+
+        ctl = OverloadController(
+            latency_budget_ms=1000.0, depth_high=1.0,
+            cooldown_s=60.0, eval_interval_s=0.0,
+        )
+        for _ in range(30):
+            ctl.note_depth(1.2)  # pressure ~1.2 -> rung 1
+        d = ctl.admission("standard")
+        assert d.rung == 1
+        assert d.window_scales == (1.0, 0.5, 0.25)
+        assert d.window_scale == 0.5
+        snap = ctl.snapshot()
+        assert snap["window_scales"]["critical"] == 1.0
+        assert snap["window_scales"]["best_effort"] == 0.25
+
+    def test_coalescer_per_class_deadlines(self):
+        from kafka_lag_based_assignor_tpu.ops.coalesce import (
+            MegabatchCoalescer,
+        )
+
+        coal = MegabatchCoalescer(window_s=0.02, max_batch=8)
+        try:
+            coal.set_window_scales((1.0, 0.5, 0.05))
+            assert coal._window_scales == (1.0, 0.5, 0.05)
+            assert coal._window_scale == 0.5  # legacy mirror = standard
+            coal.set_window_scale(0.01)       # legacy setter clamps
+            assert coal._window_scales == (0.05, 0.05, 0.05)
+        finally:
+            coal.close()
+
+    def test_service_applies_per_class_scales(self):
+        with AssignorService(
+            port=0, coalesce_max_batch=4, scrub_interval_ms=0,
+            overload_depth_high=1.0, overload_latency_budget_ms=1e9,
+            overload_cooldown_s=60.0,
+        ) as svc:
+            ctl = svc._overload
+            for _ in range(30):
+                # Post-decay pressure in [1.0, 1.5): exactly rung 1.
+                ctl.note_depth(1.8)
+            ctl._last_eval = None
+            with AssignorServiceClient(*svc.address) as c:
+                c.stream_assign(
+                    "pc", "t0", [[p, p] for p in range(8)], MEMBERS
+                )
+            assert svc._coalescer._window_scales == (1.0, 0.5, 0.25)

@@ -473,3 +473,62 @@ def test_lint_resident_buffer_assignment_outside_audited_helper():
         f.code == "L018"
         for f in lint.lint_source(Path("tests/x.py"), bad)
     )
+
+
+def test_l019_peer_payload_confined_to_wire():
+    """L019: peer-bound federation payload construction is confined to
+    the audited serializer (federated/wire.py) — envelope-shaped dict
+    literals anywhere in package code, and raw json.dumps inside the
+    federated package, are flagged; wire.py itself and tests are
+    exempt; noqa waives."""
+    peers_mod = Path("kafka_lag_based_assignor_tpu/federated/peers.py")
+    wire_mod = Path("kafka_lag_based_assignor_tpu/federated/wire.py")
+    service_mod = Path("kafka_lag_based_assignor_tpu/service.py")
+
+    envelope = (
+        "def build(a, b):\n"
+        "    return {'duals': {'A': a, 'B': b}, 'epoch': 1}\n"
+    )
+    assert any(
+        f.code == "L019" for f in lint.lint_source(peers_mod, envelope)
+    )
+    assert any(
+        f.code == "L019"
+        for f in lint.lint_source(service_mod, envelope)
+    )
+    assert not any(
+        f.code == "L019" for f in lint.lint_source(wire_mod, envelope)
+    )
+    assert not any(
+        f.code == "L019"
+        for f in lint.lint_source(Path("tests/x.py"), envelope)
+    )
+
+    marginals = "def build(l):\n    return {'marginals': l}\n"
+    assert any(
+        f.code == "L019"
+        for f in lint.lint_source(peers_mod, marginals)
+    )
+
+    dumps = (
+        "import json\n"
+        "def send(payload):\n"
+        "    return json.dumps(payload).encode()\n"
+    )
+    assert any(
+        f.code == "L019" for f in lint.lint_source(peers_mod, dumps)
+    )
+    # json.dumps outside the federated package is not L019's business.
+    assert not any(
+        f.code == "L019" for f in lint.lint_source(service_mod, dumps)
+    )
+    assert not any(
+        f.code == "L019" for f in lint.lint_source(wire_mod, dumps)
+    )
+
+    waived = envelope.replace(
+        "{'duals'", "{  # noqa: L019\n        'duals'"
+    )
+    assert not any(
+        f.code == "L019" for f in lint.lint_source(peers_mod, waived)
+    )

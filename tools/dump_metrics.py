@@ -70,6 +70,7 @@ def main() -> int:
             stats = client.request("stats") if args.summary else {}
             lifecycle = stats.get("lifecycle")
             scrub = stats.get("scrub")
+            federation = stats.get("federation")
     except OSError as exc:
         print(
             f"cannot reach sidecar at {args.host}:{args.port}: {exc}",
@@ -273,6 +274,16 @@ def main() -> int:
                 f"{int(scrub.get('quarantined_streams', 0))} stream(s) "
                 "quarantined now"
             )
+            # Scrub-coverage SLO (ROADMAP state-integrity (b)): a
+            # wedged scrubber is flagged by PRESENCE — audit progress
+            # stalled while streams are live — not by counters that
+            # quietly stopped moving.
+            if scrub.get("wedged"):
+                print(
+                    "scrub WEDGED: no audit progress for > 3 "
+                    "intervals while streams are live "
+                    "(klba_scrub_streams_audited_total stalled)"
+                )
         elif lifecycle:
             print("scrub: disabled (tpu.assignor.scrub.interval.ms=0)")
         quarantines = js.get("klba_quarantine_total", {}).get(
@@ -288,6 +299,41 @@ def main() -> int:
                     f"{int(s['value'])}"
                 )
             print(f"quarantine total: {int(total)}")
+
+        # Federation view (DEPLOYMENT.md "Federated assignment"):
+        # degradation rung, per-peer link/breaker state, dual-cache
+        # age, and the stale/fenced rejection totals — the "is this
+        # sidecar converging with its peers, and who is partitioned"
+        # look.
+        if federation:
+            rung = federation.get("rung") or "never ran"
+            cache = federation.get("last_good")
+            cache_txt = (
+                f"last-good duals {cache['age_s']:.1f}s old "
+                f"({cache['rounds']} rounds)"
+                if cache else "no last-good duals"
+            )
+            print(
+                f"federation: rung={rung} epoch="
+                f"{federation.get('epoch')} "
+                f"last_rounds={federation.get('last_rounds')}, "
+                f"{cache_txt}"
+            )
+            for pid, peer in sorted(
+                (federation.get("peers") or {}).items()
+            ):
+                print(
+                    f"peer {pid} ({peer.get('address')}): "
+                    f"breaker={peer.get('breaker')} "
+                    f"last={peer.get('last_outcome')} "
+                    f"epoch_seen={peer.get('epoch_seen')}"
+                )
+            stale = by_label("klba_peer_stale_duals_total", "reason")
+            if stale:
+                rows = ", ".join(
+                    f"{k}={int(v)}" for k, v in sorted(stale.items())
+                )
+                print(f"stale/fenced duals rejected: {rows}")
         return 0
     print(json.dumps(result["json"], indent=2, sort_keys=True))
     return 0

@@ -93,6 +93,17 @@ Rules:
         drop a mirror without its buffer.  Waivable with
         ``# noqa: L018`` stating why the write cannot go through an
         audited helper.
+  L019  peer-bound federation payload constructed outside the audited
+        serializer (federated/wire.py): the privacy contract — raw
+        partition lags never leave the cluster — is only auditable if
+        every ``peer_sync`` payload flows through wire.py's
+        whitelisted, C-bounded builders.  Flagged: a dict literal
+        carrying a ``"duals"`` or ``"marginals"`` key anywhere in
+        package code outside wire.py (the payload envelope being
+        hand-rolled), and any ``json.dumps`` call inside the
+        ``federated/`` package outside wire.py (serialization that
+        bypasses the audit).  Waivable with ``# noqa: L019`` stating
+        why the payload is not peer-bound.
 """
 
 from __future__ import annotations
@@ -479,6 +490,61 @@ def _l018_findings(
     return findings
 
 
+#: L019: the payload-envelope keys whose dict-literal construction is
+#: confined to the audited serializer.
+_L019_PAYLOAD_KEYS = frozenset({"duals", "marginals"})
+
+
+def _l019_findings(
+    rel: str, tree: ast.AST, lines: List[str], in_federated: bool
+) -> List[Finding]:
+    """Peer-payload audit (docstring rule L019): envelope-shaped dict
+    literals anywhere in package code, plus raw ``json.dumps`` inside
+    the federated package — both belong in federated/wire.py."""
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Dict):
+            keys = {
+                k.value for k in node.keys
+                if isinstance(k, ast.Constant) and isinstance(k.value, str)
+            }
+            if keys & _L019_PAYLOAD_KEYS and (
+                "noqa: L019" not in lines[node.lineno - 1]
+            ):
+                findings.append(
+                    Finding(
+                        rel,
+                        node.lineno,
+                        "L019",
+                        "peer payload envelope (duals/marginals dict) "
+                        "built outside federated/wire.py: use the "
+                        "audited serializer so the no-raw-lags "
+                        "contract stays enforceable (or waive with "
+                        "`# noqa: L019`)",
+                    )
+                )
+        elif in_federated and isinstance(node, ast.Call):
+            func = node.func
+            is_dumps = (
+                isinstance(func, ast.Attribute) and func.attr == "dumps"
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "json"
+            )
+            if is_dumps and "noqa: L019" not in lines[node.lineno - 1]:
+                findings.append(
+                    Finding(
+                        rel,
+                        node.lineno,
+                        "L019",
+                        "raw json.dumps in the federated package: "
+                        "peer-bound bytes must go through "
+                        "federated/wire.encode (or waive with "
+                        "`# noqa: L019`)",
+                    )
+                )
+    return findings
+
+
 _UNBOUNDED_QUEUE_TYPES = ("Queue", "LifoQueue", "PriorityQueue")
 
 
@@ -650,6 +716,14 @@ def lint_source(path: Path, source: str) -> List[Finding]:
     if is_package:
         findings.extend(_l014_list_buffer_findings(rel, tree, lines))
         findings.extend(_l015_findings(rel, tree, lines))
+    # L019 applies to package code outside the audited serializer: the
+    # federation privacy contract is enforceable only while every
+    # peer-bound payload is built (and serialized) in wire.py.
+    in_federated = is_package and "federated" in path.parts
+    if is_package and not (in_federated and path.name == "wire.py"):
+        findings.extend(
+            _l019_findings(rel, tree, lines, in_federated=in_federated)
+        )
     # L017 applies to package code OUTSIDE utils/snapshot.py (the
     # backend layer owns the raw atomic write; everyone else must go
     # through a SnapshotBackend so fencing polices the write).
