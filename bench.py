@@ -2368,6 +2368,252 @@ def config12_federated():
     }
 
 
+def config13_sharded():
+    """Sharded-scale probe (ISSUE 13): the mesh-native backend on this
+    host's device mesh — a P-sharded solve at a shape that exercises
+    >= 4 devices, and the stream-sharded megabatch against a
+    single-device twin.  What must hold (gated in main whenever a mesh
+    is constructible — on CPU that needs
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8``, else the
+    probe records ``skipped``): every sharded answer valid and
+    count-balanced at quality <= 1.1x the bound, ZERO fresh compiles in
+    the sharded warm loops, the stream-sharded megabatch within the
+    CPU-ref no-regression bound of its single-device twin (2.5x — the
+    virtual mesh timeshares ONE physical CPU, so collectives add pure
+    overhead; the >= linear-scaling gate is reserved for hardware,
+    where D devices actually exist), and a ``mesh.collective`` fault
+    mid-wave serving every row valid through the single-device
+    fallback with the manager degraded."""
+    import threading
+    import time as time_mod
+
+    from kafka_lag_based_assignor_tpu.ops.coalesce import (
+        MegabatchCoalescer,
+    )
+    from kafka_lag_based_assignor_tpu.ops.streaming import (
+        StreamingAssignor,
+    )
+    from kafka_lag_based_assignor_tpu.sharded.mesh import MeshManager
+    from kafka_lag_based_assignor_tpu.sharded.solve import solve_sharded
+    from kafka_lag_based_assignor_tpu.utils import faults
+    from kafka_lag_based_assignor_tpu.utils.observability import (
+        compile_count,
+        install_compile_counter,
+    )
+
+    install_compile_counter()
+    import jax
+
+    n_dev = len(jax.devices())
+    out = {"config": "sharded_scale", "devices": n_dev}
+    if n_dev < 4:
+        out["skipped"] = (
+            f"{n_dev} device(s) visible; the probe needs >= 4 (set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8 for "
+            "the virtual CPU mesh)"
+        )
+        log(json.dumps(out))
+        return out
+    D = 8 if n_dev >= 8 else 4
+    mgr = MeshManager(devices=D, solve_min_rows=1024).configure()
+    rng = np.random.default_rng(0x5A4D)
+
+    # ---- Part A: P-sharded solve, >= 4 devices at this bucket.
+    P, C = 32768, 64
+    lags = zipf_lags(rng, P)
+
+    def quality(choice, arr):
+        totals = np.bincount(choice, weights=arr, minlength=C)
+        return quality_ratio(
+            imbalance(totals), imbalance_bound(arr, C)
+        )
+
+    single = StreamingAssignor(num_consumers=C)
+    t0 = time_mod.perf_counter()
+    single_choice = single.rebalance(lags)
+    single_ms = (time_mod.perf_counter() - t0) * 1000.0
+    solve_sharded(mgr.solve_mesh(), lags, C, refine_iters=64)  # compile
+    c0 = compile_count()
+    walls, worst_q, valid = [], 0.0, True
+    for _ in range(5):
+        fresh = zipf_lags(rng, P)
+        t0 = time_mod.perf_counter()
+        ch, cnt, _, _ = solve_sharded(
+            mgr.solve_mesh(), fresh, C, refine_iters=64
+        )
+        walls.append((time_mod.perf_counter() - t0) * 1000.0)
+        counts = np.bincount(ch, minlength=C)
+        valid &= bool(
+            ch.min() >= 0 and ch.max() < C
+            and counts.max() - counts.min() <= 1
+            and np.array_equal(cnt, counts)
+        )
+        worst_q = max(worst_q, quality(ch, fresh))
+    out["solve"] = {
+        "partitions": P,
+        "consumers": C,
+        "mesh_devices": D,
+        "valid": valid,
+        "warm_compile_count": compile_count() - c0,
+        "sharded_p50_ms": round(float(np.median(walls)), 2),
+        "single_cold_ms": round(single_ms, 2),
+        "worst_quality_ratio": round(worst_q, 4),
+        "single_quality_ratio": round(
+            quality(np.asarray(single_choice), lags), 4
+        ),
+    }
+
+    # ---- Part B: stream-sharded megabatch vs the single-device twin.
+    N, P2, C2 = 8, 2048, 8
+
+    def run_waves(mesh_manager, seed, waves=6):
+        rng_w = np.random.default_rng(seed)
+        engines = [
+            StreamingAssignor(
+                num_consumers=C2, refine_iters=64,
+                refine_threshold=None,
+            )
+            for _ in range(N)
+        ]
+        for e in engines:
+            e.rebalance(rng_w.integers(0, 1000, P2).astype(np.int64))
+        coal = MegabatchCoalescer(
+            window_s=2.0, max_batch=N, lock_waves=1,
+            mesh_manager=mesh_manager,
+        )
+        all_valid, errors = True, 0
+
+        def wave():
+            nonlocal all_valid, errors
+            arrs = [
+                rng_w.integers(0, 1000, P2).astype(np.int64)
+                for _ in range(N)
+            ]
+            outs = [None] * N
+
+            def run(i):
+                nonlocal errors
+                try:
+                    outs[i] = engines[i].submit_epoch(arrs[i], coal)
+                except Exception:  # noqa: BLE001 — counted below
+                    errors += 1
+
+            threads = [
+                threading.Thread(target=run, args=(i,))
+                for i in range(N)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for o in outs:
+                if o is None:
+                    continue
+                cc = np.bincount(np.asarray(o), minlength=C2)
+                all_valid &= bool(cc.max() - cc.min() <= 1)
+
+        try:
+            wave()  # re-stack + lock
+            wave()  # first locked wave (compiles once when sharded)
+            cw0 = compile_count()
+            t0 = time_mod.perf_counter()
+            for _ in range(waves):
+                wave()
+            wall = (time_mod.perf_counter() - t0) * 1000.0
+            compiles = compile_count() - cw0
+            sharded_roster = coal.stats()["stream_sharded_rosters"]
+        finally:
+            coal.close()
+        return wall, compiles, all_valid, errors, sharded_roster
+
+    sh_wall, sh_compiles, sh_valid, sh_errors, sh_rosters = run_waves(
+        mgr, 0xB1
+    )
+    si_wall, si_compiles, si_valid, si_errors, _ = run_waves(
+        None, 0xB2
+    )
+    out["megabatch"] = {
+        "streams": N,
+        "partitions": P2,
+        "consumers": C2,
+        "stream_sharded_rosters": sh_rosters,
+        "sharded_wall_ms": round(sh_wall, 2),
+        "single_wall_ms": round(si_wall, 2),
+        "wall_ratio_vs_single": round(sh_wall / max(si_wall, 1e-9), 3),
+        "warm_compile_count": sh_compiles,
+        "single_warm_compile_count": si_compiles,
+        "all_valid": bool(sh_valid and si_valid),
+        "errors": sh_errors + si_errors,
+    }
+
+    # ---- Part C: mesh.collective drill — one fault mid-wave must
+    # serve every row valid through the single-device fallback and
+    # degrade the manager (no invalid assignment, no request error).
+    drill_mgr = MeshManager(devices=D, solve_min_rows=1024).configure()
+    rng_d = np.random.default_rng(0xC3)
+    engines = [
+        StreamingAssignor(
+            num_consumers=C2, refine_iters=64, refine_threshold=None
+        )
+        for _ in range(N)
+    ]
+    for e in engines:
+        e.rebalance(rng_d.integers(0, 1000, P2).astype(np.int64))
+    coal = MegabatchCoalescer(
+        window_s=2.0, max_batch=N, lock_waves=1, mesh_manager=drill_mgr
+    )
+    drill_valid, drill_errors = True, 0
+    try:
+
+        def drill_wave():
+            nonlocal drill_valid, drill_errors
+            arrs = [
+                rng_d.integers(0, 1000, P2).astype(np.int64)
+                for _ in range(N)
+            ]
+
+            def run(i):
+                nonlocal drill_valid, drill_errors
+                try:
+                    o = engines[i].submit_epoch(arrs[i], coal)
+                    cc = np.bincount(np.asarray(o), minlength=C2)
+                    drill_valid &= bool(cc.max() - cc.min() <= 1)
+                except Exception:  # noqa: BLE001 — counted below
+                    drill_errors += 1
+
+            threads = [
+                threading.Thread(target=run, args=(i,))
+                for i in range(N)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+
+        drill_wave()  # lock sharded
+        inj = faults.FaultInjector(0xD).plan(
+            "mesh.collective", times=1
+        )
+        with faults.injected(inj):
+            drill_wave()  # faulted wave: single-stream fallback
+        drill_wave()  # re-locked single-device
+        out["collective_drill"] = {
+            "fired": inj.fired("mesh.collective"),
+            "degraded": not drill_mgr.active,
+            "all_valid": drill_valid,
+            "errors": drill_errors,
+            "ok": bool(
+                inj.fired("mesh.collective") == 1
+                and not drill_mgr.active
+                and drill_valid
+                and drill_errors == 0
+            ),
+        }
+    finally:
+        coal.close()
+    return out
+
+
 def main():
     # A wedged accelerator tunnel must degrade the benchmark, not hang it
     # (the framework's own watchdog philosophy, SURVEY §5 failure row):
@@ -2418,7 +2664,7 @@ def main():
     for fn in (config1_readme, config2_zipf, config3_vmap, config4_skew,
                config5_northstar, config6_multistream, config7_overload,
                config8_restart, config9_delta, config10_handoff,
-               config11_scrub, config12_federated):
+               config11_scrub, config12_federated, config13_sharded):
         before = klba_metrics.REGISTRY.snapshot()
         r = fn()
         deltas = klba_metrics.histogram_deltas(
@@ -2857,6 +3103,64 @@ def main():
                 f"{fp.get('stale_rejected')}/"
                 f"{fp.get('fenced_rejected')} — regressed or fenced "
                 "duals are not being rejected and counted"
+            )
+    # Sharded-scale gates (whenever a >= 4-device mesh was
+    # constructible — virtual CPU or hardware): validity + quality on
+    # every sharded answer, zero compiles in both sharded warm loops,
+    # the CPU-ref no-regression bound on the stream-sharded megabatch
+    # (2.5x of the single-device twin — the virtual mesh timeshares
+    # one physical CPU; the >= linear-scaling gate is reserved for
+    # hardware), and the mesh.collective drill serving valid through
+    # the single-device fallback.
+    sh = results.get("sharded_scale", {})
+    if sh and not sh.get("skipped"):
+        sv = sh.get("solve", {})
+        if not sv.get("valid", False):
+            failures.append(
+                "sharded_scale solve produced an invalid (count-"
+                "imbalanced or out-of-range) assignment"
+            )
+        if sv.get("warm_compile_count", 1) != 0:
+            failures.append(
+                f"sharded_scale solve compiled "
+                f"{sv.get('warm_compile_count')} executable(s) in the "
+                "warm loop — the sharded program cache is not holding"
+            )
+        if sv.get("worst_quality_ratio", 99) > 1.1:
+            failures.append(
+                f"sharded_scale solve worst_quality_ratio "
+                f"{sv.get('worst_quality_ratio')} > 1.1"
+            )
+        mb = sh.get("megabatch", {})
+        if not mb.get("all_valid", False) or mb.get("errors", 1):
+            failures.append(
+                "sharded_scale megabatch served invalid rows or "
+                f"errors ({mb.get('errors')})"
+            )
+        if mb.get("warm_compile_count", 1) != 0:
+            failures.append(
+                f"sharded_scale megabatch compiled "
+                f"{mb.get('warm_compile_count')} executable(s) in the "
+                "locked sharded steady state"
+            )
+        if mb.get("stream_sharded_rosters", 0) < 1:
+            failures.append(
+                "sharded_scale megabatch never locked a stream-"
+                "sharded roster — the placement path did not engage"
+            )
+        ratio = mb.get("wall_ratio_vs_single")
+        if ratio is not None and ratio > 2.5:
+            failures.append(
+                f"sharded_scale megabatch wall_ratio_vs_single "
+                f"{ratio} > 2.5 — the sharded placement regressed "
+                "past the virtual-mesh overhead bound"
+            )
+        if not sh.get("collective_drill", {}).get("ok", False):
+            failures.append(
+                f"sharded_scale collective drill failed: "
+                f"{sh.get('collective_drill')} — a mesh fault must "
+                "serve valid through the single-device fallback and "
+                "degrade the manager"
             )
     for msg in failures:
         log(f"bench: REGRESSION GATE FAILED: {msg}")

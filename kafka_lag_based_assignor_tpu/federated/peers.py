@@ -180,6 +180,7 @@ class FederationCoordinator:
         max_staleness_s: float = DEFAULT_MAX_STALENESS_S,
         fence_token: Optional[Callable[[], Optional[int]]] = None,
         clock: Optional[Callable[[], float]] = None,
+        capacity: Optional[Any] = None,
     ):
         if not self_id:
             raise ValueError("federation self_id must be non-empty")
@@ -199,6 +200,16 @@ class FederationCoordinator:
         self.max_staleness_s = float(max_staleness_s)
         self._fence_token = fence_token or (lambda: None)
         self._clock = clock or metrics.REGISTRY.clock
+        # Weighted shards (ROADMAP federated (c)): this cluster's
+        # per-consumer capacity weight vector, exchanged in the hello
+        # phase and summed into the global count-marginal target.  None
+        # = contribute uniform weights (the n/C back-compat marginal
+        # when NO shard advertises capacity).  Length is validated
+        # against C at use — a roster-size change simply drops it.
+        self.capacity = (
+            np.asarray(capacity, dtype=np.float64)
+            if capacity is not None else None
+        )
         self._watchdog = watchdog or Watchdog(
             sync_timeout_s, cooldown_s=30.0, failure_threshold=2
         )
@@ -374,6 +385,7 @@ class FederationCoordinator:
                     int(params.get("round", 0)), C,
                     total_lag=shard["total"], n_valid=shard["n"],
                     fence_token=token,
+                    capacity=self._capacity_for(C),
                 )
             duals = params.get("duals") or {}
             a = duals.get("A")
@@ -405,6 +417,14 @@ class FederationCoordinator:
             total_lag=total, n_valid=n, load=load, colsum=colsum,
             fence_token=token,
         )
+
+    def _capacity_for(self, C: int) -> Optional[list]:
+        """This cluster's capacity vector as a wire-ready list, or None
+        when unset or shaped for a different roster."""
+        cap = self.capacity
+        if cap is None or cap.shape != (int(C),):
+            return None
+        return [float(v) for v in cap]
 
     # -- the initiator half -------------------------------------------------
 
@@ -587,11 +607,51 @@ class FederationCoordinator:
             shard = self._shard
             total = shard["total"]
             n = shard["n"]
+        # Weighted shards: every shard's capacity vector (uniform ones
+        # when a shard advertises none or sends an unusable one) is
+        # NORMALIZED to sum C before summing — the aggregation is then
+        # scale-invariant (a cluster reporting [1000, 500] and one
+        # reporting [2, 1] express the same preference with the same
+        # weight, and an unweighted cluster's uniform vote counts
+        # equally).  A peer vector with a NaN/negative entry (the
+        # wire audit rejects them at construction, but the response is
+        # parsed JSON) is dropped to uniform, counted as stale state.
+        # With NO shard weighted, the cap vector degenerates to
+        # exactly the uniform n/C marginal.
+        def _norm(vec) -> Optional[np.ndarray]:
+            if vec is None or not (
+                isinstance(vec, (list, np.ndarray)) and len(vec) == C
+            ):
+                return None
+            if not wire.capacity_usable(vec):
+                return None
+            arr = np.asarray(vec, np.float64)
+            return arr * (C / arr.sum())
+
+        own_cap = _norm(self._capacity_for(C))
+        cap_vecs = [own_cap if own_cap is not None
+                    else np.ones(C, np.float64)]
+        any_weighted = own_cap is not None
         for resp in hello.values():
             total += int(resp.get("total_lag", 0))
             n += int(resp.get("n_valid", 0))
+            raw_cap = resp.get("capacity")
+            peer_cap = _norm(raw_cap)
+            if peer_cap is not None:
+                cap_vecs.append(peer_cap)
+                any_weighted = True
+            else:
+                if raw_cap is not None:
+                    self._count_stale("capacity")
+                cap_vecs.append(np.ones(C, np.float64))
         scale = max(float(total), 1.0) / C
-        cap = max(float(n), 1.0) / C
+        cap_frac: Optional[np.ndarray] = None
+        if any_weighted:
+            capw = np.sum(cap_vecs, axis=0)
+            cap_frac = capw / capw.sum()
+            cap = max(float(n), 1.0) * cap_frac
+        else:
+            cap = max(float(n), 1.0) / C
         with self._shard_lock:
             weights = self._shard_dedup(self._shard, scale)
         A, B = fedsolve.initial_duals(C)
@@ -667,11 +727,16 @@ class FederationCoordinator:
                 "C": int(C),
                 "at": self._clock(),
                 "rounds": rounds,
+                # The weighted-count shares (None = uniform) ride the
+                # cache so the last-good-global rung rounds with the
+                # same capacity apportionment the exchange converged
+                # under.
+                "cap_frac": cap_frac,
             }
         self.last_rounds = rounds
         choice, _, _ = fedsolve.round_local_shard(
             lags, C, A, B, scale, remote_load,
-            refine_iters=refine_iters,
+            refine_iters=refine_iters, capacity_frac=cap_frac,
         )
         self._m_staleness.set(0.0)
         return {
@@ -697,6 +762,7 @@ class FederationCoordinator:
         choice, _, _ = fedsolve.round_local_shard(
             lags, C, cached["A"], cached["B"], cached["scale"],
             cached["base_load"], refine_iters=refine_iters,
+            capacity_frac=cached.get("cap_frac"),
         )
         self._m_staleness.set(age)
         return {
@@ -755,6 +821,7 @@ class FederationCoordinator:
             cached = self._last_good
             cache = None
             if cached is not None:
+                cap_frac = cached.get("cap_frac")
                 cache = {
                     "A": [float(v) for v in cached["A"]],
                     "B": [float(v) for v in cached["B"]],
@@ -763,6 +830,10 @@ class FederationCoordinator:
                     "C": cached["C"],
                     "age_s": self._clock() - cached["at"],
                     "rounds": cached["rounds"],
+                    "cap_frac": (
+                        [float(v) for v in cap_frac]
+                        if cap_frac is not None else None
+                    ),
                 }
         return {
             "epoch": self.local_epoch,
@@ -823,7 +894,14 @@ class FederationCoordinator:
                         float(cache.get("age_s", 0.0)), 0.0
                     ),
                     "rounds": int(cache.get("rounds", 0)),
+                    "cap_frac": (
+                        np.asarray(cache["cap_frac"], np.float64)
+                        if cache.get("cap_frac") is not None else None
+                    ),
                 }
+                cf = restored["cap_frac"]
+                if cf is not None and cf.shape != (C,):
+                    restored["cap_frac"] = None
                 if (
                     restored["A"].shape == (C,)
                     and restored["B"].shape == (C,)

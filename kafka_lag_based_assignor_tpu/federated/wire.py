@@ -43,7 +43,7 @@ _RESPONSE_KEYS = frozenset(
     {
         "version", "peer_id", "epoch", "fence_token", "round",
         "num_consumers", "marginals", "total_lag", "n_valid",
-        "rejected",
+        "rejected", "capacity",
     }
 )
 _DUALS_KEYS = frozenset({"A", "B"})
@@ -101,6 +101,30 @@ def _check_payload(
             payload["marginals"][key] = _check_vector(
                 f"marginals.{key}", marginals[key], C
             )
+    capacity = payload.get("capacity")
+    if capacity is not None:
+        # The weighted-shard capacity vector rides the SAME consumer-
+        # axis shape audit as the marginals: C-bounded, so a
+        # partition-axis vector cannot smuggle out under this key —
+        # and every entry must be a finite positive weight (a NaN or
+        # negative capacity would poison the summed global count
+        # marginal; the initiator re-checks with the same rule).
+        vec = _check_vector("capacity", capacity, C)
+        if not capacity_usable(vec):
+            raise PayloadViolation(
+                "capacity entries must be finite and > 0"
+            )
+        payload["capacity"] = vec
+
+
+def capacity_usable(vec) -> bool:
+    """True when ``vec`` is a usable capacity weight vector: every
+    entry finite and strictly positive.  Shared by the construction
+    audit above and the INITIATOR's consumption of a peer's hello
+    response (a hostile/buggy peer's NaN or negative entry must never
+    reach the summed count marginal)."""
+    arr = np.asarray(vec, dtype=np.float64)
+    return bool(np.all(np.isfinite(arr)) and np.all(arr > 0))
 
 
 def sync_request(
@@ -149,10 +173,15 @@ def sync_response(
     load: Optional[Any] = None,
     colsum: Optional[Any] = None,
     fence_token: Optional[int] = None,
+    capacity: Optional[Any] = None,
 ) -> Dict[str, Any]:
     """Build (and audit) one ``peer_sync`` response body: the peer's
     marginal contribution (exchange phase) or just its handshake
-    scalars (hello phase — ``load``/``colsum`` None)."""
+    scalars (hello phase — ``load``/``colsum`` None).  ``capacity``
+    (hello phase, optional) is this shard's per-consumer capacity
+    weight vector — the weighted-shard count marginal's raw material
+    (ROADMAP federated (c)); consumer-axis bounded like every vector
+    on this wire."""
     body: Dict[str, Any] = {
         "version": PROTOCOL_VERSION,
         "peer_id": str(peer_id),
@@ -166,6 +195,8 @@ def sync_response(
         body["fence_token"] = int(fence_token)
     if load is not None:
         body["marginals"] = {"load": load, "colsum": colsum}
+    if capacity is not None:
+        body["capacity"] = capacity
     _check_payload(body, _RESPONSE_KEYS, int(num_consumers))
     return body
 

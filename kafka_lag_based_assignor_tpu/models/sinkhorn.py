@@ -347,8 +347,15 @@ def _sinkhorn_duals_jit(
     return A, B
 
 
-def _round_parallel(lags, ws, valid, A, B, C: int, floor_cap, extras):
+def _round_parallel(lags, ws, valid, A, B, C: int, floor_cap, extras,
+                    cap_vec=None, cap_max=None):
     """Parallel (O(P log P), no per-partition scan) plan rounding.
+
+    ``cap_vec`` (int32[C] summing to the valid row count) replaces the
+    uniform floor/ceil capacities with EXPLICIT per-consumer seat
+    counts — the federated weighted-shard rounding (ops/fedsolve) seats
+    capacity-proportional counts this way; ``cap_max`` must then bound
+    its largest entry (STATIC: it sizes the open-slot enumeration).
 
     1. each partition takes its plan-argmax consumer (tiled, parallel);
     2. capacity repair: within each consumer's takers (sorted lag desc) the
@@ -364,9 +371,12 @@ def _round_parallel(lags, ws, valid, A, B, C: int, floor_cap, extras):
     Returns choice int32[P] (input order, -1 for invalid rows).
     """
     P = ws.shape[0]
-    cap = floor_cap + (jnp.arange(C, dtype=jnp.int32) < extras).astype(
-        jnp.int32
-    )  # int32[C], sums to n_valid
+    if cap_vec is None:
+        cap = floor_cap + (jnp.arange(C, dtype=jnp.int32) < extras).astype(
+            jnp.int32
+        )  # int32[C], sums to n_valid
+    else:
+        cap = cap_vec.astype(jnp.int32)
 
     # Noise-FREE argmax: the per-(p, j) hash tie-break costs ~8 int ops
     # per logit (~2/3 of the whole [P, C] pass at the 100k north star)
@@ -404,7 +414,7 @@ def _round_parallel(lags, ws, valid, A, B, C: int, floor_cap, extras):
     load_rank = jnp.zeros((C,), jnp.int32).at[
         jnp.argsort(kept_load).astype(jnp.int32)
     ].set(jnp.arange(C, dtype=jnp.int32))
-    cap_max = P // C + 1
+    cap_max = int(cap_max) if cap_max is not None else P // C + 1
     slot_r = jnp.repeat(
         jnp.arange(cap_max, dtype=jnp.int32)[:, None], C, axis=1
     ).reshape(-1)

@@ -368,11 +368,12 @@ class _ResidentBatch:
 
     __slots__ = (
         "shape_key", "choice", "row_tab", "counts", "lags", "n_real",
-        "valid", "poisoned", "lock",
+        "valid", "poisoned", "lock", "mesh",
     )
 
     def __init__(
-        self, shape_key, choice, row_tab, counts, lags, n_real: int
+        self, shape_key, choice, row_tab, counts, lags, n_real: int,
+        mesh=None,
     ):
         self.shape_key = shape_key
         self.choice = choice
@@ -383,6 +384,10 @@ class _ResidentBatch:
         self.valid = True
         self.poisoned = False
         self.lock = threading.Lock()
+        # Stream-axis mesh this batch's stacked buffers are sharded
+        # over (sharded/megabatch.place_batch at lock time), or None
+        # for the single-device placement.  Staged uploads follow it.
+        self.mesh = mesh
 
     @property
     def n_pad(self) -> int:
@@ -577,6 +582,20 @@ class MegabatchCoalescer:
         # already multiplies the executable count.  0 disables the
         # stacked delta path (every wave stages dense).
         delta_k: int = 512,
+        # Stream-axis sharding (sharded/megabatch): the mesh manager
+        # whose ("streams",) mesh locked rosters spread over — N
+        # tenants' rows run on D devices instead of queueing on one.
+        # The default "auto" follows the process-wide active manager
+        # (sharded/mesh.activate — what a mesh-enabled service
+        # installs at boot), which itself defaults to None =
+        # single-device placement; an EXPLICIT None pins this
+        # coalescer single-device regardless of any global manager (a
+        # mesh-off service must not adopt a co-resident instance's
+        # mesh).  A ``mesh.collective`` fault or a sharded dispatch
+        # failure degrades the manager: in-flight rows resolve through
+        # the existing single-stream fallback and later waves place
+        # single-device.
+        mesh_manager="auto",
     ):
         if window_s < 0:
             raise ValueError(f"window_s={window_s} must be >= 0")
@@ -591,6 +610,7 @@ class MegabatchCoalescer:
         self.lock_waves = int(lock_waves)
         self.pipeline = bool(pipeline)
         self.delta_k = int(delta_k)
+        self._mesh_manager = mesh_manager
         # Overload backpressure: the shed ladder's rung-1 action scales
         # the admission window down (smaller waves, lower parked
         # latency — batch efficiency yields before latency).  Plain
@@ -750,8 +770,13 @@ class MegabatchCoalescer:
             locked = sum(
                 1 for r in self._rosters.values() if r.batch is not None
             )
+            sharded = sum(
+                1 for r in self._rosters.values()
+                if r.batch is not None and r.batch.mesh is not None
+            )
         return {
             "locked_rosters": locked,
+            "stream_sharded_rosters": sharded,
             "roster_hits": self._m_hits.value,
             "restack_flushes": self._m_restack.value,
             "roster_invalidations": self._m_invalid.value,
@@ -1113,6 +1138,35 @@ class MegabatchCoalescer:
         m = getattr(resident, "materialize", None)
         return m() if m is not None else resident
 
+    # -- stream-axis sharding (sharded/megabatch) --------------------------
+
+    def _mesh_mgr(self):
+        if self._mesh_manager != "auto":
+            return self._mesh_manager  # explicit manager, or None = off
+        from ..sharded import mesh as mesh_mod
+
+        return mesh_mod.active_manager()
+
+    def _stream_mesh(self, n_pad: int):
+        """The ("streams",) mesh a batch of ``n_pad`` rows should shard
+        over, or None for the single-device placement (no/degraded
+        manager, or a batch axis the mesh does not divide)."""
+        mgr = self._mesh_mgr()
+        if mgr is None or not mgr.active:
+            return None
+        from ..sharded.megabatch import shardable
+
+        mesh = mgr.streams_mesh()
+        return mesh if shardable(mesh, n_pad) else None
+
+    def _degrade_mesh(self, reason: str) -> None:
+        """A sharded flush failed: fall the PROCESS back to the
+        single-device placement (the manager's ladder) — in-flight rows
+        already resolve through the single-stream fallback."""
+        mgr = self._mesh_mgr()
+        if mgr is not None:
+            mgr.degrade(reason)
+
     def _note_flush_cost(self, started: float, compiles_before: int) -> None:
         """EWMA of dispatch->readback wall time — the deadline-triage
         estimate of what one more full flush would cost a parked row.
@@ -1173,13 +1227,16 @@ class MegabatchCoalescer:
         rows: List[EpochSubmission],
         n_pad: int,
         row_of: Callable[[int], int],
+        mesh=None,
     ):
         """Upload stage: fill a rotating staging buffer (row placement
         via ``row_of`` — wave order for re-stacks, the stable roster
         index for locked waves; pad rows stay zero-lag / 0.0-limit) and
-        start the async H2D.  Returns (slot, lags_dev, limits_dev); the
-        slot's ``ready`` is cleared and must be re-set by the wave's
-        readback (or by the caller on a dispatch error)."""
+        start the async H2D.  ``mesh`` (a stream-sharded locked batch's
+        mesh) lands each row's slice directly on its device.  Returns
+        (slot, lags_dev, limits_dev); the slot's ``ready`` is cleared
+        and must be re-set by the wave's readback (or by the caller on
+        a dispatch error)."""
         s0 = rows[0]
         slot = self._staging_slot(
             s0.shape_key, n_pad, s0.bucket, s0.payload.dtype
@@ -1195,8 +1252,15 @@ class MegabatchCoalescer:
                 slot.limits[r] = s.limit
             self._m_h2d_dense.inc(slot.lags.nbytes)
             try:
-                lags_dev = jax.device_put(slot.lags)
-                limits_dev = jax.device_put(slot.limits)
+                if mesh is not None:
+                    from ..sharded.megabatch import place_rows
+
+                    lags_dev, limits_dev = place_rows(
+                        mesh, slot.lags, slot.limits
+                    )
+                else:
+                    lags_dev = jax.device_put(slot.lags)
+                    limits_dev = jax.device_put(slot.limits)
             except Exception:
                 slot.ready.set()
                 raise
@@ -1207,6 +1271,7 @@ class MegabatchCoalescer:
         rows: List[EpochSubmission],
         n_pad: int,
         row_of: Callable[[int], int],
+        mesh=None,
     ):
         """Delta upload stage (locked waves only): fill the rotating
         [n_pad, K] index/value staging pair — per-row padding entries
@@ -1231,9 +1296,16 @@ class MegabatchCoalescer:
                 slot.limits[r] = s.limit
             self._m_h2d_delta.inc(slot.idx.nbytes + slot.vals.nbytes)
             try:
-                idx_dev = jax.device_put(slot.idx)
-                vals_dev = jax.device_put(slot.vals)
-                limits_dev = jax.device_put(slot.limits)
+                if mesh is not None:
+                    from ..sharded.megabatch import place_rows
+
+                    idx_dev, vals_dev, limits_dev = place_rows(
+                        mesh, slot.idx, slot.vals, slot.limits
+                    )
+                else:
+                    idx_dev = jax.device_put(slot.idx)
+                    vals_dev = jax.device_put(slot.vals)
+                    limits_dev = jax.device_put(slot.limits)
             except Exception:
                 slot.ready.set()
                 raise
@@ -1286,6 +1358,17 @@ class MegabatchCoalescer:
         s0 = rows[0]
         C = s0.num_consumers
         row_of = lambda i: rows[i].resident.row  # noqa: E731
+        if batch.mesh is not None:
+            # The sharded dispatch boundary: an injected (or real)
+            # ``mesh.collective`` failure BEFORE any staging/donation
+            # degrades the manager and raises — the batch is intact, so
+            # _flush_group's isolation path resolves every row through
+            # the single-stream executable (materializing its row from
+            # the frozen batch) inside the same request budget, and the
+            # next stable wave re-stacks on the single-device placement.
+            mgr = self._mesh_mgr()
+            if mgr is not None:
+                mgr.check_collective()
         delta_wave = False
         slot = None
         if self._delta_wave_ok(rows):
@@ -1296,7 +1379,9 @@ class MegabatchCoalescer:
             try:
                 faults.fire("delta.apply")
                 slot, idx_dev, vals_dev, limits_dev = (
-                    self._stage_delta_upload(rows, batch.n_pad, row_of)
+                    self._stage_delta_upload(
+                        rows, batch.n_pad, row_of, mesh=batch.mesh
+                    )
                 )
                 delta_wave = True
             except Exception:  # noqa: BLE001 — dense is the fallback
@@ -1306,7 +1391,7 @@ class MegabatchCoalescer:
                 )
         if not delta_wave:
             slot, lags_dev, limits_dev = self._stage_upload(
-                rows, batch.n_pad, row_of
+                rows, batch.n_pad, row_of, mesh=batch.mesh
             )
             # Rows that PLANNED a delta but rode a dense wave (mixed
             # wave, oversized K, an injected staging fault) are
@@ -1341,6 +1426,8 @@ class MegabatchCoalescer:
                     )
         except Exception:
             self._poison(batch)  # donated state is unrecoverable
+            if batch.mesh is not None:
+                self._degrade_mesh("dispatch")
             slot.ready.set()
             raise
         self._m_hits.inc()
@@ -1414,6 +1501,8 @@ class MegabatchCoalescer:
                     "resident batch", exc_info=True,
                 )
                 self._poison(batch)
+                if batch.mesh is not None:
+                    self._degrade_mesh("readback")
                 for s in rows:
                     if not s.future.done():
                         if delta_wave:
@@ -1561,9 +1650,31 @@ class MegabatchCoalescer:
             # The roster locks: this wave's stacked successors BECOME
             # the resident batch (the widened lag rows included — the
             # stacked delta path scatters into them); rows' ownership
-            # moves to it.
+            # moves to it.  With an active streams mesh the successors
+            # are sharded over it ONCE here (sharded/megabatch) — the
+            # locked executable then donates sharded buffers and
+            # returns sharded successors, so the steady state pays no
+            # per-flush re-placement; a placement failure locks
+            # single-device and degrades the manager.
+            mesh = self._stream_mesh(n_pad)
+            if mesh is not None:
+                try:
+                    from ..sharded.megabatch import place_batch
+
+                    choice_b, tab_b, counts_b, lags_b = place_batch(
+                        mesh, (choice_b, tab_b, counts_b, lags_b)
+                    )
+                except Exception:  # noqa: BLE001 — single-device locks
+                    LOGGER.warning(
+                        "stream-axis placement failed; locking the "
+                        "roster on the single-device placement",
+                        exc_info=True,
+                    )
+                    self._degrade_mesh("place")
+                    mesh = None
             batch = _ResidentBatch(
-                s0.shape_key, choice_b, tab_b, counts_b, lags_b, n_real=N
+                s0.shape_key, choice_b, tab_b, counts_b, lags_b,
+                n_real=N, mesh=mesh,
             )
             handles = [ResidentRow(batch, i) for i in range(N)]
             with self._roster_lock:

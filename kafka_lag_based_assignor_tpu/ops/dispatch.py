@@ -11,6 +11,16 @@ in processing order (lag descending, partition id ascending, :228-235).
 Member-rank convention: per group, subscribed members sorted
 lexicographically map to dense kernel indices, so the kernel's integer
 tie-break reproduces the reference's member-id string compare (:259).
+
+Backend selection (multi-device): :func:`sharded_solve_manager` is the
+ONE place a huge single solve is routed to the P-axis-sharded backend
+(:mod:`..sharded.solve`) — the active mesh manager
+(``tpu.assignor.mesh.devices``), its health, and the
+single-device-wins row floor all gate here.  Single-device remains the
+default AND the degradation target: a missing/degraded mesh answers
+None and callers run the unchanged single-device path; a sharded
+dispatch that faults (``mesh.collective``) degrades the manager and
+falls back inside the same request budget.
 """
 
 from __future__ import annotations
@@ -81,6 +91,20 @@ def ensure_x64() -> None:
     """int64 lags (Kafka offsets are Java longs) require JAX x64 mode."""
     if not jax.config.jax_enable_x64:
         jax.config.update("jax_enable_x64", True)
+
+
+def sharded_solve_manager(num_rows: int, num_consumers: int):
+    """Backend selection for one P-sized solve: the active
+    :class:`..sharded.mesh.MeshManager` when the P-axis-sharded backend
+    should serve this shape, else None (single-device default).  One
+    global load + a couple of int compares on the unconfigured path —
+    safe on the cold-solve boundary."""
+    from ..sharded import mesh as mesh_mod
+
+    mgr = mesh_mod.active_manager()
+    if mgr is None or int(num_consumers) < 2:
+        return None
+    return mgr if mgr.should_shard_solve(num_rows) else None
 
 
 def _rebuild_topic(
@@ -298,4 +322,5 @@ __all__ = [
     "assign_topic_device",
     "ensure_x64",
     "pad_bucket",
+    "sharded_solve_manager",
 ]

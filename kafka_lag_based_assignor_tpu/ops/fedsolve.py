@@ -130,6 +130,9 @@ def shard_marginals(ws_u, count_u, wsum_u, A, B):
 @functools.partial(jax.jit, static_argnames=("num_consumers",))
 def _dual_step_jit(A, B, load, colsum, cap, step_scale, prev_spread,
                    num_consumers: int, eta: float = 8.0):
+    # ``cap`` is the count-marginal target: a scalar (the uniform
+    # n_global / C) or an f32[C] vector (capacity-weighted shards,
+    # ROADMAP federated (c)) — the update is elementwise either way.
     del num_consumers  # shape key only (cache hygiene across C)
     eta32 = jnp.float32(eta)
     spread = jnp.max(load) - jnp.min(load)
@@ -146,9 +149,14 @@ def _dual_step_jit(A, B, load, colsum, cap, step_scale, prev_spread,
     return A, B, step_scale, spread, delta
 
 
-def dual_step(A, B, load_sum, colsum_sum, cap: float, step_scale: float,
+def dual_step(A, B, load_sum, colsum_sum, cap, step_scale: float,
               prev_spread: float):
     """One damped mirror/Sinkhorn step on globally summed marginals.
+
+    ``cap`` is the count-marginal target — the uniform scalar
+    ``n_global / C``, or an [C] vector of capacity-weighted per-consumer
+    count targets (summing to ``n_global``) when the shards carried a
+    capacity vector through the handshake (ROADMAP federated (c)).
 
     The ``load`` half-step uses the CURRENT duals' load marginal and the
     ``colsum`` half-step re-reads the column marginal — the leader's
@@ -166,7 +174,7 @@ def dual_step(A, B, load_sum, colsum_sum, cap: float, step_scale: float,
         jnp.asarray(A), jnp.asarray(B),
         jnp.asarray(load_sum, dtype=jnp.float32),
         jnp.asarray(colsum_sum, dtype=jnp.float32),
-        jnp.float32(cap), jnp.float32(step_scale),
+        jnp.asarray(cap, dtype=jnp.float32), jnp.float32(step_scale),
         jnp.float32(prev_spread), num_consumers=int(np.asarray(A).shape[0]),
     )
     return (
@@ -188,10 +196,12 @@ def initial_duals(num_consumers: int):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("num_consumers", "refine_iters")
+    jax.jit,
+    static_argnames=("num_consumers", "refine_iters", "cap_max"),
 )
 def _round_local_jit(lags, valid, ws, A, B, base_totals,
-                     num_consumers: int, refine_iters: int):
+                     num_consumers: int, refine_iters: int,
+                     cap_vec=None, cap_max: int = 0):
     from .packing import table_rows
     from .refine import build_choice_tables, refine_rounds_resident
 
@@ -200,9 +210,22 @@ def _round_local_jit(lags, valid, ws, A, B, base_totals,
     n_valid = jnp.sum(valid.astype(jnp.int32))
     floor_cap = n_valid // C
     extras = n_valid - floor_cap * C
-    choice = _round_parallel(lags, ws, valid, A, B, C, floor_cap, extras)
+    # Weighted shards (ROADMAP federated (c)): an explicit per-consumer
+    # seat vector replaces the uniform floor/ceil capacities, and the
+    # exchange refinement runs SWAP-ONLY so the capacity-proportional
+    # counts it seats are never eroded back toward uniform by
+    # count-changing moves.
+    choice = _round_parallel(
+        lags, ws, valid, A, B, C, floor_cap, extras,
+        cap_vec=cap_vec, cap_max=cap_max if cap_vec is not None else None,
+    )
+    # Weighted caps overflow the uniform ceil(P/C)+1 table: size the
+    # row table to the LARGEST per-consumer seat count (static — the
+    # host passed it) or its totals silently truncate to the first M
+    # rows and the refinement balances a fiction.
+    m_rows = max(table_rows(P, C), int(cap_max))
     row_tab, r_counts, r_totals = build_choice_tables(
-        lags, valid, choice, C, table_rows(P, C)
+        lags, valid, choice, C, m_rows
     )
     # The other shards' converged loads ride as a FIXED per-consumer
     # base: local exchanges then minimize the GLOBAL peak (local totals
@@ -213,13 +236,33 @@ def _round_local_jit(lags, valid, ws, A, B, base_totals,
         r_totals + base_totals.astype(r_totals.dtype),
         num_consumers=C, iters=refine_iters,
         max_pairs=min(C // 2, _MAX_PAIRS),
+        allow_moves=cap_vec is None,
     )
     return s_choice, s_counts, s_totals - base_totals.astype(r_totals.dtype)
 
 
+def apportion_counts(n: int, weights) -> np.ndarray:
+    """Largest-remainder apportionment of ``n`` seats over non-negative
+    ``weights`` (uniform when they are degenerate).  Returns int32[C]
+    summing to exactly ``n`` — the per-consumer seat vector of the
+    weighted-shard rounding and the global count-marginal targets."""
+    w = np.asarray(weights, dtype=np.float64)
+    w = np.where(np.isfinite(w) & (w > 0), w, 0.0)
+    if w.sum() <= 0:
+        w = np.ones_like(w)
+    quota = float(n) * w / w.sum()
+    base = np.floor(quota).astype(np.int64)
+    rem = int(n - base.sum())
+    if rem > 0:
+        order = np.argsort(-(quota - base), kind="stable")
+        base[order[:rem]] += 1
+    return base.astype(np.int32)
+
+
 def round_local_shard(lags, num_consumers: int, A, B,
                       scale: float, base_load,
-                      refine_iters: Optional[int] = None):
+                      refine_iters: Optional[int] = None,
+                      capacity_frac=None):
     """Dual-seeded integral rounding of ONE shard (host entry point).
 
     ``lags`` are the UNPADDED local rows (sorted-pid order; padding to
@@ -229,7 +272,11 @@ def round_local_shard(lags, num_consumers: int, A, B,
     marginal of every OTHER shard (ws units) — converted to lag units
     and held fixed while the local exchange refinement balances global
     peaks.  Locally count-balanced by construction (capacities
-    floor/ceil of the LOCAL row count).
+    floor/ceil of the LOCAL row count) — unless ``capacity_frac``
+    (f64[C] fractions summing to ~1, the handshake's capacity-weighted
+    shares) is given, in which case the local seats are apportioned
+    capacity-proportionally (:func:`apportion_counts`) and the
+    refinement runs swap-only so the weighted counts hold exactly.
 
     Returns ``(choice int32[P] — input order — counts int32[C],
     local_totals[C] in lag units)``.
@@ -246,8 +293,15 @@ def round_local_shard(lags, num_consumers: int, A, B,
         # round must absorb — 64 rounds that suffice at P=512 leave a
         # 1.4x peak at P=2048 (measured; 256 recovers 1.0001).  Pow2 by
         # construction (P_pad is), so the executable count stays one
-        # per (P_pad, C) bucket.
-        refine_iters = min(1024, max(128, int(lags_p.shape[0]) // 8))
+        # per (P_pad, C) bucket.  The WEIGHTED path converges slower —
+        # swap-only exchanges from a capacity-skewed start move one row
+        # pair per (pair, round) — so its auto budget is deeper
+        # (measured at P=1024/4x-capacity: 128 rounds leave 1.64x,
+        # 512 reach 1.085x and plateau).
+        if capacity_frac is not None:
+            refine_iters = min(2048, max(512, int(lags_p.shape[0]) // 2))
+        else:
+            refine_iters = min(1024, max(128, int(lags_p.shape[0]) // 8))
     _require_concrete(lags_p, valid, "round_local_shard")
     lags_j = jnp.asarray(lags_p)
     valid_j = jnp.asarray(valid)
@@ -258,8 +312,28 @@ def round_local_shard(lags, num_consumers: int, A, B,
     base_totals = jnp.asarray(
         np.asarray(base_load, dtype=np.float64) * max(float(scale), 1e-9)
     ).astype(jnp.int64)
-    choice, counts, totals = _round_local_jit(
-        lags_j, valid_j, ws, jnp.asarray(A), jnp.asarray(B), base_totals,
-        num_consumers=int(num_consumers), refine_iters=int(refine_iters),
-    )
+    if capacity_frac is not None:
+        cap_np = apportion_counts(P, capacity_frac)
+        # cap_max is a STATIC jit arg (it sizes the open-slot
+        # enumeration, and the table rows): quantize it to the next
+        # pow2 (bounded by the padded row count) so a drifting P or a
+        # shifting capacity split reuses one executable per (P_pad, C,
+        # pow2-cap) rung instead of recompiling the serving path on
+        # every seat-count change — the same bucketing discipline as
+        # every other static in this package.  Over-sizing is safe:
+        # the enumeration masks on the true cap vector.
+        cap_ceil = 1 << max(int(cap_np.max()) - 1, 0).bit_length()
+        choice, counts, totals = _round_local_jit(
+            lags_j, valid_j, ws, jnp.asarray(A), jnp.asarray(B),
+            base_totals, num_consumers=int(num_consumers),
+            refine_iters=int(refine_iters),
+            cap_vec=jnp.asarray(cap_np),
+            cap_max=min(cap_ceil, int(lags_p.shape[0])),
+        )
+    else:
+        choice, counts, totals = _round_local_jit(
+            lags_j, valid_j, ws, jnp.asarray(A), jnp.asarray(B),
+            base_totals, num_consumers=int(num_consumers),
+            refine_iters=int(refine_iters),
+        )
     return np.asarray(choice)[:P], np.asarray(counts), np.asarray(totals)

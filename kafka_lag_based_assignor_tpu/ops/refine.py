@@ -414,8 +414,16 @@ def refine_rounds_resident(
     quality_limit=None,
     bulk_transfer: bool = False,
     fan: int = 1,
+    allow_moves: bool = True,
 ):
     """Traced resident-table round loop (see the section comment above).
+
+    ``allow_moves`` (static, parity body only — the bulk rounds are
+    swap-only by construction) disables the count-changing MOVE
+    candidates so the loop is strictly count-preserving: the federated
+    weighted-shard rounding (ops/fedsolve) seats capacity-weighted
+    per-consumer counts that an exchange refinement must tighten for
+    load WITHOUT eroding back toward uniform counts.
 
     ``choice``/``row_tab``/``counts``/``totals`` are the loop-carried
     state (the streaming engine keeps them device-resident between
@@ -482,6 +490,8 @@ def refine_rounds_resident(
         heavy = order[C - 1 - kk]              # [K]
         diff = totals[heavy] - totals[light]   # [K] >= 0
         move_ok = counts[heavy] > counts[light]
+        if not allow_moves:
+            move_ok = jnp.zeros_like(move_ok)
         delta = diff >> 1
         diff_q = diff >> pshift
         delta_q = delta >> pshift

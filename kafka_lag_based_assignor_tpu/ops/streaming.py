@@ -11,7 +11,13 @@ the optional warm start for the streaming-rebalance benchmark):
   transfer-lean :func:`..ops.batched.assign_stream` path plus a
   quality-refinement pass (churn is unbounded on cold paths anyway, and
   refining makes a guardrail trip actually restore near-bound quality
-  rather than resetting to plain greedy's slack);
+  rather than resetting to plain greedy's slack).  When the active mesh
+  manager elects the P-axis-sharded backend for the shape
+  (:func:`..ops.dispatch.sharded_solve_manager` — ``sharded/``), ONE
+  sharded seed+refine dispatch serves the cold solve instead and the
+  resident state rebuilds lazily from its choice (the
+  :meth:`StreamingAssignor.seed_choice` contract); any sharded failure
+  degrades the manager and falls back single-device in-request;
 * **warm rebalance** — keep the previous assignment; first evaluate its
   quality under the NEW lags host-side (one weighted bincount, ~1 ms at
   P=100k).  If the max/mean imbalance is still within
@@ -116,6 +122,17 @@ LOGGER = logging.getLogger(__name__)
 DELTA_MIN_K = 16
 _DELTA_ENTRY_BYTES = 4 + 8
 
+# Adaptive-cutoff tuning (StreamingAssignor.delta_adaptive): window of
+# observed per-epoch changed fractions, the sample floor below which
+# the global knob serves unchanged, the quantile the cutoff tracks, and
+# its safety margin — q90 * 1.5 keeps the stream's routine epochs
+# inside the cutoff while anomalous spikes (churn storms, resyncs)
+# fall back dense.
+_ADAPT_WINDOW = 64
+_ADAPT_MIN_SAMPLES = 8
+_ADAPT_QUANTILE = 0.9
+_ADAPT_MARGIN = 1.5
+
 
 def delta_bucket(n_changed: int) -> int:
     """Pow2 K bucket a delta of ``n_changed`` entries pads to."""
@@ -144,6 +161,11 @@ class StreamingStats:
     count_spread: int = 0
     refine_rounds: int = 0  # resident-refine rounds the fused dispatch ran
     refine_exchanges: int = 0  # exchanges it applied (churn <= 2x this)
+    # The delta/dense cutoff actually in force this epoch (equals the
+    # global delta_max_fraction until the adaptive window has enough
+    # samples — see StreamingAssignor.delta_adaptive).
+    delta_effective_fraction: float = 0.0
+    sharded_solve: bool = False  # this epoch's cold solve ran P-sharded
 
     @property
     def quality_ratio(self) -> float:
@@ -463,6 +485,26 @@ class StreamingAssignor:
         delta_enabled: bool = True,
         delta_max_fraction: float = 0.125,
         delta_buckets: int = 6,
+        # Per-stream ADAPTIVE delta cutoff (ROADMAP delta follow-on
+        # (b)): instead of one global ``delta_max_fraction`` knob, the
+        # engine tracks this stream's observed changed-fraction
+        # distribution (bounded window) and auto-tunes the effective
+        # delta/dense cutoff — a steady-2%-churn stream tightens the
+        # cutoff so an anomalous wide epoch goes dense instead of
+        # exercising a big-K executable, while a steady-20% stream
+        # raises it (up to 2x the knob, never past 0.5) so its routine
+        # epochs keep the sparse upload.  The strict byte gate (padded
+        # delta < dense payload) and the warmed K ladder still bind
+        # either way.  False pins the cutoff to the global knob.
+        delta_adaptive: bool = True,
+        # Multi-device backend selection for COLD solves (sharded/):
+        # "auto" (default) follows the process-wide active mesh
+        # manager via ops/dispatch; an explicit
+        # :class:`..sharded.mesh.MeshManager` pins this engine to it;
+        # None pins the engine single-device regardless of any global
+        # manager (a mesh-off service's engines must not adopt a
+        # co-resident instance's mesh).
+        mesh_backend="auto",
     ):
         self.num_consumers = int(num_consumers)
         self.refine_iters = int(refine_iters)
@@ -491,6 +533,18 @@ class StreamingAssignor:
         self.delta_enabled = bool(delta_enabled) and int(delta_buckets) > 0
         self.delta_max_fraction = float(delta_max_fraction)
         self.delta_buckets = int(delta_buckets)
+        self.delta_adaptive = bool(delta_adaptive)
+        self.mesh_backend = mesh_backend
+        # Observed changed-fraction window (bounded: deque maxlen) and
+        # the last effective cutoff actually applied — the stats /
+        # dump_metrics surface of the adaptive knob.
+        from collections import deque
+
+        self._churn_fractions = deque(maxlen=_ADAPT_WINDOW)
+        self.last_effective_delta_fraction = self.delta_max_fraction
+        self._m_eff_fraction = metrics.REGISTRY.gauge(
+            "klba_delta_effective_fraction"
+        )
         # Top rung of the K ladder; a delta whose bucket exceeds it
         # falls back to the dense upload.
         ladder = delta_k_ladder(self.delta_buckets)
@@ -533,6 +587,9 @@ class StreamingAssignor:
             )
             for o in ("applied", "fallback", "resync")
         }
+        # True when the LAST cold solve was served by the P-sharded
+        # backend (stats surface; reset per cold solve).
+        self._cold_was_sharded = False
         self._prev_choice: Optional[np.ndarray] = None
         # Device-RESIDENT warm state between dispatches: (padded int32
         # choice[bucket], per-consumer row table int32[C, M], counts
@@ -590,6 +647,8 @@ class StreamingAssignor:
             "count_spread": s.count_spread,
             "refine_rounds": s.refine_rounds,
             "refine_exchanges": s.refine_exchanges,
+            "delta_effective_fraction": s.delta_effective_fraction,
+            "sharded_solve": s.sharded_solve,
         }
         if self.flight is not None:
             # A recorder takes ownership of its record (annotates it in
@@ -657,6 +716,17 @@ class StreamingAssignor:
             raise ValueError("lags must be non-negative")
         P = lags.shape[0]
         stats = StreamingStats()
+        # The delta/dense cutoff in force THIS epoch: decided from the
+        # window of PAST observed fractions (this epoch's own fraction
+        # is recorded after the diff, so the cutoff never chases the
+        # sample it is gating).
+        self.last_effective_delta_fraction = (
+            self._effective_delta_fraction()
+        )
+        stats.delta_effective_fraction = (
+            self.last_effective_delta_fraction
+        )
+        self._m_eff_fraction.set(self.last_effective_delta_fraction)
 
         # Input-driven quantities that cannot change within one rebalance:
         # computed once, shared by every quality evaluation below.
@@ -671,6 +741,7 @@ class StreamingAssignor:
         if prev is None or prev.shape[0] != P:
             stats.cold_start = True
             choice = self._cold_solve(lags)
+            stats.sharded_solve = self._cold_was_sharded
             prev_for_churn = None
             self._fill_quality_stats(stats, choice, lags, bound,
                                      exact_bincount)
@@ -724,6 +795,7 @@ class StreamingAssignor:
                 stats.guardrail_tripped = True
                 stats.cold_start = True
                 choice = self._cold_solve(lags)
+                stats.sharded_solve = self._cold_was_sharded
                 self._fill_quality_stats(stats, choice, lags, bound,
                                          exact_bincount)
 
@@ -853,18 +925,74 @@ class StreamingAssignor:
 
     def _cold_solve(self, lags: np.ndarray) -> np.ndarray:
         """Fresh greedy solve + quality refinement (unbounded-churn path;
-        budget = ``cold_refine_iters``, 0 disables).
+        budget = ``cold_refine_iters``, 0 disables).  When the mesh
+        manager elects the P-axis-sharded backend for this shape
+        (:meth:`_sharded_cold_solve`), ONE sharded dispatch serves the
+        cold solve instead — single-device remains the default and the
+        degradation target.
 
         The refined path runs solve -> refine as one chained async
         dispatch with a single device->host readback at the end — on a
         high-latency transport a host round-trip between the two would
         double the cold cost.  The lag payload is uploaded once and shared
         by both kernels."""
+        self._cold_was_sharded = False
         with metrics.span("stream.cold_solve"):
             return self._cold_solve_inner(lags)
 
+    def _sharded_cold_solve(self, lags: np.ndarray):
+        """The P-axis-sharded cold backend (ops/dispatch backend
+        selection): when the active mesh manager elects to shard this
+        shape, ONE sharded seed+refine dispatch replaces the
+        single-device greedy chain; the device-resident warm state is
+        left stale and rebuilt by the next warm epoch from this choice
+        — exactly the :meth:`seed_choice` contract, so the warm loop
+        (and the megabatch) stay on their single/stream-sharded paths.
+        Returns None when the single-device backend should serve
+        (unconfigured/degraded mesh, shape below the floor, or a
+        sharded dispatch failing — which also degrades the manager so
+        the fleet falls back, not just this request)."""
+        mb = self.mesh_backend
+        if mb is None:
+            return None  # pinned single-device
+        if mb == "auto":
+            from .dispatch import sharded_solve_manager
+
+            mgr = sharded_solve_manager(
+                lags.shape[0], self.num_consumers
+            )
+        else:
+            mgr = mb if (
+                mb.active
+                and self.num_consumers >= 2
+                and mb.should_shard_solve(lags.shape[0])
+            ) else None
+        if mgr is None:
+            return None
+        from ..sharded.solve import solve_sharded
+
+        try:
+            with metrics.span("stream.sharded_solve"):
+                choice, _, _, _ = solve_sharded(
+                    mgr.solve_mesh(), lags, self.num_consumers,
+                    refine_iters=self.cold_refine_iters,
+                )
+        except Exception:
+            LOGGER.warning(
+                "sharded cold solve failed; degrading to the "
+                "single-device backend", exc_info=True,
+            )
+            mgr.degrade("solve")
+            return None
+        self._cold_was_sharded = True
+        self._drop_resident()
+        return np.asarray(choice).astype(np.int32)
+
     def _cold_solve_inner(self, lags: np.ndarray) -> np.ndarray:
         C = self.num_consumers
+        sharded = self._sharded_cold_solve(lags)
+        if sharded is not None:
+            return sharded
         if self.cold_refine_iters <= 0 or C < 2:
             self._drop_resident()
             return np.asarray(
@@ -1167,6 +1295,25 @@ class StreamingAssignor:
         self._fill_stats_from_device(stats, totals, counts, rounds, ex)
         return narrow_np.astype(np.int32)
 
+    def _effective_delta_fraction(self) -> float:
+        """The delta/dense cutoff in force for the next epoch: the
+        global ``delta_max_fraction`` knob until the bounded window
+        holds enough samples, then ``q90 * margin`` of this stream's
+        observed fractions — clamped to [knob/4, min(2*knob, 0.5)] so
+        a noisy window can neither disable the delta path nor push a
+        padded upload past the byte-win regime (the strict byte gate
+        and the warmed K ladder still bind independently)."""
+        base = self.delta_max_fraction
+        if not (self.delta_adaptive and self.delta_enabled):
+            return base
+        w = self._churn_fractions
+        if len(w) < _ADAPT_MIN_SAMPLES:
+            return base
+        q = sorted(w)[int(_ADAPT_QUANTILE * (len(w) - 1))]
+        hi = min(2.0 * base, 0.5)
+        lo = base / 4.0
+        return float(min(max(_ADAPT_MARGIN * q, lo), hi))
+
     def _delta_plan(self, lags: np.ndarray, payload):
         """Build this epoch's padded (idx, vals) delta against the host
         lag mirror, or None when the epoch must upload dense: delta
@@ -1192,9 +1339,13 @@ class StreamingAssignor:
             return None
         n = int(changed.size)
         P = lags.shape[0]
+        # Feed the adaptive window with the OBSERVED fraction (whatever
+        # the outcome) so the cutoff tracks this stream's real churn
+        # distribution, then gate on the epoch-start effective cutoff.
+        self._churn_fractions.append(n / max(P, 1))
         K = delta_bucket(n)
         if (
-            n > self.delta_max_fraction * P
+            n > self.last_effective_delta_fraction * P
             or K > self._delta_kmax
             or K * _DELTA_ENTRY_BYTES >= payload.nbytes
         ):

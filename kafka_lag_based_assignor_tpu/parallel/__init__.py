@@ -1,1 +1,3 @@
-"""Device-mesh sharding for multi-chip assignment."""
+"""Compatibility package: absorbed into :mod:`..sharded` (the
+first-class multi-device backend).  ``parallel.mesh`` re-exports the
+topic-axis API from :mod:`..sharded.topics`."""

@@ -1,225 +1,21 @@
-"""Multi-chip execution: shard the topic batch over a device mesh.
-
-Scaling model (the framework's analog of the scaling-book recipe — pick a
-mesh, annotate shardings, let XLA insert collectives):
-
-* **topic axis ("topics")** — the data-parallel dimension.  Per-topic
-  assignment is independent (SURVEY §2.4.3), so a topic batch [T, P] shards
-  across devices with zero communication in the solve itself.  This is the
-  axis that rides ICI when T outgrows one chip.
-* **member axis ("members")** — the tensor-parallel analog.  Global
-  observability stats (per-member load summed across all topics) reduce the
-  [T, C] totals matrix over the topic axis with ``psum``; the resulting [C]
-  vector is computed shard-locally over a member-axis sharding, so at very
-  large C no device materializes all members' accumulators during stats.
-
-The greedy solve inside one topic is sequential over rounds (inherent to
-LPT), so it is never split across devices — sequential depth stays on-chip
-where it is cheap, and the mesh buys throughput across topics.
-
-Everything compiles under ``jit`` over a ``jax.sharding.Mesh``; tested on a
-virtual 8-device CPU mesh and dry-run by the driver via
-``__graft_entry__.dryrun_multichip``.
-"""
+"""Compatibility shim: the topic-axis mesh backend moved to
+:mod:`..sharded.topics` when multi-device became a first-class
+subsystem (mesh manager, P-sharded solve, stream-sharded megabatch —
+see :mod:`..sharded`).  Import from there; this module re-exports the
+old names so existing callers keep working."""
 
 from __future__ import annotations
 
-import functools
-import inspect
-from typing import Optional, Sequence
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-from ..ops.rounds_kernel import assign_topic_rounds
-
-# shard_map moved to the jax namespace (and its replication-check kwarg
-# was renamed check_rep -> check_vma) across the jax versions this
-# package supports; resolve both ONCE so the sharded step builds on
-# either API without a per-call probe.
-if hasattr(jax, "shard_map"):
-    _shard_map = jax.shard_map
-else:  # jax <= 0.4.x: the experimental home
-    from jax.experimental.shard_map import shard_map as _shard_map
-_CHECK_KW = (
-    "check_vma"
-    if "check_vma" in inspect.signature(_shard_map).parameters
-    else "check_rep"
+from ..sharded.topics import (
+    assign_global_replicated,
+    assign_sharded,
+    make_mesh,
+    shard_topic_batch,
 )
 
-
-def make_mesh(
-    devices: Optional[Sequence[jax.Device]] = None,
-    topics_axis: Optional[int] = None,
-    members_axis: int = 1,
-) -> Mesh:
-    """Build a 2D ("topics", "members") mesh.
-
-    Default: all topic parallelism — ("topics", 1).  Pass ``members_axis``
-    > 1 to carve devices for the member-axis stats sharding.
-    """
-    devices = list(devices if devices is not None else jax.devices())
-    n = len(devices)
-    if topics_axis is None:
-        topics_axis = n // members_axis
-    if topics_axis * members_axis != n:
-        raise ValueError(
-            f"mesh {topics_axis}x{members_axis} != {n} devices"
-        )
-    dev_array = np.asarray(devices).reshape(topics_axis, members_axis)
-    return Mesh(dev_array, axis_names=("topics", "members"))
-
-
-def _sharded_step(
-    lags, partition_ids, valid, *, num_consumers: int, members_axis: int,
-    refine_iters: int = 0,
-):
-    """Per-shard body under shard_map: local topic block [T_loc, P] solved
-    with the vmapped rounds kernel, then cross-shard psum for global stats.
-
-    ``refine_iters`` chains the per-topic exchange refinement onto each
-    local topic — refinement is per-topic like the solve itself, so it
-    shards over the "topics" axis with ZERO additional communication (the
-    stats psum below already reflects the refined totals).
-
-    The member-axis devices each reduce only their C/members_axis slice of
-    the [T_loc, C] totals before the psum over "topics" — so the global
-    per-member stats are computed and stored member-sharded (no device
-    materializes all members' accumulators)."""
-    fn = functools.partial(assign_topic_rounds, num_consumers=num_consumers)
-    choice, counts, totals = jax.vmap(fn)(lags, partition_ids, valid)
-    if refine_iters:
-        from ..ops.refine import refine_assignment
-
-        rfn = functools.partial(
-            refine_assignment, num_consumers=num_consumers,
-            iters=refine_iters,
-        )
-        choice, counts, totals = jax.vmap(rfn)(lags, valid, choice)
-    c_local = num_consumers // members_axis
-    offset = jax.lax.axis_index("members") * c_local
-    local_load = jax.lax.dynamic_slice_in_dim(
-        jnp.sum(totals, axis=0), offset, c_local
-    )
-    local_count = jax.lax.dynamic_slice_in_dim(
-        jnp.sum(counts, axis=0), offset, c_local
-    )
-    member_load = jax.lax.psum(local_load, axis_name="topics")
-    member_count = jax.lax.psum(local_count, axis_name="topics")
-    return choice, counts, totals, member_load, member_count
-
-
-def assign_sharded(
-    mesh: Mesh,
-    lags,
-    partition_ids,
-    valid,
-    num_consumers: int,
-    refine_iters: int = 0,
-):
-    """Solve a topic batch sharded over ``mesh``.
-
-    Args: arrays of shape [T, P] with T divisible by the mesh's "topics"
-    axis size and ``num_consumers`` divisible by its "members" axis size.
-    ``refine_iters`` (static, 0 = strict parity) chains the per-topic
-    exchange refinement onto each shard-local topic — no additional
-    cross-device communication (see :func:`_sharded_step`).
-    Returns (choice [T, P], counts [T, C], totals [T, C], member_load [C],
-    member_count [C]) — the per-member global stats are computed and stored
-    member-sharded.
-
-    The whole path is jitted; the collectives (psum over "topics") are
-    inserted by XLA from the shard_map specs and ride ICI.
-    """
-    members_axis = mesh.shape["members"]
-    if num_consumers % members_axis:
-        raise ValueError(
-            f"num_consumers={num_consumers} not divisible by members axis "
-            f"{members_axis}"
-        )
-    step = _jitted_sharded_step(
-        mesh, num_consumers, members_axis, int(refine_iters)
-    )
-    return step(lags, partition_ids, valid)
-
-
-@functools.lru_cache(maxsize=64)
-def _jitted_sharded_step(
-    mesh: Mesh, num_consumers: int, members_axis: int, refine_iters: int = 0
-):
-    """Build + jit the shard_map step once per (mesh, C, members-axis,
-    refine budget) — jax.jit caches per function object, so constructing a
-    fresh wrapper on every call would retrace and recompile each
-    rebalance."""
-    step = _shard_map(
-        functools.partial(
-            _sharded_step,
-            num_consumers=num_consumers,
-            members_axis=members_axis,
-            refine_iters=refine_iters,
-        ),
-        mesh=mesh,
-        in_specs=(P("topics", None), P("topics", None), P("topics", None)),
-        out_specs=(
-            P("topics", None),  # choice
-            P("topics", None),  # counts
-            P("topics", None),  # totals
-            P("members"),       # member_load: sharded over member axis
-            P("members"),       # member_count
-        ),
-        # The rounds kernel's scan carry starts from literal zeros, which the
-        # varying-manual-axes checker types as unvarying even though the data
-        # flowing into it varies over "topics"; parity with the unsharded
-        # kernel is asserted by tests instead.  (check_vma on current jax,
-        # check_rep on the 0.4.x experimental API — see _CHECK_KW above.)
-        **{_CHECK_KW: False},
-    )
-    return jax.jit(step)
-
-
-def assign_global_replicated(mesh: Mesh, lags, partition_ids, valid,
-                             num_consumers: int):
-    """The cross-topic GLOBAL quality mode on a mesh: an explicit, tested
-    REPLICATION decision rather than a sharding.
-
-    The global kernel carries member totals across topics sequentially
-    (topic t+1's seating depends on totals after topic t —
-    ops/rounds_kernel.assign_global_rounds), so the topic axis cannot be
-    data-parallel without changing semantics; and C-axis sharding would
-    put the per-round C-sized sort/argmin under collectives for no win at
-    realistic C.  Replicating the solve on every device is the honest
-    mapping: each device computes the identical assignment (deterministic
-    kernel — bit-identical replicas), so downstream topic-sharded
-    consumers (e.g. the refine pass or stats) can read their slice with
-    no broadcast step.
-
-    Returns (choice [T, P], counts [T, C], totals [C]) fully replicated.
-    """
-    from ..ops.rounds_kernel import assign_global_rounds
-
-    rep = NamedSharding(mesh, P())
-    fn = jax.jit(
-        functools.partial(
-            assign_global_rounds, num_consumers=num_consumers
-        ),
-        in_shardings=(rep, rep, rep),
-        out_shardings=(rep, rep, rep),
-    )
-    return fn(
-        jax.device_put(lags, rep),
-        jax.device_put(partition_ids, rep),
-        jax.device_put(valid, rep),
-    )
-
-
-def shard_topic_batch(mesh: Mesh, lags, partition_ids, valid):
-    """Device-put a host topic batch with the mesh's topic sharding, so the
-    transfer lands each shard directly on its device (no host gather)."""
-    spec = NamedSharding(mesh, P("topics", None))
-    return (
-        jax.device_put(lags, spec),
-        jax.device_put(partition_ids, spec),
-        jax.device_put(valid, spec),
-    )
+__all__ = [
+    "assign_global_replicated",
+    "assign_sharded",
+    "make_mesh",
+    "shard_topic_batch",
+]

@@ -655,18 +655,22 @@ def _fresh_engine(
     C: int,
     flight: metrics.FlightRecorder,
     delta_opts: Optional[Dict[str, Any]] = None,
+    mesh_backend: Any = None,
 ):
     """THE service-default engine construction (guardrail ON at 1.25,
-    unlike the library default, plus the stream's flight ring and the
-    service's delta-epoch knobs) — every site that makes an engine
-    (first epoch, degraded-ladder cold rung, drift-guard rebuild,
-    snapshot rehydration) goes through here, so a recovered or rebuilt
-    engine can never drift from a freshly created one and silently
-    break the bit-exact recovery contract."""
+    unlike the library default, plus the stream's flight ring, the
+    service's delta-epoch knobs, and ITS mesh backend — explicit, so a
+    mesh-off service's engines can never adopt a co-resident
+    instance's globally activated mesh) — every site that makes an
+    engine (first epoch, degraded-ladder cold rung, drift-guard
+    rebuild, snapshot rehydration) goes through here, so a recovered
+    or rebuilt engine can never drift from a freshly created one and
+    silently break the bit-exact recovery contract."""
     from .ops.streaming import StreamingAssignor
 
     return StreamingAssignor(
         num_consumers=C, imbalance_guardrail=1.25, flight=flight,
+        mesh_backend=mesh_backend,
         **(delta_opts or {}),
     )
 
@@ -941,6 +945,23 @@ class AssignorService:
         delta_enabled: bool = True,
         delta_max_fraction: float = 0.125,
         delta_buckets: int = 6,
+        # Per-stream adaptive delta cutoff (ops/streaming; ROADMAP
+        # delta follow-on (b)): auto-tune each stream's delta/dense
+        # cutoff from its observed churn distribution instead of
+        # pinning it to delta_max_fraction; the effective value
+        # surfaces per stream and in dump_metrics --summary.
+        delta_adaptive: bool = True,
+        # Multi-device sharding (sharded/; DEPLOYMENT.md "Multi-device
+        # sharding"): the mesh spec discovered + validated ONCE at
+        # start() — "off" (default), "auto", or a device count — and
+        # the partition floor below which the P-sharded solve backend
+        # is not selected.  With a mesh active, locked megabatch
+        # rosters also spread their stream axis over it.  Degradation
+        # (lost device, mesh.collective fault, a sharded dispatch
+        # failing) falls back to the single-device backend process-wide
+        # and serves in-flight requests down the existing ladder.
+        mesh_devices: Any = "off",
+        mesh_solve_min_rows: int = 65536,
         # Opt-in plain-HTTP /metrics listener (utils/metrics_http):
         # port to bind on the service host (0 = ephemeral, for tests);
         # None disables.
@@ -1030,6 +1051,12 @@ class AssignorService:
         federation_rounds: int = 16,
         federation_sync_timeout_s: float = 2.0,
         federation_max_staleness_s: float = 300.0,
+        # Weighted shards (ROADMAP federated (c)): this cluster's
+        # per-consumer capacity weight vector (list of positive
+        # floats), exchanged in the hello handshake and summed into
+        # the capacity-weighted count marginal; None contributes
+        # uniform weights.
+        federation_capacity: Optional[List[float]] = None,
         # False skips the recovered-shape warm-up pass in start()
         # (tests/drills that assert recovery semantics without paying
         # compiles); production keeps it on — it is what makes the
@@ -1095,7 +1122,22 @@ class AssignorService:
             "delta_enabled": bool(delta_enabled),
             "delta_max_fraction": float(delta_max_fraction),
             "delta_buckets": int(delta_buckets),
+            "delta_adaptive": bool(delta_adaptive),
         }
+        # Mesh manager (sharded/mesh): constructed here — cheap and
+        # inert — but discovered/validated in start() (never per
+        # request) and installed as the process-wide backend-selection
+        # input there.  None when the knob is "off".
+        from .sharded.mesh import MeshManager, _parse_spec
+
+        self._mesh = (
+            MeshManager(
+                devices=mesh_devices,
+                solve_min_rows=int(mesh_solve_min_rows),
+            )
+            if _parse_spec(mesh_devices) != "off"
+            else None
+        )
         # What the warm-up drives: 0 rungs when delta mode is off.
         self._warm_delta_buckets = (
             int(delta_buckets) if delta_enabled else 0
@@ -1113,6 +1155,7 @@ class AssignorService:
                 lock_waves=int(coalesce_lock_waves),
                 pipeline=bool(coalesce_pipeline),
                 delta_k=delta_k,
+                mesh_manager=self._mesh,
             )
         else:
             self._coalescer = None
@@ -1260,6 +1303,7 @@ class AssignorService:
                 max_staleness_s=float(federation_max_staleness_s),
                 fence_token=self._federation_fence_token,
                 clock=clock,
+                capacity=federation_capacity,
             )
         else:
             if federation_peers:
@@ -1340,6 +1384,9 @@ class AssignorService:
             "delta_enabled": cfg.delta_enabled,
             "delta_max_fraction": cfg.delta_max_fraction,
             "delta_buckets": cfg.delta_buckets,
+            "delta_adaptive": cfg.delta_adaptive,
+            "mesh_devices": cfg.mesh_devices,
+            "mesh_solve_min_rows": cfg.mesh_solve_min_rows,
             "metrics_port": cfg.metrics_port,
             "snapshot_path": cfg.snapshot_path,
             "snapshot_interval_s": cfg.snapshot_interval_s,
@@ -1356,6 +1403,7 @@ class AssignorService:
             "federation_rounds": cfg.federation_rounds,
             "federation_sync_timeout_s": cfg.federation_sync_timeout_s,
             "federation_max_staleness_s": cfg.federation_max_staleness_s,
+            "federation_capacity": cfg.federation_capacity,
             "warmup_shapes": cfg.warmup_shapes or None,
             "slo_classes": cfg.slo_classes,
             "slo_deadline_s": cfg.slo_deadline_s,
@@ -1503,6 +1551,11 @@ class AssignorService:
             result["federation"] = (
                 self._federation.status()
                 if self._federation is not None else None
+            )
+            # Multi-device mesh (DEPLOYMENT.md "Multi-device
+            # sharding"); None when tpu.assignor.mesh.devices=off.
+            result["mesh"] = (
+                self._mesh.status() if self._mesh is not None else None
             )
             return result, None
         if method == "metrics":
@@ -1998,7 +2051,7 @@ class AssignorService:
                 # exactly one place.  Each stream gets its own small
                 # flight ring alongside the engine.
                 st.flight = _stream_ring()
-                st.engine = _fresh_engine(C, st.flight, self._delta_opts)
+                st.engine = _fresh_engine(C, st.flight, self._delta_opts, self._mesh)
                 st.members = members_sorted
                 # Poisoned-stream recovery: if the last epoch for this sid
                 # died on the snake rung, warm-restart from the snapshot of
@@ -2037,7 +2090,7 @@ class AssignorService:
                 # cold-solve the NEW roster over the OLD C (imbalanced
                 # counts on growth, an index past members_sorted on
                 # shrink).  The stream keeps its flight ring.
-                st.engine = _fresh_engine(C, st.flight, self._delta_opts)
+                st.engine = _fresh_engine(C, st.flight, self._delta_opts, self._mesh)
                 st.members = members_sorted
                 st.pids = None
                 metrics.REGISTRY.counter(
@@ -2366,6 +2419,12 @@ class AssignorService:
                 # whether THIS answer demands a dense re-send.
                 "lag_epoch": lag_epoch,
                 "resync": resync,
+                # Adaptive-delta surface (ROADMAP delta follow-on (b)):
+                # the delta/dense cutoff actually in force this epoch.
+                "delta_effective_fraction": s.delta_effective_fraction,
+                # Multi-device surface: this epoch's cold solve (if
+                # any) ran on the P-sharded backend.
+                "sharded_solve": s.sharded_solve,
             },
             "options": opts,
         }
@@ -2381,7 +2440,7 @@ class AssignorService:
         import numpy as np
 
         ring = _stream_ring()
-        fresh = _fresh_engine(C, ring, self._delta_opts)
+        fresh = _fresh_engine(C, ring, self._delta_opts, self._mesh)
         _apply_stream_opts(fresh, opts)
         try:
             choice = self._watchdog.call(
@@ -3012,7 +3071,7 @@ class AssignorService:
                     klass = "standard"
                 st = _Stream()
                 st.flight = _stream_ring()
-                st.engine = _fresh_engine(C, st.flight, self._delta_opts)
+                st.engine = _fresh_engine(C, st.flight, self._delta_opts, self._mesh)
                 # The recovery contract: the first warm epoch must be
                 # bit-identical to an uninterrupted process's epoch
                 # from the SAME seeded choice — seed_choice leaves
@@ -3174,6 +3233,18 @@ class AssignorService:
         # and request-thread log lines carry the minted request id.
         install_compile_counter()
         metrics.install_log_request_ids()
+        if self._mesh is not None:
+            # Mesh discovery/validation ONCE at service start (never
+            # per request), and BEFORE the warm-up below: with the
+            # manager active, the warm-up's megabatch waves lock onto
+            # the stream-sharded placement and the sharded executables
+            # compile off the serving path.  A spec the visible
+            # devices cannot satisfy degrades to single-device here —
+            # boot keeps serving.
+            from .sharded import mesh as mesh_mod
+
+            self._mesh.configure()
+            mesh_mod.activate(self._mesh)
         if self._snapshot_store is not None:
             # Takeover handshake FIRST (DEPLOYMENT.md "Cross-host
             # hand-off"): acquire the writer lease — waiting out a
@@ -3217,6 +3288,7 @@ class AssignorService:
                     # service's configured rung count.
                     coalesce_max_batch=coalesce_batch,
                     delta_buckets=self._warm_delta_buckets,
+                    mesh_manager=self._mesh,
                 )
         if self._recovery_shapes and self._recovery_warmup:
             # Megabatch warm-up for the RECOVERED shapes, off the
@@ -3233,6 +3305,7 @@ class AssignorService:
                     solvers=("stream",),
                     coalesce_max_batch=coalesce_batch,
                     delta_buckets=self._warm_delta_buckets,
+                    mesh_manager=self._mesh,
                 )
         # The serving surfaces come up under the lifecycle lock: a
         # drain/stop that raced the (possibly minutes-long) recovery
@@ -3287,6 +3360,12 @@ class AssignorService:
         self._close_listener()
         if self._coalescer is not None:
             self._coalescer.close()
+        if self._mesh is not None:
+            # Uninstall OUR manager only (a replacement instance's mesh
+            # must not be clobbered by a stopping predecessor).
+            from .sharded import mesh as mesh_mod
+
+            mesh_mod.deactivate(self._mesh)
         self._set_lifecycle("stopped")
         self._stopped_event.set()
 
@@ -3684,7 +3763,30 @@ def main() -> None:
              "rebuild off the serving path) so the restart storm's "
              "first epochs coalesce like steady-state traffic",
     )
+    parser.add_argument(
+        "--mesh-devices", default="off", metavar="SPEC",
+        help="device mesh for the sharded backends: 'off' (default, "
+             "single-device), 'auto' (all visible devices), or a "
+             "device count; discovered/validated once at start "
+             "(DEPLOYMENT.md 'Multi-device sharding')",
+    )
+    parser.add_argument(
+        "--mesh-solve-min-rows", type=int, default=65536, metavar="N",
+        help="partition floor below which the P-sharded solve backend "
+             "is not selected (single device wins outright; default "
+             "65536)",
+    )
+    parser.add_argument(
+        "--federation-capacity", default=None, metavar="W,W,...",
+        help="this cluster's per-consumer capacity weight vector "
+             "(comma-separated positive floats) for the weighted "
+             "federated count marginal; unset = uniform",
+    )
     opts = parser.parse_args()
+    federation_capacity = (
+        [float(v) for v in opts.federation_capacity.split(",")]
+        if opts.federation_capacity else None
+    )
     service = AssignorService(
         opts.host, opts.port, warmup_shapes=opts.warmup,
         coalesce_window_ms=opts.coalesce_window_ms,
@@ -3719,6 +3821,9 @@ def main() -> None:
         federation_max_staleness_s=max(
             opts.federation_max_staleness_ms, 0.0
         ) / 1000.0,
+        federation_capacity=federation_capacity,
+        mesh_devices=opts.mesh_devices,
+        mesh_solve_min_rows=opts.mesh_solve_min_rows,
     )
     # SIGTERM/SIGINT drain gracefully: admissions stop with a
     # structured retry-after reject, in-flight waves flush, the final

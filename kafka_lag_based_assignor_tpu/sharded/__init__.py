@@ -1,0 +1,50 @@
+"""Mesh-native multi-device backend (first-class, tier-1 tested).
+
+One subsystem for every topology decision (lint L020 confines
+``Mesh``/``shard_map``/``NamedSharding`` construction here):
+
+* :mod:`.mesh` — the mesh manager: discover/validate once at service
+  start (``tpu.assignor.mesh.devices``), degrade to single-device on a
+  lost device or a ``mesh.collective`` fault.
+* :mod:`.solve` — the P-axis-sharded solve (seed sort + plan stats +
+  exchange refine; replicated consumer-axis state all-reduced per
+  round; bit-identical to ops/refine at mesh size 1).
+* :mod:`.megabatch` — stream-axis placement for the roster-locked
+  megabatch (N tenants spread over D devices, zero collectives).
+* :mod:`.topics` — the topic-axis batch backend (absorbed from the old
+  ``parallel/`` side module).
+
+Backend selection lives in :mod:`..ops.dispatch`
+(``sharded_solve_manager``): single-device remains the default and the
+degradation target.  Tier-1 runs every sharded path on the virtual
+8-device CPU mesh (``XLA_FLAGS=--xla_force_host_platform_device_count``,
+forced by tests/conftest.py).
+"""
+
+from .mesh import (
+    MeshCollectiveError,
+    MeshManager,
+    activate,
+    active_manager,
+    deactivate,
+    managed,
+)
+from .solve import (
+    plan_stats_sharded,
+    refine_sharded,
+    seed_reference,
+    solve_sharded,
+)
+
+__all__ = [
+    "MeshCollectiveError",
+    "MeshManager",
+    "activate",
+    "active_manager",
+    "deactivate",
+    "managed",
+    "plan_stats_sharded",
+    "refine_sharded",
+    "seed_reference",
+    "solve_sharded",
+]

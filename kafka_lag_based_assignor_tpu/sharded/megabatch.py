@@ -1,0 +1,77 @@
+"""Stream-axis sharding for the roster-locked megabatch.
+
+The megabatch coalescer (:mod:`..ops.coalesce`) stacks N tenants' warm
+epochs into ONE vmapped fused dispatch — but on a single device those N
+independent rows still queue on one chip.  The rows are embarrassingly
+parallel (each tenant's refine loop touches only its own [B]/[C, M]
+slices), so the stacked batch partitions perfectly over a leading
+``("streams",)`` mesh axis with ZERO collectives: this module owns the
+placement decisions, and the coalescer stays the only caller.
+
+* :func:`place_batch` shards a locked roster's stacked resident
+  successors ``(choice [N, B], row_tab [N, C, M], counts [N, C], lags
+  [N, B])`` across the streams mesh ONCE at lock time — the locked
+  executable then donates sharded buffers and returns sharded
+  successors, so the steady state pays no per-flush re-placement
+  (exactly the zero-re-stack contract, now spread over D devices).
+* :func:`place_rows` lands a wave's staged host uploads (lags/limits,
+  or the delta idx/vals) directly on their row's device — each shard's
+  H2D slice transfers to its own chip, no gather hop.
+* :func:`shardable` is the eligibility rule: the padded batch axis must
+  cover and divide the mesh (pow2 n_pad over pow2 D always divides once
+  n_pad >= D).
+
+Round-10 invariants are preserved by construction: the executables and
+their donation signatures are unchanged (placement is input sharding,
+not new code paths), churn still invalidates the roster exactly once,
+and per-row failure isolation/digest quarantine read per-row outputs
+that slicing a sharded array serves identically.  A ``mesh.collective``
+fault (or a real placement/dispatch failure) degrades the coalescer to
+the single-device placement via the mesh manager — in-flight rows
+resolve through the existing single-stream fallback, never an invalid
+answer.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .mesh import STREAMS_AXIS
+
+
+def shardable(mesh, n_pad: int) -> bool:
+    """True when a padded batch of ``n_pad`` rows splits evenly over
+    ``mesh``'s streams axis."""
+    if mesh is None:
+        return False
+    D = mesh.shape[STREAMS_AXIS]
+    return D > 1 and n_pad >= D and n_pad % D == 0
+
+
+def stream_sharding(mesh, rank: int) -> NamedSharding:
+    """Leading-axis ("streams") sharding for a rank-``rank`` stacked
+    array: rows spread over devices, every trailing axis replicated
+    within its row's shard."""
+    spec = PartitionSpec(STREAMS_AXIS, *([None] * (rank - 1)))
+    return NamedSharding(mesh, spec)
+
+
+def place_batch(mesh, arrays):
+    """Shard a locked batch's stacked device buffers over the streams
+    axis (one reshard per LOCK, not per flush).  Returns the placed
+    tuple in input order."""
+    return tuple(
+        jax.device_put(a, stream_sharding(mesh, a.ndim)) for a in arrays
+    )
+
+
+def place_rows(mesh, *host_arrays):
+    """Start the async H2D of a wave's staged host arrays with the
+    streams sharding — each row's slice lands on its own device.  The
+    caller (the coalescer's counted ``_stage_upload`` /
+    ``_stage_delta_upload`` sites) owns the byte accounting."""
+    return tuple(
+        jax.device_put(a, stream_sharding(mesh, a.ndim))
+        for a in host_arrays
+    )

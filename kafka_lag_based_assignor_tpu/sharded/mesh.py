@@ -1,0 +1,294 @@
+"""Mesh manager: discover + validate the device mesh ONCE at service start.
+
+Multi-device is a first-class, tested backend (ROADMAP "shard the solve
+and the megabatch"): the P axis of one huge solve shards over the mesh
+(:mod:`.solve`), the megabatch's stream axis spreads tenants across
+devices (:mod:`.megabatch`), and the topic-axis batch backend lives in
+:mod:`.topics`.  This module owns the topology decisions every one of
+those paths shares:
+
+* **Discovery/validation at start, not per request.**  The service (or a
+  library embedder) builds one :class:`MeshManager` from the
+  ``tpu.assignor.mesh.devices`` knob ("off" | "auto" | an integer),
+  calls :meth:`MeshManager.configure` once at boot — real TPUs, or the
+  8-device virtual CPU mesh via
+  ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` so every
+  sharded path runs in tier-1 — and :func:`activate` installs it as the
+  process-wide backend selection input (the :mod:`..utils.faults`
+  ``_ACTIVE`` pattern: one global load on the off path).
+
+* **Single-device is the default AND the degradation target.**  An
+  unconfigured process never builds a mesh; a configured one that loses
+  devices (``configure`` finding fewer than asked), takes an injected
+  ``mesh.collective`` fault, or sees a sharded dispatch raise is
+  :meth:`degraded <MeshManager.degrade>` — every later backend
+  selection answers "single-device" and the existing degraded-mode
+  ladder serves the in-flight request (the callers catch, never the
+  mesh).  Degradation is observable: ``klba_mesh_active`` /
+  ``klba_mesh_devices`` gauges, ``klba_mesh_degraded_total{reason}``.
+
+Lint rule L020 confines ``Mesh``/``shard_map``/``NamedSharding``
+construction to this package, so topology cannot leak back into ad-hoc
+side modules (the old ``parallel/`` dead end this subsystem absorbed).
+"""
+
+from __future__ import annotations
+
+import inspect
+import logging
+import threading
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, List, Optional
+
+import jax
+from jax.sharding import Mesh
+
+from ..utils import faults, metrics
+
+LOGGER = logging.getLogger(__name__)
+
+# shard_map moved to the jax namespace (and its replication-check kwarg
+# was renamed check_rep -> check_vma) across the jax versions this
+# package supports; resolve both ONCE so every sharded step in this
+# package builds on either API without a per-call probe.
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # jax <= 0.4.x: the experimental home
+    from jax.experimental.shard_map import shard_map
+CHECK_KW = (
+    "check_vma"
+    if "check_vma" in inspect.signature(shard_map).parameters
+    else "check_rep"
+)
+
+#: Axis names: the P-sharded solve partitions partition rows over "p";
+#: the megabatch spreads tenant rows over "streams".
+SOLVE_AXIS = "p"
+STREAMS_AXIS = "streams"
+
+#: Default P floor below which a single device wins outright (the
+#: sharded seed/refine pays collectives per round; a small solve's
+#: whole working set fits one chip).  Deployments override via
+#: ``tpu.assignor.mesh.solve.min.rows``.
+DEFAULT_SOLVE_MIN_ROWS = 65536
+
+
+class MeshCollectiveError(RuntimeError):
+    """A sharded dispatch lost a collective (injected ``mesh.collective``
+    fault or a real cross-device failure): the mesh manager has already
+    degraded to the single-device backend; the caller serves this
+    request down the existing ladder."""
+
+
+def _parse_spec(spec: Any) -> Any:
+    """``"off"`` | ``"auto"`` | positive int (accepts int-like strings)."""
+    if spec in (None, "", "off", "0", 0, False):
+        return "off"
+    if spec == "auto":
+        return "auto"
+    try:
+        n = int(spec)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"mesh devices spec {spec!r} invalid; use 'off', 'auto', or "
+            "a positive integer"
+        )
+    if n < 1:
+        raise ValueError(f"mesh devices spec {n} must be >= 1")
+    return n
+
+
+class MeshManager:
+    """One process's device-mesh topology + health state.
+
+    ``devices`` is the ``tpu.assignor.mesh.devices`` spec: ``"off"``
+    (never shard — the constructor is cheap and inert), ``"auto"`` (all
+    visible devices; inactive when only one is visible), or an integer
+    N (exactly the first N visible devices; fewer visible = boot-time
+    degrade, not an exception — fail open to single-device).
+    ``solve_min_rows`` gates the P-sharded solve backend: below it the
+    single-device path wins outright.
+    """
+
+    def __init__(
+        self,
+        devices: Any = "auto",
+        solve_min_rows: int = DEFAULT_SOLVE_MIN_ROWS,
+    ):
+        self.spec = _parse_spec(devices)
+        self.solve_min_rows = int(solve_min_rows)
+        self._lock = threading.Lock()
+        self._devices: List[Any] = []
+        self._degraded: Optional[str] = None
+        self._configured = False
+        self._solve_mesh: Optional[Mesh] = None
+        self._streams_mesh: Optional[Mesh] = None
+        self._m_active = metrics.REGISTRY.gauge("klba_mesh_active")
+        self._m_devices = metrics.REGISTRY.gauge("klba_mesh_devices")
+
+    # -- discovery ----------------------------------------------------------
+
+    def configure(self) -> "MeshManager":
+        """Discover + validate the mesh (call once at service start,
+        NEVER per request).  A spec the visible devices cannot satisfy
+        degrades to single-device — boot keeps serving — rather than
+        raising; re-calling re-validates (a shrunk device set degrades
+        here too)."""
+        with self._lock:
+            self._configured = True
+            if self.spec == "off":
+                self._install([], None)
+                return self
+            visible = list(jax.devices())
+            want = len(visible) if self.spec == "auto" else int(self.spec)
+            if want < 2:
+                # One device is not a mesh: quietly single-device (the
+                # "auto" default on a lone chip must not look degraded).
+                self._install([], None)
+                return self
+            if len(visible) < want:
+                LOGGER.warning(
+                    "mesh.devices=%s but only %d device(s) visible; "
+                    "degrading to the single-device backend",
+                    self.spec, len(visible),
+                )
+                self._install([], "missing_devices")
+                return self
+            self._install(visible[:want], None)
+            LOGGER.info(
+                "device mesh configured: %d device(s) on %s",
+                want, visible[0].platform,
+            )
+        return self
+
+    def _install(self, devices: List[Any], degraded: Optional[str]) -> None:
+        """Caller holds the lock: adopt a device set (or none) and
+        rebuild the cached axis meshes."""
+        self._devices = devices
+        self._degraded = degraded
+        if devices:
+            self._solve_mesh = Mesh(devices, axis_names=(SOLVE_AXIS,))
+            self._streams_mesh = Mesh(devices, axis_names=(STREAMS_AXIS,))
+        else:
+            self._solve_mesh = None
+            self._streams_mesh = None
+        if degraded is not None:
+            metrics.REGISTRY.counter(
+                "klba_mesh_degraded_total", {"reason": degraded}
+            ).inc()
+        self._m_active.set(1 if devices else 0)
+        self._m_devices.set(len(devices))
+
+    # -- selection ----------------------------------------------------------
+
+    @property
+    def active(self) -> bool:
+        """True while the sharded backends may be selected (configured,
+        >= 2 devices, not degraded)."""
+        return bool(self._devices) and self._degraded is None
+
+    @property
+    def size(self) -> int:
+        return len(self._devices) if self.active else 0
+
+    def solve_mesh(self) -> Mesh:
+        """The 1-D ("p",) mesh of the P-sharded solve."""
+        m = self._solve_mesh
+        if m is None or not self.active:
+            raise RuntimeError("mesh manager is not active")
+        return m
+
+    def streams_mesh(self) -> Mesh:
+        """The 1-D ("streams",) mesh of the stream-sharded megabatch."""
+        m = self._streams_mesh
+        if m is None or not self.active:
+            raise RuntimeError("mesh manager is not active")
+        return m
+
+    def should_shard_solve(self, num_rows: int) -> bool:
+        """Backend selection for one P-sized solve: mesh active AND the
+        row count clears the single-device-wins floor."""
+        return self.active and int(num_rows) >= self.solve_min_rows
+
+    # -- degradation --------------------------------------------------------
+
+    def check_collective(self) -> None:
+        """The ``mesh.collective`` fault point for callers about to
+        enter a sharded dispatch: a firing plan degrades the manager
+        (every later selection answers single-device) and raises
+        :class:`MeshCollectiveError` so THIS request walks the
+        caller's existing ladder — no invalid assignment is ever
+        served off a half-dead mesh."""
+        try:
+            faults.fire("mesh.collective")
+        except Exception as exc:
+            self.degrade("collective")
+            raise MeshCollectiveError(
+                "mesh collective failed; degraded to the single-device "
+                "backend"
+            ) from exc
+
+    def degrade(self, reason: str) -> None:
+        """Fall back to the single-device backend process-wide (a lost
+        device, a collective fault, a sharded dispatch raising).
+        Idempotent; :meth:`restore` / :meth:`configure` re-arms."""
+        with self._lock:
+            if self._degraded is not None or not self._devices:
+                return
+            LOGGER.warning(
+                "device mesh degraded (%s): sharded backends disabled, "
+                "single-device serves", reason,
+            )
+            self._install([], reason)
+
+    def restore(self) -> "MeshManager":
+        """Re-validate after an operator fixed the topology (the mesh
+        analog of a breaker's half-open probe, but operator-driven —
+        a flapping device must not re-arm itself)."""
+        return self.configure()
+
+    def status(self) -> Dict[str, Any]:
+        """The service ``stats.mesh`` section."""
+        return {
+            "spec": self.spec,
+            "configured": self._configured,
+            "active": self.active,
+            "devices": len(self._devices),
+            "degraded": self._degraded,
+            "solve_min_rows": self.solve_min_rows,
+        }
+
+
+# The active manager.  ``active_manager`` is the backend-selection hook
+# compiled into ops/dispatch: ONE global load + None compare when no
+# mesh is configured (the faults._ACTIVE pattern).
+_ACTIVE: Optional[MeshManager] = None
+
+
+def active_manager() -> Optional[MeshManager]:
+    return _ACTIVE
+
+
+def activate(manager: MeshManager) -> MeshManager:
+    global _ACTIVE
+    _ACTIVE = manager
+    return manager
+
+
+def deactivate(manager: Optional[MeshManager] = None) -> None:
+    """Clear the active manager (pass ``manager`` to only clear when it
+    is still the installed one — a stopping service must not clobber a
+    replacement's mesh)."""
+    global _ACTIVE
+    if manager is None or _ACTIVE is manager:
+        _ACTIVE = None
+
+
+@contextmanager
+def managed(manager: MeshManager) -> Iterator[MeshManager]:
+    """Scope an active manager to a block (tests, bench probes)."""
+    activate(manager)
+    try:
+        yield manager
+    finally:
+        deactivate(manager)

@@ -1,0 +1,526 @@
+"""P-axis-sharded solve: seed sort + plan stats + exchange refine over a
+device mesh.
+
+The single-leader solve caps the north star at one chip's HBM/FLOPs: a
+1M-partition lag vector, its sort, and the refine working set must all
+fit one device.  This module shards the PARTITION axis over the mesh
+manager's 1-D ``("p",)`` mesh (:mod:`.mesh`) so one huge solve spans
+devices, with the consumer-axis state — per-consumer totals and counts,
+C << P — kept REPLICATED and all-reduced per round:
+
+* **Seed** (:func:`_seed_local`): each shard sorts its local rows lag
+  descending (one local P/D-sized sort — the expensive sort never
+  crosses devices), a one-scalar ``all_gather`` fixes each shard's
+  global valid-rank offset, and row with global rank g takes consumer
+  ``g % C``.  Global ranks are a bijection over the valid rows, so the
+  seed is count-balanced (``max - min <= 1``) by construction at ANY
+  mesh size.
+
+* **Refine** (:func:`_refine_loop`): the EXACT round structure of
+  :func:`..ops.refine.refine_assignment` — rank consumers by replicated
+  totals, pair heavy with rotated light partners, score move/swap
+  candidates with the same quantized packed-key sort + neighbour scans
+  + segmented argmin — run per shard over LOCAL rows, then ONE
+  ``pmin``-based all-reduce per round picks each pair's globally best
+  exchange and a ``psum`` folds the winner's transfer back into the
+  replicated totals/counts.  At mesh size 1 the local candidate set IS
+  the global set and every reduce is the identity, so the result is
+  **bit-identical** to ``refine_assignment`` (pinned by
+  tests/test_sharded.py); at sizes 2-8 swaps are found within a shard
+  (moves anywhere), so the output is count-balanced and quality-gated
+  rather than bit-equal — the documented contract.
+
+* **Plan stats** (:func:`plan_stats_sharded`): the per-consumer
+  load/count marginals of an assignment as one shard-local segment sum
+  + ``psum`` — no device ever materializes another shard's rows.
+
+Executable discipline: one jitted ``shard_map`` program per (mesh, C,
+budget, bucket) via an lru-cached builder — repeated solves at a shape
+compile NOTHING after the first (the differential fuzz and the bench's
+``sharded_scale`` probe gate on ``utils/observability.compile_count``).
+
+Dispatch boundary: :func:`solve_sharded` / :func:`refine_sharded` fire
+the ``mesh.collective`` fault point on entry; callers (the streaming
+engine's cold hook, ops/dispatch selection) catch any failure, degrade
+the mesh manager, and fall back to the single-device backend inside the
+same request budget.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..ops.packing import pad_bucket, pad_chunk
+from ..ops.refine import _PAIR_BITS, _SBIG_INT, _VBITS
+from ..ops.sortops import bincount_sorted, segment_argmin_first, segment_sum
+from ..utils import faults, metrics
+from .mesh import CHECK_KW, SOLVE_AXIS, shard_map
+
+
+def _quant_shift_all(lags, assigned, axis: str):
+    """:func:`..ops.refine._quant_shift` with the max taken over EVERY
+    shard (``pmax``), so all devices quantize identically; identity at
+    mesh size 1."""
+    maxlag = jnp.maximum(jnp.max(jnp.where(assigned, lags, 0)), 1)
+    maxlag = lax.pmax(maxlag, axis)
+    bitlen = 64 - lax.clz(maxlag.astype(jnp.int64))
+    return jnp.maximum(bitlen - _VBITS, 0).astype(jnp.int64)
+
+
+def _seed_local(lags, valid, num_consumers: int, axis: str, num_shards: int):
+    """Count-balanced sharded seed (module docstring): local lag-desc
+    sort, cross-shard valid-rank offsets, consumer = global rank mod C.
+    Returns choice int32[L] in local input order (-1 on padding)."""
+    L = lags.shape[0]
+    C = int(num_consumers)
+    arangeL = jnp.arange(L, dtype=jnp.int32)
+    key = jnp.where(valid, -lags, jnp.iinfo(jnp.int64).max)
+    _, srow = lax.sort((key, arangeL), num_keys=1)
+    v_loc = jnp.sum(valid.astype(jnp.int32))
+    counts_all = lax.all_gather(v_loc, axis)  # [D] scalar gather
+    d = lax.axis_index(axis)
+    offset = jnp.sum(
+        jnp.where(jnp.arange(num_shards, dtype=jnp.int32) < d,
+                  counts_all, 0)
+    ).astype(jnp.int32)
+    g = offset + arangeL
+    seat = jnp.where(
+        arangeL < v_loc, (g % C).astype(jnp.int32), jnp.int32(-1)
+    )
+    return jnp.zeros((L,), jnp.int32).at[srow].set(seat)
+
+
+def _refine_loop(
+    lags, valid, choice, num_consumers: int, iters: int,
+    max_pairs: Optional[int], patience: int, axis: str, num_shards: int,
+):
+    """The :func:`..ops.refine.refine_assignment` round loop over LOCAL
+    rows with replicated consumer-axis state all-reduced per round (one
+    ``pmin`` winner election + one ``psum`` transfer fold); identity
+    reduces — and therefore bit-parity — at mesh size 1."""
+    C = int(num_consumers)
+    L = lags.shape[0]
+    K = max(1, min(C // 2, max_pairs if max_pairs is not None else C // 2))
+    if K >= (1 << _PAIR_BITS) - 1:
+        raise ValueError(
+            f"max_pairs={K} exceeds the packed pair-id field "
+            f"({_PAIR_BITS} bits)"
+        )
+    arangeC = jnp.arange(C, dtype=jnp.int32)
+    arangeL = jnp.arange(L, dtype=jnp.int32)
+    key_big = jnp.iinfo(jnp.int64).max
+    vmask = (jnp.int64(1) << _VBITS) - 1
+    sbig = jnp.asarray(_SBIG_INT, jnp.int64)
+    D = int(num_shards)
+
+    choice = choice.astype(jnp.int32)
+    assigned = valid & (choice >= 0)
+    seg0 = jnp.where(assigned, choice, -1)
+    totals0 = lax.psum(
+        segment_sum(jnp.where(assigned, lags, 0), seg0, C), axis
+    )
+    counts0 = lax.psum(bincount_sorted(seg0, C), axis)
+    zero32 = jnp.int32(0)
+    if C < 2 or iters <= 0:
+        return choice, counts0, totals0, zero32
+    pshift = _quant_shift_all(lags, assigned, axis)
+    n_light = C - K
+    didx = lax.axis_index(axis)
+
+    def body(state):
+        it, since, choice, totals, counts = state
+        safe_choice = jnp.clip(choice, 0, C - 1)
+
+        # Pairing over the REPLICATED totals: identical on every shard
+        # (deterministic argsort of identical inputs).
+        order = jnp.argsort(totals).astype(jnp.int32)
+        rank = jnp.zeros((C,), jnp.int32).at[order].set(arangeC)
+        shift = it % jnp.int32(n_light)
+        light_slot = (jnp.arange(K, dtype=jnp.int32) + shift) % n_light
+        light = order[light_slot]
+        heavy = order[C - 1 - jnp.arange(K)]
+        diff = totals[heavy] - totals[light]
+
+        slot_to_pair = (
+            jnp.full((n_light,), K, jnp.int32)
+            .at[light_slot]
+            .set(jnp.arange(K, dtype=jnp.int32))
+        )
+        pair_of = jnp.where(
+            rank < n_light,
+            slot_to_pair[jnp.clip(rank, 0, n_light - 1)],
+            C - 1 - rank,
+        )
+        heavy_side = rank >= C - K
+        move_ok_pair = counts[heavy] > counts[light]
+        move_ok_of = jnp.where(
+            heavy_side,
+            jnp.pad(move_ok_pair, (0, 1))[jnp.clip(pair_of, 0, K)],
+            False,
+        )
+        combo_tab = (
+            pair_of
+            | (heavy_side.astype(jnp.int32) << _PAIR_BITS)
+            | (move_ok_of.astype(jnp.int32) << (_PAIR_BITS + 1))
+        )
+        combo = jnp.where(assigned, combo_tab[safe_choice], -1)
+        k_p = combo & ((1 << _PAIR_BITS) - 1)
+        row_heavy = (combo >> _PAIR_BITS) & 1
+        row_move_ok = (combo >> (_PAIR_BITS + 1)) & 1
+        participates = (combo >= 0) & (k_p < K)
+        kc = jnp.clip(k_p, 0, K - 1)
+        diff_p = jnp.where(participates, diff[kc], 0)
+        delta_p = diff_p >> 1
+
+        # LOCAL candidate sort (the expensive P-sized work stays on
+        # shard; the oracle's key layout verbatim).
+        qself = lags >> pshift
+        tgt = jnp.clip(lags - delta_p, 0, None) >> pshift
+        qval = jnp.where(row_heavy == 1, tgt, qself)
+        key = jnp.where(
+            participates,
+            (k_p.astype(jnp.int64) << (_VBITS + 1))
+            | (jnp.clip(qval, 0, vmask) << 1)
+            | row_heavy.astype(jnp.int64),
+            key_big,
+        )
+        skey, slag, srow, smove_ok = lax.sort(
+            (key, lags, arangeL, row_move_ok), num_keys=1
+        )
+        part_s = skey < key_big
+        pair_s = (skey >> (_VBITS + 1)).astype(jnp.int32)
+        heavy_s = part_s & ((skey & 1) == 1)
+        light_s = part_s & ((skey & 1) == 0)
+        qlag_s = slag >> pshift
+        diff_s = jnp.where(heavy_s, diff[jnp.clip(pair_s, 0, K - 1)], 0)
+        delta_q_s = (diff_s >> 1) >> pshift
+        diff_q_s = diff_s >> pshift
+
+        prev_l = lax.cummax(jnp.where(light_s, arangeL, -1))
+        nxt_l = lax.cummin(
+            jnp.where(light_s, arangeL, L), reverse=True
+        )
+
+        def neighbour(nb):
+            inb = jnp.clip(nb, 0, L - 1)
+            nkey = skey[inb]
+            okq = (
+                (nb >= 0) & (nb < L)
+                & ((nkey & 1) == 0)
+                & ((nkey >> (_VBITS + 1)).astype(jnp.int32) == pair_s)
+            )
+            d_q = qlag_s - ((nkey >> 1) & vmask)
+            ok = heavy_s & okq & (d_q > 0) & (d_q < diff_q_s)
+            return jnp.where(ok, jnp.abs(d_q - delta_q_s), sbig)
+
+        err_a = neighbour(prev_l)
+        err_b = neighbour(nxt_l)
+        use_b = err_b < err_a
+        err_swap = jnp.where(use_b, err_b, err_a)
+        nb_sel = jnp.where(use_b, nxt_l, prev_l)
+
+        ok_move = (
+            heavy_s & (smove_ok == 1) & (slag > 0) & (slag < diff_s)
+        )
+        score_move = jnp.where(
+            ok_move, jnp.abs(qlag_s - delta_q_s), sbig
+        )
+        combined = jnp.where(
+            score_move <= err_swap,
+            score_move << 1,
+            (err_swap << 1) | 1,
+        )
+        seg_h = jnp.where(heavy_s, pair_s, K)
+        minv, widx = segment_argmin_first(combined, seg_h, K, L)
+
+        # Per-pair winner ELECTION across shards: the smallest packed
+        # score wins, ties to the lowest device index.  All-reduced so
+        # every shard agrees; identity at D=1.
+        gmin = lax.pmin(minv, axis)
+        has = minv == gmin
+        win_d = lax.pmin(
+            jnp.where(has, jnp.full((K,), didx, jnp.int32), D), axis
+        )
+        mine = win_d == didx
+        do = gmin < (sbig << 1)
+        is_swap = (gmin & 1) == 1
+
+        wclip = jnp.clip(widx, 0, L - 1)
+        p_sel = srow[wclip]
+        lag_p = slag[wclip]
+        nb_k = jnp.clip(nb_sel[wclip], 0, L - 1)
+        q_sel = srow[nb_k]
+        lag_q = slag[nb_k]
+        use_swap = do & is_swap
+        d_amt = jnp.where(use_swap, lag_p - lag_q, lag_p)
+        d_amt = jnp.where(do, d_amt, 0)
+        # The winner's exact transfer, folded into the replicated
+        # totals (only the winning shard contributes non-zero).
+        d_k = lax.psum(jnp.where(mine, d_amt, 0), axis)
+
+        upd_p = jnp.where(mine & do, p_sel, jnp.int32(L))
+        upd_q = jnp.where(mine & use_swap, q_sel, jnp.int32(L))
+        new_choice = choice.at[upd_p].set(light, mode="drop")
+        new_choice = new_choice.at[upd_q].set(heavy, mode="drop")
+        new_totals = totals.at[heavy].add(-d_k).at[light].add(d_k)
+        dc = (do & ~is_swap).astype(jnp.int32)
+        new_counts = counts.at[heavy].add(-dc).at[light].add(dc)
+        peak_dropped = jnp.max(new_totals) < jnp.max(totals)
+        new_since = jnp.where(peak_dropped, zero32, since + 1)
+        return it + 1, new_since, new_choice, new_totals, new_counts
+
+    def cond(state):
+        it, since = state[0], state[1]
+        return (it < iters) & (since < patience)
+
+    it, _, choice, totals, counts = lax.while_loop(
+        cond, body, (zero32, zero32, choice, totals0, counts0)
+    )
+    return choice, counts, totals, it
+
+
+@functools.lru_cache(maxsize=32)
+def _sharded_executable(
+    mesh, num_consumers: int, iters: int, max_pairs, patience: int,
+    seeded: bool,
+):
+    """Build + jit ONE shard_map program per (mesh, C, budget, mode) —
+    the builder is lru-cached so repeated solves retrace nothing."""
+    D = mesh.shape[SOLVE_AXIS]
+
+    if seeded:
+
+        def step(lags, valid):
+            choice = _seed_local(
+                lags, valid, num_consumers, SOLVE_AXIS, D
+            )
+            return _refine_loop(
+                lags, valid, choice, num_consumers, iters, max_pairs,
+                patience, SOLVE_AXIS, D,
+            )
+
+        in_specs = (
+            PartitionSpec(SOLVE_AXIS), PartitionSpec(SOLVE_AXIS),
+        )
+    else:
+
+        def step(lags, valid, choice):
+            return _refine_loop(
+                lags, valid, choice, num_consumers, iters, max_pairs,
+                patience, SOLVE_AXIS, D,
+            )
+
+        in_specs = (
+            PartitionSpec(SOLVE_AXIS), PartitionSpec(SOLVE_AXIS),
+            PartitionSpec(SOLVE_AXIS),
+        )
+    mapped = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=(
+            PartitionSpec(SOLVE_AXIS),  # choice
+            PartitionSpec(),            # counts: replicated
+            PartitionSpec(),            # totals: replicated
+            PartitionSpec(),            # rounds
+        ),
+        # The while_loop carry starts from literal zeros (typed
+        # unvarying by the manual-axes checker even though data varies
+        # over "p"); parity with the unsharded kernel is asserted by
+        # tests instead — the same waiver the topic-axis backend uses.
+        **{CHECK_KW: False},
+    )
+    return jax.jit(mapped)
+
+
+def shard_bucket(num_rows: int, num_shards: int) -> int:
+    """Padded solve shape: the streaming buckets (pow2 on accelerators,
+    4096-chunks on CPU) rounded up to a multiple of the mesh size so
+    the P axis splits evenly."""
+    B = (
+        pad_chunk(num_rows)
+        if jax.default_backend() == "cpu"
+        else pad_bucket(num_rows)
+    )
+    D = int(num_shards)
+    if B % D:
+        B += D - (B % D)
+    return B
+
+
+def _place_inputs(mesh, *host_arrays):
+    """Device-put padded host inputs with the "p" sharding so each
+    shard's slice lands directly on its device (no host gather)."""
+    spec = NamedSharding(mesh, PartitionSpec(SOLVE_AXIS))
+    return tuple(jax.device_put(a, spec) for a in host_arrays)
+
+
+def solve_sharded(
+    mesh,
+    lags: np.ndarray,
+    num_consumers: int,
+    refine_iters: int = 64,
+    max_pairs: Optional[int] = None,
+    patience: int = 8,
+):
+    """One P-axis-sharded cold solve (seed + refine) on ``mesh``.
+
+    ``lags`` is the exact host [P] int64 vector; padding to the
+    mesh-divisible bucket happens here.  Fires ``mesh.collective`` on
+    entry (the sharded dispatch boundary — callers degrade to the
+    single-device backend on any failure).  Returns ``(choice int32[P]
+    in input order, counts int32[C], totals int64[C], rounds)`` as host
+    arrays; the choice is count-balanced at any mesh size.
+    """
+    from ..ops.dispatch import ensure_x64
+
+    ensure_x64()
+    faults.fire("mesh.collective")
+    C = int(num_consumers)
+    lags = np.ascontiguousarray(lags, dtype=np.int64)
+    P_len = int(lags.shape[0])
+    D = mesh.shape[SOLVE_AXIS]
+    B = shard_bucket(P_len, D)
+    lags_p = np.zeros(B, dtype=np.int64)
+    lags_p[:P_len] = lags
+    valid = np.zeros(B, dtype=bool)
+    valid[:P_len] = True
+    step = _sharded_executable(
+        mesh, C, int(refine_iters), max_pairs, int(patience), True
+    )
+    with metrics.span("sharded.solve"):
+        choice, counts, totals, rounds = step(
+            *_place_inputs(mesh, lags_p, valid)
+        )
+        choice_np, counts_np, totals_np, rounds_np = jax.device_get(
+            (choice, counts, totals, rounds)
+        )
+    metrics.REGISTRY.counter(
+        "klba_sharded_dispatch_total", {"path": "solve"}
+    ).inc()
+    return (
+        np.asarray(choice_np)[:P_len].astype(np.int32),
+        np.asarray(counts_np),
+        np.asarray(totals_np),
+        int(rounds_np),
+    )
+
+
+def refine_sharded(
+    mesh,
+    lags: np.ndarray,
+    valid: np.ndarray,
+    choice: np.ndarray,
+    num_consumers: int,
+    iters: int = 16,
+    max_pairs: Optional[int] = None,
+    patience: int = 8,
+):
+    """Mesh-parity refinement entry: the P-sharded equivalent of
+    :func:`..ops.refine.refine_assignment` — bit-identical to it at
+    mesh size 1, count-preserving and quality-gated at sizes 2-8.
+    Inputs are host arrays of one padded length divisible by the mesh
+    size.  Returns host ``(choice int32[P], counts, totals, rounds)``.
+    """
+    from ..ops.dispatch import ensure_x64
+
+    ensure_x64()
+    faults.fire("mesh.collective")
+    C = int(num_consumers)
+    D = mesh.shape[SOLVE_AXIS]
+    lags = np.ascontiguousarray(lags, dtype=np.int64)
+    if lags.shape[0] % D:
+        raise ValueError(
+            f"refine_sharded input length {lags.shape[0]} must divide "
+            f"the mesh size {D} (pad with valid=False rows)"
+        )
+    step = _sharded_executable(
+        mesh, C, int(iters), max_pairs, int(patience), False
+    )
+    with metrics.span("sharded.refine"):
+        out = step(
+            *_place_inputs(
+                mesh,
+                lags,
+                np.ascontiguousarray(valid, dtype=bool),
+                np.ascontiguousarray(choice, dtype=np.int32),
+            )
+        )
+        choice_o, counts_o, totals_o, rounds_o = jax.device_get(out)
+    metrics.REGISTRY.counter(
+        "klba_sharded_dispatch_total", {"path": "refine"}
+    ).inc()
+    return (
+        np.asarray(choice_o).astype(np.int32),
+        np.asarray(counts_o),
+        np.asarray(totals_o),
+        int(rounds_o),
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _plan_stats_executable(mesh, num_consumers: int):
+    def step(lags, valid, choice):
+        assigned = valid & (choice >= 0)
+        seg = jnp.where(assigned, choice, -1)
+        totals = lax.psum(
+            segment_sum(jnp.where(assigned, lags, 0), seg, num_consumers),
+            SOLVE_AXIS,
+        )
+        counts = lax.psum(
+            bincount_sorted(seg, num_consumers), SOLVE_AXIS
+        )
+        return totals, counts
+
+    mapped = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(
+            PartitionSpec(SOLVE_AXIS), PartitionSpec(SOLVE_AXIS),
+            PartitionSpec(SOLVE_AXIS),
+        ),
+        out_specs=(PartitionSpec(), PartitionSpec()),
+        **{CHECK_KW: False},
+    )
+    return jax.jit(mapped)
+
+
+def plan_stats_sharded(mesh, lags, valid, choice, num_consumers: int):
+    """Sharded plan stats: per-consumer ``(totals int64[C], counts
+    int32[C])`` of an assignment via shard-local segment sums + one
+    ``psum`` — no device materializes another shard's rows.  Inputs are
+    host arrays of one mesh-divisible padded length."""
+    from ..ops.dispatch import ensure_x64
+
+    ensure_x64()
+    step = _plan_stats_executable(mesh, int(num_consumers))
+    totals, counts = step(
+        *_place_inputs(
+            mesh,
+            np.ascontiguousarray(lags, dtype=np.int64),
+            np.ascontiguousarray(valid, dtype=bool),
+            np.ascontiguousarray(choice, dtype=np.int32),
+        )
+    )
+    return np.asarray(totals), np.asarray(counts)
+
+
+def seed_reference(lags: np.ndarray, num_consumers: int) -> np.ndarray:
+    """Host twin of the mesh-1 sharded seed (tests + the bench's
+    single-device comparator): lag-descending stable sort, consumer =
+    rank mod C.  ``solve_sharded`` on a 1-device mesh with
+    ``refine_iters=0`` is bit-identical to this."""
+    C = int(num_consumers)
+    lags = np.asarray(lags, dtype=np.int64)
+    order = np.lexsort((np.arange(lags.shape[0]), -lags))
+    choice = np.empty(lags.shape[0], dtype=np.int32)
+    choice[order] = np.arange(lags.shape[0], dtype=np.int32) % C
+    return choice

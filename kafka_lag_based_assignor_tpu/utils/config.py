@@ -77,6 +77,25 @@ COALESCE_PIPELINE_CONFIG = "tpu.assignor.coalesce.pipeline"
 DELTA_ENABLED_CONFIG = "tpu.assignor.delta.enabled"
 DELTA_MAX_FRACTION_CONFIG = "tpu.assignor.delta.max.fraction"
 DELTA_BUCKETS_CONFIG = "tpu.assignor.delta.buckets"
+# Per-stream ADAPTIVE delta cutoff (ops/streaming; ROADMAP delta
+# follow-on (b)): each engine tracks its observed churn distribution
+# (bounded window) and auto-tunes the delta/dense cutoff within
+# [max.fraction/4, min(2*max.fraction, 0.5)] instead of pinning it to
+# the one global knob.  The effective fraction surfaces in the stream
+# stats, klba_delta_effective_fraction, and dump_metrics --summary.
+DELTA_ADAPTIVE_CONFIG = "tpu.assignor.delta.adaptive"
+# Multi-device sharding (sharded/; DEPLOYMENT.md "Multi-device
+# sharding").  ``mesh.devices`` selects the device mesh discovered and
+# validated ONCE at service start: "off" (default — single-device),
+# "auto" (all visible devices; single-device when only one is
+# visible), or an integer N (exactly N devices; fewer visible degrades
+# to single-device at boot, fail-open).  On CPU hosts the virtual mesh
+# needs XLA_FLAGS=--xla_force_host_platform_device_count=N set before
+# jax initializes.  ``mesh.solve.min.rows`` is the partition-count
+# floor below which a single device wins outright and the P-sharded
+# solve backend is not selected.
+MESH_DEVICES_CONFIG = "tpu.assignor.mesh.devices"
+MESH_SOLVE_MIN_ROWS_CONFIG = "tpu.assignor.mesh.solve.min.rows"
 # SLO classes + overload control (utils/overload, served by the
 # sidecar).  Per-stream class: "tpu.assignor.slo.class.<stream_id>" =
 # critical | standard | best_effort (a wire-level params.slo_class
@@ -163,6 +182,14 @@ FEDERATION_SYNC_TIMEOUT_CONFIG = "tpu.assignor.federation.sync.timeout.ms"
 FEDERATION_MAX_STALENESS_CONFIG = (
     "tpu.assignor.federation.max.staleness.ms"
 )
+# Weighted shards (ROADMAP federated (c)): this cluster's per-consumer
+# capacity weight vector as comma-separated positive floats (length =
+# the consumer count federated_assign serves).  Exchanged in the hello
+# handshake through the audited federated/wire serializer and summed
+# into the global count-marginal target — consumers with more capacity
+# take proportionally more partitions.  Empty/unset contributes
+# uniform weights (the n/C marginal when no cluster is weighted).
+FEDERATION_CAPACITY_CONFIG = "tpu.assignor.federation.capacity"
 # "P:C[:T][,P:C[:T]...]" — shapes to pre-compile at configure() time
 # (consumer startup, NOT on the rebalance critical path): each entry warms
 # the kernels for max_partitions P / num_consumers C / a topic batch of T
@@ -253,6 +280,11 @@ class AssignorConfig:
     delta_enabled: bool = True
     delta_max_fraction: float = 0.125
     delta_buckets: int = 6
+    delta_adaptive: bool = True
+    # Multi-device sharding (sharded/): mesh spec + P-sharded-solve
+    # row floor ("off" = single-device, the default).
+    mesh_devices: str = "off"
+    mesh_solve_min_rows: int = 65536
     # SLO classes + overload control (utils/overload): per-stream class
     # map, per-class deadline budgets (seconds), and the overload
     # detector's pressure normalizers (0 latency budget = auto).
@@ -285,6 +317,7 @@ class AssignorConfig:
     federation_rounds: int = 16
     federation_sync_timeout_s: float = 2.0
     federation_max_staleness_s: float = 300.0
+    federation_capacity: Optional[list] = None
     # (max_partitions, num_consumers) shapes to pre-compile at configure().
     warmup_shapes: list = field(default_factory=list)
     consumer_group_props: Dict[str, Any] = field(default_factory=dict)
@@ -444,6 +477,24 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
     federation_max_staleness_s = _as_ms(
         FEDERATION_MAX_STALENESS_CONFIG, 300_000.0
     )
+    raw_capacity = consumer_group_props.get(
+        FEDERATION_CAPACITY_CONFIG, ""
+    )
+    federation_capacity = None
+    if raw_capacity not in (None, ""):
+        try:
+            federation_capacity = [
+                float(v) for v in str(raw_capacity).split(",")
+            ]
+        except ValueError:
+            raise ValueError(
+                f"{FEDERATION_CAPACITY_CONFIG}={raw_capacity!r} must be "
+                "comma-separated numbers"
+            )
+        if any(v <= 0 for v in federation_capacity):
+            raise ValueError(
+                f"{FEDERATION_CAPACITY_CONFIG} entries must be > 0"
+            )
 
     # SLO class map + per-class deadline budgets: prefix-keyed entries,
     # validated against the class roster (utils/overload) so a typo'd
@@ -495,6 +546,19 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
             "(each rung is one compiled executable per shape bucket)"
         )
 
+    # Mesh knobs: the spec is validated HERE (the sharded/ parser) so a
+    # typo'd device count fails at configure() time, not at boot.
+    from ..sharded.mesh import _parse_spec as _parse_mesh_spec
+
+    raw_mesh = consumer_group_props.get(MESH_DEVICES_CONFIG, "off")
+    try:
+        mesh_devices = str(_parse_mesh_spec(raw_mesh))
+    except ValueError as exc:
+        raise ValueError(f"{MESH_DEVICES_CONFIG}: {exc}")
+    mesh_solve_min_rows = _as_int(
+        MESH_SOLVE_MIN_ROWS_CONFIG, 65536, 1
+    )
+
     # The controller keeps this knob in ms (it normalizes a p99 that is
     # measured in ms), so convert _as_ms's seconds back out once, here.
     overload_latency_budget_ms = (
@@ -538,6 +602,11 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
         ),
         delta_max_fraction=delta_max_fraction,
         delta_buckets=delta_buckets,
+        delta_adaptive=_as_bool(
+            consumer_group_props.get(DELTA_ADAPTIVE_CONFIG, True)
+        ),
+        mesh_devices=mesh_devices,
+        mesh_solve_min_rows=mesh_solve_min_rows,
         slo_classes=slo_classes,
         slo_deadline_s=slo_deadline_s,
         overload_latency_budget_ms=overload_latency_budget_ms,
@@ -557,6 +626,7 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
         federation_rounds=federation_rounds,
         federation_sync_timeout_s=federation_sync_timeout_s,
         federation_max_staleness_s=federation_max_staleness_s,
+        federation_capacity=federation_capacity,
         recovery_prestack=_as_bool(
             consumer_group_props.get(RECOVERY_PRESTACK_CONFIG, False)
         ),

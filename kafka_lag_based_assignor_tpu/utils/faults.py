@@ -59,6 +59,17 @@ Named fault points (every one threaded through production code):
                     divergence, quarantine the stream/row, and heal it
                     bit-exact from host truth.  Use ``raise`` plans;
                     the seed picks the flipped element and bit
+``mesh.collective`` entry of a SHARDED dispatch (the P-sharded solve's
+                    :func:`..sharded.solve.solve_sharded` /
+                    ``refine_sharded`` and the coalescer's stream-sharded
+                    locked flush via
+                    :meth:`..sharded.mesh.MeshManager.check_collective`)
+                    — a lost device / failed collective: the mesh
+                    manager DEGRADES to the single-device backend and
+                    the in-flight request walks the existing ladder
+                    (single-device cold solve, single-stream flush
+                    fallback) inside its deadline — no invalid
+                    assignment is ever served off a half-dead mesh
 ``snapshot.write``  a lifecycle snapshot save (:meth:`..utils.snapshot.
                     SnapshotStore.save`) — a failure here exercises the
                     fail-open write contract (serving continues on the
@@ -168,6 +179,7 @@ FAULT_POINTS = frozenset(
         "device.corrupt.choice",
         "device.corrupt.counts",
         "device.corrupt.lags",
+        "mesh.collective",
         "peer.partition",
         "peer.slow_link",
         "peer.sync",

@@ -55,6 +55,7 @@ def warmup(
     stream_refine_iters: int = 128,
     coalesce_max_batch: int = 1,
     delta_buckets: int = 6,
+    mesh_manager=None,
 ) -> List[Tuple[str, int, int, int, float]]:
     """Pre-compile kernels for every shape the deployment will see.
 
@@ -100,6 +101,14 @@ def warmup(
         ``stream_refine_iters`` (batch bucket and exchange budget are
         both part of the executable signature).  Recorded as
         ``("coalesce", batch_bucket, P, C, seconds)`` rows.
+      mesh_manager: an ACTIVE :class:`..sharded.mesh.MeshManager` warms
+        the P-axis-sharded solve executable at this mesh size (per-mesh
+        -size executables: the sharded program is one compile per
+        (mesh, bucket, C, budget) — recorded as ``("sharded", D, P, C,
+        s)`` rows).  The stream-sharded MEGABATCH variants warm through
+        the ``coalesce`` jobs automatically while the manager is the
+        process-active one (the warm-up waves lock onto the sharded
+        placement exactly like production waves).  None skips.
       delta_buckets: > 0 additionally warms the DELTA-EPOCH executables
         (ops/streaming "delta epochs"): one synthetic delta dispatch
         per pow2 K rung of the ladder on the inline path (rungs whose
@@ -173,9 +182,16 @@ def warmup(
                     # route its unchanged-lags warm epoch through the
                     # K=16 delta variant and leave the dense one cold);
                     # the delta ladder warms via its own jobs below.
+                    # mesh_backend=None pins THIS job's cold solves to
+                    # the SINGLE-device chain even while a mesh manager
+                    # is active: the single-device executables are the
+                    # mesh's degradation target and must be warm
+                    # regardless (the sharded program warms via its own
+                    # job below).
                     engine = StreamingAssignor(
                         num_consumers=C, refine_iters=stream_refine_iters,
                         refine_threshold=None, delta_enabled=False,
+                        mesh_backend=None,
                     )
                     engine.rebalance(lags1d)
                     out = engine.rebalance(lags1d)
@@ -233,6 +249,34 @@ def warmup(
                     return out
 
                 jobs.append(("stream", 1, stream_job))
+            if (
+                "stream" in solvers
+                and mesh_manager is not None
+                and mesh_manager.active
+            ):
+
+                def sharded_job(lags1d=lags1d, C=C):
+                    # The production cold hook dispatches
+                    # solve_sharded with the engine's cold budget
+                    # (StreamingAssignor default — _fresh_engine) when
+                    # the manager elects this shape; warm exactly that
+                    # executable.  A shape below the manager's row
+                    # floor warms nothing it will never serve — the
+                    # solve still runs (cheap) so the (mesh, bucket)
+                    # program exists if an operator lowers the floor.
+                    from .ops.streaming import StreamingAssignor
+                    from .sharded.solve import solve_sharded
+
+                    budget = StreamingAssignor(
+                        num_consumers=C
+                    ).cold_refine_iters
+                    out = solve_sharded(
+                        mesh_manager.solve_mesh(), lags1d, C,
+                        refine_iters=budget,
+                    )
+                    return out[0]
+
+                jobs.append(("sharded", mesh_manager.size, sharded_job))
             if "stream" in solvers and delta_buckets > 0:
                 from .ops.streaming import delta_k_ladder
 
@@ -259,6 +303,7 @@ def warmup(
                             refine_threshold=None,
                             delta_max_fraction=1.0,
                             delta_buckets=delta_buckets,
+                            mesh_backend=None,
                         )
                         cur = lags1d.copy()
                         eng.rebalance(cur)
@@ -294,6 +339,7 @@ def warmup(
                                 refine_threshold=None,
                                 delta_max_fraction=1.0,
                                 delta_buckets=max(delta_buckets, 1),
+                                mesh_backend=None,
                             )
                             for _ in range(n)
                         ]

@@ -892,8 +892,8 @@ def test_service_stats_exposes_coalesce_roster_tracking(service):
         stats = c.request("stats")
     co = stats["coalesce"]
     assert set(co) == {
-        "locked_rosters", "roster_hits", "restack_flushes",
-        "roster_invalidations", "dead_rows_dropped",
+        "locked_rosters", "stream_sharded_rosters", "roster_hits",
+        "restack_flushes", "roster_invalidations", "dead_rows_dropped",
     }
     assert all(isinstance(v, int) for v in co.values())
     # A max_batch <= 1 service has no coalescer and no section.

@@ -585,6 +585,7 @@ def test_service_from_config_wires_delta_knobs():
             "delta_enabled": True,
             "delta_max_fraction": 0.25,
             "delta_buckets": 3,
+            "delta_adaptive": True,
         }
         assert svc._coalescer.delta_k == DELTA_MIN_K << 2  # ladder top
     with AssignorService.from_config(
@@ -673,3 +674,92 @@ def test_service_ctor_validates_delta_knobs():
     ):
         with pytest.raises(ValueError):
             AssignorService(port=0, **kw)
+
+
+# -- per-stream adaptive max.fraction (ROADMAP delta follow-on (b)) -------
+
+
+def test_adaptive_effective_fraction_defaults_to_knob():
+    """Below the sample floor — and with adaptivity off — the global
+    knob serves unchanged."""
+    eng = StreamingAssignor(num_consumers=4, delta_max_fraction=0.2)
+    assert eng._effective_delta_fraction() == 0.2
+    off = StreamingAssignor(
+        num_consumers=4, delta_max_fraction=0.2, delta_adaptive=False
+    )
+    off._churn_fractions.extend([0.01] * 64)
+    assert off._effective_delta_fraction() == 0.2
+
+
+def test_adaptive_tightens_on_low_churn_and_spike_goes_dense():
+    """A steady low-churn stream tightens its cutoff to knob/4, so an
+    anomalous epoch ABOVE the effective cutoff (but still below the
+    global knob) uploads dense — counted as a fallback."""
+    rng = np.random.default_rng(31)
+    P = 4096
+    eng = StreamingAssignor(
+        num_consumers=8, refine_iters=16, refine_threshold=None,
+        delta_max_fraction=0.125, delta_buckets=8,
+    )
+    cur = rng.integers(0, 1000, P).astype(np.int64)
+    eng.rebalance(cur)
+    eng.rebalance(cur)
+    for _ in range(10):
+        cur = _drift(rng, cur, 16)  # ~0.4% churn
+        eng.rebalance(cur)
+    eff = eng.last_effective_delta_fraction
+    assert eff == pytest.approx(0.125 / 4)  # clamped at the floor
+    fallback = _counter(
+        "klba_delta_epochs_total", outcome="fallback"
+    ).value
+    # 8% churn: below the 12.5% knob, above the 3.125% effective
+    # cutoff -> dense.
+    cur = _drift(rng, cur, int(0.08 * P))
+    eng.rebalance(cur)
+    assert _counter(
+        "klba_delta_epochs_total", outcome="fallback"
+    ).value == fallback + 1
+
+
+def test_adaptive_raises_cutoff_for_high_churn_stream():
+    """A stream whose routine churn exceeds the global knob RAISES its
+    cutoff (up to 2x the knob) so its routine epochs keep the sparse
+    upload — bounded by the byte gate and the warmed ladder."""
+    rng = np.random.default_rng(32)
+    P = 4096
+    eng = StreamingAssignor(
+        num_consumers=8, refine_iters=16, refine_threshold=None,
+        delta_max_fraction=0.05, delta_buckets=9,  # K up to 4096
+    )
+    cur = rng.integers(0, 10**6, P).astype(np.int64)
+    eng.rebalance(cur)
+    eng.rebalance(cur)
+    n = int(0.08 * P)  # routine churn 8% > the 5% knob
+    applied_before = _counter(
+        "klba_delta_epochs_total", outcome="applied"
+    ).value
+    for _ in range(12):
+        cur = _drift(rng, cur, n)
+        eng.rebalance(cur)
+    assert eng.last_effective_delta_fraction == pytest.approx(
+        min(1.5 * 0.08, 0.1), rel=0.1
+    )
+    # Once the window learned the distribution, the 8% epochs apply
+    # as deltas (they were fallbacks under the raw 5% knob).
+    assert _counter(
+        "klba_delta_epochs_total", outcome="applied"
+    ).value > applied_before
+
+
+def test_adaptive_effective_fraction_on_wire_stats():
+    with AssignorService(
+        port=0, coalesce_max_batch=1, scrub_interval_ms=0
+    ) as svc:
+        with AssignorServiceClient(*svc.address, timeout_s=180.0) as c:
+            r = c.stream_assign(
+                "af", "t0", [[p, p * 3] for p in range(64)], ["A", "B"]
+            )
+            assert r["stream"]["delta_effective_fraction"] == (
+                pytest.approx(0.125)
+            )
+            assert r["stream"]["sharded_solve"] is False

@@ -841,3 +841,199 @@ class TestPerClassWindows:
                     "pc", "t0", [[p, p] for p in range(8)], MEMBERS
                 )
             assert svc._coalescer._window_scales == (1.0, 0.5, 0.25)
+
+
+# -- weighted shards (ROADMAP federated (c)) --------------------------------
+
+
+class TestWeightedShards:
+    def test_wire_capacity_is_consumer_axis_bounded(self):
+        body = wire.sync_response(
+            "a", 1, 0, C, total_lag=10, n_valid=4,
+            capacity=[2.0, 1.0, 1.0, 1.0],
+        )
+        assert body["capacity"] == [2.0, 1.0, 1.0, 1.0]
+        with pytest.raises(wire.PayloadViolation, match="length"):
+            wire.sync_response(
+                "a", 1, 0, C, total_lag=10, n_valid=4,
+                capacity=[1.0] * (C + 3),  # partition-axis smuggle
+            )
+
+    def test_apportion_counts(self):
+        cap = fedsolve.apportion_counts(10, [2.0, 1.0, 1.0])
+        assert cap.tolist() == [5, 3, 2]
+        assert cap.sum() == 10
+        # Degenerate weights fall back to uniform.
+        uni = fedsolve.apportion_counts(9, [0.0, 0.0, 0.0])
+        assert sorted(uni.tolist()) == [3, 3, 3]
+
+    def test_round_local_shard_weighted_counts_hold_exactly(self):
+        """Capacity-proportional seats are seated exactly AND survive
+        the (swap-only) exchange refinement — count-changing moves are
+        disabled on the weighted path."""
+        rng = np.random.default_rng(21)
+        P = 512
+        lags = rng.integers(1, 10**6, P).astype(np.int64)
+        cap_frac = np.array([0.5, 1 / 6, 1 / 6, 1 / 6])
+        A, B = fedsolve.initial_duals(C)
+        choice, counts, _ = fedsolve.round_local_shard(
+            lags, C, A, B, scale=float(lags.sum()) / C,
+            base_load=np.zeros(C, np.float32),
+            capacity_frac=cap_frac,
+        )
+        target = fedsolve.apportion_counts(P, cap_frac)
+        np.testing.assert_array_equal(counts, target)
+        np.testing.assert_array_equal(
+            np.bincount(choice, minlength=C), target
+        )
+
+    def test_weighted_quality_load_stays_bounded(self):
+        """Heterogeneous-capacity QUALITY gate: with a 4x-capacity
+        consumer, converged duals + the weighted rounding keep the
+        load imbalance bounded (the high-count consumer absorbs the
+        SMALL rows) — well under the ~4x a capacity-blind count skew
+        would produce."""
+        rng = np.random.default_rng(22)
+        P = 1024
+        lags = rng.integers(1, 10**6, P).astype(np.int64)
+        capw = np.array([4.0, 1.0, 1.0, 1.0])
+        cap_frac = capw / capw.sum()
+        scale = max(float(lags.sum()), 1.0) / C
+        weights = fedsolve.shard_dedup(lags, np.ones(P, bool), scale)
+        A, B = fedsolve.initial_duals(C)
+        ss, spread = 1.0, float("inf")
+        for _ in range(60):
+            load, col = fedsolve.shard_marginals(*weights, A, B)
+            A, B, ss, spread, delta = fedsolve.dual_step(
+                A, B, load, col, P * cap_frac, ss, spread
+            )
+            if delta <= fedsolve.DUAL_TOL:
+                break
+        choice, counts, _ = fedsolve.round_local_shard(
+            lags, C, A, B, scale, np.zeros(C, np.float32),
+            capacity_frac=cap_frac,
+        )
+        np.testing.assert_array_equal(
+            counts, fedsolve.apportion_counts(P, cap_frac)
+        )
+        totals = np.bincount(choice, weights=lags, minlength=C)
+        assert totals.max() / totals.mean() <= 1.35
+
+    def test_config_capacity_knob(self):
+        from kafka_lag_based_assignor_tpu.utils.config import (
+            parse_config,
+        )
+
+        cfg = parse_config({
+            "group.id": "g",
+            "tpu.assignor.federation.capacity": "3,1,1,1",
+        })
+        assert cfg.federation_capacity == [3.0, 1.0, 1.0, 1.0]
+        with pytest.raises(ValueError, match="capacity"):
+            parse_config({
+                "group.id": "g",
+                "tpu.assignor.federation.capacity": "3,zero",
+            })
+        with pytest.raises(ValueError, match="> 0"):
+            parse_config({
+                "group.id": "g",
+                "tpu.assignor.federation.capacity": "3,-1",
+            })
+
+    def test_two_sidecars_converge_weighted_counts(self):
+        """End-to-end: both sidecars advertise a 3x-capacity first
+        consumer through the audited hello handshake; the converged
+        GLOBAL assignment seats capacity-proportional counts on each
+        local shard (and the payloads stay lag-free)."""
+        ports = _free_ports(2)
+        ids = ("wa", "wb")
+        svcs = []
+        for i in range(2):
+            j = 1 - i
+            svc = AssignorService(
+                port=ports[i],
+                coalesce_max_batch=1,
+                scrub_interval_ms=0,
+                federation_self_id=ids[i],
+                federation_peers=f"{ids[j]}=127.0.0.1:{ports[j]}",
+                federation_rounds=8,
+                federation_sync_timeout_s=60.0,
+                federation_capacity=[3.0, 1.0, 1.0, 1.0],
+            )
+            svc.start()
+            svcs.append(svc)
+        try:
+            clients = [
+                AssignorServiceClient("127.0.0.1", p, timeout_s=180.0)
+                for p in ports
+            ]
+            shards = {ids[0]: _shard(51), ids[1]: _shard(52)}
+            # Register both shards, then a converged pass.
+            for sid, cl in zip(ids, clients):
+                cl.federated_assign(
+                    "t0", _rows(shards[sid]), MEMBERS
+                )
+            r = clients[0].federated_assign(
+                "t0", _rows(shards[ids[0]]), MEMBERS
+            )
+            assert r["federation"]["rung"] == "global"
+            sizes = np.array(
+                [len(r["assignments"][m]) for m in MEMBERS]
+            )
+            # Summed capacity [6,2,2,2] -> frac [.5,1/6,1/6,1/6]:
+            # the local shard's seats follow the apportionment.
+            target = fedsolve.apportion_counts(
+                SHARD_P, np.array([0.5, 1 / 6, 1 / 6, 1 / 6])
+            )
+            np.testing.assert_array_equal(np.sort(sizes)[::-1][:1],
+                                          np.sort(target)[::-1][:1])
+            assert sizes[0] == target[0]
+            assert abs(int(sizes.sum()) - SHARD_P) == 0
+            for cl in clients:
+                cl.close()
+        finally:
+            for s in svcs:
+                s.stop()
+
+
+class TestCapacityHygiene:
+    """Review fixes: a peer's NaN/negative capacity never reaches the
+    summed count marginal (dropped to uniform + counted), the wire
+    audit rejects it at construction, and per-shard vectors are
+    normalized so the aggregation is scale-invariant."""
+
+    def test_wire_rejects_nonfinite_and_nonpositive(self):
+        for bad in ([float("nan"), 1, 1, 1], [-1.0, 1, 1, 1],
+                    [0.0, 1, 1, 1]):
+            with pytest.raises(
+                wire.PayloadViolation, match="finite and > 0"
+            ):
+                wire.sync_response(
+                    "a", 1, 0, C, total_lag=1, n_valid=4,
+                    capacity=bad,
+                )
+
+    def test_capacity_usable(self):
+        assert wire.capacity_usable([1.0, 2.0])
+        assert not wire.capacity_usable([1.0, float("inf")])
+        assert not wire.capacity_usable([1.0, float("nan")])
+        assert not wire.capacity_usable([1.0, 0.0])
+        assert not wire.capacity_usable([1.0, -2.0])
+
+    def test_scale_invariant_aggregation(self):
+        """Two initiators whose shards express the SAME capacity
+        ratios in different units must produce the same cap vector:
+        the per-shard normalization (each vector scaled to sum C)
+        makes the hello-phase sum unit-free."""
+        coord = FederationCoordinator(
+            self_id="s", peers=[], capacity=[1000.0, 1000.0, 500.0,
+                                             500.0],
+        )
+        small = FederationCoordinator(
+            self_id="s2", peers=[], capacity=[2.0, 2.0, 1.0, 1.0],
+        )
+        a = np.asarray(coord._capacity_for(C), np.float64)
+        b = np.asarray(small._capacity_for(C), np.float64)
+        np.testing.assert_allclose(
+            a * (C / a.sum()), b * (C / b.sum())
+        )

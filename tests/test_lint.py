@@ -532,3 +532,59 @@ def test_l019_peer_payload_confined_to_wire():
     assert not any(
         f.code == "L019" for f in lint.lint_source(peers_mod, waived)
     )
+
+
+def test_l020_mesh_construction_confined_to_sharded():
+    """L020: Mesh/NamedSharding/shard_map/make_mesh construction is
+    confined to the sharded/ subsystem — package code elsewhere is
+    flagged; sharded/ modules, tests, and tools are exempt; noqa
+    waives."""
+    ops_mod = Path("kafka_lag_based_assignor_tpu/ops/streaming.py")
+    sharded_mod = Path(
+        "kafka_lag_based_assignor_tpu/sharded/megabatch.py"
+    )
+
+    src = (
+        "from jax.sharding import Mesh\n"
+        "def build(devices):\n"
+        "    return Mesh(devices, ('p',))\n"
+    )
+    assert any(
+        f.code == "L020" for f in lint.lint_source(ops_mod, src)
+    )
+    assert not any(
+        f.code == "L020" for f in lint.lint_source(sharded_mod, src)
+    )
+    assert not any(
+        f.code == "L020"
+        for f in lint.lint_source(Path("tests/x.py"), src)
+    )
+
+    sharded_call = (
+        "def place(mesh, a, spec):\n"
+        "    import jax\n"
+        "    from jax.sharding import NamedSharding\n"
+        "    return jax.device_put(a, NamedSharding(mesh, spec))\n"
+    )
+    assert any(
+        f.code == "L020"
+        for f in lint.lint_source(ops_mod, sharded_call)
+    )
+
+    waived = (
+        "from jax.sharding import Mesh\n"
+        "def build(devices):\n"
+        "    return Mesh(devices, ('p',))  # noqa: L020\n"
+    )
+    assert not any(
+        f.code == "L020" for f in lint.lint_source(ops_mod, waived)
+    )
+
+    # The whole production tree is clean (the real gate).
+    root = Path(lint.__file__).resolve().parent.parent
+    findings = [
+        f
+        for f in lint.lint_paths(iter(lint.repo_python_files(root)))
+        if f.code == "L020"
+    ]
+    assert findings == []

@@ -1,0 +1,633 @@
+"""Mesh-native sharded backend tests (sharded/): the mesh manager's
+discover/validate/degrade lifecycle, the P-axis-sharded solve's
+differential fuzz against the single-device kernels (mesh-1 BIT parity,
+sizes 2-8 count-balance + quality gates, zero warm-loop compiles), the
+stream-axis-sharded megabatch's round-10 invariants (locked zero
+re-stack steady state, churn invalidates exactly once, per-row digest
+quarantine), and the ``mesh.collective`` degradation ladder — all on
+the virtual 8-device CPU mesh tests/conftest.py forces."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from kafka_lag_based_assignor_tpu.ops.coalesce import MegabatchCoalescer
+from kafka_lag_based_assignor_tpu.ops.refine import refine_assignment
+from kafka_lag_based_assignor_tpu.ops.streaming import StreamingAssignor
+from kafka_lag_based_assignor_tpu.sharded import mesh as mesh_mod
+from kafka_lag_based_assignor_tpu.sharded.solve import (
+    plan_stats_sharded,
+    refine_sharded,
+    seed_reference,
+    solve_sharded,
+)
+from kafka_lag_based_assignor_tpu.utils import faults, metrics
+from kafka_lag_based_assignor_tpu.utils.observability import (
+    compile_count,
+    count_constrained_bound,
+    install_compile_counter,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8,
+    reason="virtual 8-device CPU mesh unavailable",
+)
+
+
+def _mesh(D):
+    from jax.sharding import Mesh
+
+    return Mesh(jax.devices()[:D], (mesh_mod.SOLVE_AXIS,))
+
+
+def _manager(**kw):
+    kw.setdefault("devices", "auto")
+    kw.setdefault("solve_min_rows", 256)
+    return mesh_mod.MeshManager(**kw).configure()
+
+
+def _quality(choice, lags, C):
+    totals = np.bincount(choice, weights=lags, minlength=C)
+    mean = totals.mean()
+    imb = float(totals.max() / mean) if mean else 1.0
+    return imb / max(count_constrained_bound(lags, C), 1.0)
+
+
+def _assert_valid(choice, P, C):
+    assert choice.shape == (P,)
+    assert choice.min() >= 0 and choice.max() < C
+    counts = np.bincount(choice, minlength=C)
+    assert counts.max() - counts.min() <= 1
+    return counts
+
+
+@pytest.fixture(autouse=True)
+def _no_global_manager():
+    """No leftover active manager (other suites must keep their
+    single-device behavior)."""
+    faults.deactivate()
+    mesh_mod.deactivate()
+    yield
+    faults.deactivate()
+    mesh_mod.deactivate()
+
+
+# -- mesh manager -----------------------------------------------------------
+
+
+class TestMeshManager:
+    def test_spec_parsing(self):
+        assert mesh_mod._parse_spec("off") == "off"
+        assert mesh_mod._parse_spec(None) == "off"
+        assert mesh_mod._parse_spec(0) == "off"
+        assert mesh_mod._parse_spec("auto") == "auto"
+        assert mesh_mod._parse_spec("4") == 4
+        with pytest.raises(ValueError, match="invalid"):
+            mesh_mod._parse_spec("many")
+        with pytest.raises(ValueError, match=">= 1"):
+            mesh_mod._parse_spec(-2)
+
+    def test_configure_auto_and_fixed(self):
+        mgr = _manager()
+        assert mgr.active and mgr.size == 8
+        assert mgr.solve_mesh().shape[mesh_mod.SOLVE_AXIS] == 8
+        assert mgr.streams_mesh().shape[mesh_mod.STREAMS_AXIS] == 8
+        fixed = _manager(devices=4)
+        assert fixed.active and fixed.size == 4
+
+    def test_missing_devices_degrades_not_raises(self):
+        mgr = mesh_mod.MeshManager(devices=64).configure()
+        assert not mgr.active
+        assert mgr.status()["degraded"] == "missing_devices"
+
+    def test_off_is_inert(self):
+        mgr = mesh_mod.MeshManager(devices="off").configure()
+        assert not mgr.active and mgr.size == 0
+        with pytest.raises(RuntimeError, match="not active"):
+            mgr.solve_mesh()
+
+    def test_degrade_restore_cycle(self):
+        mgr = _manager()
+        before = metrics.REGISTRY.counter(
+            "klba_mesh_degraded_total", {"reason": "collective"}
+        ).value
+        inj = faults.FaultInjector(3).plan("mesh.collective", times=1)
+        with faults.injected(inj):
+            with pytest.raises(mesh_mod.MeshCollectiveError):
+                mgr.check_collective()
+        assert not mgr.active
+        assert metrics.REGISTRY.counter(
+            "klba_mesh_degraded_total", {"reason": "collective"}
+        ).value == before + 1
+        # Operator-driven re-arm (never automatic).
+        assert mgr.restore().active
+
+    def test_should_shard_solve_floor(self):
+        mgr = _manager(solve_min_rows=1024)
+        assert mgr.should_shard_solve(1024)
+        assert not mgr.should_shard_solve(1023)
+        mgr.degrade("test")
+        assert not mgr.should_shard_solve(1 << 20)
+
+    def test_activate_scoping(self):
+        mgr = _manager()
+        with mesh_mod.managed(mgr):
+            assert mesh_mod.active_manager() is mgr
+        assert mesh_mod.active_manager() is None
+        # deactivate(other) must not clobber a different install.
+        mesh_mod.activate(mgr)
+        mesh_mod.deactivate(_manager())
+        assert mesh_mod.active_manager() is mgr
+
+
+# -- P-sharded solve: differential fuzz ------------------------------------
+
+
+class TestShardedSolve:
+    def test_mesh1_refine_bit_parity_fuzz(self):
+        """The sharded refine on a 1-device mesh is BIT-identical to
+        ops/refine.refine_assignment — same quantized scoring, same
+        winner selection, identity all-reduces."""
+        P, C = 4096, 16
+        mesh = _mesh(1)
+        for seed in range(4):
+            rng = np.random.default_rng(seed)
+            lags = rng.integers(0, 10**9, P).astype(np.int64)
+            valid = np.ones(P, bool)
+            start = seed_reference(lags, C)
+            ch_s, cnt_s, tot_s, _ = refine_sharded(
+                mesh, lags, valid, start, C, iters=16
+            )
+            ch_r, cnt_r, tot_r = refine_assignment(
+                lags, valid, start, num_consumers=C, iters=16
+            )
+            np.testing.assert_array_equal(ch_s, np.asarray(ch_r))
+            np.testing.assert_array_equal(cnt_s, np.asarray(cnt_r))
+            np.testing.assert_array_equal(tot_s, np.asarray(tot_r))
+
+    def test_mesh1_solve_bit_parity_with_host_twin(self):
+        """Full mesh-1 solve == host seed twin + the oracle refine
+        (the single-device path of the same pipeline)."""
+        P, C = 4096, 16
+        rng = np.random.default_rng(9)
+        lags = rng.integers(0, 10**9, P).astype(np.int64)
+        ch, cnt, tot, _ = solve_sharded(_mesh(1), lags, C, refine_iters=32)
+        twin, _, _ = refine_assignment(
+            lags, np.ones(P, bool), seed_reference(lags, C),
+            num_consumers=C, iters=32,
+        )
+        np.testing.assert_array_equal(ch, np.asarray(twin))
+
+    @pytest.mark.parametrize("D", [1, 2, 4, 8])
+    def test_differential_fuzz_all_mesh_sizes(self, D):
+        """Same seeded lag sequences through every mesh size: valid
+        count-balanced assignments, quality within tolerance of the
+        input-driven bound, replicated counts/totals agreeing with the
+        host recomputation."""
+        P, C = 4096, 16
+        mesh = _mesh(D)
+        for seed in (0, 1, 2):
+            rng = np.random.default_rng(seed)
+            # Skewed lags: uniform + a heavy zipf-ish head.
+            lags = rng.integers(0, 10**6, P).astype(np.int64)
+            lags[: P // 64] *= rng.integers(10, 1000, P // 64)
+            ch, cnt, tot, rounds = solve_sharded(
+                mesh, lags, C, refine_iters=64
+            )
+            counts = _assert_valid(ch, P, C)
+            np.testing.assert_array_equal(cnt, counts)
+            np.testing.assert_array_equal(
+                tot,
+                np.bincount(ch, weights=lags, minlength=C).astype(
+                    np.int64
+                ),
+            )
+            assert _quality(ch, lags, C) <= 1.1, (D, seed)
+
+    def test_unaligned_p_pads_and_stays_valid(self):
+        P, C = 1000, 8
+        rng = np.random.default_rng(4)
+        lags = rng.integers(0, 10**9, P).astype(np.int64)
+        ch, cnt, _, _ = solve_sharded(_mesh(8), lags, C, refine_iters=32)
+        counts = _assert_valid(ch, P, C)
+        np.testing.assert_array_equal(cnt, counts)
+
+    def test_quality_tracks_single_device_cold(self):
+        """The sharded solve's quality stays within 10% of the
+        single-device cold chain's at the same budget."""
+        P, C = 8192, 16
+        rng = np.random.default_rng(11)
+        lags = rng.integers(0, 10**9, P).astype(np.int64)
+        eng = StreamingAssignor(num_consumers=C)
+        single = eng.rebalance(lags)
+        ch, _, _, _ = solve_sharded(_mesh(8), lags, C, refine_iters=64)
+        assert _quality(ch, lags, C) <= max(
+            1.1, 1.1 * _quality(np.asarray(single), lags, C)
+        )
+
+    def test_zero_warm_loop_compiles(self):
+        install_compile_counter()
+        P, C = 2048, 8
+        rng = np.random.default_rng(5)
+        mesh = _mesh(8)
+        solve_sharded(
+            mesh, rng.integers(0, 10**9, P).astype(np.int64), C,
+            refine_iters=32,
+        )
+        before = compile_count()
+        for _ in range(4):
+            solve_sharded(
+                mesh, rng.integers(0, 10**9, P).astype(np.int64), C,
+                refine_iters=32,
+            )
+        assert compile_count() == before
+
+    def test_plan_stats_sharded_matches_host(self):
+        P, C = 2048, 8
+        rng = np.random.default_rng(6)
+        lags = rng.integers(0, 10**9, P).astype(np.int64)
+        choice = rng.integers(0, C, P).astype(np.int32)
+        valid = np.ones(P, bool)
+        tot, cnt = plan_stats_sharded(_mesh(8), lags, valid, choice, C)
+        np.testing.assert_array_equal(
+            tot,
+            np.bincount(choice, weights=lags, minlength=C).astype(
+                np.int64
+            ),
+        )
+        np.testing.assert_array_equal(
+            cnt, np.bincount(choice, minlength=C)
+        )
+
+    def test_refine_sharded_rejects_indivisible_length(self):
+        with pytest.raises(ValueError, match="must divide"):
+            refine_sharded(
+                _mesh(8), np.ones(1001, np.int64), np.ones(1001, bool),
+                np.zeros(1001, np.int32), 4,
+            )
+
+
+# -- streaming cold hook (ops/dispatch backend selection) -------------------
+
+
+class TestStreamingColdHook:
+    def test_cold_solve_routes_sharded_and_warm_loop_continues(self):
+        P, C = 2048, 8
+        rng = np.random.default_rng(7)
+        with mesh_mod.managed(_manager(solve_min_rows=1024)):
+            eng = StreamingAssignor(num_consumers=C, refine_iters=128)
+            lags = rng.integers(0, 10**9, P).astype(np.int64)
+            ch = eng.rebalance(lags)
+            assert eng.last_stats.cold_start
+            assert eng.last_stats.sharded_solve
+            _assert_valid(np.asarray(ch), P, C)
+            # The warm loop stays on the single/stream-sharded path:
+            # drifted lags refine from the sharded cold's choice.
+            drift = lags.copy()
+            drift[:100] += rng.integers(1, 10**8, 100)
+            ch2 = eng.rebalance(drift)
+            assert not eng.last_stats.cold_start
+            _assert_valid(np.asarray(ch2), P, C)
+
+    def test_below_floor_stays_single_device(self):
+        with mesh_mod.managed(_manager(solve_min_rows=1 << 20)):
+            eng = StreamingAssignor(num_consumers=4)
+            eng.rebalance(np.arange(256, dtype=np.int64))
+            assert not eng.last_stats.sharded_solve
+
+    def test_collective_fault_degrades_to_single_device(self):
+        P, C = 2048, 8
+        rng = np.random.default_rng(8)
+        mgr = _manager(solve_min_rows=1024)
+        with mesh_mod.managed(mgr):
+            eng = StreamingAssignor(num_consumers=C)
+            inj = faults.FaultInjector(1).plan(
+                "mesh.collective", times=1
+            )
+            with faults.injected(inj):
+                ch = eng.rebalance(
+                    rng.integers(0, 10**9, P).astype(np.int64)
+                )
+            # Served VALID through the single-device backend, manager
+            # degraded for the fleet.
+            assert not eng.last_stats.sharded_solve
+            _assert_valid(np.asarray(ch), P, C)
+            assert not mgr.active
+
+
+# -- stream-sharded megabatch ----------------------------------------------
+
+
+N_STREAMS = 8
+MB_P, MB_C = 512, 8
+
+
+def _engines(n=N_STREAMS, seed=0, **kw):
+    rng = np.random.default_rng(seed)
+    kw.setdefault("refine_iters", 64)
+    kw.setdefault("refine_threshold", None)
+    engines = [
+        StreamingAssignor(num_consumers=MB_C, **kw) for _ in range(n)
+    ]
+    for e in engines:
+        e.rebalance(rng.integers(0, 1000, MB_P).astype(np.int64))
+    return engines, rng
+
+
+def _wave(engines, coal, rng, perturb=None):
+    arrs = [
+        rng.integers(0, 1000, MB_P).astype(np.int64)
+        if perturb is None else perturb(i)
+        for i in range(len(engines))
+    ]
+    outs = [None] * len(engines)
+    errs = []
+
+    def run(i):
+        try:
+            outs[i] = engines[i].submit_epoch(arrs[i], coal)
+        except Exception as exc:  # noqa: BLE001 — asserted by callers
+            errs.append((i, exc))
+
+    threads = [
+        threading.Thread(target=run, args=(i,))
+        for i in range(len(engines))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return outs, errs
+
+
+def _locked_batch(coal):
+    with coal._roster_lock:
+        batches = [
+            r.batch for r in coal._rosters.values() if r.batch is not None
+        ]
+    assert len(batches) == 1
+    return batches[0]
+
+
+class TestStreamShardedMegabatch:
+    def test_locks_sharded_and_zero_steady_state_compiles(self):
+        install_compile_counter()
+        mgr = _manager(solve_min_rows=1 << 20)
+        with mesh_mod.managed(mgr):
+            engines, rng = _engines(seed=1)
+            coal = MegabatchCoalescer(
+                window_s=2.0, max_batch=N_STREAMS, lock_waves=1,
+                mesh_manager=mgr,
+            )
+            try:
+                outs, errs = _wave(engines, coal, rng)  # re-stack + lock
+                assert not errs
+                batch = _locked_batch(coal)
+                assert batch.mesh is not None
+                assert coal.stats()["stream_sharded_rosters"] == 1
+                _wave(engines, coal, rng)  # first sharded locked wave
+                before = compile_count()
+                for _ in range(3):
+                    outs, errs = _wave(engines, coal, rng)
+                    assert not errs
+                    for o in outs:
+                        _assert_valid(np.asarray(o), MB_P, MB_C)
+                assert compile_count() == before
+                # Donation held: the batch adopted sharded successors.
+                assert _locked_batch(coal).mesh is not None
+            finally:
+                coal.close()
+
+    def test_churn_invalidates_exactly_once_then_relocks_sharded(self):
+        mgr = _manager(solve_min_rows=1 << 20)
+        with mesh_mod.managed(mgr):
+            engines, rng = _engines(seed=2)
+            coal = MegabatchCoalescer(
+                window_s=2.0, max_batch=N_STREAMS, lock_waves=1,
+                mesh_manager=mgr,
+            )
+            try:
+                _wave(engines, coal, rng)
+                _wave(engines, coal, rng)
+                inv0 = coal.stats()["roster_invalidations"]
+                # One stream's state goes stale (seed_choice) — the
+                # churn wave re-stacks, invalidating EXACTLY once.
+                engines[0].seed_choice(
+                    np.asarray(
+                        engines[0]._prev_choice, dtype=np.int32
+                    )
+                )
+                outs, errs = _wave(engines, coal, rng)
+                assert not errs
+                assert (
+                    coal.stats()["roster_invalidations"] == inv0 + 1
+                )
+                # The next stable wave re-locks onto the sharded
+                # placement.
+                _wave(engines, coal, rng)
+                assert _locked_batch(coal).mesh is not None
+            finally:
+                coal.close()
+
+    def test_collective_fault_serves_single_fallback_and_degrades(self):
+        mgr = _manager(solve_min_rows=1 << 20)
+        with mesh_mod.managed(mgr):
+            engines, rng = _engines(seed=3)
+            coal = MegabatchCoalescer(
+                window_s=2.0, max_batch=N_STREAMS, lock_waves=1,
+                mesh_manager=mgr,
+            )
+            try:
+                _wave(engines, coal, rng)
+                assert _locked_batch(coal).mesh is not None
+                inj = faults.FaultInjector(5).plan(
+                    "mesh.collective", times=1
+                )
+                with faults.injected(inj):
+                    outs, errs = _wave(engines, coal, rng)
+                # NO invalid assignment served: every row resolved
+                # through the single-stream fallback.
+                assert not errs
+                for o in outs:
+                    _assert_valid(np.asarray(o), MB_P, MB_C)
+                assert inj.fired("mesh.collective") == 1
+                assert not mgr.active
+                # Later waves re-lock on the single-device placement.
+                _wave(engines, coal, rng)
+                _wave(engines, coal, rng)
+                assert _locked_batch(coal).mesh is None
+            finally:
+                coal.close()
+
+    def test_corrupt_locked_row_quarantines_and_heals(self):
+        """device.corrupt.choice on a stream-SHARDED locked row: the
+        next wave's per-row digest detects it, the row's future fails
+        with CorruptStateDetected, the roster is evicted exactly once,
+        and the healed re-stack serves valid answers again."""
+        from kafka_lag_based_assignor_tpu.utils.scrub import (
+            CorruptStateDetected,
+        )
+
+        mgr = _manager(solve_min_rows=1 << 20)
+        with mesh_mod.managed(mgr):
+            engines, rng = _engines(seed=4)
+            coal = MegabatchCoalescer(
+                window_s=2.0, max_batch=N_STREAMS, lock_waves=1,
+                mesh_manager=mgr,
+            )
+            try:
+                _wave(engines, coal, rng)
+                assert _locked_batch(coal).mesh is not None
+                inj = faults.FaultInjector(11).plan(
+                    "device.corrupt.choice", times=1
+                )
+                with faults.injected(inj):
+                    # Wave A adopts successors then corrupts one row at
+                    # the readback boundary.
+                    outs, errs = _wave(engines, coal, rng)
+                    assert not errs
+                    # Wave B's input-side digest catches the flip on
+                    # exactly one stream; the rest serve normally.
+                    outs, errs = _wave(engines, coal, rng)
+                assert inj.fired("device.corrupt.choice") == 1
+                assert len(errs) in (1, 2)
+                for _, exc in errs:
+                    assert isinstance(exc, CorruptStateDetected)
+                for i, o in enumerate(outs):
+                    if o is not None:
+                        _assert_valid(np.asarray(o), MB_P, MB_C)
+                # Quarantined engines heal on the next wave (rebuilt
+                # from host truth), and the roster re-locks.
+                outs, errs = _wave(engines, coal, rng)
+                assert not errs
+                for o in outs:
+                    _assert_valid(np.asarray(o), MB_P, MB_C)
+            finally:
+                coal.close()
+
+
+# -- service integration ----------------------------------------------------
+
+
+class TestServiceMesh:
+    def test_service_stats_and_sharded_cold(self):
+        from kafka_lag_based_assignor_tpu.service import (
+            AssignorService,
+            AssignorServiceClient,
+        )
+
+        svc = AssignorService(
+            port=0, coalesce_max_batch=1, scrub_interval_ms=0,
+            mesh_devices="auto", mesh_solve_min_rows=512,
+        ).start()
+        try:
+            with AssignorServiceClient(
+                *svc.address, timeout_s=180.0
+            ) as c:
+                stats = c.request("stats")
+                assert stats["mesh"] == {
+                    "spec": "auto", "configured": True, "active": True,
+                    "devices": 8, "degraded": None,
+                    "solve_min_rows": 512,
+                }
+                rng = np.random.default_rng(13)
+                lags = [
+                    [p, int(v)] for p, v in enumerate(
+                        rng.integers(0, 10**6, 1024)
+                    )
+                ]
+                r = c.stream_assign(
+                    "s-mesh", "t0", lags, ["a", "b", "c", "d"]
+                )
+                assert r["stream"]["sharded_solve"] is True
+                assert r["stream"]["cold_start"] is True
+                sizes = [
+                    len(v) for v in r["assignments"].values()
+                ]
+                assert max(sizes) - min(sizes) <= 1
+        finally:
+            svc.stop()
+        assert mesh_mod.active_manager() is None  # stop() uninstalls
+
+    def test_service_mesh_off_by_default(self):
+        from kafka_lag_based_assignor_tpu.service import (
+            AssignorService,
+            AssignorServiceClient,
+        )
+
+        svc = AssignorService(
+            port=0, coalesce_max_batch=1, scrub_interval_ms=0
+        ).start()
+        try:
+            with AssignorServiceClient(*svc.address) as c:
+                assert c.request("stats")["mesh"] is None
+        finally:
+            svc.stop()
+
+    def test_config_knobs(self):
+        from kafka_lag_based_assignor_tpu.utils.config import (
+            parse_config,
+        )
+
+        cfg = parse_config({
+            "group.id": "g",
+            "tpu.assignor.mesh.devices": "auto",
+            "tpu.assignor.mesh.solve.min.rows": "2048",
+        })
+        assert cfg.mesh_devices == "auto"
+        assert cfg.mesh_solve_min_rows == 2048
+        assert parse_config({"group.id": "g"}).mesh_devices == "off"
+        with pytest.raises(ValueError, match="mesh.devices"):
+            parse_config({
+                "group.id": "g",
+                "tpu.assignor.mesh.devices": "lots",
+            })
+
+
+class TestMeshOffConfinement:
+    """An instance configured OFF must never adopt a co-resident
+    instance's globally activated mesh (the in-process standby /
+    multi-sidecar topologies): explicit ``None`` pins both the engine
+    cold hook and the coalescer single-device; only the ``"auto"``
+    default follows the global manager."""
+
+    def test_engine_pinned_off_ignores_global_manager(self):
+        with mesh_mod.managed(_manager(solve_min_rows=256)):
+            eng = StreamingAssignor(
+                num_consumers=8, mesh_backend=None
+            )
+            eng.rebalance(
+                np.random.default_rng(0).integers(
+                    0, 10**6, 2048
+                ).astype(np.int64)
+            )
+            assert not eng.last_stats.sharded_solve
+
+    def test_engine_pinned_to_explicit_manager(self):
+        mgr = _manager(solve_min_rows=256)
+        # NOT globally activated — the explicit pin alone selects it.
+        eng = StreamingAssignor(num_consumers=8, mesh_backend=mgr)
+        eng.rebalance(
+            np.random.default_rng(1).integers(
+                0, 10**6, 2048
+            ).astype(np.int64)
+        )
+        assert eng.last_stats.sharded_solve
+
+    def test_coalescer_pinned_off_ignores_global_manager(self):
+        with mesh_mod.managed(_manager(solve_min_rows=1 << 20)):
+            coal = MegabatchCoalescer(
+                window_s=0.001, max_batch=8, mesh_manager=None
+            )
+            try:
+                assert coal._stream_mesh(8) is None
+            finally:
+                coal.close()
+            auto = MegabatchCoalescer(window_s=0.001, max_batch=8)
+            try:
+                assert auto._stream_mesh(8) is not None
+            finally:
+                auto.close()

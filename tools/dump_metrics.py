@@ -71,6 +71,7 @@ def main() -> int:
             lifecycle = stats.get("lifecycle")
             scrub = stats.get("scrub")
             federation = stats.get("federation")
+            mesh = stats.get("mesh")
     except OSError as exc:
         print(
             f"cannot reach sidecar at {args.host}:{args.port}: {exc}",
@@ -154,6 +155,39 @@ def main() -> int:
                 f"{int(outcomes.get('fallback', 0))} fallback / "
                 f"{int(outcomes.get('resync', 0))} resync)"
             )
+        # Adaptive-delta view (ROADMAP delta follow-on (b)): the
+        # last effective delta/dense cutoff any stream applied — a
+        # value pinned to the configured max.fraction means the
+        # adaptive window has not diverged from the global knob.
+        eff = js.get("klba_delta_effective_fraction", {}).get(
+            "series", []
+        )
+        if eff:
+            print(
+                f"delta effective max.fraction {eff[0]['value']:.4f} "
+                "(adaptive per-stream cutoff, last writer)"
+            )
+
+        # Multi-device mesh view (DEPLOYMENT.md "Multi-device
+        # sharding"): topology, health, and sharded-dispatch volume —
+        # the "is the sharded backend actually serving" look.
+        if mesh:
+            state = (
+                "ACTIVE" if mesh.get("active")
+                else f"degraded ({mesh.get('degraded')})"
+                if mesh.get("degraded") else "inactive"
+            )
+            print(
+                f"mesh: {state}, {mesh.get('devices', 0)} device(s) "
+                f"(spec {mesh.get('spec')}, solve floor "
+                f"{mesh.get('solve_min_rows')} rows)"
+            )
+            sharded = by_label("klba_sharded_dispatch_total", "path")
+            if sharded:
+                rows = ", ".join(
+                    f"{k}={int(v)}" for k, v in sorted(sharded.items())
+                )
+                print(f"sharded dispatches: {rows}")
         for s in js.get("klba_span_duration_ms", {}).get("series", []):
             span = s["labels"].get("span", "")
             if span.startswith("coalesce.") and span != "coalesce.window":

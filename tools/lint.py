@@ -104,6 +104,16 @@ Rules:
         ``federated/`` package outside wire.py (serialization that
         bypasses the audit).  Waivable with ``# noqa: L019`` stating
         why the payload is not peer-bound.
+  L020  mesh/shard_map construction outside the sharded subsystem:
+        ``Mesh(...)`` / ``NamedSharding(...)`` / ``shard_map(...)`` /
+        ``make_mesh(...)`` calls in package code outside
+        ``kafka_lag_based_assignor_tpu/sharded/`` — every multi-device
+        topology decision (axis names, placement, degradation) lives
+        in the sharded/ backend and is selected through ops/dispatch,
+        so a stray mesh in a side module cannot drift from the mesh
+        manager's validate/degrade lifecycle (the dead-end the old
+        ``parallel/`` module was).  Waivable with ``# noqa: L020``
+        stating why the construction cannot live in sharded/.
 """
 
 from __future__ import annotations
@@ -545,6 +555,39 @@ def _l019_findings(
     return findings
 
 
+#: L020: the mesh-construction entry points confined to sharded/.
+_L020_MESH_CTORS = frozenset(
+    {"Mesh", "NamedSharding", "shard_map", "make_mesh"}
+)
+
+
+def _l020_findings(
+    rel: str, tree: ast.AST, lines: List[str]
+) -> List[Finding]:
+    """Mesh-topology audit (docstring rule L020): mesh/shard_map
+    construction calls in package code outside the sharded/ package."""
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _call_name(node) not in _L020_MESH_CTORS:
+            continue
+        if "noqa: L020" in lines[node.lineno - 1]:
+            continue
+        findings.append(
+            Finding(
+                rel,
+                node.lineno,
+                "L020",
+                f"mesh construction ({_call_name(node)}) outside the "
+                "sharded/ subsystem: topology decisions live in "
+                "kafka_lag_based_assignor_tpu/sharded (selected via "
+                "ops/dispatch) — or waive with `# noqa: L020`",
+            )
+        )
+    return findings
+
+
 _UNBOUNDED_QUEUE_TYPES = ("Queue", "LifoQueue", "PriorityQueue")
 
 
@@ -724,6 +767,10 @@ def lint_source(path: Path, source: str) -> List[Finding]:
         findings.extend(
             _l019_findings(rel, tree, lines, in_federated=in_federated)
         )
+    # L020 applies to package code OUTSIDE the sharded/ subsystem (the
+    # one home for mesh topology construction).
+    if is_package and "sharded" not in path.parts:
+        findings.extend(_l020_findings(rel, tree, lines))
     # L017 applies to package code OUTSIDE utils/snapshot.py (the
     # backend layer owns the raw atomic write; everyone else must go
     # through a SnapshotBackend so fencing polices the write).
