@@ -2614,6 +2614,204 @@ def config13_sharded():
     return out
 
 
+def config14_linear():
+    """Linear-OT quality-mode probe (ISSUE 14): the O(P + C) mirror-prox
+    solve (ops/linear_ot) against the dense Sinkhorn path.  What must
+    hold (gated in main):
+
+    * **quality** — at the parity shape the linear mode's
+      quality_ratio is <= 1.05x the dense Sinkhorn solve's;
+    * **memory** — the linear solve's peak device memory does NOT
+      scale with P*C: a live-buffer census + the module's analytic
+      working-set estimate everywhere (XLA:CPU reports no allocator
+      stats; a committed [P, C] plan would still surface as a live
+      buffer), with ``jax.local_devices()[0].memory_stats()`` growth
+      deltas folded in where the backend exposes them (the raw
+      lifetime peak is process-wide and not attributable to one
+      solve).  Gate: peak < 1/8 of the [P, C] f32 block at the large
+      shape, and the large shape's peak grows sub-P*C from the small
+      one's;
+    * **zero warm compiles** — repeated linear solves at a warmed
+      shape compile nothing;
+    * **additive bound** — every solve's max consumer load holds
+      ``<= total/C + max_lag`` (asserted inside ops/linear_ot; a
+      violation raises and fails the probe).
+
+    When >= 4 devices are visible, the P-sharded composition
+    (sharded/solve.solve_linear_sharded) must return BIT-IDENTICAL
+    assignments to the single-device path (else the part records
+    skipped)."""
+    import time as time_mod
+
+    from kafka_lag_based_assignor_tpu.models.sinkhorn import (
+        assign_topic_sinkhorn,
+    )
+    from kafka_lag_based_assignor_tpu.ops import dispatch as dispatch_mod
+    from kafka_lag_based_assignor_tpu.ops import linear_ot
+    from kafka_lag_based_assignor_tpu.ops.packing import pad_topic_rows
+    from kafka_lag_based_assignor_tpu.utils.observability import (
+        compile_count,
+        install_compile_counter,
+    )
+
+    install_compile_counter()
+    import jax
+
+    out = {"config": "linear_ot_scale"}
+    rng = np.random.default_rng(0x11EA)
+
+    def solve_quality(totals, arr, C):
+        totals = np.asarray(totals)
+        return quality_ratio(
+            imbalance(totals.astype(np.float64)),
+            imbalance_bound(arr, C),
+        )
+
+    # ---- Part A: quality parity vs the dense Sinkhorn solve.
+    P, C = 4096, 64
+    lags = zipf_lags(rng, P)
+    lp, pp, vp = pad_topic_rows(lags)
+    with dispatch_mod.quality_scope("sinkhorn"):
+        _, _, s_tot = assign_topic_sinkhorn(
+            lp, pp, vp, num_consumers=C
+        )
+    with dispatch_mod.quality_scope("linear"):
+        t0 = time_mod.perf_counter()
+        _, _, l_tot = linear_ot.assign_topic_linear(
+            lp, pp, vp, num_consumers=C
+        )
+        linear_ms = (time_mod.perf_counter() - t0) * 1000.0
+    q_sink = solve_quality(s_tot, lags, C)
+    q_lin = solve_quality(l_tot, lags, C)
+    out["parity"] = {
+        "partitions": P,
+        "consumers": C,
+        "quality_ratio_sinkhorn": round(q_sink, 5),
+        "quality_ratio_linear": round(q_lin, 5),
+        "linear_vs_sinkhorn": round(q_lin / max(q_sink, 1e-9), 5),
+        "linear_cold_ms": round(linear_ms, 2),
+    }
+
+    # ---- Part B: memory scaling + zero-warm-compile gates.  Two
+    # shapes a factor of 4 apart in P at fixed C: an O(P*C) peak
+    # would quadruple; the linear peak is dominated by the O(P)
+    # vectors + the fixed (tile, C) block.
+    C2 = 128
+    shapes = [16384, 65536]
+    mem_rows = []
+    dev = jax.local_devices()[0]
+    for Pn in shapes:
+        arr = zipf_lags(rng, Pn)
+        lpn, ppn, vpn = pad_topic_rows(arr)
+        with dispatch_mod.quality_scope("linear"):
+            # Warm the executables, then measure the repeat solves.
+            linear_ot.assign_topic_linear(lpn, ppn, vpn, num_consumers=C2)
+            stats_fn = getattr(dev, "memory_stats", None)
+            base_stats = stats_fn() if callable(stats_fn) else None
+            c0 = compile_count()
+            t0 = time_mod.perf_counter()
+            _, _, tot_n = linear_ot.assign_topic_linear(
+                lpn, ppn, vpn, num_consumers=C2
+            )
+            warm_ms = (time_mod.perf_counter() - t0) * 1000.0
+            warm_compiles = compile_count() - c0
+        info = linear_ot.last_solve_info() or {}
+        pc_bytes = int(lpn.shape[0]) * C2 * 4
+        # Live-buffer census (a materialized [P, C] plan would be a
+        # committed buffer) + the module's analytic working-set
+        # estimate: the attributable, backend-independent gate value.
+        live = max(
+            (int(np.prod(a.shape)) * a.dtype.itemsize
+             for a in jax.live_arrays()),
+            default=0,
+        )
+        peak = max(live, int(info.get("peak_bytes_estimate", 0)))
+        peak_source = "live_buffers+estimate"
+        if base_stats and "peak_bytes_in_use" in base_stats:
+            # Allocator stats where the backend exposes them:
+            # peak_bytes_in_use is a PROCESS-LIFETIME high-water mark
+            # (configs 1-13 already pushed it), so only the growth
+            # since the pre-solve snapshot is attributable to this
+            # solve — fold that delta in, and report the raw peak for
+            # the hardware follow-on (ROADMAP linear-space (a)).
+            raw_peak = int(dev.memory_stats()["peak_bytes_in_use"])
+            delta = raw_peak - int(base_stats["peak_bytes_in_use"])
+            peak = max(peak, delta)
+            peak_source = "memory_stats_delta+live_buffers+estimate"
+        mem_rows.append({
+            "partitions": int(lpn.shape[0]),
+            "consumers": C2,
+            "tiles": info.get("tiles"),
+            "tile": info.get("tile"),
+            "warm_ms": round(warm_ms, 2),
+            "warm_compile_count": int(warm_compiles),
+            "peak_bytes": int(peak),
+            "peak_source": peak_source,
+            "pc_bytes": pc_bytes,
+            "peak_pc_fraction": round(peak / pc_bytes, 4),
+            "quality_ratio": round(
+                solve_quality(tot_n, arr, C2), 5
+            ),
+        })
+    out["scale"] = {
+        "rows": mem_rows,
+        # Sub-P*C growth: with P x4 at fixed C, an O(P*C) peak grows
+        # ~4x; the linear peak's growth is bounded by the O(P) terms.
+        "peak_growth": round(
+            mem_rows[1]["peak_bytes"] / max(mem_rows[0]["peak_bytes"], 1),
+            3,
+        ),
+        "warm_compile_count": sum(
+            r["warm_compile_count"] for r in mem_rows
+        ),
+    }
+
+    # ---- Part C: sharded composition — bit-identical at mesh sizes.
+    n_dev = len(jax.devices())
+    if n_dev < 4:
+        out["sharded"] = {"skipped": (
+            f"{n_dev} device(s) visible; the parity part needs >= 4"
+        )}
+    else:
+        from kafka_lag_based_assignor_tpu.sharded.mesh import MeshManager
+        from kafka_lag_based_assignor_tpu.sharded.solve import (
+            solve_linear_sharded,
+        )
+
+        D = 8 if n_dev >= 8 else 4
+        Pq, Cq = 32768, 64
+        arr = zipf_lags(rng, Pq)
+        lpq, ppq, vpq = pad_topic_rows(arr)
+        with dispatch_mod.quality_scope("linear"):
+            single, _, tot_single = linear_ot.assign_topic_linear(
+                lpq, ppq, vpq, num_consumers=Cq, refine_iters=64
+            )
+            mgr = MeshManager(devices=D, solve_min_rows=1024).configure()
+            t0 = time_mod.perf_counter()
+            sharded_ch, _, tot_sh, _ = solve_linear_sharded(
+                mgr.solve_mesh(), arr, Cq, refine_iters=64
+            )
+            sharded_ms = (time_mod.perf_counter() - t0) * 1000.0
+        out["sharded"] = {
+            "partitions": Pq,
+            "consumers": Cq,
+            "mesh_devices": D,
+            "bit_identical": bool(
+                np.array_equal(
+                    sharded_ch, np.asarray(single)[:Pq]
+                )
+                and np.array_equal(
+                    np.asarray(tot_sh), np.asarray(tot_single)
+                )
+            ),
+            "sharded_ms": round(sharded_ms, 2),
+            "quality_ratio": round(
+                solve_quality(tot_sh, arr, Cq), 5
+            ),
+        }
+    return out
+
+
 def main():
     # A wedged accelerator tunnel must degrade the benchmark, not hang it
     # (the framework's own watchdog philosophy, SURVEY §5 failure row):
@@ -2664,7 +2862,8 @@ def main():
     for fn in (config1_readme, config2_zipf, config3_vmap, config4_skew,
                config5_northstar, config6_multistream, config7_overload,
                config8_restart, config9_delta, config10_handoff,
-               config11_scrub, config12_federated, config13_sharded):
+               config11_scrub, config12_federated, config13_sharded,
+               config14_linear):
         before = klba_metrics.REGISTRY.snapshot()
         r = fn()
         deltas = klba_metrics.histogram_deltas(
@@ -3162,6 +3361,57 @@ def main():
                 "serve valid through the single-device fallback and "
                 "degrade the manager"
             )
+
+    # linear_ot_scale gates (ISSUE 14): quality parity with the dense
+    # Sinkhorn solve, peak device memory NOT scaling with P*C, zero
+    # warm-loop compiles, and — when a mesh was constructible —
+    # bit-identical sharded composition.
+    lo = results.get("linear_ot_scale", {})
+    if lo:
+        pa = lo.get("parity", {})
+        if pa.get("linear_vs_sinkhorn", 99) > 1.05:
+            failures.append(
+                f"linear_ot_scale quality_ratio_linear is "
+                f"{pa.get('linear_vs_sinkhorn')}x the dense Sinkhorn "
+                "solve's (> 1.05x) at the parity shape"
+            )
+        sc = lo.get("scale", {})
+        if sc.get("warm_compile_count", 1) != 0:
+            failures.append(
+                f"linear_ot_scale compiled "
+                f"{sc.get('warm_compile_count')} executable(s) in the "
+                "warm loop — the linear quality mode's jit cache is "
+                "not holding"
+            )
+        # The absolute fraction gate reads the LARGEST shape: at small
+        # P the constant O(tile*C) term legitimately dominates the
+        # tiny [P, C] block; what must never happen is the big shape's
+        # peak tracking P*C.
+        rows = sc.get("rows", [])
+        if rows and rows[-1].get("peak_pc_fraction", 99) > 0.125:
+            row = rows[-1]
+            failures.append(
+                f"linear_ot_scale peak memory at "
+                f"{row.get('partitions')}x{row.get('consumers')} "
+                f"is {row.get('peak_pc_fraction')} of the [P, C] "
+                "f32 block (> 1/8) — the linear mode's peak is "
+                "scaling with P*C"
+            )
+        # P x4 at fixed C: an O(P*C)-proportional peak quadruples;
+        # allow the O(P) terms to quadruple plus slack, but fail the
+        # gate before a full P*C-shaped blow-up reappears.
+        if sc.get("peak_growth", 99) > 4.5:
+            failures.append(
+                f"linear_ot_scale peak_growth {sc.get('peak_growth')} "
+                "> 4.5 across a 4x P step — super-linear memory"
+            )
+        lsh = lo.get("sharded", {})
+        if lsh and not lsh.get("skipped"):
+            if not lsh.get("bit_identical", False):
+                failures.append(
+                    "linear_ot_scale sharded composition is not "
+                    "bit-identical to the single-device linear solve"
+                )
     for msg in failures:
         log(f"bench: REGRESSION GATE FAILED: {msg}")
     sys.exit(1 if failures else 0)

@@ -484,6 +484,26 @@ def assign_topic_sinkhorn(
     _pallas_available()  # resolve kernel choice eagerly, outside the trace
     _require_concrete(lags, valid, "assign_topic_sinkhorn")
     C = int(num_consumers)
+    # Quality-mode selection (ops/dispatch, ``tpu.assignor.quality.mode``):
+    # when the dispatch layer elects the linear-space O(P + C) mode for
+    # this shape — explicitly pinned, or "auto" at row counts where the
+    # dense [U, C] streams stop fitting — the solve is served by
+    # ops/linear_ot under the SAME output contract, so every existing
+    # caller picks it up with no API change.
+    from ..ops.dispatch import resolve_quality_mode
+
+    if resolve_quality_mode(lags.shape[0], C) == "linear":
+        from ..ops.linear_ot import assign_topic_linear
+
+        return assign_topic_linear(
+            lags, partition_ids, valid, num_consumers=C,
+            iters=iters, refine_iters=refine_iters,
+        )
+    from ..utils import metrics
+
+    metrics.REGISTRY.counter(
+        "klba_quality_solve_total", {"mode": "sinkhorn"}
+    ).inc()
     ws_u, count_u, wsum_u = _dedup_weights(
         np.asarray(lags), np.asarray(valid), C
     )
@@ -514,16 +534,34 @@ def _assign_topic_sinkhorn_jit(
     iters: int,
     refine_iters: int,
 ):
+    C = int(num_consumers)
+    A, B = _sinkhorn_duals_jit(
+        ws_u, count_u, wsum_u, num_consumers=C, iters=iters
+    )
+    ws = _scaled_ws(lags, valid, C)
+    return _round_refine_portfolio(
+        lags, partition_ids, valid, ws, A, B,
+        num_consumers=C, refine_iters=refine_iters,
+    )
+
+
+def _round_refine_portfolio(
+    lags, partition_ids, valid, ws, A, B, *,
+    num_consumers: int, refine_iters: int,
+):
+    """Shared rounding + refine + portfolio tail of BOTH quality modes
+    (called inside the Sinkhorn jit above and the linear mode's
+    :func:`..ops.linear_ot._finish_linear_jit`): round the implicit
+    plan described by the ``(A, B)`` duals, refine the more promising
+    start, and never return worse than greedy.  Every buffer here is
+    [P]- or [C, M]-shaped — O(P + C) live memory — which is what lets
+    the linear mode share it unchanged."""
     from ..ops.rounds_kernel import assign_topic_rounds
 
     from ..ops.sortops import segment_sum
 
     C = int(num_consumers)
     P = lags.shape[0]
-    A, B = _sinkhorn_duals_jit(
-        ws_u, count_u, wsum_u, num_consumers=C, iters=iters
-    )
-    ws = _scaled_ws(lags, valid, C)
 
     n_valid = jnp.sum(valid.astype(jnp.int32))
     floor_cap = n_valid // C

@@ -21,11 +21,25 @@ default AND the degradation target: a missing/degraded mesh answers
 None and callers run the unchanged single-device path; a sharded
 dispatch that faults (``mesh.collective``) degrades the manager and
 falls back inside the same request budget.
+
+Quality-mode selection (``tpu.assignor.quality.mode``):
+:func:`resolve_quality_mode` is the ONE place a quality solve is
+routed between the dense Sinkhorn path (:mod:`..models.sinkhorn`) and
+the linear-space O(P + C) mirror-prox path (:mod:`.linear_ot`) —
+``sinkhorn`` / ``linear`` pin a mode process-wide, ``auto`` (default)
+picks linear at row counts where the dense [U, C] streams stop
+fitting, or whenever the active mesh elects the P-sharded backend for
+the shape (the two compose: the linear duals shard over the same
+mesh).  ``assign_topic_sinkhorn`` consults it on entry, so every
+existing caller — and the streaming cold path — picks the mode up
+without API change.
 """
 
 from __future__ import annotations
 
 import logging
+import threading
+from contextlib import contextmanager
 from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
@@ -91,6 +105,113 @@ def ensure_x64() -> None:
     """int64 lags (Kafka offsets are Java longs) require JAX x64 mode."""
     if not jax.config.jax_enable_x64:
         jax.config.update("jax_enable_x64", True)
+
+
+#: Valid ``tpu.assignor.quality.mode`` values (mirrored in
+#: utils/config so a typo fails at configure() time).
+QUALITY_MODES = ("sinkhorn", "linear", "auto")
+
+#: "auto" routes the quality solve to the linear-space mode at or
+#: above this many partition rows: past it the dense path's [U, C]
+#: streamed working set (U capped at models/sinkhorn._DEDUP_CAP) stops
+#: paying for its dedup pre-pass, and the O(P + C) path is both
+#: smaller and sharding-composable.  Below it the dense Sinkhorn path
+#: keeps its measured latency edge.
+LINEAR_AUTO_MIN_ROWS = 32768
+
+# Process-wide quality-plane knobs (the faults._ACTIVE pattern: one
+# dict load on the hot path; service start() installs the configured
+# values, tests scope overrides via quality_scope).
+_QUALITY = {"mode": "auto", "tile": 1024}
+_QUALITY_LOCK = threading.Lock()
+
+
+def normalize_quality_mode(mode) -> str:
+    m = str(mode)
+    if m not in QUALITY_MODES:
+        raise ValueError(
+            f"quality mode {mode!r} invalid; choose one of {QUALITY_MODES}"
+        )
+    return m
+
+
+def set_quality_mode(mode) -> str:
+    """Install the process-wide quality mode (service start(), tests)."""
+    m = normalize_quality_mode(mode)
+    with _QUALITY_LOCK:
+        _QUALITY["mode"] = m
+    return m
+
+
+def quality_mode() -> str:
+    return _QUALITY["mode"]
+
+
+def set_quality_tile(tile) -> int:
+    """Install the process-wide linear-mode tile size (pow2 rows per
+    streamed tile — the ``tpu.assignor.quality.tile`` knob)."""
+    from .linear_ot import validate_tile
+
+    t = validate_tile(tile)
+    with _QUALITY_LOCK:
+        _QUALITY["tile"] = t
+    return t
+
+
+def quality_tile() -> int:
+    return _QUALITY["tile"]
+
+
+@contextmanager
+def quality_scope(mode, tile: Optional[int] = None):
+    """Scope a quality mode (and optionally a tile size) to a block —
+    tests and the per-mode warm-up jobs force one mode regardless of
+    the process-wide setting.  The previous knobs are restored even
+    when a setter rejects its value (an invalid tile must not leave
+    the mode permanently rerouted)."""
+    with _QUALITY_LOCK:
+        prev = dict(_QUALITY)
+    try:
+        set_quality_mode(mode)
+        if tile is not None:
+            set_quality_tile(tile)
+        yield
+    finally:
+        with _QUALITY_LOCK:
+            _QUALITY.update(prev)
+
+
+def resolve_quality_mode(num_rows: int, num_consumers: int) -> str:
+    """THE quality-mode router (module docstring): the mode one
+    P-rows-by-C-consumers quality solve should run.  Pinned modes win;
+    "auto" picks linear at scale (the row floor).  Callers that can
+    actually SHARD the solve — the streaming cold hook, which already
+    holds an electing mesh — additionally prefer linear under "auto"
+    at any size (the linear duals are the only quality iteration that
+    composes with the mesh); a plain single-device quality solve below
+    the floor keeps the dense path's measured latency edge."""
+    mode = _QUALITY["mode"]
+    if mode != "auto":
+        return mode
+    if int(num_consumers) < 2:
+        return "sinkhorn"
+    if int(num_rows) >= LINEAR_AUTO_MIN_ROWS:
+        return "linear"
+    return "sinkhorn"
+
+
+def quality_status() -> Dict:
+    """The service ``stats.quality`` section (and dump_metrics
+    --summary's quality rows): mode/tile knobs plus the last linear
+    solve's tile count and peak-memory estimate."""
+    from .linear_ot import last_solve_info
+
+    return {
+        "mode": quality_mode(),
+        "tile": quality_tile(),
+        "auto_min_rows": LINEAR_AUTO_MIN_ROWS,
+        "last_linear_solve": last_solve_info(),
+    }
 
 
 def sharded_solve_manager(num_rows: int, num_consumers: int):
@@ -317,10 +438,18 @@ def assign_per_topic(
 
 
 __all__ = [
+    "QUALITY_MODES",
     "assign_device",
     "assign_group_device",
     "assign_topic_device",
     "ensure_x64",
     "pad_bucket",
+    "quality_mode",
+    "quality_scope",
+    "quality_status",
+    "quality_tile",
+    "resolve_quality_mode",
+    "set_quality_mode",
+    "set_quality_tile",
     "sharded_solve_manager",
 ]

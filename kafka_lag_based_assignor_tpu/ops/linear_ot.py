@@ -1,0 +1,460 @@
+"""Linear-space OT quality mode: O(P + C)-memory mirror-prox solve.
+
+The dense Sinkhorn quality path (:mod:`..models.sinkhorn`) keeps its
+iteration STATE in O(C) — the rank-structured duals — but every duals
+iteration and every rounding pass still *streams* [U, C] / [P, C]-
+proportional logits buffers, and at the 1M x 10k north star that
+working set (~40 GB of f32) can never ship (ROADMAP "Linear-space
+quality mode at megascale").  This module recasts the whole quality
+solve in **linear memory**:
+
+* **Mirror-prox duals** (Log-Averaged Mirror Prox, arXiv:2511.11359 —
+  pattern only): the same implicit plan ``logX[p, j] = -ws_p*A_j + B_j``
+  as the Sinkhorn solver, iterated with an extragradient
+  (predictor/corrector) step — the gradient is re-evaluated at the
+  extrapolated dual point before the committed update, which is what
+  lets the linear-space iteration keep Sinkhorn-grade convergence
+  without the host-side dedup pre-pass.  Each marginal evaluation scans
+  the P axis in FIXED-SIZE tiles inside one fused executable
+  (``lax.scan`` over tiles of a pow2 knob, ``tpu.assignor.quality.tile``)
+  so peak device memory is **O(tile*C + P + C)**: the f32 ws/count
+  vectors, one live (tile, C) logits block, and the dual vectors —
+  never a [P, C] (or [U, C]) plan.
+
+* **Mesh-size-independent accumulation**: tiles are grouped into
+  ``_SUPERBLOCKS`` fixed row blocks (>= the largest supported mesh)
+  whose partial marginals are ALWAYS combined in the same left-to-right
+  order.  The P-axis-sharded composition (:func:`..sharded.solve.
+  solve_linear_sharded`) assigns whole superblocks to mesh shards and
+  all-gathers the per-block partials before the identical ordered
+  combine — so the duals trajectory, and therefore the final
+  assignment, is **bit-identical at mesh size 1 vs 2-8** (the round-17
+  replicated-consumer-state pattern, now with deterministic f32
+  reduction order; pinned by tests/test_linear_ot.py).
+
+* **Push-relabel-style additive rounding** (arXiv:2203.03732 — pattern
+  only): rows take their implicit-plan argmax consumer (tile-streamed),
+  over-capacity consumers *push* their surplus rows to open seats in
+  ascending-load round-robin order (the capacity repair of
+  :func:`..models.sinkhorn._round_parallel`), and the exchange-refine +
+  greedy portfolio tail is shared verbatim with the Sinkhorn solver
+  (:func:`..models.sinkhorn._round_refine_portfolio`).  The additive
+  guarantee — ``max consumer load <= total/C + max_lag`` — is asserted
+  on every solve; it maps directly onto the bench's ``imbalance_bound``
+  (the count-constrained lower bound is >= total/C, so quality_ratio is
+  bounded by ``1 + max_lag/(total/C)``).
+
+Mode selection lives in :mod:`.dispatch` (``tpu.assignor.quality.mode``
+= ``sinkhorn | linear | auto``): ``assign_topic_sinkhorn`` callers and
+the streaming cold path pick the linear mode up without any API change.
+Lint rule L021 confines [P, C]-proportional dense materialization to
+the Sinkhorn legacy path and this module's tile body.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+from typing import Mapping, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..types import AssignmentMap, TopicPartitionLag
+
+LOGGER = logging.getLogger(__name__)
+
+#: Fixed number of accumulation blocks along the P axis.  Per-block
+#: partial marginals are combined in a FIXED left-to-right order, and
+#: the sharded composition assigns whole blocks to mesh shards — both
+#: paths therefore run the identical f32 addition sequence, which is
+#: what makes the solve bit-identical across mesh sizes (module
+#: docstring).  Must be a pow2 >= the largest supported mesh (8).
+_SUPERBLOCKS = 8
+
+#: Default rows per tile (the ``tpu.assignor.quality.tile`` knob's
+#: default, mirrored in utils/config).  (1024, C) f32 logits blocks are
+#: ~4 MB at C=1000 — comfortably resident on any backend.
+DEFAULT_TILE = 1024
+
+# THE tile validator lives with the config key (utils/config) so the
+# knob surface and this executable cannot drift.
+from ..utils.config import validate_quality_tile as validate_tile
+
+
+def plan_shape(num_rows: int, tile: int):
+    """Padded solve geometry: ``(P2, tile_eff, n_tiles)``.  ``P2`` is
+    the pow2 bucket (>= 64 so the 8 superblocks stay non-empty) and
+    ``tile_eff`` the effective tile (shrunk so the superblock split is
+    exact; both pow2, so every division below is exact).  Used by the
+    single-device and sharded paths alike — the geometry is part of the
+    bit-parity contract."""
+    from .packing import pad_bucket
+
+    P2 = pad_bucket(max(int(num_rows), _SUPERBLOCKS * 8))
+    t = max(8, min(validate_tile(tile), P2 // _SUPERBLOCKS))
+    return P2, t, P2 // t
+
+
+def _ws_cnt(lags, valid, scale):
+    """Per-row f32 scaled lags + validity weights (elementwise — the
+    one form that is trivially identical under any P sharding).  The
+    f64 divide matches :func:`..models.sinkhorn._scaled_ws` given the
+    host-computed scale."""
+    w = jnp.where(valid, lags, 0).astype(jnp.float64)
+    ws = (w / scale).astype(jnp.float32)
+    cnt = valid.astype(jnp.float32)
+    return ws, cnt
+
+
+def _to_blocks(x, P2: int, nblocks: int, tile: int):
+    """Pad a [P] vector to P2 rows and reshape to
+    [nblocks, tiles_per_block, tile] (padding rows carry weight 0 and
+    contribute exactly nothing to any marginal)."""
+    x = jnp.pad(x, (0, P2 - x.shape[0]))
+    return x.reshape(nblocks, (P2 // nblocks) // tile, tile)
+
+
+def _superblock_partials(ws_blocks, cnt_blocks, A, B):
+    """Per-superblock marginal partials: ``(load[Sb, C], colsum[Sb, C])``
+    with each block's tiles accumulated SEQUENTIALLY (``lax.scan``
+    carries the f32 accumulators, so the addition order per block is
+    fixed regardless of backend fusion)."""
+    C = A.shape[0]
+
+    def one_block(args):
+        ws_t, cnt_t = args  # [tiles_per_block, tile]
+
+        def tile_step(carry, wc):
+            # THE tile body — the only place a (tile, C) block lives
+            # (lint L021 confines dense rank-1 x rank-1 broadcasts to
+            # functions like this one).
+            acc_l, acc_c = carry
+            w_t, c_t = wc
+            logits = -w_t[:, None] * A[None, :] + B[None, :]
+            x = jax.nn.softmax(logits, axis=1)
+            acc_l = acc_l + (w_t[:, None] * x).sum(axis=0)
+            acc_c = acc_c + (c_t[:, None] * x).sum(axis=0)
+            return (acc_l, acc_c), None
+
+        zero = jnp.zeros((C,), jnp.float32)
+        (l_b, c_b), _ = lax.scan(tile_step, (zero, zero), (ws_t, cnt_t))
+        return l_b, c_b
+
+    return lax.map(one_block, (ws_blocks, cnt_blocks))
+
+
+def _ordered_sum(parts):
+    """Fixed left-to-right combine of [S, C] partials — S is static, so
+    the unrolled adds run in the same order on every path (the bit-
+    parity contract of the module docstring)."""
+    acc = parts[0]
+    for s in range(1, parts.shape[0]):
+        acc = acc + parts[s]
+    return acc
+
+
+def mirror_prox(stats_fn, num_consumers: int, iters: int, n_valid,
+                eta: float = 8.0, tol: float = 2e-5):
+    """The shared mirror-prox dual loop (single-device AND sharded —
+    ``stats_fn(A, B) -> (load, colsum)`` is the only thing that
+    differs, and both implementations are bit-identical by
+    construction).
+
+    Extragradient step: the mirror gradient is evaluated once at the
+    current duals (predictor) and once at the extrapolated point
+    (corrector); the COMMITTED update uses the look-ahead gradient.
+    The damped step scale and the two-residual early exit mirror the
+    Sinkhorn iteration (:func:`..models.sinkhorn._sinkhorn_duals_jit`)
+    so the two quality modes share one convergence contract.
+
+    Returns ``(A, B, rounds)``."""
+    C = int(num_consumers)
+    cap = jnp.maximum(n_valid.astype(jnp.float32), 1.0) / C
+    eta32 = jnp.float32(eta)
+
+    from .plan_stats import noise
+
+    def body(state):
+        i, sc, prev_spread, _, A, B = state
+        load1, _ = stats_fn(A, B)
+        spread = jnp.max(load1) - jnp.min(load1)
+        grew = spread > prev_spread
+        sc = jnp.where(
+            grew,
+            sc * jnp.float32(0.5),
+            jnp.minimum(sc * jnp.float32(1.2), jnp.float32(1.0)),
+        )
+        # Predictor: extrapolate the consumer duals along the centered
+        # load gradient, then re-evaluate BOTH marginals there.
+        A_half = A + eta32 * sc * (load1 - jnp.mean(load1))
+        load2, colsum2 = stats_fn(A_half, B)
+        # Corrector: commit the update with the look-ahead gradient;
+        # one Sinkhorn column scaling toward the balanced marginal.
+        A2 = A + eta32 * sc * (load2 - jnp.mean(load2))
+        upd = jnp.log(cap / (colsum2 + jnp.float32(1e-9)))
+        B2 = B + upd
+        delta = jnp.maximum(spread, jnp.max(jnp.abs(upd)))
+        return i + 1, sc, spread, delta, A2, B2
+
+    def cond(state):
+        i, delta = state[0], state[3]
+        return (i < iters) & (delta > jnp.float32(tol))
+
+    A0 = jnp.zeros((C,), jnp.float32)
+    B0 = noise(
+        jnp.zeros((C,), jnp.int32), jnp.arange(C, dtype=jnp.int32)
+    )
+    inf32 = jnp.float32(jnp.inf)
+    it, _, _, _, A, B = lax.while_loop(
+        cond, body, (jnp.int32(0), jnp.float32(1.0), inf32, inf32, A0, B0)
+    )
+    return A, B, it
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_consumers", "iters", "tile")
+)
+def _linear_duals_jit(lags, valid, scale, n_valid, *,
+                      num_consumers: int, iters: int, tile: int):
+    """ONE fused executable for the whole dual solve: the mirror-prox
+    outer loop with tile-streamed marginal scans inside.  Peak live
+    memory is the [P2] f32 ws/count vectors + one (tile, C) block +
+    the [_SUPERBLOCKS, C] partials + a handful of [C] vectors —
+    O(P + tile*C + C), never [P, C]."""
+    C = int(num_consumers)
+    P2, t, _ = plan_shape(lags.shape[0], tile)
+    ws, cnt = _ws_cnt(lags, valid, scale)
+    ws_b = _to_blocks(ws, P2, _SUPERBLOCKS, t)
+    cnt_b = _to_blocks(cnt, P2, _SUPERBLOCKS, t)
+
+    def stats_fn(A, B):
+        pl, pc = _superblock_partials(ws_b, cnt_b, A, B)
+        return _ordered_sum(pl), _ordered_sum(pc)
+
+    return mirror_prox(stats_fn, C, iters, n_valid)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_consumers", "refine_iters")
+)
+def _finish_linear_jit(lags, partition_ids, valid, A, B, *,
+                       num_consumers: int, refine_iters: int):
+    """The rounding pass: implicit-plan argmax (tile-streamed) +
+    capacity push + the exchange-refine/greedy-portfolio tail shared
+    verbatim with the Sinkhorn solver — every buffer [P]- or
+    [C, M]-shaped (O(P + C) total)."""
+    from ..models.sinkhorn import _round_refine_portfolio, _scaled_ws
+
+    ws = _scaled_ws(lags, valid, num_consumers)
+    return _round_refine_portfolio(
+        lags, partition_ids, valid, ws, A, B,
+        num_consumers=num_consumers, refine_iters=refine_iters,
+    )
+
+
+def additive_bound(lags, valid, num_consumers: int) -> float:
+    """The push-relabel-style additive guarantee on the max consumer
+    load: ``total_valid_lag / C + max_lag``.  Every linear-mode solve
+    is asserted against it (:func:`finish_from_duals`); relative to
+    the bench's ``imbalance_bound`` (whose load form is >= total/C)
+    it bounds quality_ratio by ``1 + max_lag / (total/C)``."""
+    lags_np = np.asarray(lags)
+    valid_np = np.asarray(valid)
+    vals = lags_np[valid_np]
+    if vals.size == 0:
+        return 0.0
+    total = float(vals.sum(dtype=np.float64))
+    return total / int(num_consumers) + float(vals.max())
+
+
+# Last linear solve's observability record (dump_metrics --summary and
+# the service stats `quality` section read it via
+# ops/dispatch.quality_status): tile geometry, peak-memory estimate,
+# duals rounds, and which backend ran the duals.
+_LAST: Optional[dict] = None
+
+
+def last_solve_info() -> Optional[dict]:
+    return _LAST
+
+
+def _peak_bytes_estimate(P2: int, C: int, tile: int) -> int:
+    """Device-memory model of the duals executable (the bench's
+    ``linear_ot_scale`` probe folds it into the measured-peak gate;
+    also the operator-facing summary row).  O(P) term: the int64 lag
+    input (8B), the bool valid mask (1B), the f64 ``_ws_cnt``
+    intermediate (8B, x64 mode), and the f32 ws + count vectors
+    (2 x 4B) — 25 bytes/row.  Plus ~3 live (tile, C) f32 blocks
+    (logits, softmax, weighted product), the per-superblock partials,
+    and the dual/marginal vectors."""
+    return (
+        25 * P2
+        + 3 * tile * C * 4
+        + 2 * _SUPERBLOCKS * C * 4
+        + 8 * C * 4
+    )
+
+
+def finish_from_duals(
+    lags_p: np.ndarray,
+    pids_p: np.ndarray,
+    valid_p: np.ndarray,
+    A,
+    B,
+    num_consumers: int,
+    refine_iters: int,
+    *,
+    tiles: int,
+    tile: int,
+    rounds: int,
+    backend: str,
+):
+    """Shared host tail of both linear entries: run the rounding
+    executable, ASSERT the additive bound, record the quality-plane
+    metrics, and return host ``(choice, counts, totals)``.
+
+    Raising on a bound violation is deliberate: the portfolio tail can
+    only return greedy-or-better, and greedy's least-loaded placement
+    satisfies ``max <= total/C + max_lag`` by construction — a miss
+    here means the rounding contract itself broke, which must surface
+    loudly rather than serve a silently unbalanced assignment."""
+    from ..utils import metrics
+
+    global _LAST
+    C = int(num_consumers)
+    choice, counts, totals = _finish_linear_jit(
+        lags_p, pids_p, valid_p, A, B,
+        num_consumers=C, refine_iters=int(refine_iters),
+    )
+    choice_np, counts_np, totals_np = (
+        np.asarray(x) for x in jax.device_get((choice, counts, totals))
+    )
+    bound = additive_bound(lags_p, valid_p, C)
+    max_tot = float(totals_np.max()) if totals_np.size else 0.0
+    if bound > 0.0 and max_tot > bound * (1.0 + 1e-6) + 0.5:
+        raise RuntimeError(
+            f"linear OT additive rounding bound violated: max consumer "
+            f"load {max_tot:.0f} > total/C + max_lag = {bound:.0f} "
+            "(push-relabel additive guarantee, ops/linear_ot)"
+        )
+    P2 = int(lags_p.shape[0])
+    _LAST = {
+        "backend": backend,
+        "rows": P2,
+        "consumers": C,
+        "tile": int(tile),
+        "tiles": int(tiles),
+        "duals_rounds": int(rounds),
+        "peak_bytes_estimate": _peak_bytes_estimate(P2, C, int(tile)),
+    }
+    metrics.REGISTRY.counter(
+        "klba_quality_solve_total", {"mode": "linear"}
+    ).inc()
+    metrics.REGISTRY.gauge("klba_quality_last_tile_count").set(
+        int(tiles)
+    )
+    metrics.REGISTRY.gauge("klba_quality_last_peak_bytes").set(
+        _LAST["peak_bytes_estimate"]
+    )
+    return choice_np, counts_np, totals_np
+
+
+def _trivial_assignment(lags_np, valid_np, num_consumers: int):
+    """Host fast path for C == 1 or an all-invalid topic (no duals
+    worth running)."""
+    C = int(num_consumers)
+    choice = np.where(valid_np, 0, -1).astype(np.int32)
+    counts = np.zeros(C, np.int64)
+    totals = np.zeros(C, np.int64)
+    counts[0] = int(valid_np.sum())
+    totals[0] = int(lags_np[valid_np].sum(dtype=np.int64))
+    return choice, counts, totals
+
+
+def assign_topic_linear(
+    lags,
+    partition_ids,
+    valid,
+    num_consumers: int,
+    iters: int = 24,
+    refine_iters: Optional[int] = None,
+    tile: Optional[int] = None,
+):
+    """Integral, count-balanced assignment from the linear-space
+    mirror-prox duals — the O(P + C) twin of
+    :func:`..models.sinkhorn.assign_topic_sinkhorn`, same output
+    contract ``(choice int32[P] in input order, counts, totals)``.
+
+    HOST-ONLY entry point (the scale/validity aggregation runs in
+    numpy).  ``tile`` overrides the process-wide
+    ``tpu.assignor.quality.tile`` knob; ``refine_iters=None`` selects
+    the Sinkhorn solver's per-rounding-path auto budget."""
+    from ..models.sinkhorn import (
+        _AUTO_REFINE_PARALLEL,
+        _AUTO_REFINE_SCAN,
+        _SCAN_ROUNDING_MAX_P,
+        _require_concrete,
+        _scale_np,
+    )
+    from .dispatch import ensure_x64, quality_tile
+
+    ensure_x64()
+    _require_concrete(lags, valid, "assign_topic_linear")
+    C = int(num_consumers)
+    lags_np = np.ascontiguousarray(np.asarray(lags), dtype=np.int64)
+    valid_np = np.ascontiguousarray(np.asarray(valid), dtype=bool)
+    pids_np = np.asarray(partition_ids)
+    n_valid = int(valid_np.sum())
+    if C < 2 or n_valid == 0:
+        return _trivial_assignment(lags_np, valid_np, max(C, 1))
+    P = int(lags_np.shape[0])
+    tile_knob = quality_tile() if tile is None else tile
+    _, tile_e, n_tiles = plan_shape(P, tile_knob)
+    if refine_iters is None:
+        refine_iters = (
+            _AUTO_REFINE_PARALLEL
+            if P > _SCAN_ROUNDING_MAX_P
+            else _AUTO_REFINE_SCAN
+        )
+    scale = _scale_np(lags_np, valid_np, C)
+    A, B, rounds = _linear_duals_jit(
+        lags_np, valid_np,
+        np.float64(scale), np.float32(n_valid),
+        num_consumers=C, iters=int(iters), tile=tile_e,
+    )
+    return finish_from_duals(
+        lags_np, pids_np, valid_np, A, B, C, refine_iters,
+        tiles=n_tiles, tile=tile_e, rounds=int(rounds),
+        backend="single",
+    )
+
+
+def assign_linear(
+    partition_lag_per_topic: Mapping[str, Sequence[TopicPartitionLag]],
+    subscriptions: Mapping[str, Sequence[str]],
+    iters: int = 24,
+    refine_iters: Optional[int] = None,
+) -> AssignmentMap:
+    """Map-level linear-mode solve (same surface as
+    :func:`..models.sinkhorn.assign_sinkhorn`); per-topic independence
+    preserved."""
+    from .dispatch import assign_per_topic, ensure_x64
+    from .packing import pad_topic_rows
+
+    ensure_x64()
+
+    def solve_topic(lags, pids, num_consumers):
+        lags_p, pids_p, valid = pad_topic_rows(lags, pids)
+        choice, _, _ = assign_topic_linear(
+            lags_p, pids_p, valid, num_consumers=num_consumers,
+            iters=iters, refine_iters=refine_iters,
+        )
+        return choice
+
+    return assign_per_topic(
+        partition_lag_per_topic, subscriptions, solve_topic
+    )

@@ -80,7 +80,9 @@ def implicit_plan_rows(
     p_idx int[R], ws f32[R], A/B f32[C] -> f32[R, C]."""
     logits = (
         noise(p_idx[:, None], jnp.arange(A.shape[0], dtype=jnp.int32)[None, :])
-        - ws[:, None] * A[None, :]
+        # noqa: L021 — [R, C], not [P, C]: callers materialize a few
+        # requested rows (the rounding scan passes R=1), never the plan.
+        - ws[:, None] * A[None, :]  # noqa: L021
         + B[None, :]
     )
     return jax.nn.softmax(logits, axis=1)

@@ -969,14 +969,33 @@ class StreamingAssignor:
             ) else None
         if mgr is None:
             return None
-        from ..sharded.solve import solve_sharded
+        # Quality-mode selection for the sharded cold solve
+        # (ops/dispatch, ``tpu.assignor.quality.mode``): this hook
+        # holds an electing mesh, so it is the one caller that can
+        # actually SHARD the linear duals — under "auto" (and a
+        # pinned "linear") the cold solve runs the mirror-prox duals
+        # P-sharded over the same mesh
+        # (sharded/solve.solve_linear_sharded) instead of the
+        # seed+exchange program; only a pinned "sinkhorn" keeps the
+        # exchange program.  Both fall back down the identical
+        # single-device ladder.
+        from .dispatch import quality_mode
+
+        use_linear = quality_mode() != "sinkhorn"
+        from ..sharded.solve import solve_linear_sharded, solve_sharded
 
         try:
             with metrics.span("stream.sharded_solve"):
-                choice, _, _, _ = solve_sharded(
-                    mgr.solve_mesh(), lags, self.num_consumers,
-                    refine_iters=self.cold_refine_iters,
-                )
+                if use_linear:
+                    choice, _, _, _ = solve_linear_sharded(
+                        mgr.solve_mesh(), lags, self.num_consumers,
+                        refine_iters=self.cold_refine_iters,
+                    )
+                else:
+                    choice, _, _, _ = solve_sharded(
+                        mgr.solve_mesh(), lags, self.num_consumers,
+                        refine_iters=self.cold_refine_iters,
+                    )
         except Exception:
             LOGGER.warning(
                 "sharded cold solve failed; degrading to the "
@@ -988,11 +1007,53 @@ class StreamingAssignor:
         self._drop_resident()
         return np.asarray(choice).astype(np.int32)
 
+    def _linear_cold_solve(self, lags: np.ndarray):
+        """Single-device linear-OT quality cold solve (ops/linear_ot):
+        selected only when ``tpu.assignor.quality.mode`` is PINNED to
+        "linear" — under "auto" the single-device greedy+refine cold
+        chain keeps its measured latency contract and the linear mode
+        engages through the sharded hook above.  Serves the choice as
+        a cold seed exactly like the sharded backend (resident state
+        dropped, rebuilt by the next warm epoch); any failure falls
+        open to the greedy chain.  Returns None when not selected."""
+        from .dispatch import quality_mode
+
+        if quality_mode() != "linear" or self.num_consumers < 2:
+            return None
+        from .linear_ot import assign_topic_linear
+        from .packing import pad_topic_rows
+
+        try:
+            with metrics.span("stream.linear_solve"):
+                # Pad to the pow2 bucket BEFORE the solve: the linear
+                # executables key on the padded shape, so drifting
+                # partition counts reuse one warmed compile per bucket
+                # (exactly what the per-mode warm-up drove) instead of
+                # tracing per exact P on the serve path.
+                lags_p, pids_p, valid_p = pad_topic_rows(lags)
+                choice, _, _ = assign_topic_linear(
+                    lags_p, pids_p, valid_p,
+                    num_consumers=self.num_consumers,
+                    refine_iters=self.cold_refine_iters,
+                )
+                choice = np.asarray(choice)[: lags.shape[0]]
+        except Exception:
+            LOGGER.warning(
+                "linear-OT cold solve failed; serving this epoch "
+                "through the greedy cold chain", exc_info=True,
+            )
+            return None
+        self._drop_resident()
+        return np.asarray(choice).astype(np.int32)
+
     def _cold_solve_inner(self, lags: np.ndarray) -> np.ndarray:
         C = self.num_consumers
         sharded = self._sharded_cold_solve(lags)
         if sharded is not None:
             return sharded
+        linear = self._linear_cold_solve(lags)
+        if linear is not None:
+            return linear
         if self.cold_refine_iters <= 0 or C < 2:
             self._drop_resident()
             return np.asarray(

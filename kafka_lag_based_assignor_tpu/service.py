@@ -962,6 +962,15 @@ class AssignorService:
         # and serves in-flight requests down the existing ladder.
         mesh_devices: Any = "off",
         mesh_solve_min_rows: int = 65536,
+        # Quality-mode plane (ops/dispatch + ops/linear_ot;
+        # DEPLOYMENT.md "Quality modes"): routing between the dense
+        # Sinkhorn path and the linear-space O(P + C) mirror-prox path
+        # ("auto" picks linear at scale or whenever the mesh elects
+        # the P-sharded backend — the two compose), plus the linear
+        # mode's streamed tile size (pow2 rows; peak device memory
+        # O(tile*C + P + C)).  Installed process-wide at start().
+        quality_mode: str = "auto",
+        quality_tile: int = 1024,
         # Opt-in plain-HTTP /metrics listener (utils/metrics_http):
         # port to bind on the service host (0 = ephemeral, for tests);
         # None disables.
@@ -1138,6 +1147,15 @@ class AssignorService:
             if _parse_spec(mesh_devices) != "off"
             else None
         )
+        # Quality-plane knobs: validated HERE (fail at construction,
+        # not at the first quality solve) but installed process-wide
+        # in start() — a constructed-but-never-started instance must
+        # not clobber a live sibling's routing.
+        from .ops.dispatch import normalize_quality_mode
+        from .utils.config import validate_quality_tile
+
+        self._quality_mode = normalize_quality_mode(quality_mode)
+        self._quality_tile = validate_quality_tile(quality_tile)
         # What the warm-up drives: 0 rungs when delta mode is off.
         self._warm_delta_buckets = (
             int(delta_buckets) if delta_enabled else 0
@@ -1387,6 +1405,8 @@ class AssignorService:
             "delta_adaptive": cfg.delta_adaptive,
             "mesh_devices": cfg.mesh_devices,
             "mesh_solve_min_rows": cfg.mesh_solve_min_rows,
+            "quality_mode": cfg.quality_mode,
+            "quality_tile": cfg.quality_tile,
             "metrics_port": cfg.metrics_port,
             "snapshot_path": cfg.snapshot_path,
             "snapshot_interval_s": cfg.snapshot_interval_s,
@@ -1557,6 +1577,12 @@ class AssignorService:
             result["mesh"] = (
                 self._mesh.status() if self._mesh is not None else None
             )
+            # Quality-mode plane (DEPLOYMENT.md "Quality modes"):
+            # mode/tile knobs + the last linear solve's tile count and
+            # peak-memory estimate (dump_metrics --summary rows).
+            from .ops.dispatch import quality_status
+
+            result["quality"] = quality_status()
             return result, None
         if method == "metrics":
             # The registry, both ways: structured JSON for programmatic
@@ -3233,6 +3259,15 @@ class AssignorService:
         # and request-thread log lines carry the minted request id.
         install_compile_counter()
         metrics.install_log_request_ids()
+        # Quality-plane knobs installed process-wide BEFORE the mesh
+        # configure and the warm-up: the per-mode warm-up jobs (and
+        # every quality solve after them) route through
+        # ops/dispatch.resolve_quality_mode, which must already see
+        # this instance's configuration.
+        from .ops import dispatch as dispatch_mod
+
+        dispatch_mod.set_quality_mode(self._quality_mode)
+        dispatch_mod.set_quality_tile(self._quality_tile)
         if self._mesh is not None:
             # Mesh discovery/validation ONCE at service start (never
             # per request), and BEFORE the warm-up below: with the
@@ -3777,6 +3812,18 @@ def main() -> None:
              "65536)",
     )
     parser.add_argument(
+        "--quality-mode", default="auto",
+        choices=("sinkhorn", "linear", "auto"),
+        help="quality-solve routing (DEPLOYMENT.md 'Quality modes'): "
+             "dense sinkhorn, the linear-space O(P + C) mirror-prox "
+             "path, or auto (linear at scale / under a mesh; default)",
+    )
+    parser.add_argument(
+        "--quality-tile", type=int, default=1024, metavar="ROWS",
+        help="linear quality mode's streamed tile size in rows (pow2; "
+             "peak device memory O(tile*C + P + C); default 1024)",
+    )
+    parser.add_argument(
         "--federation-capacity", default=None, metavar="W,W,...",
         help="this cluster's per-consumer capacity weight vector "
              "(comma-separated positive floats) for the weighted "
@@ -3824,6 +3871,8 @@ def main() -> None:
         federation_capacity=federation_capacity,
         mesh_devices=opts.mesh_devices,
         mesh_solve_min_rows=opts.mesh_solve_min_rows,
+        quality_mode=opts.quality_mode,
+        quality_tile=opts.quality_tile,
     )
     # SIGTERM/SIGINT drain gracefully: admissions stop with a
     # structured retry-after reject, in-flight waves flush, the final

@@ -34,6 +34,19 @@ C << P — kept REPLICATED and all-reduced per round:
   load/count marginals of an assignment as one shard-local segment sum
   + ``psum`` — no device ever materializes another shard's rows.
 
+* **Linear-OT quality duals** (:func:`solve_linear_sharded`): the
+  linear-space mirror-prox quality mode (:mod:`..ops.linear_ot`)
+  composed with this mesh — each shard tile-streams its LOCAL rows'
+  marginal partials per fixed superblock, one ``all_gather`` per outer
+  iteration replicates the per-block partials, and the ordered f32
+  combine + dual update run identically on every shard (consumer-axis
+  duals all-reduced per outer iteration, the replicated-state pattern
+  above).  Because the superblock decomposition and combine order are
+  mesh-size-independent, the duals trajectory — and the final rounded
+  assignment, which runs the single-device rounding pass on the
+  replicated duals — is **bit-identical at mesh size 1 vs 2-8**
+  (pinned by tests/test_linear_ot.py).
+
 Executable discipline: one jitted ``shard_map`` program per (mesh, C,
 budget, bucket) via an lru-cached builder — repeated solves at a shape
 compile NOTHING after the first (the differential fuzz and the bench's
@@ -511,6 +524,131 @@ def plan_stats_sharded(mesh, lags, valid, choice, num_consumers: int):
         )
     )
     return np.asarray(totals), np.asarray(counts)
+
+
+@functools.lru_cache(maxsize=32)
+def _linear_duals_executable(
+    mesh, num_consumers: int, iters: int, tile: int
+):
+    """Build + jit the P-sharded mirror-prox dual program: one
+    executable per (mesh, C, iters, tile) — shapes re-specialize via
+    the jit cache like every other sharded program here."""
+    from ..ops import linear_ot
+
+    D = mesh.shape[SOLVE_AXIS]
+    S = linear_ot._SUPERBLOCKS
+    C = int(num_consumers)
+
+    def step(lags, valid, scale, n_valid):
+        # Local rows -> local superblocks (shard d owns whole blocks
+        # d*S/D .. (d+1)*S/D - 1 of the GLOBAL decomposition; padding
+        # sits at the global tail, so block contents match the
+        # single-device layout exactly).
+        L = lags.shape[0]
+        ws, cnt = linear_ot._ws_cnt(lags, valid, scale)
+        ws_b = linear_ot._to_blocks(ws, L, S // D, tile)
+        cnt_b = linear_ot._to_blocks(cnt, L, S // D, tile)
+
+        def stats_fn(A, B):
+            pl, pc = linear_ot._superblock_partials(ws_b, cnt_b, A, B)
+            # Consumer-axis all-reduce per outer iteration: gather the
+            # per-block partials into GLOBAL block order, then the
+            # same fixed left-to-right combine as the single-device
+            # path — bit-identical marginals at any mesh size.
+            pl = lax.all_gather(pl, SOLVE_AXIS, axis=0, tiled=True)
+            pc = lax.all_gather(pc, SOLVE_AXIS, axis=0, tiled=True)
+            return (
+                linear_ot._ordered_sum(pl),
+                linear_ot._ordered_sum(pc),
+            )
+
+        return linear_ot.mirror_prox(stats_fn, C, int(iters), n_valid)
+
+    mapped = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(
+            PartitionSpec(SOLVE_AXIS), PartitionSpec(SOLVE_AXIS),
+            PartitionSpec(), PartitionSpec(),
+        ),
+        out_specs=(
+            PartitionSpec(),  # A: replicated duals
+            PartitionSpec(),  # B
+            PartitionSpec(),  # rounds
+        ),
+        **{CHECK_KW: False},
+    )
+    return jax.jit(mapped)
+
+
+def solve_linear_sharded(
+    mesh,
+    lags: np.ndarray,
+    num_consumers: int,
+    iters: int = 24,
+    refine_iters: int = 64,
+    tile: Optional[int] = None,
+):
+    """One linear-OT quality cold solve with the DUALS P-sharded over
+    ``mesh`` (module docstring): the O(iters * P * C) marginal scans —
+    the dominant cost — split across shards; the O(P log P) rounding
+    pass then runs the unchanged single-device linear rounding on the
+    replicated duals, so the result is bit-identical to
+    :func:`..ops.linear_ot.assign_topic_linear` at ANY mesh size.
+
+    ``lags`` is the exact host [P] int64 vector.  Fires
+    ``mesh.collective`` on entry (callers degrade to the single-device
+    backend on any failure).  Returns ``(choice int32[P] in input
+    order, counts, totals, duals_rounds)`` as host arrays."""
+    from ..models.sinkhorn import _scale_np
+    from ..ops import linear_ot
+    from ..ops.dispatch import ensure_x64, quality_tile
+
+    ensure_x64()
+    faults.fire("mesh.collective")
+    C = int(num_consumers)
+    lags = np.ascontiguousarray(lags, dtype=np.int64)
+    P_len = int(lags.shape[0])
+    D = mesh.shape[SOLVE_AXIS]
+    tile_knob = quality_tile() if tile is None else tile
+    # The pow2 plan bucket divides by any pow2 mesh size <= the
+    # superblock count; larger (or non-pow2) meshes cannot take whole
+    # superblocks, so the composition declines them loudly.
+    S = linear_ot._SUPERBLOCKS
+    if D > S or S % D:
+        raise ValueError(
+            f"solve_linear_sharded needs a pow2 mesh size <= {S}, "
+            f"got {D}"
+        )
+    P2, tile_e, n_tiles = linear_ot.plan_shape(P_len, tile_knob)
+    lags_p = np.zeros(P2, dtype=np.int64)
+    lags_p[:P_len] = lags
+    valid = np.zeros(P2, dtype=bool)
+    valid[:P_len] = True
+    scale = _scale_np(lags_p, valid, C)
+    step = _linear_duals_executable(mesh, C, int(iters), tile_e)
+    lags_d, valid_d = _place_inputs(mesh, lags_p, valid)
+    with metrics.span("sharded.linear_duals"):
+        A, B, rounds = step(
+            lags_d, valid_d,
+            np.float64(scale), np.float32(int(valid.sum())),
+        )
+        A, B, rounds_np = jax.device_get((A, B, rounds))
+    metrics.REGISTRY.counter(
+        "klba_sharded_dispatch_total", {"path": "linear"}
+    ).inc()
+    pids_p = np.arange(P2, dtype=np.int32)
+    choice, counts, totals = linear_ot.finish_from_duals(
+        lags_p, pids_p, valid, np.asarray(A), np.asarray(B), C,
+        int(refine_iters), tiles=n_tiles, tile=tile_e,
+        rounds=int(rounds_np), backend=f"sharded:{D}",
+    )
+    return (
+        choice[:P_len].astype(np.int32),
+        counts,
+        totals,
+        int(rounds_np),
+    )
 
 
 def seed_reference(lags: np.ndarray, num_consumers: int) -> np.ndarray:

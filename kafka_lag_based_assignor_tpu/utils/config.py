@@ -40,6 +40,37 @@ BREAKER_FAILURES_CONFIG = "tpu.assignor.breaker.failures"  # int >= 1
 LAG_RETRIES_CONFIG = "tpu.assignor.lag.retries"  # int >= 0
 LAG_RETRY_BACKOFF_CONFIG = "tpu.assignor.lag.retry.backoff.ms"
 SINKHORN_ITERS_CONFIG = "tpu.assignor.sinkhorn.iters"  # int > 0
+# Quality-mode plane (ops/dispatch + ops/linear_ot; DEPLOYMENT.md
+# "Quality modes").  ``quality.mode`` routes every quality solve:
+# "sinkhorn" pins the dense implicit-plan path, "linear" pins the
+# O(P + C)-memory mirror-prox path, "auto" (default) picks linear at
+# large row counts or whenever the device mesh elects the P-sharded
+# backend for the shape (the linear duals shard over the same mesh).
+# ``quality.tile`` is the linear mode's streamed tile size in rows
+# (pow2; peak device memory is O(tile*C + P + C)).
+QUALITY_MODE_CONFIG = "tpu.assignor.quality.mode"
+QUALITY_TILE_CONFIG = "tpu.assignor.quality.tile"
+
+#: Valid ``quality.mode`` values (ops/dispatch mirrors this tuple).
+QUALITY_MODES = ("sinkhorn", "linear", "auto")
+
+_MAX_QUALITY_TILE = 1 << 16
+
+
+def validate_quality_tile(tile) -> int:
+    """THE ``quality.tile`` validator — shared by this config key and
+    ops/linear_ot (the knob and the executable cannot drift): a power
+    of two in [8, 65536]."""
+    try:
+        t = int(tile)
+    except (TypeError, ValueError):
+        raise ValueError(f"quality tile {tile!r} is not an integer")
+    if t < 8 or t > _MAX_QUALITY_TILE or (t & (t - 1)):
+        raise ValueError(
+            f"quality tile {t} must be a power of two in "
+            f"[8, {_MAX_QUALITY_TILE}]"
+        )
+    return t
 # int >= 0, or unset/"auto".  For the "sinkhorn" solver, "auto" selects
 # the per-rounding-path budget (models/sinkhorn: 24 for the sequential
 # scan rounding, 96 for the parallel rounding, which starts coarser) and
@@ -269,6 +300,10 @@ class AssignorConfig:
     # refinement); refine_iters None = per-path auto budget.
     sinkhorn_iters: int = 24
     refine_iters: Optional[int] = None
+    # Quality-mode routing + the linear mode's tile size (ops/dispatch
+    # / ops/linear_ot; "auto" = linear at scale or under a mesh).
+    quality_mode: str = "auto"
+    quality_tile: int = 1024
     # Megabatch coalescer (ops/coalesce): admission window + batch cap,
     # roster lock threshold, and the flush-pipeline toggle.
     coalesce_window_s: float = 0.0005
@@ -385,6 +420,20 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
             f"'global' solver's cross-topic balance; unset it or choose "
             f"solver 'rounds'/'scan'/'sinkhorn'"
         )
+
+    quality_mode = str(
+        consumer_group_props.get(QUALITY_MODE_CONFIG, "auto")
+    )
+    if quality_mode not in QUALITY_MODES:
+        raise ValueError(
+            f"{QUALITY_MODE_CONFIG}={quality_mode!r} invalid; choose "
+            f"one of {QUALITY_MODES}"
+        )
+    raw_tile = consumer_group_props.get(QUALITY_TILE_CONFIG, 1024)
+    try:
+        quality_tile = validate_quality_tile(raw_tile)
+    except ValueError as exc:
+        raise ValueError(f"{QUALITY_TILE_CONFIG}: {exc}")
 
     raw_shapes = consumer_group_props.get(WARMUP_SHAPES_CONFIG, "")
     warmup_shapes = []
@@ -591,6 +640,8 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
         lag_retry_backoff_s=_as_ms(LAG_RETRY_BACKOFF_CONFIG, 50.0),
         sinkhorn_iters=sinkhorn_iters,
         refine_iters=refine_iters,
+        quality_mode=quality_mode,
+        quality_tile=quality_tile,
         coalesce_window_s=_as_ms(COALESCE_WINDOW_CONFIG, 0.5),
         coalesce_max_batch=_as_int(COALESCE_MAX_BATCH_CONFIG, 32, 1),
         coalesce_lock_waves=_as_int(COALESCE_LOCK_WAVES_CONFIG, 1, 1),

@@ -64,7 +64,15 @@ def warmup(
       consumers: exact consumer-group sizes to warm (C is not bucketed —
         it is a static kernel parameter).
       topics: topic-batch sizes to warm for the batched kernels (bucketed).
-      solvers: subset of {"rounds", "scan", "global", "stream", "sinkhorn"}.
+      solvers: subset of {"rounds", "scan", "global", "stream",
+        "sinkhorn", "linear"}.  The quality plane warms PER MODE
+        (ops/dispatch quality routing): "sinkhorn" compiles the dense
+        implicit-plan executables under a pinned scope, and the
+        linear-space O(P + C) executables additionally warm when
+        requested explicitly OR when ``resolve_quality_mode(P, C)``
+        would route the shape to them (including the P-sharded duals
+        program when ``mesh_manager`` is active — recorded as
+        ``("sharded_linear", D, P, C, s)`` rows).
       all_partition_buckets: warm every bucket up to the max (True) or only
         the single bucket ``max_partitions`` pads to (default — smaller
         shapes still trigger one compile each on first sight).
@@ -102,10 +110,13 @@ def warmup(
         both part of the executable signature).  Recorded as
         ``("coalesce", batch_bucket, P, C, seconds)`` rows.
       mesh_manager: an ACTIVE :class:`..sharded.mesh.MeshManager` warms
-        the P-axis-sharded solve executable at this mesh size (per-mesh
-        -size executables: the sharded program is one compile per
-        (mesh, bucket, C, budget) — recorded as ``("sharded", D, P, C,
-        s)`` rows).  The stream-sharded MEGABATCH variants warm through
+        the P-axis-sharded cold-solve executable at this mesh size
+        (per-mesh-size executables: the sharded program is one compile
+        per (mesh, bucket, C, budget)) — the LINEAR quality variant
+        the streaming cold hook dispatches unless the quality mode is
+        pinned "sinkhorn" (recorded as ``("sharded_linear", D, P, C,
+        s)`` / ``("sharded", D, P, C, s)`` rows accordingly).  The
+        stream-sharded MEGABATCH variants warm through
         the ``coalesce`` jobs automatically while the manager is the
         process-active one (the warm-up waves lock onto the sharded
         placement exactly like production waves).  None skips.
@@ -255,28 +266,49 @@ def warmup(
                 and mesh_manager.active
             ):
 
-                def sharded_job(lags1d=lags1d, C=C):
-                    # The production cold hook dispatches
-                    # solve_sharded with the engine's cold budget
+                from .ops import dispatch as _dispatch_mod
+
+                sharded_linear = _dispatch_mod.quality_mode() != "sinkhorn"
+
+                def sharded_job(
+                    lags1d=lags1d, C=C, linear=sharded_linear
+                ):
+                    # The production cold hook dispatches the sharded
+                    # backend with the engine's cold budget
                     # (StreamingAssignor default — _fresh_engine) when
-                    # the manager elects this shape; warm exactly that
-                    # executable.  A shape below the manager's row
-                    # floor warms nothing it will never serve — the
-                    # solve still runs (cheap) so the (mesh, bucket)
-                    # program exists if an operator lowers the floor.
+                    # the manager elects this shape — the LINEAR
+                    # quality variant unless the mode is pinned
+                    # "sinkhorn" (ops/streaming._sharded_cold_solve);
+                    # warm exactly that executable.  A shape below the
+                    # manager's row floor warms nothing it will never
+                    # serve — the solve still runs (cheap) so the
+                    # (mesh, bucket) program exists if an operator
+                    # lowers the floor.
                     from .ops.streaming import StreamingAssignor
-                    from .sharded.solve import solve_sharded
+                    from .sharded.solve import (
+                        solve_linear_sharded,
+                        solve_sharded,
+                    )
 
                     budget = StreamingAssignor(
                         num_consumers=C
                     ).cold_refine_iters
-                    out = solve_sharded(
+                    solver = (
+                        solve_linear_sharded if linear else solve_sharded
+                    )
+                    out = solver(
                         mesh_manager.solve_mesh(), lags1d, C,
                         refine_iters=budget,
                     )
                     return out[0]
 
-                jobs.append(("sharded", mesh_manager.size, sharded_job))
+                jobs.append(
+                    (
+                        "sharded_linear" if sharded_linear else "sharded",
+                        mesh_manager.size,
+                        sharded_job,
+                    )
+                )
             if "stream" in solvers and delta_buckets > 0:
                 from .ops.streaming import delta_k_ladder
 
@@ -429,20 +461,48 @@ def warmup(
 
                     jobs.append(("coalesce", n, coalesce_job))
                     n *= 2
-            if "sinkhorn" in solvers:
+            if "sinkhorn" in solvers or "linear" in solvers:
+                # PER-MODE quality jobs (ops/dispatch quality routing):
+                # the dense Sinkhorn executables warm under a pinned
+                # "sinkhorn" scope (so an auto-routed process still
+                # compiles the dense variant it serves below the linear
+                # floor), and the linear-space executables warm when
+                # they are explicitly requested OR when the dispatch
+                # layer would route this (P, C) to them — exactly the
+                # executables production dispatches, nothing more.
+                from .ops import dispatch as dispatch_mod
                 from .models.sinkhorn import assign_topic_sinkhorn
 
                 valid1d = np.ones(P, dtype=bool)
-                jobs.append(
-                    (
-                        "sinkhorn",
-                        1,
-                        lambda: assign_topic_sinkhorn(
-                            lags1d, pids1d, valid1d, num_consumers=C,
-                            iters=sinkhorn_iters, refine_iters=refine_iters,
-                        ),
-                    )
+                want_linear = "linear" in solvers or (
+                    "sinkhorn" in solvers
+                    and dispatch_mod.resolve_quality_mode(P, C) == "linear"
                 )
+                if "sinkhorn" in solvers and (
+                    dispatch_mod.quality_mode() != "linear"
+                ):
+
+                    def sinkhorn_job(lags1d=lags1d, C=C):
+                        with dispatch_mod.quality_scope("sinkhorn"):
+                            return assign_topic_sinkhorn(
+                                lags1d, pids1d, valid1d,
+                                num_consumers=C, iters=sinkhorn_iters,
+                                refine_iters=refine_iters,
+                            )
+
+                    jobs.append(("sinkhorn", 1, sinkhorn_job))
+                if want_linear:
+
+                    def linear_job(lags1d=lags1d, C=C):
+                        from .ops.linear_ot import assign_topic_linear
+
+                        return assign_topic_linear(
+                            lags1d, pids1d, valid1d, num_consumers=C,
+                            iters=sinkhorn_iters,
+                            refine_iters=refine_iters,
+                        )
+
+                    jobs.append(("linear", 1, linear_job))
             for T in t_buckets:
                 lags = np.broadcast_to(lags1d, (T, P)).copy()
                 pids = np.broadcast_to(pids1d, (T, P)).copy()

@@ -1,0 +1,423 @@
+"""Linear-space OT quality mode (ops/linear_ot + ops/dispatch routing):
+small-shape differential suite against the dense Sinkhorn solve
+(quality parity, additive rounding bound, count balance, determinism),
+mesh-1 vs mesh-4/8 BIT parity of the sharded duals composition on the
+virtual 8-device CPU mesh, the ``tpu.assignor.quality.*`` knob surface,
+and the per-mode warm-up jobs."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from kafka_lag_based_assignor_tpu.models.sinkhorn import (
+    assign_topic_sinkhorn,
+)
+from kafka_lag_based_assignor_tpu.ops import dispatch as dispatch_mod
+from kafka_lag_based_assignor_tpu.ops.linear_ot import (
+    additive_bound,
+    assign_topic_linear,
+)
+from kafka_lag_based_assignor_tpu.ops.packing import pad_topic_rows
+from kafka_lag_based_assignor_tpu.sharded import mesh as mesh_mod
+from kafka_lag_based_assignor_tpu.utils import metrics
+
+needs_mesh = pytest.mark.skipif(
+    len(jax.devices()) < 8,
+    reason="virtual 8-device CPU mesh unavailable",
+)
+
+
+def _instance(P, C, seed, profile="uniform"):
+    rng = np.random.default_rng(seed)
+    if profile == "skew":
+        lags = np.zeros(P, np.int64)
+        hot = rng.choice(P, max(P // 10, 1), replace=False)
+        lags[hot] = rng.integers(10**5, 10**7, size=hot.size)
+    elif profile == "zipf":
+        ranks = rng.permutation(P) + 1
+        lags = (1000 * (P / ranks) ** (1 / 1.1)).astype(np.int64)
+    else:
+        lags = rng.integers(0, 10**6, P).astype(np.int64)
+    return lags
+
+
+def _check_valid(choice, counts, totals, lags_p, valid_p, C):
+    choice = np.asarray(choice)
+    counts = np.asarray(counts)
+    totals = np.asarray(totals)
+    n_valid = int(valid_p.sum())
+    assert (choice[~valid_p] == -1).all()
+    assert (choice[valid_p] >= 0).all() and (choice[valid_p] < C).all()
+    ref_counts = np.bincount(choice[choice >= 0], minlength=C)
+    assert counts.sum() == n_valid
+    np.testing.assert_array_equal(counts, ref_counts)
+    assert counts.max() - counts.min() <= 1
+    ref_totals = np.zeros(C, np.int64)
+    np.add.at(
+        ref_totals, choice[valid_p].astype(np.int64), lags_p[valid_p]
+    )
+    np.testing.assert_array_equal(totals, ref_totals)
+
+
+class TestDifferential:
+    """Linear mode vs dense Sinkhorn at (P <= 4096, C <= 64)."""
+
+    @pytest.mark.parametrize(
+        "P,C,profile,seed",
+        [
+            (512, 16, "skew", 4),
+            (1024, 8, "uniform", 7),
+            (2048, 32, "zipf", 11),
+            (4096, 64, "zipf", 3),
+        ],
+    )
+    def test_quality_within_5pct_of_dense_sinkhorn(
+        self, P, C, profile, seed
+    ):
+        lags = _instance(P, C, seed, profile)
+        lp, pp, vp = pad_topic_rows(lags)
+        with dispatch_mod.quality_scope("sinkhorn"):
+            _, _, s_tot = assign_topic_sinkhorn(
+                lp, pp, vp, num_consumers=C
+            )
+        choice, counts, totals = assign_topic_linear(
+            lp, pp, vp, num_consumers=C
+        )
+        _check_valid(choice, counts, totals, lp, np.asarray(vp), C)
+        s_max = float(np.asarray(s_tot).max())
+        l_max = float(np.asarray(totals).max())
+        assert l_max <= 1.05 * s_max + 1e-9, (l_max, s_max)
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_additive_bound_holds(self, seed):
+        P, C = 1536, 24
+        lags = _instance(P, C, seed, "zipf")
+        lp, pp, vp = pad_topic_rows(lags)
+        _, _, totals = assign_topic_linear(lp, pp, vp, num_consumers=C)
+        bound = additive_bound(lp, vp, C)
+        assert float(np.asarray(totals).max()) <= bound * (1 + 1e-6) + 0.5
+
+    def test_determinism_across_runs(self):
+        lags = _instance(2048, 16, 5, "zipf")
+        lp, pp, vp = pad_topic_rows(lags)
+        a = assign_topic_linear(lp, pp, vp, num_consumers=16)
+        b = assign_topic_linear(lp, pp, vp, num_consumers=16)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    def test_explicit_tile_honored_and_equal(self):
+        """The tile size is a memory/layout knob, not a semantics knob:
+        different pow2 tiles keep count balance and the additive bound
+        (the superblock combine order — the bit-parity contract — is
+        tile-independent only per tile value, so cross-tile results
+        may differ in ties; invariants must hold for all)."""
+        lags = _instance(1024, 8, 9)
+        lp, pp, vp = pad_topic_rows(lags)
+        for tile in (8, 64, 1024):
+            choice, counts, totals = assign_topic_linear(
+                lp, pp, vp, num_consumers=8, tile=tile
+            )
+            _check_valid(choice, counts, totals, lp, np.asarray(vp), 8)
+            assert (
+                float(np.asarray(totals).max())
+                <= additive_bound(lp, vp, 8) * (1 + 1e-6) + 0.5
+            )
+
+    def test_trivial_paths(self):
+        lags = np.array([5, 9, 0, 0], dtype=np.int64)
+        valid = np.array([True, True, False, False])
+        pids = np.arange(4, dtype=np.int32)
+        # C == 1: everything on the one consumer.
+        choice, counts, totals = assign_topic_linear(
+            lags, pids, valid, num_consumers=1
+        )
+        assert list(choice) == [0, 0, -1, -1]
+        assert counts[0] == 2 and totals[0] == 14
+        # All-invalid: nothing assigned.
+        none_valid = np.zeros(4, bool)
+        choice, counts, totals = assign_topic_linear(
+            lags, pids, none_valid, num_consumers=3
+        )
+        assert (choice == -1).all()
+        assert counts.sum() == 0 and totals.sum() == 0
+
+    def test_host_only_contract_rejects_tracers(self):
+        lags = np.arange(16, dtype=np.int64)
+        valid = np.ones(16, dtype=bool)
+
+        @jax.jit
+        def traced(lags, valid):
+            return assign_topic_linear(
+                lags, np.arange(16, dtype=np.int32), valid,
+                num_consumers=2,
+            )
+
+        with pytest.raises(TypeError, match="host-only"):
+            traced(lags, valid)
+
+    def test_invalid_tile_rejected(self):
+        lags = _instance(64, 4, 0)
+        lp, pp, vp = pad_topic_rows(lags)
+        with pytest.raises(ValueError, match="power of two"):
+            assign_topic_linear(lp, pp, vp, num_consumers=4, tile=100)
+
+
+class TestDispatchRouting:
+    """tpu.assignor.quality.mode routing (ops/dispatch): pinned modes
+    win, auto picks linear at scale or under an electing mesh, and
+    assign_topic_sinkhorn callers pick the mode up with no API
+    change."""
+
+    def test_pinned_linear_routes_assign_topic_sinkhorn(self):
+        lags = _instance(1024, 8, 13)
+        lp, pp, vp = pad_topic_rows(lags)
+        with dispatch_mod.quality_scope("linear"):
+            via_sink = assign_topic_sinkhorn(
+                lp, pp, vp, num_consumers=8
+            )
+        direct = assign_topic_linear(lp, pp, vp, num_consumers=8)
+        for x, y in zip(via_sink, direct):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    def test_auto_small_shape_stays_sinkhorn(self):
+        with dispatch_mod.quality_scope("auto"):
+            assert (
+                dispatch_mod.resolve_quality_mode(1024, 8) == "sinkhorn"
+            )
+            assert (
+                dispatch_mod.resolve_quality_mode(
+                    dispatch_mod.LINEAR_AUTO_MIN_ROWS, 8
+                )
+                == "linear"
+            )
+
+    @needs_mesh
+    def test_auto_below_floor_stays_sinkhorn_even_with_mesh(self):
+        """An active mesh does NOT reroute plain (unshardable)
+        quality solves below the floor — the dense path keeps its
+        small-shape latency edge; the mesh composition engages in the
+        streaming cold hook, which holds the electing mesh (see
+        TestShardedParity)."""
+        mgr = mesh_mod.MeshManager(
+            devices=4, solve_min_rows=512
+        ).configure()
+        with dispatch_mod.quality_scope("auto"):
+            with mesh_mod.managed(mgr):
+                assert (
+                    dispatch_mod.resolve_quality_mode(1024, 8)
+                    == "sinkhorn"
+                )
+
+    def test_quality_scope_restores_on_invalid_tile(self):
+        before = dispatch_mod.quality_mode()
+        with pytest.raises(ValueError, match="power of two"):
+            with dispatch_mod.quality_scope("linear", tile=100):
+                pass  # pragma: no cover — setter raises first
+        assert dispatch_mod.quality_mode() == before
+
+    def test_solve_counter_by_mode(self):
+        lags = _instance(512, 4, 17)
+        lp, pp, vp = pad_topic_rows(lags)
+
+        def count(mode):
+            snap = metrics.REGISTRY.snapshot()
+            series = snap.get("klba_quality_solve_total", {}).get(
+                "series", []
+            )
+            return sum(
+                s["value"] for s in series
+                if s["labels"].get("mode") == mode
+            )
+
+        before = count("linear")
+        assign_topic_linear(lp, pp, vp, num_consumers=4)
+        assert count("linear") == before + 1
+        before_s = count("sinkhorn")
+        with dispatch_mod.quality_scope("sinkhorn"):
+            assign_topic_sinkhorn(lp, pp, vp, num_consumers=4)
+        assert count("sinkhorn") == before_s + 1
+
+    def test_quality_status_surface(self):
+        lags = _instance(512, 4, 23)
+        lp, pp, vp = pad_topic_rows(lags)
+        assign_topic_linear(lp, pp, vp, num_consumers=4)
+        status = dispatch_mod.quality_status()
+        assert status["mode"] in dispatch_mod.QUALITY_MODES
+        last = status["last_linear_solve"]
+        assert last is not None
+        assert last["tiles"] >= 1
+        assert last["peak_bytes_estimate"] > 0
+        # The estimate is the memory CONTRACT: far below the [P, C]
+        # block at any real shape (here P2=512, C=4).
+        assert last["peak_bytes_estimate"] < 512 * 4 * 4 * 64
+
+    def test_invalid_mode_rejected(self):
+        with pytest.raises(ValueError, match="quality mode"):
+            dispatch_mod.set_quality_mode("dense")
+
+
+@needs_mesh
+class TestShardedParity:
+    """The P-sharded duals composition (sharded/solve.
+    solve_linear_sharded) is BIT-IDENTICAL to the single-device linear
+    solve at every mesh size — the superblock all-gather + ordered
+    combine makes the f32 reduction order mesh-independent."""
+
+    @pytest.mark.parametrize("D", [2, 4, 8])
+    def test_mesh_sizes_bit_identical(self, D):
+        P, C = 2048, 16
+        lags = _instance(P, C, 29, "zipf")
+        lp, pp, vp = pad_topic_rows(lags)
+        single = assign_topic_linear(
+            lp, pp, vp, num_consumers=C, iters=12, refine_iters=32
+        )
+        mgr = mesh_mod.MeshManager(
+            devices=D, solve_min_rows=1
+        ).configure()
+        from kafka_lag_based_assignor_tpu.sharded.solve import (
+            solve_linear_sharded,
+        )
+
+        choice, counts, totals, rounds = solve_linear_sharded(
+            mgr.solve_mesh(), lags, C, iters=12, refine_iters=32
+        )
+        np.testing.assert_array_equal(
+            choice, np.asarray(single[0])[:P]
+        )
+        np.testing.assert_array_equal(counts, np.asarray(single[1]))
+        np.testing.assert_array_equal(totals, np.asarray(single[2]))
+        assert rounds >= 1
+
+    def test_streaming_cold_path_selects_linear_under_mesh(self):
+        """The streaming cold hook routes through the quality
+        dispatcher: with a mesh electing the shape and mode auto, the
+        cold solve runs the sharded LINEAR backend (counted under
+        klba_sharded_dispatch_total{path=linear}) and stays valid."""
+        from kafka_lag_based_assignor_tpu.ops.streaming import (
+            StreamingAssignor,
+        )
+
+        def linear_dispatches():
+            snap = metrics.REGISTRY.snapshot()
+            series = snap.get(
+                "klba_sharded_dispatch_total", {}
+            ).get("series", [])
+            return sum(
+                s["value"] for s in series
+                if s["labels"].get("path") == "linear"
+            )
+
+        P, C = 2048, 8
+        lags = _instance(P, C, 31)
+        mgr = mesh_mod.MeshManager(
+            devices=4, solve_min_rows=256
+        ).configure()
+        with dispatch_mod.quality_scope("auto"):
+            with mesh_mod.managed(mgr):
+                before = linear_dispatches()
+                eng = StreamingAssignor(num_consumers=C)
+                choice = eng.rebalance(lags)
+                assert linear_dispatches() == before + 1
+                assert eng.last_stats.sharded_solve
+        counts = np.bincount(np.asarray(choice), minlength=C)
+        assert counts.max() - counts.min() <= 1
+
+    def test_streaming_pinned_linear_single_device(self):
+        """Mode pinned "linear" without a mesh: the single-device cold
+        solve serves through ops/linear_ot (stream.linear_solve span)
+        and the warm loop proceeds normally from the seed."""
+        from kafka_lag_based_assignor_tpu.ops.streaming import (
+            StreamingAssignor,
+        )
+
+        P, C = 1024, 8
+        lags = _instance(P, C, 37)
+        with dispatch_mod.quality_scope("linear"):
+            eng = StreamingAssignor(num_consumers=C)
+            choice = eng.rebalance(lags)
+            counts = np.bincount(np.asarray(choice), minlength=C)
+            assert counts.max() - counts.min() <= 1
+            # A warm epoch after the linear seed still serves.
+            drift = lags.copy()
+            drift[: P // 20] += 1000
+            choice2 = eng.rebalance(drift)
+            counts2 = np.bincount(np.asarray(choice2), minlength=C)
+            assert counts2.max() - counts2.min() <= 1
+
+    def test_oversized_mesh_rejected(self):
+        from kafka_lag_based_assignor_tpu.sharded.solve import (
+            solve_linear_sharded,
+        )
+
+        class FakeMesh:
+            shape = {mesh_mod.SOLVE_AXIS: 3}
+
+        with pytest.raises(ValueError, match="pow2 mesh"):
+            solve_linear_sharded(
+                FakeMesh(), np.arange(64, dtype=np.int64), 4
+            )
+
+
+class TestConfigKnobs:
+    def test_parse_quality_knobs(self):
+        from kafka_lag_based_assignor_tpu.utils.config import (
+            parse_config,
+        )
+
+        cfg = parse_config({
+            "group.id": "g",
+            "tpu.assignor.quality.mode": "linear",
+            "tpu.assignor.quality.tile": 2048,
+        })
+        assert cfg.quality_mode == "linear"
+        assert cfg.quality_tile == 2048
+        assert parse_config({"group.id": "g"}).quality_mode == "auto"
+
+    @pytest.mark.parametrize(
+        "key,value,match",
+        [
+            ("tpu.assignor.quality.mode", "dense", "invalid"),
+            ("tpu.assignor.quality.tile", 100, "power of two"),
+            ("tpu.assignor.quality.tile", "big", "not an integer"),
+        ],
+    )
+    def test_bad_quality_knobs_fail_at_configure(
+        self, key, value, match
+    ):
+        from kafka_lag_based_assignor_tpu.utils.config import (
+            parse_config,
+        )
+
+        with pytest.raises(ValueError, match=match):
+            parse_config({"group.id": "g", key: value})
+
+
+class TestWarmupPerMode:
+    def test_linear_solver_warms_linear_rows(self):
+        from kafka_lag_based_assignor_tpu.warmup import warmup
+
+        done = warmup(
+            max_partitions=64, consumers=[4], solvers=("linear",)
+        )
+        assert [d[0] for d in done] == ["linear"]
+
+    def test_sinkhorn_solver_rows_unchanged_under_auto(self):
+        from kafka_lag_based_assignor_tpu.warmup import warmup
+
+        with dispatch_mod.quality_scope("auto"):
+            done = warmup(
+                max_partitions=64, consumers=[4],
+                solvers=("sinkhorn",),
+            )
+        assert [d[0] for d in done] == ["sinkhorn"]
+
+    def test_pinned_linear_replaces_sinkhorn_job(self):
+        from kafka_lag_based_assignor_tpu.warmup import warmup
+
+        with dispatch_mod.quality_scope("linear"):
+            done = warmup(
+                max_partitions=64, consumers=[4],
+                solvers=("sinkhorn",),
+            )
+        assert [d[0] for d in done] == ["linear"]

@@ -588,3 +588,74 @@ def test_l020_mesh_construction_confined_to_sharded():
         if f.code == "L020"
     ]
     assert findings == []
+
+
+def test_l021_dense_materialization_confined_to_tile_bodies():
+    """L021: the dense rank-1 x rank-1 broadcast (``a[:, None] *
+    b[None, :]`` — the [P, C] materialization idiom) is banned in
+    package code outside the Sinkhorn legacy path and tile-body
+    functions; noqa waives; tests/tools are exempt."""
+    ops_mod = Path("kafka_lag_based_assignor_tpu/ops/fedsolve.py")
+    legacy = Path("kafka_lag_based_assignor_tpu/models/sinkhorn.py")
+
+    dense = (
+        "def plan(ws, A, B):\n"
+        "    return -ws[:, None] * A[None, :] + B[None, :]\n"
+    )
+    assert any(
+        f.code == "L021" for f in lint.lint_source(ops_mod, dense)
+    )
+    # Either operand order is the same materialization.
+    flipped = (
+        "def plan(ws, A):\n"
+        "    return A[None, :] * ws[:, None]\n"
+    )
+    assert any(
+        f.code == "L021" for f in lint.lint_source(ops_mod, flipped)
+    )
+    # The Sinkhorn legacy path keeps its measured dense rounding.
+    assert not any(
+        f.code == "L021" for f in lint.lint_source(legacy, dense)
+    )
+    # Tile bodies are the allowed streaming zone (enclosing-function
+    # aware: any nesting level inside a *tile* function).
+    tiled = (
+        "def scan(ws_t, A, B):\n"
+        "    def tile_step(carry, w_t):\n"
+        "        x = -w_t[:, None] * A[None, :] + B[None, :]\n"
+        "        return carry + x.sum(), None\n"
+        "    return tile_step\n"
+    )
+    assert not any(
+        f.code == "L021" for f in lint.lint_source(ops_mod, tiled)
+    )
+    # Outside the package the idiom is not policed.
+    assert not any(
+        f.code == "L021"
+        for f in lint.lint_source(Path("tests/x.py"), dense)
+    )
+    # Same-direction broadcasts ([K, M]-style table masks) are NOT the
+    # [P, C] idiom and stay unflagged.
+    table = (
+        "def mask(mslots, counts, heavy):\n"
+        "    return mslots[None, :] < counts[heavy][:, None]\n"
+    )
+    assert not any(
+        f.code == "L021" for f in lint.lint_source(ops_mod, table)
+    )
+    waived = (
+        "def plan(ws, A, B):\n"
+        "    return -ws[:, None] * A[None, :]  # noqa: L021\n"
+    )
+    assert not any(
+        f.code == "L021" for f in lint.lint_source(ops_mod, waived)
+    )
+
+    # The whole production tree is clean (the real gate).
+    root = Path(lint.__file__).resolve().parent.parent
+    findings = [
+        f
+        for f in lint.lint_paths(iter(lint.repo_python_files(root)))
+        if f.code == "L021"
+    ]
+    assert findings == []

@@ -72,6 +72,7 @@ def main() -> int:
             scrub = stats.get("scrub")
             federation = stats.get("federation")
             mesh = stats.get("mesh")
+            quality = stats.get("quality")
     except OSError as exc:
         print(
             f"cannot reach sidecar at {args.host}:{args.port}: {exc}",
@@ -188,6 +189,34 @@ def main() -> int:
                     f"{k}={int(v)}" for k, v in sorted(sharded.items())
                 )
                 print(f"sharded dispatches: {rows}")
+
+        # Quality-mode view (DEPLOYMENT.md "Quality modes"): the
+        # routing knobs, per-mode solve counts, and the last linear
+        # solve's tile geometry + peak-memory estimate — the "which
+        # quality path is serving, and in how much memory" look, next
+        # to the mesh rows above.
+        if quality:
+            print(
+                f"quality mode: {quality.get('mode')} "
+                f"(tile {quality.get('tile')} rows, auto floor "
+                f"{quality.get('auto_min_rows')} rows)"
+            )
+            last = quality.get("last_linear_solve")
+            if last:
+                peak = last.get("peak_bytes_estimate", 0)
+                print(
+                    f"last linear solve: {last.get('rows')} rows x "
+                    f"{last.get('consumers')} consumers on "
+                    f"{last.get('backend')}, {last.get('tiles')} "
+                    f"tiles, {last.get('duals_rounds')} duals rounds, "
+                    f"peak-mem est {peak / (1024.0 * 1024.0):.1f} MiB"
+                )
+        solves = by_label("klba_quality_solve_total", "mode")
+        if solves:
+            rows = ", ".join(
+                f"{k}={int(v)}" for k, v in sorted(solves.items())
+            )
+            print(f"quality solves: {rows}")
         for s in js.get("klba_span_duration_ms", {}).get("series", []):
             span = s["labels"].get("span", "")
             if span.startswith("coalesce.") and span != "coalesce.window":

@@ -114,6 +114,19 @@ Rules:
         manager's validate/degrade lifecycle (the dead-end the old
         ``parallel/`` module was).  Waivable with ``# noqa: L020``
         stating why the construction cannot live in sharded/.
+  L021  [P, C]-proportional dense materialization in package code: an
+        arithmetic broadcast of two complementary axis-expanded
+        rank-1 operands (``a[:, None] * b[None, :]`` and friends —
+        THE idiom that builds a dense (rows, consumers) block) outside
+        the Sinkhorn legacy path (models/sinkhorn.py) and the
+        quality-mode tile bodies (functions whose name contains
+        ``tile`` — ops/linear_ot streams fixed-size tiles so the peak
+        stays O(tile*C + P + C); ops/plan_stats' tile kernels
+        likewise).  At the 1M x 10k north star a [P, C] f32 buffer is
+        ~40 GB and can never ship — new dense blocks must be
+        tile-streamed, or carry a ``# noqa: L021`` waiver stating why
+        the block is NOT [P, C]-proportional (enclosing-function-aware
+        walker).
 """
 
 from __future__ import annotations
@@ -588,6 +601,77 @@ def _l020_findings(
     return findings
 
 
+#: L021: BinOp node types whose complementary axis-expanded operands
+#: materialize a dense rank-2 block.
+_L021_OPS = (ast.Mult, ast.Add, ast.Sub, ast.Div, ast.Mod)
+
+
+def _axis_expanded(node, none_last: bool) -> bool:
+    """True for a Subscript whose index tuple carries ``None`` in the
+    trailing (``a[:, None]``; ``none_last``) or leading
+    (``b[None, :]``) position — numpy/jax's rank-expansion idiom.  A
+    leading ``-`` (UnaryOp) is transparent."""
+    if isinstance(node, ast.UnaryOp):
+        node = node.operand
+    if not isinstance(node, ast.Subscript):
+        return False
+    idx = node.slice
+    if not isinstance(idx, ast.Tuple) or len(idx.elts) < 2:
+        return False
+    elt = idx.elts[-1] if none_last else idx.elts[0]
+    return isinstance(elt, ast.Constant) and elt.value is None
+
+
+def _is_dense_outer_binop(node: ast.BinOp) -> bool:
+    """True when the BinOp's direct operands are complementary
+    axis-expanded rank-1s: ``x[:, None] <op> y[None, :]`` (either
+    order) — the construction of a dense (rows, consumers) block."""
+    if not isinstance(node.op, _L021_OPS):
+        return False
+    left, right = node.left, node.right
+    return (
+        _axis_expanded(left, True) and _axis_expanded(right, False)
+    ) or (
+        _axis_expanded(left, False) and _axis_expanded(right, True)
+    )
+
+
+def _l021_findings(rel: str, tree: ast.AST, lines: List[str]) -> List[Finding]:
+    """Walk with enclosing-function context (the L013 pattern): dense
+    rank-2 materialization is allowed only inside the tile-streaming
+    bodies (functions whose name contains ``tile``), where the block
+    is bounded at (tile, C) by construction."""
+    findings: List[Finding] = []
+
+    def visit(node: ast.AST, in_tile_body: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_scope = in_tile_body
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child_scope = in_tile_body or "tile" in child.name
+            if (
+                isinstance(child, ast.BinOp)
+                and not in_tile_body
+                and _is_dense_outer_binop(child)
+                and "noqa: L021" not in lines[child.lineno - 1]
+            ):
+                findings.append(
+                    Finding(
+                        rel,
+                        child.lineno,
+                        "L021",
+                        "[P, C]-proportional dense broadcast outside a "
+                        "tile body: stream it in fixed-size tiles "
+                        "(ops/linear_ot pattern) or waive with "
+                        "`# noqa: L021` stating why the block is not "
+                        "[P, C]-proportional",
+                    )
+                )
+            visit(child, child_scope)
+
+    visit(tree, False)
+    return findings
+
+
 _UNBOUNDED_QUEUE_TYPES = ("Queue", "LifoQueue", "PriorityQueue")
 
 
@@ -771,6 +855,11 @@ def lint_source(path: Path, source: str) -> List[Finding]:
     # one home for mesh topology construction).
     if is_package and "sharded" not in path.parts:
         findings.extend(_l020_findings(rel, tree, lines))
+    # L021 applies to package code outside the Sinkhorn legacy path
+    # (models/sinkhorn.py keeps its measured dense rounding); tile-
+    # streaming bodies are exempted inside the walker.
+    if is_package and path.name != "sinkhorn.py":
+        findings.extend(_l021_findings(rel, tree, lines))
     # L017 applies to package code OUTSIDE utils/snapshot.py (the
     # backend layer owns the raw atomic write; everyone else must go
     # through a SnapshotBackend so fencing polices the write).
