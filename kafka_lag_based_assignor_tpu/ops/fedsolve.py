@@ -175,7 +175,11 @@ def dual_step(A, B, load_sum, colsum_sum, cap, step_scale: float,
         jnp.asarray(load_sum, dtype=jnp.float32),
         jnp.asarray(colsum_sum, dtype=jnp.float32),
         jnp.asarray(cap, dtype=jnp.float32), jnp.float32(step_scale),
-        jnp.float32(prev_spread), num_consumers=int(np.asarray(A).shape[0]),
+        jnp.float32(prev_spread),
+        # A's length IS C (the consumer-group size): a membership
+        # constant that changes only on rebalance, not a per-epoch
+        # runtime value — one executable per group size is the design.
+        num_consumers=int(np.asarray(A).shape[0]),  # noqa: A003
     )
     return (
         np.asarray(A2), np.asarray(B2), float(s2), float(spread),
@@ -328,7 +332,10 @@ def round_local_shard(lags, num_consumers: int, A, B,
             base_totals, num_consumers=int(num_consumers),
             refine_iters=int(refine_iters),
             cap_vec=jnp.asarray(cap_np),
-            cap_max=min(cap_ceil, int(lags_p.shape[0])),
+            # lags_p arrives pre-padded to a pow2 bucket (see the
+            # cap_ceil comment above): min() of two pow2-bounded
+            # values stays on the ladder — no per-P executable mint.
+            cap_max=min(cap_ceil, int(lags_p.shape[0])),  # noqa: A003
         )
     else:
         choice, counts, totals = _round_local_jit(

@@ -1,0 +1,941 @@
+"""The whole-program analyzer (tools/analyze): fixture-snippet golden
+tests for the deep analyses (A001 donation safety, A002 lock-order /
+held-lock discipline, A003 recompile hazard), W001 unused-waiver
+accounting, the monolith parity pin for the ported L001-L021 rules,
+SARIF 2.1.0 output validation, the incremental cache, and the
+repo-wide clean gate (the analyzer analog of test_lint's)."""
+
+import importlib.util
+import json
+import sys
+import textwrap
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tools.analyze import (  # noqa: E402
+    LEGACY_CODES,
+    REGISTRY,
+    analyze_paths,
+    analyze_sources,
+    repo_python_files,
+)
+from tools.analyze.cache import AnalysisCache  # noqa: E402
+from tools.analyze.reporters import (  # noqa: E402
+    build_sarif,
+    render_json,
+    render_text,
+)
+
+STREAMING = "kafka_lag_based_assignor_tpu/ops/streaming.py"
+COALESCE = "kafka_lag_based_assignor_tpu/ops/coalesce.py"
+WATCHDOG = "kafka_lag_based_assignor_tpu/utils/watchdog.py"
+SERVICE = "kafka_lag_based_assignor_tpu/service.py"
+
+
+def codes_of(report, code):
+    return [f for f in report.findings if f.code == code]
+
+
+def run_snippet(rel, src, codes=None):
+    return analyze_sources({rel: textwrap.dedent(src)}, codes=codes)
+
+
+# --- parity: the ported legacy rules vs the frozen monolith ---------------
+
+
+def test_legacy_rules_match_monolith_byte_for_byte():
+    """Every L001-L021 finding the retired 1,048-line monolith would
+    raise on the CURRENT tree is raised identically by the engine port
+    (same path, line, code, and message — compared as rendered lines),
+    and vice versa."""
+    spec = importlib.util.spec_from_file_location(
+        "legacy_lint_monolith",
+        REPO / "tests" / "fixtures" / "legacy_lint_monolith.py",
+    )
+    monolith = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(monolith)
+
+    sys.path.insert(0, str(REPO / "tools"))
+    import lint  # noqa: E402 — the shim under test
+
+    files = monolith.repo_python_files(REPO)
+    old = sorted(str(f) for f in monolith.lint_paths(iter(files)))
+    new = sorted(str(f) for f in lint.lint_paths(iter(files)))
+    assert old == new
+    # the gate itself: a clean tree stays clean through the port
+    assert new == []
+
+
+def test_shim_runs_exactly_the_legacy_ruleset():
+    """`python tools/lint.py` semantics: deep rules and waiver
+    accounting never leak into the shim's findings."""
+    src = """\
+    import threading
+
+
+    def registry():
+        return {}
+
+
+    class Watchdog:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def trip(self):
+            with self._lock:
+                registry()
+    """
+    rep = run_snippet(WATCHDOG, src, codes=list(LEGACY_CODES))
+    assert rep.findings == []  # A002/W001 are not legacy codes
+    rep = run_snippet(WATCHDOG, src)
+    assert len(codes_of(rep, "A002")) == 1
+
+
+def test_registry_covers_catalog():
+    for code in LEGACY_CODES + ("A001", "A002", "A003"):
+        assert code in REGISTRY, code
+    for code in ("A001", "A002", "A003"):
+        assert REGISTRY[code].waivable
+    assert not REGISTRY["L007"].waivable  # monolith semantics kept
+
+
+# --- A001 donation safety -------------------------------------------------
+
+A001_POSITIVE = """\
+import functools
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(1, 2))
+def _warm_step(lags, choice, counts, iters: int):
+    return choice, counts
+
+
+def epoch(lags, choice, counts):
+    out = _warm_step(lags, choice, counts, iters=4)
+    stale = counts.sum()
+    return out, stale
+"""
+
+
+def test_a001_detects_seeded_use_after_donation_in_streaming():
+    rep = run_snippet(STREAMING, A001_POSITIVE)
+    found = codes_of(rep, "A001")
+    assert len(found) == 1
+    assert found[0].line == 12  # the read, not the dispatch
+    assert "`counts`" in found[0].message
+    assert "_warm_step" in found[0].message
+
+
+def test_a001_negative_rebound_result():
+    src = A001_POSITIVE.replace(
+        "    stale = counts.sum()\n    return out, stale\n",
+        "    choice, counts = out\n    return counts.sum()\n",
+    )
+    rep = run_snippet(STREAMING, src)
+    assert codes_of(rep, "A001") == []
+
+
+def test_a001_waived_with_reason():
+    src = A001_POSITIVE.replace(
+        "    stale = counts.sum()",
+        "    stale = counts.sum()  # noqa: A001 — fault-injection read",
+    )
+    rep = run_snippet(STREAMING, src)
+    assert codes_of(rep, "A001") == []
+    assert codes_of(rep, "W001") == []  # the waiver is USED
+
+
+def test_a001_cross_file_donor():
+    """The donor lives in ops/streaming.py; the hazardous call site in
+    the coalescer — the cross-module case the monolith could never
+    express."""
+    donor = """\
+    import functools
+    import jax
+
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def _warm_step(lags, choice, iters: int):
+        return choice
+    """
+    caller = """\
+    from .streaming import _warm_step
+
+
+    def flush(lags, choice):
+        out = _warm_step(lags, choice, iters=2)
+        return choice.sum(), out
+    """
+    rep = analyze_sources(
+        {
+            STREAMING: textwrap.dedent(donor),
+            COALESCE: textwrap.dedent(caller),
+        }
+    )
+    found = codes_of(rep, "A001")
+    assert len(found) == 1
+    assert found[0].path == COALESCE
+    assert found[0].line == 6
+
+
+def test_a001_container_and_attribute_bindings():
+    """`resident[i]` donations track the container; `batch.lags`
+    donations track the attribute and are killed by an audited
+    adopt_* swap (the real coalescer shape)."""
+    src = """\
+    import functools
+    import jax
+
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def _locked(lags, choice, row_tab, iters: int):
+        return choice, row_tab
+
+
+    def bad(lags, resident):
+        out = _locked(lags, resident[0], resident[1], iters=2)
+        return resident, out
+
+
+    def good(lags, batch):
+        out = _locked(lags, batch.choice, batch.lags, iters=2)
+        batch.adopt_resident_buffers(out)
+        return out
+
+
+    def bad_attr(lags, batch):
+        out = _locked(lags, batch.choice, batch.lags, iters=2)
+        return batch.lags, out
+    """
+    rep = run_snippet(COALESCE, src)
+    found = codes_of(rep, "A001")
+    lines = sorted(f.line for f in found)
+    assert lines == [12, 12, 23]  # resident (x2 donated args), bad_attr
+
+
+def test_a001_loop_back_edge():
+    """A warm loop that redispatches a donated binding without
+    rebinding it reads corrupt data on iteration two."""
+    src = """\
+    import functools
+    import jax
+
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def _warm_step(lags, choice, iters: int):
+        return choice
+
+
+    def bad_loop(feed, choice):
+        for lags in feed:
+            out = _warm_step(lags, choice, iters=2)
+        return out
+
+
+    def good_loop(feed, choice):
+        for lags in feed:
+            choice = _warm_step(lags, choice, iters=2)
+        return choice
+    """
+    rep = run_snippet(STREAMING, src)
+    found = codes_of(rep, "A001")
+    assert len(found) == 1
+    assert found[0].line == 12  # the loop's own redispatch read
+
+
+def test_a001_sibling_branch_not_after():
+    """A read in the OTHER arm of an if/else is not on any path after
+    the dispatch."""
+    src = """\
+    import functools
+    import jax
+
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def _warm_step(lags, choice, iters: int):
+        return choice
+
+
+    def epoch(lags, choice, warm):
+        if warm:
+            out = _warm_step(lags, choice, iters=2)
+        else:
+            out = choice.copy()
+        return out
+    """
+    rep = run_snippet(STREAMING, src)
+    assert codes_of(rep, "A001") == []
+
+
+# --- A002 lock discipline -------------------------------------------------
+
+A002_BREAKER = """\
+import threading
+
+
+def registry():
+    return {}
+
+
+class Watchdog:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def _trip(self):
+        with self._lock:
+            registry()
+"""
+
+
+def test_a002_detects_seeded_registry_call_under_breaker_lock():
+    rep = run_snippet(WATCHDOG, A002_BREAKER)
+    found = codes_of(rep, "A002")
+    assert len(found) == 1
+    assert found[0].line == 14
+    assert "registry()" in found[0].message
+    assert "Watchdog._lock" in found[0].message
+
+
+def test_a002_outside_the_lock_is_fine():
+    src = A002_BREAKER.replace(
+        "        with self._lock:\n            registry()",
+        "        with self._lock:\n            pass\n        registry()",
+    )
+    rep = run_snippet(WATCHDOG, src)
+    assert codes_of(rep, "A002") == []
+
+
+def test_a002_waived_with_reason():
+    src = A002_BREAKER.replace(
+        "            registry()",
+        "            registry()  # noqa: A002 — read-only counter peek",
+    )
+    rep = run_snippet(WATCHDOG, src)
+    assert codes_of(rep, "A002") == []
+    assert codes_of(rep, "W001") == []
+
+
+def test_a002_device_sync_under_stream_lock():
+    src = """\
+    import threading
+    import jax
+
+
+    class Engine:
+        def __init__(self):
+            self._streams_lock = threading.Lock()
+
+        def flush(self, buf):
+            with self._streams_lock:
+                return jax.block_until_ready(buf)
+    """
+    rep = run_snippet(SERVICE, src)
+    found = codes_of(rep, "A002")
+    assert len(found) == 1
+    assert "block_until_ready" in found[0].message
+
+
+def test_a002_non_breaker_non_stream_lock_unflagged():
+    """An ordinary lock may wrap registry work — only breaker and
+    stream locks carry the fail-fast admission contract."""
+    src = A002_BREAKER.replace("watchdog", "metrics")
+    rep = run_snippet(
+        "kafka_lag_based_assignor_tpu/utils/metrics.py", src
+    )
+    assert codes_of(rep, "A002") == []
+
+
+def test_a002_lock_order_cycle():
+    src = """\
+    import threading
+
+
+    class S:
+        def __init__(self):
+            self._a_lock = threading.Lock()
+            self._b_lock = threading.Lock()
+
+        def one(self):
+            with self._a_lock:
+                with self._b_lock:
+                    pass
+
+        def two(self):
+            with self._b_lock:
+                with self._a_lock:
+                    pass
+    """
+    rep = run_snippet(SERVICE, src)
+    found = codes_of(rep, "A002")
+    assert len(found) == 1
+    assert "lock-order cycle" in found[0].message
+    assert "_a_lock" in found[0].message
+    assert "_b_lock" in found[0].message
+    # one consistent order: no cycle
+    consistent = src.replace(
+        "            with self._b_lock:\n"
+        "                with self._a_lock:",
+        "            with self._a_lock:\n"
+        "                with self._b_lock:",
+    )
+    rep = run_snippet(SERVICE, consistent)
+    assert codes_of(rep, "A002") == []
+
+
+def test_a002_cross_function_cycle_via_call():
+    """One-level interprocedural: holding A while calling a helper
+    that takes B, while another path nests B then A."""
+    src = """\
+    import threading
+
+
+    class S:
+        def __init__(self):
+            self._a_lock = threading.Lock()
+            self._b_lock = threading.Lock()
+
+        def helper_b(self):
+            with self._b_lock:
+                return 1
+
+        def one(self):
+            with self._a_lock:
+                return self.helper_b()
+
+        def two(self):
+            with self._b_lock:
+                with self._a_lock:
+                    pass
+    """
+    rep = run_snippet(SERVICE, src)
+    found = codes_of(rep, "A002")
+    assert len(found) == 1
+    assert "lock-order cycle" in found[0].message
+
+
+def test_a002_nested_self_acquisition():
+    src = """\
+    import threading
+
+
+    class S:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def outer(self):
+            with self._lock:
+                with self._lock:
+                    pass
+    """
+    rep = run_snippet(SERVICE, src)
+    found = codes_of(rep, "A002")
+    assert len(found) == 1
+    assert "self-deadlock" in found[0].message
+    # an RLock is reentrant by design: no finding
+    rep = run_snippet(
+        SERVICE, src.replace("threading.Lock()", "threading.RLock()")
+    )
+    assert codes_of(rep, "A002") == []
+
+
+# --- A003 recompile hazard ------------------------------------------------
+
+A003_POSITIVE = """\
+import functools
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("bucket",))
+def _cold(lags, bucket: int):
+    return lags
+
+
+def solve(lags):
+    return _cold(lags, bucket=lags.shape[0])
+"""
+
+
+def test_a003_detects_seeded_unbucketed_static():
+    rep = run_snippet(STREAMING, A003_POSITIVE)
+    found = codes_of(rep, "A003")
+    assert len(found) == 1
+    assert found[0].line == 11
+    assert "lags.shape[0]" in found[0].message
+
+
+def test_a003_bucketed_static_is_fine():
+    for helper in ("pad_bucket", "delta_bucket", "table_rows"):
+        src = A003_POSITIVE.replace(
+            "bucket=lags.shape[0]", f"bucket={helper}(lags.shape[0])"
+        )
+        rep = run_snippet(STREAMING, src)
+        assert codes_of(rep, "A003") == [], helper
+
+
+def test_a003_name_resolution_one_level():
+    """`B = len(lags)` then `bucket=B` is the same hazard; `B =
+    pad_bucket(len(lags))` is not."""
+    src = A003_POSITIVE.replace(
+        "def solve(lags):\n    return _cold(lags, bucket=lags.shape[0])",
+        "def solve(lags):\n"
+        "    B = len(lags)\n"
+        "    return _cold(lags, bucket=B)",
+    )
+    rep = run_snippet(STREAMING, src)
+    assert len(codes_of(rep, "A003")) == 1
+    ok = src.replace("B = len(lags)", "B = pad_bucket(len(lags))")
+    rep = run_snippet(STREAMING, ok)
+    assert codes_of(rep, "A003") == []
+
+
+def test_a003_waived_with_reason():
+    src = A003_POSITIVE.replace(
+        "bucket=lags.shape[0])",
+        "bucket=lags.shape[0])  # noqa: A003 — probe-only path",
+    )
+    rep = run_snippet(STREAMING, src)
+    assert codes_of(rep, "A003") == []
+    assert codes_of(rep, "W001") == []
+
+
+def test_a003_inside_jit_trace_exempt():
+    """Inside an enclosing jit the inner call inlines: .shape is a
+    trace-time static bucketed by the OUTER executable (the
+    ops/batched device-pad idiom)."""
+    src = """\
+    import functools
+    import jax
+
+
+    @functools.partial(jax.jit, static_argnames=("n_valid",))
+    def _inner(lags, n_valid: int):
+        return lags
+
+
+    @functools.partial(jax.jit, static_argnames=("num_consumers",))
+    def _outer(lags, num_consumers: int):
+        P = lags.shape[0]
+        return _inner(lags, n_valid=P)
+    """
+    rep = run_snippet(STREAMING, src)
+    assert codes_of(rep, "A003") == []
+
+
+def test_a003_bare_jit_wrapper_also_exempt():
+    """A bare `@jax.jit` (no donate/static kwargs) still makes the
+    enclosing function a trace body — the inner call inlines."""
+    src = """\
+    import functools
+    import jax
+
+
+    @functools.partial(jax.jit, static_argnames=("n_valid",))
+    def _inner(lags, n_valid: int):
+        return lags
+
+
+    @jax.jit
+    def _outer(lags):
+        return _inner(lags, n_valid=lags.shape[0])
+    """
+    rep = run_snippet(STREAMING, src)
+    assert codes_of(rep, "A003") == []
+
+
+def test_a001_a003_ignore_library_calls():
+    """np/jnp/jax library calls are never donors or static-arg jits —
+    they must not become candidate dispatch sites (cold-run cost and
+    cache size are dominated by candidates)."""
+    src = """\
+    import numpy as np
+    import jax.numpy as jnp
+
+
+    def epoch(lags):
+        a = np.asarray(lags)
+        b = jnp.asarray(a)
+        return np.sum(b)
+    """
+    rel = "kafka_lag_based_assignor_tpu/ops/refine.py"
+    rep = run_snippet(rel, src)
+    assert rep.findings == []
+    facts = rep.results[rel].facts["A001"]
+    assert facts["calls"] == []
+
+
+def test_a003_non_static_arg_not_flagged():
+    """Traced (non-static) args may be runtime-shaped — only static
+    positions mint executables."""
+    src = A003_POSITIVE.replace(
+        "return _cold(lags, bucket=lags.shape[0])",
+        "return _cold(lags[: lags.shape[0]], bucket=64)",
+    )
+    rep = run_snippet(STREAMING, src)
+    assert codes_of(rep, "A003") == []
+
+
+# --- W001 waiver accounting -----------------------------------------------
+
+
+def test_w001_unused_waiver_flagged():
+    src = """\
+    def f():
+        x = 1  # noqa: L012
+        return x
+    """
+    rep = run_snippet(STREAMING, src)
+    found = codes_of(rep, "W001")
+    assert len(found) == 1
+    assert found[0].line == 2
+    assert "L012" in found[0].message
+
+
+def test_w001_used_waiver_not_flagged():
+    src = """\
+    import time
+
+
+    def f():
+        return time.perf_counter()  # noqa: L012
+    """
+    rep = run_snippet(STREAMING, src)
+    assert codes_of(rep, "W001") == []
+    assert codes_of(rep, "L012") == []
+
+
+def test_w001_ignores_prose_and_foreign_codes():
+    """A comment-only justification line (`# noqa: L014 below — ...`)
+    and foreign-namespace waivers (BLE001, E402) are not waivers the
+    engine accounts for."""
+    src = """\
+    def f():
+        # noqa: L014 below — drained by every flusher pass
+        x = 1  # noqa: BLE001
+        return x
+    """
+    rep = run_snippet(STREAMING, src)
+    assert codes_of(rep, "W001") == []
+
+
+# --- repo gate + performance ----------------------------------------------
+
+
+def test_repo_is_analyzer_clean():
+    """The full ruleset (legacy + deep + waiver accounting) over the
+    real tree: zero findings — every A001/A002/A003 true positive is
+    fixed or carries a reasoned waiver, and no waiver is stale."""
+    rep = analyze_paths(repo_python_files(REPO))
+    assert rep.findings == [], "\n" + "\n".join(
+        str(f) for f in rep.findings
+    )
+    # the deep rules genuinely analyzed the tree (guards against a
+    # silently-empty collect pass reporting vacuous cleanliness)
+    a002 = [
+        res.facts.get("A002", {})
+        for res in rep.results.values()
+        if "A002" in res.facts
+    ]
+    assert sum(len(f.get("locks", [])) for f in a002) >= 20
+    assert sum(len(f.get("calls", [])) for f in a002) >= 100
+    a001 = [
+        res.facts.get("A001", {})
+        for res in rep.results.values()
+        if "A001" in res.facts
+    ]
+    donors = {
+        name
+        for f in a001
+        for name, spec in f.get("jits", {}).items()
+        if spec.get("donate") or spec.get("donate_names")
+    }
+    assert "_warm_fused_resident" in donors
+    assert "_megabatch_fused_locked" in donors
+
+
+# --- incremental cache ----------------------------------------------------
+
+
+def test_cache_reuses_and_invalidates(tmp_path):
+    f1 = tmp_path / "kafka_lag_based_assignor_tpu" / "mod.py"
+    f1.parent.mkdir(parents=True)
+    f1.write_text("import time\n\n\ndef f():\n    return time.time()\n")
+    cache_file = tmp_path / "cache.json"
+
+    cache = AnalysisCache(cache_file)
+    rep1 = analyze_paths([f1], cache=cache)
+    assert len(codes_of(rep1, "L012")) == 1
+    assert cache.misses == 1 and cache.hits == 0
+
+    cache = AnalysisCache(cache_file)
+    rep2 = analyze_paths([f1], cache=cache)
+    assert cache.hits == 1 and cache.misses == 0
+    assert [str(f) for f in rep2.findings] == [
+        str(f) for f in rep1.findings
+    ]
+
+    # an edit invalidates exactly that file
+    f1.write_text("def f():\n    return 0\n")
+    cache = AnalysisCache(cache_file)
+    rep3 = analyze_paths([f1], cache=cache)
+    assert cache.misses == 1
+    assert rep3.findings == []
+
+
+def test_cache_preserves_deep_facts(tmp_path):
+    """Cross-file findings stay correct when every file comes from the
+    cache (facts round-trip through JSON)."""
+    donor = tmp_path / "kafka_lag_based_assignor_tpu" / "a.py"
+    donor.parent.mkdir(parents=True)
+    donor.write_text(
+        "import functools\nimport jax\n\n\n"
+        "@functools.partial(jax.jit, donate_argnums=(1,))\n"
+        "def _step(lags, choice):\n    return choice\n"
+    )
+    caller = donor.parent / "b.py"
+    caller.write_text(
+        "from .a import _step\n\n\n"
+        "def go(lags, choice):\n"
+        "    out = _step(lags, choice)\n"
+        "    return choice.sum(), out\n"
+    )
+    cache_file = tmp_path / "cache.json"
+    rep1 = analyze_paths(
+        [donor, caller], cache=AnalysisCache(cache_file)
+    )
+    cache = AnalysisCache(cache_file)
+    rep2 = analyze_paths([donor, caller], cache=cache)
+    assert cache.hits == 2
+    assert len(codes_of(rep1, "A001")) == 1
+    assert [str(f) for f in rep2.findings] == [
+        str(f) for f in rep1.findings
+    ]
+
+
+# --- reporters ------------------------------------------------------------
+
+
+def _sample_report():
+    return run_snippet(STREAMING, A003_POSITIVE)
+
+
+def test_text_and_json_reports():
+    rep = _sample_report()
+    text = render_text(rep.findings, rep.stats)
+    assert "A003" in text and "finding(s)" in text
+    doc = json.loads(render_json(rep.findings, rep.stats))
+    assert doc["stats"]["findings"] == len(rep.findings)
+    assert doc["findings"][0]["code"] == "A003"
+    assert doc["findings"][0]["severity"] == "error"
+
+
+def test_sarif_is_valid_2_1_0():
+    rep = _sample_report()
+    doc = build_sarif(rep.findings, rep.stats)
+    assert doc["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in doc["$schema"]
+    try:
+        import jsonschema
+    except ImportError:
+        jsonschema = None
+    schema = {
+        # the SARIF 2.1.0 required-property skeleton for everything
+        # this tool emits (the full OASIS schema needs network)
+        "type": "object",
+        "required": ["version", "runs"],
+        "properties": {
+            "version": {"const": "2.1.0"},
+            "runs": {
+                "type": "array",
+                "minItems": 1,
+                "items": {
+                    "type": "object",
+                    "required": ["tool"],
+                    "properties": {
+                        "tool": {
+                            "type": "object",
+                            "required": ["driver"],
+                            "properties": {
+                                "driver": {
+                                    "type": "object",
+                                    "required": ["name"],
+                                }
+                            },
+                        },
+                        "results": {
+                            "type": "array",
+                            "items": {
+                                "type": "object",
+                                "required": ["message"],
+                                "properties": {
+                                    "message": {
+                                        "type": "object",
+                                        "required": ["text"],
+                                    },
+                                    "level": {
+                                        "enum": [
+                                            "none", "note",
+                                            "warning", "error",
+                                        ]
+                                    },
+                                },
+                            },
+                        },
+                    },
+                },
+            },
+        },
+    }
+    if jsonschema is not None:
+        jsonschema.validate(doc, schema)
+    driver = doc["runs"][0]["tool"]["driver"]
+    assert driver["name"] == "klba-analyze"
+    rule_ids = {r["id"] for r in driver["rules"]}
+    assert {"L001", "L021", "A001", "A002", "A003", "W001"} <= rule_ids
+    for result in doc["runs"][0]["results"]:
+        region = result["locations"][0]["physicalLocation"]["region"]
+        assert region["startLine"] >= 1
+        uri = result["locations"][0]["physicalLocation"][
+            "artifactLocation"
+        ]["uri"]
+        assert not uri.startswith("/")
+
+
+def test_sarif_clamps_line_zero():
+    """L001 syntax errors can anchor at line 0; SARIF regions are
+    1-based."""
+    rep = analyze_sources({STREAMING: "def f(:\n"})
+    assert len(codes_of(rep, "L001")) == 1
+    doc = build_sarif(rep.findings, rep.stats)
+    for result in doc["runs"][0]["results"]:
+        region = result["locations"][0]["physicalLocation"]["region"]
+        assert region["startLine"] >= 1
+
+
+# --- CLI path handling ----------------------------------------------------
+
+
+def test_cli_expands_directories_and_rejects_missing_paths(capsys):
+    from tools.analyze.cli import main
+
+    # a directory argument is expanded to its python files, not a crash
+    rc = main(
+        [str(REPO / "kafka_lag_based_assignor_tpu" / "ops"), "--no-cache"]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "0 finding(s)" in out
+
+    # a typo'd path must never let the gate pass green
+    rc = main(["no/such/file.py", "--no-cache"])
+    assert rc == 2
+    assert "no such file" in capsys.readouterr().err
+
+
+def test_cli_default_run_refuses_empty_tree(tmp_path, monkeypatch, capsys):
+    """An installed klba-analyze run from a non-checkout cwd must not
+    report a green gate over zero files."""
+    from tools.analyze import cli
+
+    monkeypatch.setattr(cli, "_repo_root", lambda: tmp_path)
+    rc = cli.main(["--no-cache"])
+    assert rc == 2
+    assert "no python files found" in capsys.readouterr().err
+
+
+def test_subset_run_skips_waiver_accounting(tmp_path, capsys):
+    """A load-bearing deep waiver whose donor lives in another module
+    must not be reported stale when only the caller is analyzed."""
+    from tools.analyze.cli import main
+
+    pkg = tmp_path / "kafka_lag_based_assignor_tpu"
+    pkg.mkdir()
+    (pkg / "defs.py").write_text(
+        "import functools\nimport jax\n\n\n"
+        "@functools.partial(jax.jit, static_argnames=('bucket',))\n"
+        "def _cold(lags, bucket):\n    return lags\n"
+    )
+    (pkg / "caller.py").write_text(
+        "from .defs import _cold\n\n\n"
+        "def go(lags):\n"
+        "    return _cold(lags, bucket=len(lags))  # noqa: A003\n"
+    )
+    # full set: waiver is used, clean
+    rep = analyze_paths([pkg / "defs.py", pkg / "caller.py"])
+    assert rep.findings == []
+    # subset via the CLI: no W001 'delete the stale waiver' lie
+    rc = main([str(pkg / "caller.py"), "--no-cache"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "W001" not in out
+
+
+# --- dump_metrics SARIF row -----------------------------------------------
+
+
+def test_analyzer_summary_line_survives_malformed_sarif(tmp_path):
+    sys.path.insert(0, str(REPO / "tools"))
+    import dump_metrics
+
+    good = tmp_path / "good.sarif"
+    rep = _sample_report()
+    good.write_text(
+        json.dumps(build_sarif(rep.findings, rep.stats)),
+        encoding="utf-8",
+    )
+    line = dump_metrics.analyzer_summary_line(good)
+    assert line.startswith("analyze: 1 finding(s)")
+    assert "error=1" in line
+
+    # absent, truncated, and structurally-malformed artifacts all
+    # degrade to "" — the operator summary must never die on them
+    assert dump_metrics.analyzer_summary_line(tmp_path / "no.sarif") == ""
+    bad = tmp_path / "bad.sarif"
+    bad.write_text('{"runs": [{"results": [null]}]}', encoding="utf-8")
+    assert dump_metrics.analyzer_summary_line(bad) == ""
+    bad.write_text('{"runs": "nope"}', encoding="utf-8")
+    assert dump_metrics.analyzer_summary_line(bad) == ""
+
+
+# --- packaging ------------------------------------------------------------
+
+
+def test_packaging_lists_every_subpackage():
+    """pyproject's explicit package list (needed to map tools/analyze
+    to the collision-proof installed name `klba_analyze`) must track
+    the on-disk subpackages — forgetting one would ship a wheel with a
+    hole in it."""
+    text = (REPO / "pyproject.toml").read_text(encoding="utf-8")
+    block = text.split("packages = [", 1)[1].split("]", 1)[0]
+    declared = {
+        line.strip().strip('",')
+        for line in block.splitlines()
+        if line.strip().startswith('"')
+    }
+    on_disk = {"kafka_lag_based_assignor_tpu"}
+    pkg_root = REPO / "kafka_lag_based_assignor_tpu"
+    for init in pkg_root.rglob("__init__.py"):
+        rel = init.parent.relative_to(REPO)
+        on_disk.add(str(rel).replace("/", "."))
+    assert on_disk <= declared, sorted(on_disk - declared)
+    assert "klba_analyze" in declared
+    assert '"tools"' not in text  # the collision-prone name never ships
+
+
+# --- fedsolve regression pins (this PR's triage) --------------------------
+
+
+def test_fedsolve_waivers_are_load_bearing():
+    """The two reasoned A003 waivers in ops/fedsolve.py still suppress
+    real findings: stripping them re-raises the finding (so the waiver
+    can never silently go stale — W001 would flag it first)."""
+    path = REPO / "kafka_lag_based_assignor_tpu" / "ops" / "fedsolve.py"
+    src = path.read_text(encoding="utf-8")
+    assert src.count("# noqa: A003") == 2
+    stripped = src.replace("  # noqa: A003", "")
+    rep = analyze_sources(
+        {"kafka_lag_based_assignor_tpu/ops/fedsolve.py": stripped},
+    )
+    assert len(codes_of(rep, "A003")) == 2

@@ -170,7 +170,8 @@ def test_row_tab_corruption_detected_by_audit():
     tab = np.asarray(row_tab).copy()
     tab[0, 0] = tab[0, 0] + 1 if tab[0, 0] + 1 < 512 else tab[0, 0] - 1
     # White-box corruption: bypass the injector, poke the table row.
-    e._resident = (choice, jax.device_put(tab), counts, lags)  # noqa: L018
+    # (L018 polices the warm-path modules only, so no waiver needed.)
+    e._resident = (choice, jax.device_put(tab), counts, lags)
     audited, fails = audit_engine(e)
     assert audited and "row_tab" in fails
 

@@ -1,0 +1,1 @@
+"""Repo tooling namespace (makes tools/ importable for tools.analyze)."""

@@ -1,0 +1,38 @@
+"""Pluggable whole-program static analysis (``klba-analyze``).
+
+Importing the package registers the full rule catalog: the L001-L021
+legacy rules (behavior-identical to the retired tools/lint.py
+monolith), the deep invariant analyses A001-A003, and the engine's
+W001 unused-waiver accounting.  See DEPLOYMENT.md "Static analysis"
+for the catalog, the waiver policy, and how to add a rule."""
+
+from . import rules_deep, rules_invariants, rules_style  # noqa: F401
+from .core import (
+    LEGACY_CODES,
+    REGISTRY,
+    FileContext,
+    FileResult,
+    Finding,
+    ProjectReport,
+    Rule,
+    analyze_paths,
+    analyze_source,
+    analyze_sources,
+    repo_python_files,
+    rule,
+)
+
+__all__ = [
+    "LEGACY_CODES",
+    "REGISTRY",
+    "FileContext",
+    "FileResult",
+    "Finding",
+    "ProjectReport",
+    "Rule",
+    "analyze_paths",
+    "analyze_source",
+    "analyze_sources",
+    "repo_python_files",
+    "rule",
+]

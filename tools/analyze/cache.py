@@ -1,0 +1,124 @@
+"""Mtime-keyed incremental cache for the analyzer.
+
+One JSON file (default ``.analyze_cache.json`` at the repo root,
+gitignored) maps each analyzed file to its (mtime_ns, size) stamp plus
+the full per-file result: post-suppression findings, fired
+suppressions, waiver comments, and whole-program facts.  Unchanged
+files skip parsing entirely — the deep rules' finalize still runs
+every time over the (cached) facts, so cross-file findings stay
+correct.  The cache key includes a digest of the analyzer's own
+sources: editing any rule invalidates everything."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import sys
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+from .core import FileResult, Finding
+
+CACHE_BASENAME = ".analyze_cache.json"
+
+
+def _engine_digest(codes: Optional[Any] = None) -> str:
+    """Digest of the analyzer package's own sources plus the selected
+    ruleset — the rules ARE the cache schema, so any edit to them (or a
+    different --legacy-only selection) must invalidate."""
+    h = hashlib.sha1()
+    h.update(f"py{sys.version_info[0]}.{sys.version_info[1]}".encode())
+    h.update(repr(sorted(codes) if codes is not None else None).encode())
+    pkg = Path(__file__).resolve().parent
+    for f in sorted(pkg.glob("*.py")):
+        h.update(f.name.encode())
+        h.update(f.read_bytes())
+    return h.hexdigest()
+
+
+def _encode(res: FileResult) -> Dict[str, Any]:
+    return {
+        "rel": res.rel,
+        "findings": [list(f) for f in res.findings],
+        "suppressed": [list(s) for s in res.suppressed],
+        "waivers": [[line, list(codes)] for line, codes in res.waivers],
+        "facts": res.facts,
+        "parse_failed": res.parse_failed,
+    }
+
+
+def _decode(payload: Dict[str, Any]) -> FileResult:
+    return FileResult(
+        rel=payload["rel"],
+        findings=[Finding(*f) for f in payload["findings"]],
+        suppressed=[tuple(s) for s in payload["suppressed"]],
+        waivers=[
+            (line, tuple(codes)) for line, codes in payload["waivers"]
+        ],
+        facts=payload["facts"],
+        parse_failed=payload["parse_failed"],
+    )
+
+
+class AnalysisCache:
+    """Load/lookup/store/save; a version or digest mismatch drops the
+    whole cache (never a partial mix of rule revisions)."""
+
+    def __init__(self, path: Path, codes: Optional[Any] = None) -> None:
+        self.path = path
+        self.digest = _engine_digest(codes)
+        self.hits = 0
+        self.misses = 0
+        self._dirty = False
+        self._entries: Dict[str, Dict[str, Any]] = {}
+        try:
+            payload = json.loads(path.read_text(encoding="utf-8"))
+            if payload.get("digest") == self.digest:
+                self._entries = payload.get("files", {})
+        except (OSError, ValueError):
+            self._entries = {}
+
+    def _stamp(self, path: Path) -> Optional[Dict[str, int]]:
+        try:
+            st = path.stat()
+        except OSError:
+            return None
+        return {"mtime_ns": st.st_mtime_ns, "size": st.st_size}
+
+    def lookup(self, path: Path) -> Optional[FileResult]:
+        entry = self._entries.get(str(path))
+        if entry is None:
+            self.misses += 1
+            return None
+        stamp = self._stamp(path)
+        if stamp is None or stamp != entry.get("stamp"):
+            self.misses += 1
+            return None
+        self.hits += 1
+        try:
+            return _decode(entry["result"])
+        except (KeyError, TypeError):
+            self.misses += 1
+            return None
+
+    def store(self, path: Path, res: FileResult) -> None:
+        stamp = self._stamp(path)
+        if stamp is None:
+            return
+        self._entries[str(path)] = {
+            "stamp": stamp,
+            "result": _encode(res),
+        }
+        self._dirty = True
+
+    def save(self) -> None:
+        if not self._dirty:
+            return
+        payload = {"digest": self.digest, "files": self._entries}
+        try:
+            self.path.write_text(
+                json.dumps(payload), encoding="utf-8"
+            )
+        except OSError:
+            pass  # a cold next run is the only cost
+        self._dirty = False

@@ -1,0 +1,152 @@
+"""The ``klba-analyze`` command line (also ``python -m tools.analyze``).
+
+Default run: every repo python file through the full ruleset
+(L001-L021 legacy + A001-A003 deep + W001 waiver accounting), text
+report to stdout, exit 1 on any finding.  ``--changed`` keeps the
+hot-loop invocation incremental via the mtime-keyed cache (unchanged
+files are never re-parsed); ``--sarif PATH`` writes the CI artifact
+next to whatever ``--format`` goes to stdout."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+from typing import List, Optional
+
+from .cache import CACHE_BASENAME, AnalysisCache
+from .core import LEGACY_CODES, analyze_paths, repo_python_files
+from .reporters import RENDERERS, render_sarif
+
+
+def _repo_root() -> Path:
+    root = Path(__file__).resolve().parent.parent.parent
+    if (root / "kafka_lag_based_assignor_tpu").is_dir():
+        return root
+    # installed console script (site-packages): analyze the checkout
+    # the operator is standing in
+    return Path.cwd()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="klba-analyze",
+        description=(
+            "whole-program static analysis for the TPU lag assignor "
+            "(rule catalog: DEPLOYMENT.md 'Static analysis')"
+        ),
+    )
+    parser.add_argument(
+        "paths", nargs="*", type=Path,
+        help="files to analyze (default: the whole repo)",
+    )
+    parser.add_argument(
+        "--format", choices=sorted(RENDERERS), default="text",
+        help="stdout report format (default: text)",
+    )
+    parser.add_argument(
+        "--sarif", type=Path, metavar="FILE",
+        help="also write a SARIF 2.1.0 artifact to FILE",
+    )
+    parser.add_argument(
+        "--changed", action="store_true",
+        help=(
+            "incremental mode: reuse the mtime-keyed cache so only "
+            "files changed since the last run are re-analyzed"
+        ),
+    )
+    parser.add_argument(
+        "--no-cache", action="store_true",
+        help="ignore and do not write the analysis cache",
+    )
+    parser.add_argument(
+        "--cache-file", type=Path,
+        help=f"cache location (default: <repo>/{CACHE_BASENAME})",
+    )
+    parser.add_argument(
+        "--legacy-only", action="store_true",
+        help="run only the L001-L021 ruleset (the tools/lint.py gate)",
+    )
+    parser.add_argument(
+        "--stats", action="store_true",
+        help="print timing + cache stats to stderr",
+    )
+    args = parser.parse_args(argv)
+
+    root = _repo_root()
+    if args.paths:
+        files = []
+        missing = []
+        for p in args.paths:
+            if p.is_dir():
+                files.extend(
+                    sorted(
+                        f for f in p.rglob("*.py")
+                        if "__pycache__" not in f.parts
+                    )
+                )
+            elif p.exists():
+                files.append(p)
+            else:
+                missing.append(p)
+        if missing:
+            # a typo'd path must never let the gate pass green
+            for p in missing:
+                print(f"klba-analyze: no such file: {p}", file=sys.stderr)
+            return 2
+        if not files:
+            print("klba-analyze: no python files to analyze",
+                  file=sys.stderr)
+            return 2
+    else:
+        try:
+            files = [
+                p.relative_to(Path.cwd()) for p in repo_python_files(root)
+            ]
+        except ValueError:
+            files = repo_python_files(root)
+        if not files:
+            # an installed script run outside a checkout must never
+            # report a green gate over zero files
+            print(
+                f"klba-analyze: no python files found under {root} — "
+                "run from a repo checkout or pass explicit paths",
+                file=sys.stderr,
+            )
+            return 2
+
+    codes = list(LEGACY_CODES) if args.legacy_only else None
+    cache = None
+    if not args.no_cache and (args.changed or not args.paths):
+        cache_path = args.cache_file or (root / CACHE_BASENAME)
+        cache = AnalysisCache(cache_path, codes=codes)
+    started = time.monotonic()
+    # explicit paths = a subset run: W001 waiver accounting is skipped
+    # (a deep waiver can look stale only because its cross-file facts
+    # are outside the analyzed set)
+    report = analyze_paths(
+        files, codes=codes, cache=cache,
+        waiver_accounting=not args.paths,
+    )
+    elapsed = time.monotonic() - started
+
+    print(RENDERERS[args.format](report.findings, report.stats))
+    if args.sarif is not None:
+        args.sarif.write_text(
+            render_sarif(report.findings, report.stats),
+            encoding="utf-8",
+        )
+    if args.stats:
+        hits = cache.hits if cache is not None else 0
+        misses = cache.misses if cache is not None else len(files)
+        print(
+            f"analyzed {len(files)} file(s) in {elapsed:.2f}s "
+            f"(cache: {hits} hit(s), {misses} miss(es))",
+            file=sys.stderr,
+        )
+    return 1 if report.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,439 @@
+"""Pluggable whole-program static-analysis engine (stdlib-only).
+
+The framework behind ``klba-analyze`` and the ``tools/lint.py``
+compatibility shim.  It provides:
+
+- per-rule registration (:func:`rule` / :func:`deep_rule`) with code,
+  severity, waivability, and an ``applies(ctx)`` scope predicate;
+- a shared :class:`FileContext` (parsed tree, raw lines, path-derived
+  scoping flags) handed to every rule, plus :func:`walk_with_scope` —
+  the enclosing-function-context traversal the legacy monolith
+  re-implemented per rule;
+- centralized ``# noqa: <CODE>`` suppression with accounting: waiver
+  comments are scanned with ``tokenize`` (string literals never count)
+  and any waiver that suppresses nothing is itself a finding (W001);
+- whole-program rules: per-file ``collect(ctx)`` produces
+  JSON-serializable facts (cacheable by tools/analyze/cache.py) and a
+  project-level ``finalize(facts_by_file)`` emits findings over the
+  merged set — how A001/A002/A003 (rules_deep) see across modules.
+
+Legacy rules L001-L021 are registered by rules_style / rules_invariants
+and are behavior-identical to the retired tools/lint.py monolith
+(pinned by tests/test_lint.py and the parity test in
+tests/test_analyze.py against tests/fixtures/legacy_lint_monolith.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    Iterable,
+    Iterator,
+    List,
+    NamedTuple,
+    Optional,
+    Sequence,
+    Tuple,
+)
+
+MAX_LINE = 100
+
+#: The ruleset the tools/lint.py shim runs (the monolith's catalog).
+LEGACY_CODES = tuple(f"L{i:03d}" for i in range(1, 22))
+
+#: Engine-level accounting code: an unused ``# noqa`` waiver.
+UNUSED_WAIVER_CODE = "W001"
+
+
+class Finding(NamedTuple):
+    """One diagnostic.  ``str()`` matches the monolith's line format so
+    existing tooling (and the parity test) see identical bytes."""
+
+    path: str
+    line: int
+    code: str
+    message: str
+    severity: str = "error"
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+class FileContext:
+    """Everything a per-file rule needs: the parsed tree, raw lines,
+    and the path-derived scoping flags the monolith computed inline."""
+
+    def __init__(self, path: Path, source: str, tree: ast.Module) -> None:
+        self.path = path
+        self.rel = str(path)
+        self.source = source
+        self.lines: List[str] = source.splitlines()
+        self.tree = tree
+        self.name = path.name
+        self.parts = path.parts
+        self.is_init = path.name == "__init__.py"
+        self.is_package = "kafka_lag_based_assignor_tpu" in path.parts
+        self.in_federated = self.is_package and "federated" in path.parts
+        self.in_sharded = "sharded" in path.parts
+        #: scratch space rules may use to share one-pass computations
+        #: (e.g. A001/A003 share the dispatch-site scan).
+        self.scratch: Dict[str, Any] = {}
+
+
+def walk_with_scope(
+    tree: ast.AST, marker: Callable[[str], bool]
+) -> Iterator[Tuple[ast.AST, bool]]:
+    """Yield ``(node, in_marked_scope)`` for every node: the scope flag
+    is True when ANY enclosing function's name satisfies ``marker`` —
+    the enclosing-function-context walk every L013-pattern rule (and
+    the deep analyses) share instead of re-implementing."""
+
+    def visit(node: ast.AST, flag: bool) -> Iterator[Tuple[ast.AST, bool]]:
+        for child in ast.iter_child_nodes(node):
+            child_flag = flag
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child_flag = flag or marker(child.name)
+            yield child, flag
+            yield from visit(child, child_flag)
+
+    return visit(tree, False)
+
+
+def _always(ctx: FileContext) -> bool:
+    return True
+
+
+@dataclass(frozen=True)
+class Rule:
+    """One registered analysis.  Per-file rules set ``check``;
+    whole-program rules set ``collect`` + ``finalize`` (facts must be
+    JSON-serializable so the incremental cache can hold them)."""
+
+    code: str
+    summary: str
+    severity: str = "error"
+    waivable: bool = False
+    applies: Callable[[FileContext], bool] = _always
+    check: Optional[Callable[[FileContext], Iterable[Finding]]] = None
+    collect: Optional[Callable[[FileContext], Any]] = None
+    finalize: Optional[
+        Callable[[Dict[str, Any]], Iterable[Finding]]
+    ] = None
+
+
+REGISTRY: Dict[str, Rule] = {}
+
+
+def register(r: Rule) -> Rule:
+    if r.code in REGISTRY:
+        raise ValueError(f"duplicate rule code {r.code!r}")
+    REGISTRY[r.code] = r
+    return r
+
+
+def rule(
+    code: str,
+    summary: str,
+    *,
+    severity: str = "error",
+    waivable: bool = False,
+    applies: Callable[[FileContext], bool] = _always,
+) -> Callable:
+    """Decorator registering a per-file rule: the function receives a
+    :class:`FileContext` and yields :class:`Finding`s (suppression is
+    the engine's job — rules never look at ``noqa`` themselves)."""
+
+    def deco(fn: Callable[[FileContext], Iterable[Finding]]) -> Callable:
+        register(
+            Rule(
+                code=code,
+                summary=summary,
+                severity=severity,
+                waivable=waivable,
+                applies=applies,
+                check=fn,
+            )
+        )
+        return fn
+
+    return deco
+
+
+def deep_rule(
+    code: str,
+    summary: str,
+    *,
+    finalize: Callable[[Dict[str, Any]], Iterable[Finding]],
+    severity: str = "error",
+    applies: Callable[[FileContext], bool] = _always,
+) -> Callable:
+    """Decorator registering a whole-program rule's ``collect`` phase;
+    ``finalize`` runs once over the merged per-file facts."""
+
+    def deco(fn: Callable[[FileContext], Any]) -> Callable:
+        register(
+            Rule(
+                code=code,
+                summary=summary,
+                severity=severity,
+                waivable=True,
+                applies=applies,
+                collect=fn,
+                finalize=finalize,
+            )
+        )
+        return fn
+
+    return deco
+
+
+# --- waiver scanning ------------------------------------------------------
+
+_NOQA_COMMENT = re.compile(
+    r"#\s*noqa:\s*([A-Z]+[0-9]+(?:\s*,\s*[A-Z]+[0-9]+)*)"
+)
+
+
+def scan_waivers(source: str) -> List[Tuple[int, Tuple[str, ...]]]:
+    """``(line, codes)`` for every real ``# noqa: X123[, Y456]`` COMMENT
+    on a line that carries code.  Tokenize-based, so noqa text inside
+    string literals (rule docs, test fixtures) never counts, and a
+    comment-only line (the ``# noqa: L014 below — ...`` justification
+    idiom) is prose, not a waiver."""
+    out: List[Tuple[int, Tuple[str, ...]]] = []
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in toks:
+            if tok.type != tokenize.COMMENT:
+                continue
+            if not tok.line[: tok.start[1]].strip():
+                continue
+            m = _NOQA_COMMENT.search(tok.string)
+            if m:
+                codes = tuple(
+                    c.strip() for c in m.group(1).split(",")
+                )
+                out.append((tok.start[0], codes))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return []
+    return out
+
+
+# --- per-file analysis ----------------------------------------------------
+
+
+@dataclass
+class FileResult:
+    """One file's analysis: post-suppression findings, the suppressions
+    that fired, the waiver comments present, and whole-program facts."""
+
+    rel: str
+    findings: List[Finding] = field(default_factory=list)
+    suppressed: List[Tuple[int, str]] = field(default_factory=list)
+    waivers: List[Tuple[int, Tuple[str, ...]]] = field(
+        default_factory=list
+    )
+    facts: Dict[str, Any] = field(default_factory=dict)
+    parse_failed: bool = False
+
+
+def _selected(codes: Optional[Sequence[str]]) -> List[Rule]:
+    if codes is None:
+        return [REGISTRY[c] for c in sorted(REGISTRY)]
+    return [REGISTRY[c] for c in codes if c in REGISTRY]
+
+
+def analyze_source(
+    path: Path,
+    source: str,
+    codes: Optional[Sequence[str]] = None,
+    with_facts: bool = False,
+) -> FileResult:
+    """Run the selected per-file rules (default: all registered) over
+    one source blob; optionally run the selected deep rules' collect
+    phase.  Suppression (``noqa: <code>`` on the finding's line, the
+    monolith's substring semantics) is applied here for per-file rules;
+    deep-rule findings are suppressed at finalize time from the waiver
+    records."""
+    rules = _selected(codes)
+    rel = str(path)
+    result = FileResult(rel=rel)
+    try:
+        tree = ast.parse(source, filename=rel)
+    except SyntaxError as exc:
+        result.parse_failed = True
+        if any(r.code == "L001" for r in rules):
+            result.findings.append(
+                Finding(
+                    rel, exc.lineno or 0, "L001",
+                    f"syntax error: {exc.msg}",
+                )
+            )
+        return result
+    ctx = FileContext(path, source, tree)
+    for r in rules:
+        if r.check is None or not r.applies(ctx):
+            continue
+        for f in r.check(ctx):
+            if (
+                r.waivable
+                and 0 < f.line <= len(ctx.lines)
+                and f"noqa: {r.code}" in ctx.lines[f.line - 1]
+            ):
+                result.suppressed.append((f.line, r.code))
+            else:
+                result.findings.append(f)
+    result.waivers = scan_waivers(source)
+    if with_facts:
+        for r in rules:
+            if r.collect is not None and r.applies(ctx):
+                result.facts[r.code] = r.collect(ctx)
+    return result
+
+
+# --- project-level analysis -----------------------------------------------
+
+
+@dataclass
+class ProjectReport:
+    findings: List[Finding]
+    stats: Dict[str, Any]
+    results: Dict[str, FileResult]
+
+
+def _finish(
+    results: Dict[str, FileResult],
+    codes: Optional[Sequence[str]],
+    waiver_accounting: bool = True,
+) -> ProjectReport:
+    """Deep-rule finalize + waiver accounting over per-file results.
+    ``waiver_accounting=False`` skips W001 — on a SUBSET run a deep
+    waiver can look stale merely because the facts that make it fire
+    (a donor in another module) are outside the analyzed set."""
+    rules = _selected(codes)
+    findings: List[Finding] = []
+    used: Dict[str, set] = {}
+    for rel, res in results.items():
+        findings.extend(res.findings)
+        used[rel] = set(res.suppressed)
+
+    for r in rules:
+        if r.finalize is None:
+            continue
+        facts = {
+            rel: res.facts[r.code]
+            for rel, res in results.items()
+            if r.code in res.facts
+        }
+        for f in r.finalize(facts):
+            res = results.get(f.path)
+            waived = False
+            if res is not None:
+                for line, wcodes in res.waivers:
+                    if line == f.line and r.code in wcodes:
+                        used[f.path].add((line, r.code))
+                        waived = True
+                        break
+            if not waived:
+                findings.append(f)
+
+    run_unused = waiver_accounting and (
+        codes is None or UNUSED_WAIVER_CODE in codes
+    )
+    unused = 0
+    if run_unused:
+        ran = {r.code for r in rules}
+        for rel, res in results.items():
+            if res.parse_failed:
+                continue
+            for line, wcodes in res.waivers:
+                for code in wcodes:
+                    r = REGISTRY.get(code)
+                    if r is None or not r.waivable or code not in ran:
+                        continue
+                    if (line, code) in used[rel]:
+                        continue
+                    unused += 1
+                    findings.append(
+                        Finding(
+                            rel, line, UNUSED_WAIVER_CODE,
+                            f"unused suppression `# noqa: {code}`: no "
+                            f"{code} finding is suppressed on this "
+                            "line — delete the stale waiver",
+                            "warning",
+                        )
+                    )
+
+    findings.sort(key=lambda f: (f.path, f.line, f.code, f.message))
+    by_code: Dict[str, int] = {}
+    for f in findings:
+        by_code[f.code] = by_code.get(f.code, 0) + 1
+    stats = {
+        "files": len(results),
+        "findings": len(findings),
+        "by_code": by_code,
+        "suppressed": sum(len(u) for u in used.values()),
+        "unused_waivers": unused,
+    }
+    return ProjectReport(findings=findings, stats=stats, results=results)
+
+
+def analyze_sources(
+    sources: Dict[str, str], codes: Optional[Sequence[str]] = None
+) -> ProjectReport:
+    """Analyze an in-memory {relpath: source} tree — the fixture-test
+    entry point (exercises per-file rules AND deep finalize)."""
+    results = {
+        rel: analyze_source(Path(rel), src, codes=codes, with_facts=True)
+        for rel, src in sources.items()
+    }
+    return _finish(results, codes)
+
+
+def analyze_paths(
+    paths: Iterable[Path],
+    codes: Optional[Sequence[str]] = None,
+    cache: Optional[Any] = None,
+    waiver_accounting: bool = True,
+) -> ProjectReport:
+    """Analyze files on disk; ``cache`` (tools/analyze/cache.py) makes
+    repeat runs incremental — unchanged files reuse their findings,
+    suppressions, waivers, and deep-rule facts.  Pass
+    ``waiver_accounting=False`` for subset runs (see :func:`_finish`)."""
+    results: Dict[str, FileResult] = {}
+    for path in paths:
+        rel = str(path)
+        cached = cache.lookup(path) if cache is not None else None
+        if cached is not None:
+            results[rel] = cached
+            continue
+        res = analyze_source(
+            path, path.read_text(encoding="utf-8"), codes=codes,
+            with_facts=True,
+        )
+        results[rel] = res
+        if cache is not None:
+            cache.store(path, res)
+    if cache is not None:
+        cache.save()
+    return _finish(results, codes, waiver_accounting=waiver_accounting)
+
+
+def repo_python_files(root: Path) -> List[Path]:
+    """Every python file the gate scans (the monolith's list, plus the
+    analyzer package itself via the recursive tools glob)."""
+    files = [root / "bench.py", root / "__graft_entry__.py"]
+    files += sorted((root / "kafka_lag_based_assignor_tpu").rglob("*.py"))
+    files += sorted((root / "tests").glob("*.py"))
+    files += sorted((root / "tools").rglob("*.py"))
+    return [
+        f for f in files if f.exists() and "__pycache__" not in f.parts
+    ]

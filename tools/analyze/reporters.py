@@ -1,0 +1,132 @@
+"""Output formats for the analyzer: text (the monolith's line format),
+JSON, and SARIF 2.1.0 (the CI artifact format — uploaded by tier1.yml
+and consumed by tools/dump_metrics.py --summary)."""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+from .core import REGISTRY, Finding
+
+SARIF_SCHEMA_URI = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+SARIF_VERSION = "2.1.0"
+TOOL_NAME = "klba-analyze"
+TOOL_VERSION = "1.0.0"
+
+
+def render_text(findings: List[Finding], stats: Dict[str, Any]) -> str:
+    lines = [str(f) for f in findings]
+    lines.append(
+        f"{stats['findings']} finding(s), {stats['suppressed']} "
+        f"suppressed, {stats['unused_waivers']} unused waiver(s), "
+        f"{stats['files']} file(s)"
+    )
+    return "\n".join(lines)
+
+
+def render_json(findings: List[Finding], stats: Dict[str, Any]) -> str:
+    return json.dumps(
+        {
+            "findings": [
+                {
+                    "path": f.path,
+                    "line": f.line,
+                    "code": f.code,
+                    "message": f.message,
+                    "severity": f.severity,
+                }
+                for f in findings
+            ],
+            "stats": stats,
+        },
+        indent=2,
+        sort_keys=True,
+    )
+
+
+def _sarif_level(severity: str) -> str:
+    return severity if severity in ("error", "warning", "note") else "none"
+
+
+def build_sarif(
+    findings: List[Finding], stats: Dict[str, Any]
+) -> Dict[str, Any]:
+    """A minimal-but-valid SARIF 2.1.0 document: tool.driver rule
+    metadata from the registry, one result per finding, and the run
+    stats stashed in ``runs[0].properties`` (dump_metrics reads
+    them)."""
+    rules = []
+    for code in sorted(REGISTRY):
+        r = REGISTRY[code]
+        rules.append(
+            {
+                "id": r.code,
+                "shortDescription": {"text": r.summary},
+                "defaultConfiguration": {
+                    "level": _sarif_level(r.severity)
+                },
+                "properties": {"waivable": r.waivable},
+            }
+        )
+    rules.append(
+        {
+            "id": "W001",
+            "shortDescription": {"text": "unused `# noqa` waiver"},
+            "defaultConfiguration": {"level": "warning"},
+            "properties": {"waivable": False},
+        }
+    )
+    results = []
+    for f in findings:
+        uri = f.path.replace("\\", "/").lstrip("/")
+        results.append(
+            {
+                "ruleId": f.code,
+                "level": _sarif_level(f.severity),
+                "message": {"text": f.message},
+                "locations": [
+                    {
+                        "physicalLocation": {
+                            "artifactLocation": {"uri": uri},
+                            "region": {"startLine": max(f.line, 1)},
+                        }
+                    }
+                ],
+            }
+        )
+    return {
+        "$schema": SARIF_SCHEMA_URI,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": TOOL_NAME,
+                        "version": TOOL_VERSION,
+                        "informationUri": (
+                            "https://github.com/grantneale/"
+                            "kafka-lag-based-assignor"
+                        ),
+                        "rules": rules,
+                    }
+                },
+                "results": results,
+                "properties": dict(stats),
+            }
+        ],
+    }
+
+
+def render_sarif(findings: List[Finding], stats: Dict[str, Any]) -> str:
+    return json.dumps(build_sarif(findings, stats), indent=2)
+
+
+RENDERERS = {
+    "text": render_text,
+    "json": render_json,
+    "sarif": render_sarif,
+}

@@ -1,0 +1,1004 @@
+"""Deep whole-program analyses A001-A003 — the invariants the bench
+gates and chaos soaks only catch at runtime, proven at review time.
+
+  A001  donation safety: a value passed at a ``donate_argnums`` /
+        ``donate_argnames`` position of a jitted dispatch is INVALID
+        after the dispatch (XLA reuses its buffer).  Any read of that
+        binding on a path after the dispatch — including the next
+        iteration of an enclosing warm loop — is the silent-corruption
+        class the resident-state scrubber only detects after the fact.
+  A002  lock-order / held-lock discipline: builds the project-wide
+        lock-acquisition graph (``with <lock>:`` nesting plus one level
+        of interprocedural resolution through calls made under a held
+        lock), flags cycles and non-reentrant self-acquisition, and
+        flags registry / flight-dump / device-sync calls made while a
+        breaker or stream lock is held — the round-8 bug class
+        (``note_breaker_trip`` under the watchdog lock stalled every
+        thread's fail-fast admission during an incident).
+  A003  recompile hazard: a call site of a jitted function whose
+        STATIC argument derives from an unbucketed runtime value
+        (``len(...)`` / ``.shape``) mints one executable per distinct
+        value — the compile-storm class the ``compile_count`` bench
+        gates only catch at runtime.  Static args must be constants or
+        flow through the pow2 ladder helpers (``pad_bucket`` /
+        ``delta_bucket`` / ``table_rows`` / ``pad_chunk`` / ladders).
+
+All three collect JSON-serializable per-file facts (cacheable) and
+finalize over the merged set, so a donor defined in ops/streaming.py is
+matched at its coalescer call sites.  Waivable with ``# noqa: A00x``
+stating a reason.  Known limits (deliberate — reviewer aid, not a
+verifier): bindings are tracked syntactically at the dispatch site
+(aliases of the same buffer through other names are not followed), a
+kill inside one branch of a conditional counts for all paths, and lock
+identity is name-based (per-instance locks of one class share a node).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from .core import FileContext, Finding, deep_rule
+
+# --- shared helpers -------------------------------------------------------
+
+
+def _expr_terminal(node: ast.AST) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _short(node: ast.AST) -> str:
+    try:
+        text = ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is total on 3.10
+        return "<expr>"
+    return text if len(text) <= 40 else text[:37] + "..."
+
+
+def _int_seq_kw(call: ast.Call, name: str) -> Optional[List[int]]:
+    for kw in call.keywords:
+        if kw.arg != name:
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return [v.value]
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = []
+            for e in v.elts:
+                if not (
+                    isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)
+                ):
+                    return None
+                out.append(e.value)
+            return out
+        return None
+    return None
+
+
+def _str_seq_kw(call: ast.Call, name: str) -> Optional[List[str]]:
+    for kw in call.keywords:
+        if kw.arg != name:
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            return [v.value]
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = []
+            for e in v.elts:
+                if not (
+                    isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)
+                ):
+                    return None
+                out.append(e.value)
+            return out
+        return None
+    return None
+
+
+def _jit_call_info(call: ast.Call) -> Optional[Dict[str, Any]]:
+    """Recognize ``jax.jit(...)`` and ``functools.partial(jax.jit,
+    ...)`` and extract the donation/static configuration; None when the
+    call is neither or carries none of the four keywords."""
+    fname = _expr_terminal(call.func)
+    if fname == "partial":
+        if not (call.args and _expr_terminal(call.args[0]) == "jit"):
+            return None
+    elif fname != "jit":
+        return None
+    info = {
+        "donate": _int_seq_kw(call, "donate_argnums"),
+        "donate_names": _str_seq_kw(call, "donate_argnames"),
+        "static_nums": _int_seq_kw(call, "static_argnums"),
+        "static_names": _str_seq_kw(call, "static_argnames"),
+    }
+    if all(v is None for v in info.values()):
+        return None
+    return info
+
+
+def _fn_params(fn: ast.AST) -> List[str]:
+    args = fn.args
+    return [a.arg for a in list(args.posonlyargs) + list(args.args)]
+
+
+# --- use-after-donation machinery (A001) ----------------------------------
+
+
+def _child_blocks(stmt: ast.stmt) -> Iterator[List[ast.stmt]]:
+    for name in ("body", "orelse", "finalbody"):
+        blk = getattr(stmt, name, None)
+        if blk and isinstance(blk, list):
+            yield blk
+    for handler in getattr(stmt, "handlers", []) or []:
+        if handler.body:
+            yield handler.body
+
+
+def _find_chain(
+    body: List[ast.stmt], call: ast.Call
+) -> Optional[List[Tuple[List[ast.stmt], int]]]:
+    """Ancestor chain [(block, index), ...] from the given block down
+    to the innermost statement containing ``call``; nested function /
+    class bodies are not descended (they do not execute here)."""
+    for i, stmt in enumerate(body):
+        if not any(n is call for n in ast.walk(stmt)):
+            continue
+        if not isinstance(
+            stmt,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+        ):
+            for blk in _child_blocks(stmt):
+                sub = _find_chain(blk, call)
+                if sub is not None:
+                    return [(body, i)] + sub
+        return [(body, i)]
+    return None
+
+
+def _emit_events(node: ast.AST, out: List[Tuple[str, tuple, int]]) -> None:
+    """Append (kind, key, line) binding events for one statement or
+    expression in approximate execution order.  Keys: ``("n", name)``
+    for plain names, ``("a", base, attr)`` for ``base.attr``."""
+    if isinstance(
+        node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+    ):
+        return  # a nested def's body does not execute here
+    if isinstance(node, ast.Assign):
+        _emit_events(node.value, out)
+        for t in node.targets:
+            _emit_events(t, out)
+        return
+    if isinstance(node, ast.AnnAssign):
+        if node.value is not None:
+            _emit_events(node.value, out)
+        _emit_events(node.target, out)
+        return
+    if isinstance(node, ast.AugAssign):
+        _emit_events(node.value, out)
+        # x += v both reads and rebinds x
+        key = _event_key(node.target)
+        if key is not None:
+            out.append(("load", key, node.target.lineno))
+            out.append(("store", key, node.target.lineno))
+        else:
+            _emit_events(node.target, out)
+        return
+    if isinstance(node, ast.Name):
+        key = ("n", node.id)
+        kind = "load" if isinstance(node.ctx, ast.Load) else "store"
+        out.append((kind, key, node.lineno))
+        return
+    if isinstance(node, ast.Attribute) and isinstance(
+        node.value, ast.Name
+    ):
+        key = ("a", node.value.id, node.attr)
+        if isinstance(node.ctx, ast.Load):
+            out.append(("load", key, node.lineno))
+            out.append(("load", ("n", node.value.id), node.lineno))
+        else:
+            out.append(("store", key, node.lineno))
+        return
+    if isinstance(node, ast.Call):
+        func = node.func
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and any(
+                m in func.attr for m in ("resident", "adopt", "drop")
+            )
+        ):
+            # an audited swap helper re-installs the base's buffers:
+            # evaluate its arguments, then treat the base as refreshed
+            for a in node.args:
+                _emit_events(a, out)
+            for kw in node.keywords:
+                _emit_events(kw.value, out)
+            out.append(("killbase", ("n", func.value.id), node.lineno))
+            return
+    for child in ast.iter_child_nodes(node):
+        _emit_events(child, out)
+
+
+def _event_key(node: ast.AST) -> Optional[tuple]:
+    if isinstance(node, ast.Name):
+        return ("n", node.id)
+    if isinstance(node, ast.Attribute) and isinstance(
+        node.value, ast.Name
+    ):
+        return ("a", node.value.id, node.attr)
+    return None
+
+
+def _track_key(expr: ast.AST) -> Optional[tuple]:
+    """The binding a donated argument expression reads: a plain name, a
+    ``container[i]`` element (tracked as the container name), or a
+    ``base.attr`` field."""
+    if isinstance(expr, ast.Name):
+        return ("n", expr.id)
+    if isinstance(expr, ast.Subscript) and isinstance(
+        expr.value, ast.Name
+    ):
+        return ("n", expr.value.id)
+    if isinstance(expr, ast.Attribute) and isinstance(
+        expr.value, ast.Name
+    ):
+        return ("a", expr.value.id, expr.attr)
+    return None
+
+
+def _scan_events(
+    events: List[Tuple[str, tuple, int]], key: tuple
+) -> Tuple[Optional[str], Optional[int]]:
+    """First decisive event for ``key``: ("use", line), ("killed",
+    None), or (None, None) when the binding is never touched."""
+    for kind, k, line in events:
+        if kind == "store":
+            if k == key:
+                return "killed", None
+            if key[0] == "a" and k == ("n", key[1]):
+                return "killed", None
+        elif kind == "killbase":
+            if k == key:
+                return "killed", None
+            if key[0] == "a" and k == ("n", key[1]):
+                return "killed", None
+        elif kind == "load" and k == key:
+            return "use", line
+    return None, None
+
+
+def _use_after_call(
+    fn_body: List[ast.stmt], call: ast.Call, key: tuple
+) -> Optional[int]:
+    """Line of the first read of ``key`` after the statement containing
+    ``call`` (before any rebind), following the tail of every enclosing
+    block and the back edge of the innermost enclosing loop; None when
+    the binding is rebound first or never read again."""
+    chain = _find_chain(fn_body, call)
+    if chain is None:
+        return None
+    events: List[Tuple[str, tuple, int]] = []
+    block, idx = chain[-1]
+    stmt = block[idx]
+    # the dispatch statement's own targets rebind AFTER the call runs
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            _emit_events(t, events)
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        _emit_events(stmt.target, events)
+    for blk, i in reversed(chain):
+        for later in blk[i + 1:]:
+            _emit_events(later, events)
+    verdict, line = _scan_events(events, key)
+    if verdict is not None:
+        return line
+    # back edge: the innermost enclosing loop replays its body, so the
+    # dispatch's own argument loads become next-iteration reads
+    for blk, i in reversed(chain[:-1]):
+        s = blk[i]
+        if isinstance(s, (ast.For, ast.AsyncFor, ast.While)):
+            loop_events: List[Tuple[str, tuple, int]] = []
+            for body_stmt in s.body:
+                _emit_events(body_stmt, loop_events)
+            verdict, line = _scan_events(loop_events, key)
+            return line if verdict == "use" else None
+    return None
+
+
+# --- A003 raw-runtime detection -------------------------------------------
+
+_BUCKET_MARKERS = ("bucket", "pad_chunk", "table_rows", "ladder", "pow2")
+
+
+def _is_bucketing_call(call: ast.Call) -> bool:
+    name = _expr_terminal(call.func)
+    return any(m in name for m in _BUCKET_MARKERS)
+
+
+def _expr_is_raw(expr: ast.AST) -> bool:
+    """True when the expression derives from ``len(...)`` or ``.shape``
+    WITHOUT flowing through a sanctioned bucketing helper."""
+    stack = [expr]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Call):
+            if _is_bucketing_call(n):
+                continue  # sanctioned: do not descend
+            if (
+                isinstance(n.func, ast.Name) and n.func.id == "len"
+            ):
+                return True
+            stack.extend(ast.iter_child_nodes(n))
+            continue
+        if isinstance(n, ast.Attribute) and n.attr == "shape":
+            return True
+        stack.extend(ast.iter_child_nodes(n))
+    return False
+
+
+def _last_assign_rhs(
+    fn: Optional[ast.AST], name: str, before_line: int
+) -> Optional[ast.AST]:
+    if fn is None:
+        return None
+    best: Optional[ast.AST] = None
+    best_line = -1
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+            value = node.value
+        else:
+            continue
+        if node.lineno >= before_line or node.lineno <= best_line:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == name:
+                best, best_line = value, node.lineno
+    return best
+
+
+def _arg_is_raw(
+    expr: ast.AST, fn: Optional[ast.AST], call_line: int
+) -> bool:
+    seen: set = set()
+    e = expr
+    for _ in range(4):
+        if not isinstance(e, ast.Name):
+            break
+        if e.id in seen:
+            return False
+        seen.add(e.id)
+        rhs = _last_assign_rhs(fn, e.id, call_line)
+        if rhs is None:
+            return False  # parameter / attribute state: taken on faith
+        e = rhs
+    return _expr_is_raw(e)
+
+
+# --- shared dispatch-site scan (A001 + A003) ------------------------------
+
+_PKG = "kafka_lag_based_assignor_tpu"
+
+
+def _dispatch_scan(ctx: FileContext) -> Dict[str, Any]:
+    """One pass shared by A001 and A003: the file's jit registry
+    (donation + static config) and, for every call site of a local or
+    package-imported jitted candidate, per-argument facts — the first
+    use-after-dispatch line of the binding it reads, and whether it is
+    an unbucketed runtime derivation."""
+    if "dispatch" in ctx.scratch:
+        return ctx.scratch["dispatch"]
+
+    module_fns = {
+        n.name: n
+        for n in ctx.tree.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    jits: Dict[str, Dict[str, Any]] = {}
+    jit_wrapped: set = set()  # ANY jit decoration, kwargs or not
+    imported: set = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, (ast.Name, ast.Attribute)):
+                    if _expr_terminal(dec) == "jit":
+                        jit_wrapped.add(node.name)  # bare @jax.jit
+                    continue
+                if not isinstance(dec, ast.Call):
+                    continue
+                dec_name = _expr_terminal(dec.func)
+                if dec_name == "jit" or (
+                    dec_name == "partial"
+                    and dec.args
+                    and _expr_terminal(dec.args[0]) == "jit"
+                ):
+                    jit_wrapped.add(node.name)
+                info = _jit_call_info(dec)
+                if info is not None:
+                    info["params"] = _fn_params(node)
+                    jits[node.name] = info
+        elif isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Call
+        ):
+            info = _jit_call_info(node.value)
+            if info is not None and len(node.targets) == 1 and isinstance(
+                node.targets[0], ast.Name
+            ):
+                inner = (
+                    node.value.args[0] if node.value.args else None
+                )
+                params = None
+                if isinstance(inner, ast.Name) and inner.id in module_fns:
+                    params = _fn_params(module_fns[inner.id])
+                info["params"] = params
+                jits[node.targets[0].id] = info
+        elif isinstance(node, ast.Import):
+            # only package-origin imports can name a project jit —
+            # np/jnp/jax library calls are never donors/static sites,
+            # and scanning them would dominate the cold run + cache
+            for alias in node.names:
+                if alias.name.startswith(_PKG):
+                    imported.add(
+                        alias.asname or alias.name.split(".")[0]
+                    )
+        elif isinstance(node, ast.ImportFrom):
+            if node.level == 0 and not (
+                node.module or ""
+            ).startswith(_PKG):
+                continue  # absolute import of a foreign library
+            for alias in node.names:
+                if alias.name != "*":
+                    imported.add(alias.asname or alias.name)
+
+    candidates = set(jits) | imported
+    calls: List[Dict[str, Any]] = []
+
+    def arg_fact(
+        expr: ast.AST, fn: Optional[ast.AST], call: ast.Call
+    ) -> Dict[str, Any]:
+        fact: Dict[str, Any] = {
+            "desc": _short(expr),
+            "line": expr.lineno,
+        }
+        key = _track_key(expr)
+        if key is not None and fn is not None:
+            fact["use"] = _use_after_call(fn.body, call, key)
+        else:
+            fact["use"] = None
+        fact["raw"] = _arg_is_raw(expr, fn, call.lineno)
+        return fact
+
+    def visit(node: ast.AST, fn: Optional[ast.AST]) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_fn = fn
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                child_fn = child
+            if isinstance(child, ast.Call):
+                tname = _expr_terminal(child.func)
+                dotted = (
+                    isinstance(child.func, ast.Attribute)
+                    and isinstance(child.func.value, ast.Name)
+                    and child.func.value.id in imported
+                )
+                if tname in candidates or dotted:
+                    calls.append(
+                        {
+                            "callee": tname,
+                            "line": child.lineno,
+                            "in_jit": fn is not None
+                            and (
+                                fn.name in jits
+                                or fn.name in jit_wrapped
+                            ),
+                            "args": [
+                                arg_fact(a, fn, child)
+                                for a in child.args
+                            ],
+                            "kwargs": {
+                                kw.arg: arg_fact(kw.value, fn, child)
+                                for kw in child.keywords
+                                if kw.arg is not None
+                            },
+                        }
+                    )
+            visit(child, child_fn)
+
+    visit(ctx.tree, None)
+    scan = {"jits": jits, "calls": calls}
+    ctx.scratch["dispatch"] = scan
+    return scan
+
+
+# --- A001 donation safety -------------------------------------------------
+
+
+def _finalize_a001(facts: Dict[str, Any]) -> Iterator[Finding]:
+    donors: Dict[str, Dict[str, Any]] = {}
+    for f in facts.values():
+        for name, spec in f.get("jits", {}).items():
+            if spec.get("donate") or spec.get("donate_names"):
+                donors.setdefault(name, spec)
+    for f in facts.values():
+        rel = f["rel"]
+        for call in f.get("calls", []):
+            spec = donors.get(call["callee"])
+            if spec is None:
+                continue
+            params = spec.get("params")
+            donated_names = set(spec.get("donate_names") or [])
+            positions = list(spec.get("donate") or [])
+            for p in positions:
+                if params and p < len(params):
+                    donated_names.add(params[p])
+            hits: List[Tuple[Dict[str, Any], int]] = []
+            for p in positions:
+                if p < len(call["args"]):
+                    hits.append((call["args"][p], p))
+            for name, fact in call.get("kwargs", {}).items():
+                if name in donated_names:
+                    hits.append((fact, -1))
+            for fact, _pos in hits:
+                use = fact.get("use")
+                if use is None:
+                    continue
+                yield Finding(
+                    rel,
+                    use,
+                    "A001",
+                    f"use after donation: `{fact['desc']}` was "
+                    f"donated to {call['callee']}() (dispatch at "
+                    f"line {call['line']}) and is read afterwards — "
+                    "XLA reuses donated buffers, so this read sees "
+                    "corrupt data; rebind the dispatch result (or "
+                    "waive with `# noqa: A001`)",
+                )
+
+
+@deep_rule(
+    "A001",
+    "use of a donated buffer after its jit dispatch",
+    finalize=_finalize_a001,
+    applies=lambda ctx: ctx.is_package,
+)
+def collect_a001(ctx: FileContext) -> Dict[str, Any]:
+    scan = _dispatch_scan(ctx)
+    return {"rel": ctx.rel, "jits": scan["jits"], "calls": scan["calls"]}
+
+
+# --- A003 recompile hazard ------------------------------------------------
+
+
+def _finalize_a003(facts: Dict[str, Any]) -> Iterator[Finding]:
+    jits: Dict[str, Dict[str, Any]] = {}
+    for f in facts.values():
+        for name, spec in f.get("jits", {}).items():
+            if spec.get("static_nums") or spec.get("static_names"):
+                jits.setdefault(name, spec)
+    for f in facts.values():
+        rel = f["rel"]
+        for call in f.get("calls", []):
+            spec = jits.get(call["callee"])
+            if spec is None:
+                continue
+            if call.get("in_jit"):
+                # inside an enclosing jit trace the inner call inlines
+                # — .shape is a trace-time static, bucketed by the
+                # OUTER executable's signature, not a fresh compile
+                continue
+            params = spec.get("params")
+            static_names = set(spec.get("static_names") or [])
+            positions = list(spec.get("static_nums") or [])
+            for name in static_names:
+                if params and name in params:
+                    positions.append(params.index(name))
+            hits: List[Dict[str, Any]] = []
+            for p in set(positions):
+                if p < len(call["args"]):
+                    hits.append(call["args"][p])
+            for name, fact in call.get("kwargs", {}).items():
+                if name in static_names:
+                    hits.append(fact)
+            for fact in hits:
+                if not fact.get("raw"):
+                    continue
+                yield Finding(
+                    rel,
+                    fact.get("line") or call["line"],
+                    "A003",
+                    f"recompile hazard: static argument "
+                    f"`{fact['desc']}` to jitted {call['callee']}() "
+                    "derives from an unbucketed runtime value "
+                    "(len()/.shape) — every distinct value mints an "
+                    "executable; route it through the pow2 ladder "
+                    "(pad_bucket/delta_bucket/table_rows) or waive "
+                    "with `# noqa: A003`",
+                )
+
+
+@deep_rule(
+    "A003",
+    "jit static argument from an unbucketed runtime value",
+    finalize=_finalize_a003,
+    applies=lambda ctx: ctx.is_package,
+)
+def collect_a003(ctx: FileContext) -> Dict[str, Any]:
+    scan = _dispatch_scan(ctx)
+    return {"rel": ctx.rel, "jits": scan["jits"], "calls": scan["calls"]}
+
+
+# --- A002 lock order / held-lock discipline -------------------------------
+
+#: Calls that must never run under a breaker or stream lock: registry
+#: access, flight-recorder dumps (JSON build + file write), and
+#: blocking device syncs — each can stall every other thread's
+#: fail-fast admission exactly during an incident.
+_A002_BANNED = frozenset(
+    {
+        "note_breaker_trip",
+        "flight_recorder",
+        "dump_flight",
+        "registry",
+        "get_registry",
+        "device_get",
+        "block_until_ready",
+    }
+)
+
+
+def _lock_ref(
+    expr: ast.AST, cls: Optional[str]
+) -> Optional[Dict[str, Any]]:
+    """A name-based reference to an acquired lock, or None when the
+    with-item is not lock-shaped (only attrs/names containing 'lock'
+    count)."""
+    if isinstance(expr, ast.Attribute) and isinstance(
+        expr.value, ast.Name
+    ):
+        if "lock" not in expr.attr.lower():
+            return None
+        base = expr.value.id
+        return {
+            "base": base,
+            "attr": expr.attr,
+            "cls": cls if base == "self" else None,
+        }
+    if isinstance(expr, ast.Name) and "lock" in expr.id.lower():
+        return {"base": None, "attr": expr.id, "cls": None}
+    return None
+
+
+def collect_a002_facts(ctx: FileContext) -> Dict[str, Any]:
+    locks: List[Dict[str, Any]] = []
+    edges: List[Dict[str, Any]] = []
+    calls: List[Dict[str, Any]] = []
+    fn_locks: Dict[str, List[Dict[str, Any]]] = {}
+
+    def record_lock_def(node: ast.Assign, cls: Optional[str]) -> None:
+        value = node.value
+        if not (
+            isinstance(value, ast.Call)
+            and _expr_terminal(value.func) in ("Lock", "RLock")
+        ):
+            return
+        kind = _expr_terminal(value.func)
+        for t in node.targets:
+            if (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+            ):
+                locks.append(
+                    {
+                        "cls": cls,
+                        "name": t.attr,
+                        "kind": kind,
+                        "line": node.lineno,
+                    }
+                )
+            elif isinstance(t, ast.Name):
+                locks.append(
+                    {
+                        "cls": None,
+                        "name": t.id,
+                        "kind": kind,
+                        "line": node.lineno,
+                    }
+                )
+
+    def visit(
+        node: ast.AST,
+        cls: Optional[str],
+        fn: Optional[str],
+        held: List[Dict[str, Any]],
+    ) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                visit(child, child.name, None, [])
+                continue
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                visit(child, cls, child.name, [])
+                continue
+            if isinstance(child, ast.Assign):
+                record_lock_def(child, cls)
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                refs = []
+                for item in child.items:
+                    ref = _lock_ref(item.context_expr, cls)
+                    if ref is not None:
+                        refs.append(ref)
+                for ref in refs:
+                    for outer in held:
+                        edges.append(
+                            {
+                                "outer": outer,
+                                "inner": ref,
+                                "line": child.lineno,
+                                "fn": fn,
+                            }
+                        )
+                    if fn is not None:
+                        fn_locks.setdefault(fn, []).append(ref)
+                visit(child, cls, fn, held + refs)
+                continue
+            if isinstance(child, ast.Call) and held:
+                calls.append(
+                    {
+                        "locks": list(held),
+                        "callee": _expr_terminal(child.func),
+                        "line": child.lineno,
+                    }
+                )
+            visit(child, cls, fn, held)
+
+    visit(ctx.tree, None, None, [])
+    return {
+        "rel": ctx.rel,
+        "locks": locks,
+        "edges": edges,
+        "calls": calls,
+        "fn_locks": fn_locks,
+    }
+
+
+def _a002_resolver(facts: Dict[str, Any]):
+    """Build a lock-reference resolver over every file's lock defs:
+    returns (resolve(ref, rel) -> (lock_id, kind, def_rel), ...)."""
+    by_cls_attr: Dict[Tuple[str, str], List[Tuple[str, str]]] = {}
+    by_attr: Dict[str, List[Tuple[str, str, Optional[str]]]] = {}
+    by_global: Dict[Tuple[str, str], str] = {}
+    for rel, f in facts.items():
+        for d in f.get("locks", []):
+            if d["cls"] is not None:
+                by_cls_attr.setdefault((d["cls"], d["name"]), []).append(
+                    (rel, d["kind"])
+                )
+            else:
+                by_global[(rel, d["name"])] = d["kind"]
+            by_attr.setdefault(d["name"], []).append(
+                (rel, d["kind"], d["cls"])
+            )
+
+    def shortmod(rel: str) -> str:
+        parts = rel.replace("\\", "/").split("/")
+        return "/".join(parts[-2:])
+
+    def resolve(
+        ref: Dict[str, Any], rel: str
+    ) -> Tuple[str, Optional[str], Optional[str]]:
+        cls = ref.get("cls")
+        attr = ref["attr"]
+        if cls is not None:
+            defs = by_cls_attr.get((cls, attr), [])
+            if defs:
+                drel, kind = defs[0]
+                return f"{shortmod(drel)}::{cls}.{attr}", kind, drel
+            return f"{shortmod(rel)}::{cls}.{attr}", None, rel
+        if ref.get("base") is None:
+            kind = by_global.get((rel, attr))
+            if kind is not None:
+                return f"{shortmod(rel)}::{attr}", kind, rel
+            return f"?::{attr}", None, None
+        defs = by_attr.get(attr, [])
+        if len(defs) == 1:
+            drel, kind, dcls = defs[0]
+            owner = f"{dcls}." if dcls else ""
+            return f"{shortmod(drel)}::{owner}{attr}", kind, drel
+        return f"?::{attr}", None, None
+
+    return resolve
+
+
+def _finalize_a002(facts: Dict[str, Any]) -> Iterator[Finding]:
+    resolve = _a002_resolver(facts)
+
+    # one-level interprocedural: a function's directly-acquired locks,
+    # usable only when its terminal name is project-unique
+    fn_index: Dict[str, List[Tuple[str, List[str]]]] = {}
+    for rel, f in facts.items():
+        for fname, refs in f.get("fn_locks", {}).items():
+            ids = sorted({resolve(r, rel)[0] for r in refs})
+            fn_index.setdefault(fname, []).append((rel, ids))
+
+    graph: Dict[str, set] = {}
+    sites: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+
+    def add_edge(a: str, b: str, rel: str, line: int, how: str) -> None:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+        sites.setdefault((a, b), (rel, line, how))
+
+    emitted: set = set()
+    out: List[Finding] = []
+
+    for rel, f in facts.items():
+        for e in f.get("edges", []):
+            outer_id, outer_kind, _ = resolve(e["outer"], rel)
+            inner_id, _, _ = resolve(e["inner"], rel)
+            if outer_id == inner_id:
+                same_self = (
+                    e["outer"].get("base") == "self"
+                    and e["inner"].get("base") == "self"
+                )
+                if same_self and outer_kind == "Lock":
+                    key = (rel, e["line"], outer_id)
+                    if key not in emitted:
+                        emitted.add(key)
+                        out.append(
+                            Finding(
+                                rel,
+                                e["line"],
+                                "A002",
+                                f"nested acquisition of {outer_id} "
+                                "(a non-reentrant threading.Lock) "
+                                "while already held: guaranteed "
+                                "self-deadlock (or waive with "
+                                "`# noqa: A002`)",
+                            )
+                        )
+                continue
+            add_edge(outer_id, inner_id, rel, e["line"], "nested with")
+
+    for rel, f in facts.items():
+        for c in f.get("calls", []):
+            entries = fn_index.get(c["callee"], [])
+            if len(entries) != 1:
+                continue
+            callee_rel, callee_ids = entries[0]
+            held_ids = {resolve(r, rel)[0] for r in c["locks"]}
+            for held in held_ids:
+                for inner in callee_ids:
+                    if inner == held:
+                        continue
+                    add_edge(
+                        held, inner, rel, c["line"],
+                        f"via {c['callee']}()",
+                    )
+
+    # cycle detection (iterative Tarjan SCC)
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    onstack: Dict[str, bool] = {}
+    stack: List[str] = []
+    counter = [0]
+    sccs: List[List[str]] = []
+
+    def strongconnect(v: str) -> None:
+        work = [(v, iter(sorted(graph.get(v, ()))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        onstack[v] = True
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    onstack[w] = True
+                    work.append((w, iter(sorted(graph.get(w, ())))))
+                    advanced = True
+                    break
+                if onstack.get(w):
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    onstack[w] = False
+                    comp.append(w)
+                    if w == node:
+                        break
+                sccs.append(comp)
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+
+    for comp in sccs:
+        if len(comp) < 2:
+            continue
+        members = set(comp)
+        cycle_sites = sorted(
+            (site, pair)
+            for pair, site in sites.items()
+            if pair[0] in members and pair[1] in members
+        )
+        if not cycle_sites:
+            continue
+        (rel, line, how), _pair = cycle_sites[0]
+        order = " -> ".join(sorted(members))
+        out.append(
+            Finding(
+                rel,
+                line,
+                "A002",
+                f"lock-order cycle: {order} — these locks are "
+                f"acquired in conflicting orders ({how} here); pick "
+                "one global order or waive with `# noqa: A002`",
+            )
+        )
+
+    # held-lock discipline: registry / flight-dump / device-sync work
+    # under a breaker or stream lock
+    for rel, f in facts.items():
+        for c in f.get("calls", []):
+            if c["callee"] not in _A002_BANNED:
+                continue
+            for ref in c["locks"]:
+                lock_id, _kind, def_rel = resolve(ref, rel)
+                breaker = def_rel is not None and def_rel.endswith(
+                    "watchdog.py"
+                )
+                stream = "stream" in ref["attr"].lower()
+                if not (breaker or stream):
+                    continue
+                key = (rel, c["line"], c["callee"])
+                if key in emitted:
+                    continue
+                emitted.add(key)
+                out.append(
+                    Finding(
+                        rel,
+                        c["line"],
+                        "A002",
+                        f"{c['callee']}() called while holding "
+                        f"{lock_id}: registry/flight-dump/device-"
+                        "sync work under a breaker or stream lock "
+                        "stalls every thread's fail-fast admission "
+                        "during an incident — move it outside the "
+                        "lock (or waive with `# noqa: A002`)",
+                    )
+                )
+                break
+    return iter(out)
+
+
+@deep_rule(
+    "A002",
+    "lock-order cycle or banned call under a breaker/stream lock",
+    finalize=_finalize_a002,
+    applies=lambda ctx: ctx.is_package,
+)
+def collect_a002(ctx: FileContext) -> Dict[str, Any]:
+    return collect_a002_facts(ctx)

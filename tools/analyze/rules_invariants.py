@@ -1,0 +1,651 @@
+"""Legacy invariant rules L011-L021, ported from the tools/lint.py
+monolith onto the engine's shared scope walker (behavior-identical;
+pinned by tests/test_lint.py and the tests/test_analyze.py parity
+test).  See DEPLOYMENT.md "Static analysis" for the rule catalog; every
+rule here is waivable with ``# noqa: <code>`` stating a reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from .core import FileContext, Finding, rule, walk_with_scope
+
+
+def _call_name(node: ast.Call) -> str:
+    """Terminal name of the called object: ``deque`` for both
+    ``deque(...)`` and ``collections.deque(...)``."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+# --- L011 silent except Exception ----------------------------------------
+
+
+def _catches_exception(handler: ast.ExceptHandler) -> bool:
+    """True when the handler type names bare ``Exception`` (directly or
+    in a tuple)."""
+    node = handler.type
+    types = node.elts if isinstance(node, ast.Tuple) else [node]
+    return any(
+        isinstance(t, ast.Name) and t.id == "Exception" for t in types
+    )
+
+
+def _handler_is_loud(handler: ast.ExceptHandler) -> bool:
+    """True when the body re-raises or logs the traceback: a ``raise``
+    statement, any call with an ``exc_info`` keyword, or a
+    ``logger.exception(...)`` call."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            if any(kw.arg == "exc_info" for kw in node.keywords):
+                return True
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "exception"
+            ):
+                return True
+    return False
+
+
+@rule(
+    "L011",
+    "silent `except Exception` in package code",
+    waivable=True,
+    applies=lambda ctx: ctx.is_package,
+)
+def check_silent_except(ctx: FileContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.ExceptHandler)
+            and node.type is not None
+            and _catches_exception(node)
+            and not _handler_is_loud(node)
+        ):
+            yield Finding(
+                ctx.rel,
+                node.lineno,
+                "L011",
+                "silent `except Exception`: re-raise, log with "
+                "exc_info, or waive with `# noqa: L011`",
+            )
+
+
+# --- L012 direct clock calls ---------------------------------------------
+
+
+def _is_banned_clock_call(node: ast.Call, from_time_names: set) -> bool:
+    """True for ``time.time(...)`` / ``time.perf_counter(...)`` and for
+    bare calls of those names when imported via ``from time import``."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return (
+            func.attr in ("time", "perf_counter")
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "time"
+        )
+    if isinstance(func, ast.Name):
+        return func.id in from_time_names
+    return False
+
+
+@rule(
+    "L012",
+    "direct time.time()/perf_counter() in package code",
+    waivable=True,
+    applies=lambda ctx: ctx.is_package
+    and ctx.name not in ("metrics.py", "observability.py"),
+)
+def check_direct_clock(ctx: FileContext) -> Iterator[Finding]:
+    banned_from_time = {
+        alias.asname or alias.name
+        for node in ast.walk(ctx.tree)
+        if isinstance(node, ast.ImportFrom) and node.module == "time"
+        for alias in node.names
+        if alias.name in ("time", "perf_counter")
+    }
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and _is_banned_clock_call(
+            node, banned_from_time
+        ):
+            yield Finding(
+                ctx.rel,
+                node.lineno,
+                "L012",
+                "direct time.time()/time.perf_counter() call: use "
+                "stopwatch/metrics.span or an injectable clock "
+                "(waive with `# noqa: L012`)",
+            )
+
+
+# --- L013 blocking device sync in the coalescer --------------------------
+
+
+def _is_blocking_sync_call(node: ast.Call, from_jax_names: set) -> bool:
+    """True for ``jax.device_get(...)`` / ``jax.block_until_ready(...)``,
+    any ``x.block_until_ready()`` method call, and bare calls of those
+    names when imported via ``from jax import ...``."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr in ("device_get", "block_until_ready")
+    if isinstance(func, ast.Name):
+        return func.id in from_jax_names
+    return False
+
+
+@rule(
+    "L013",
+    "blocking device sync on the coalescer dispatch path",
+    waivable=True,
+    applies=lambda ctx: ctx.is_package and ctx.name == "coalesce.py",
+)
+def check_blocking_sync(ctx: FileContext) -> Iterator[Finding]:
+    from_jax = {
+        alias.asname or alias.name
+        for node in ast.walk(ctx.tree)
+        if isinstance(node, ast.ImportFrom) and node.module == "jax"
+        for alias in node.names
+        if alias.name in ("device_get", "block_until_ready")
+    }
+    for node, in_readback in walk_with_scope(
+        ctx.tree, lambda name: "readback" in name
+    ):
+        if (
+            isinstance(node, ast.Call)
+            and not in_readback
+            and _is_blocking_sync_call(node, from_jax)
+        ):
+            yield Finding(
+                ctx.rel,
+                node.lineno,
+                "L013",
+                "blocking device sync on the coalescer's "
+                "admission/dispatch path: move it to the "
+                "readback stage (or waive with `# noqa: L013`)",
+            )
+
+
+# --- L014 unbounded buffers ----------------------------------------------
+
+_UNBOUNDED_QUEUE_TYPES = ("Queue", "LifoQueue", "PriorityQueue")
+
+
+def _is_unbounded_buffer_ctor(node: ast.Call) -> Optional[str]:
+    """Returns the offending type name for a ``deque`` without a
+    (non-None) ``maxlen`` or a queue.Queue family call without a
+    positive ``maxsize``; None when bounded/unrelated."""
+    name = _call_name(node)
+    if name == "deque":
+        for kw in node.keywords:
+            if kw.arg == "maxlen" and not (
+                isinstance(kw.value, ast.Constant)
+                and kw.value.value is None
+            ):
+                return None
+        if len(node.args) >= 2:  # deque(iterable, maxlen) positional
+            return None
+        return "deque"
+    if name in _UNBOUNDED_QUEUE_TYPES:
+        bound = None
+        if node.args:
+            bound = node.args[0]
+        for kw in node.keywords:
+            if kw.arg == "maxsize":
+                bound = kw.value
+        if bound is None:
+            return name
+        # A literal bound must be positive (maxsize=0 means unbounded);
+        # a computed bound is taken on faith — the rule targets the
+        # default-unbounded constructors, not arithmetic.
+        if isinstance(bound, ast.Constant) and (
+            not isinstance(bound.value, int) or bound.value <= 0
+        ):
+            return name
+        return None
+    return None
+
+
+@rule(
+    "L014",
+    "unbounded buffer in package code",
+    waivable=True,
+    applies=lambda ctx: ctx.is_package,
+)
+def check_unbounded_buffers(ctx: FileContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        unbounded = _is_unbounded_buffer_ctor(node)
+        if unbounded is not None:
+            yield Finding(
+                ctx.rel,
+                node.lineno,
+                "L014",
+                f"unbounded {unbounded} buffer: "
+                "pass maxlen/maxsize (or waive with `# noqa: L014` "
+                "stating the bound)",
+            )
+    # Instance-attribute list buffers: within one class, an attribute
+    # assigned an empty list literal AND ``.append``-ed, with no
+    # visible trim (``del self.x[...]`` or a ``self.x = self.x[...]``
+    # re-slice), must carry an explicit waiver stating its bound.
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        assigns: dict = {}  # attr -> first empty-list assignment node
+        appended: set = set()
+        trimmed: set = set()
+
+        def self_attr(node) -> Optional[str]:
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+            ):
+                return node.attr
+            return None
+
+        for node in ast.walk(cls):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                value = node.value
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for target in targets:
+                    attr = self_attr(target)
+                    if attr is None:
+                        continue
+                    if isinstance(value, ast.List) and not value.elts:
+                        assigns.setdefault(attr, node)
+                    elif isinstance(value, ast.Subscript):
+                        inner = self_attr(value.value)
+                        if inner == attr:
+                            trimmed.add(attr)  # self.x = self.x[...]
+            elif isinstance(node, ast.Delete):
+                for target in node.targets:
+                    if isinstance(target, ast.Subscript):
+                        attr = self_attr(target.value)
+                        if attr is not None:
+                            trimmed.add(attr)  # del self.x[...]
+            elif isinstance(node, ast.Call):
+                func = node.func
+                if isinstance(func, ast.Attribute) and func.attr in (
+                    "append", "extend", "insert",
+                ):
+                    attr = self_attr(func.value)
+                    if attr is not None:
+                        appended.add(attr)
+        for attr, node in assigns.items():
+            if attr not in appended or attr in trimmed:
+                continue
+            yield Finding(
+                ctx.rel,
+                node.lineno,
+                "L014",
+                f"unbounded list buffer self.{attr} (assigned [] and "
+                "appended, no visible trim): add an explicit bound "
+                "or waive with `# noqa: L014` stating the bound",
+            )
+
+
+# --- L015 bare write-mode open -------------------------------------------
+
+
+def _open_write_mode(node: ast.Call) -> bool:
+    """True for ``open(...)`` / ``io.open(...)`` calls whose mode is a
+    string CONSTANT selecting a write/append/create/update mode.  A
+    missing mode is a read; a computed mode is taken on faith (the rule
+    targets the literal ``open(p, "w")`` idiom)."""
+    if _call_name(node) != "open":
+        return False
+    mode = node.args[1] if len(node.args) > 1 else None
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if not isinstance(mode, ast.Constant) or not isinstance(
+        mode.value, str
+    ):
+        return False
+    return any(ch in mode.value for ch in "wax+")
+
+
+@rule(
+    "L015",
+    "bare write-mode open() in package code",
+    waivable=True,
+    applies=lambda ctx: ctx.is_package,
+)
+def check_bare_write_open(ctx: FileContext) -> Iterator[Finding]:
+    for node, in_helper in walk_with_scope(
+        ctx.tree, lambda name: "atomic_write" in name
+    ):
+        if (
+            isinstance(node, ast.Call)
+            and not in_helper
+            and _open_write_mode(node)
+        ):
+            yield Finding(
+                ctx.rel,
+                node.lineno,
+                "L015",
+                "bare write-mode open() in package code: go "
+                "through utils/snapshot.atomic_write_bytes "
+                "(or waive with `# noqa: L015`)",
+            )
+
+
+# --- L016 raw H2D uploads in the warm-path modules -----------------------
+
+#: The counted upload sites — the only functions in the warm-path
+#: modules allowed to start a host->device transfer explicitly.
+_L016_UPLOAD_SITES = (
+    "_stage_upload", "_stage_delta_upload", "_cold_solve_inner",
+)
+
+
+def _is_upload_call(node: ast.Call) -> bool:
+    """True for ``jax.device_put(...)`` (any base) and
+    ``jnp.asarray(...)`` / ``jax.numpy.asarray(...)`` — the explicit
+    H2D entry points.  ``np.asarray`` (a D2H materialization in this
+    codebase) is deliberately not matched."""
+    func = node.func
+    if not isinstance(func, ast.Attribute):
+        return False
+    if func.attr == "device_put":
+        return True
+    if func.attr != "asarray":
+        return False
+    base = func.value
+    if isinstance(base, ast.Name):
+        return base.id == "jnp"
+    return (
+        isinstance(base, ast.Attribute)
+        and base.attr == "numpy"
+        and isinstance(base.value, ast.Name)
+        and base.value.id == "jax"
+    )
+
+
+@rule(
+    "L016",
+    "raw host->device upload outside the counted helpers",
+    waivable=True,
+    applies=lambda ctx: ctx.is_package
+    and ctx.name in ("coalesce.py", "streaming.py"),
+)
+def check_raw_upload(ctx: FileContext) -> Iterator[Finding]:
+    for node, in_site in walk_with_scope(
+        ctx.tree,
+        lambda name: any(site in name for site in _L016_UPLOAD_SITES),
+    ):
+        if (
+            isinstance(node, ast.Call)
+            and not in_site
+            and _is_upload_call(node)
+        ):
+            yield Finding(
+                ctx.rel,
+                node.lineno,
+                "L016",
+                "raw host->device upload outside the counted "
+                "dense-upload helpers: route it through "
+                "_stage_upload/_stage_delta_upload/"
+                "_cold_solve_inner so "
+                "klba_h2d_bytes_total stays honest (or waive "
+                "with `# noqa: L016`)",
+            )
+
+
+# --- L017 snapshot persistence outside the backend layer -----------------
+
+
+def _is_atomic_write_call(node: ast.Call) -> bool:
+    """True for ``atomic_write_bytes(...)`` however addressed
+    (bare name or any dotted base)."""
+    return _call_name(node) == "atomic_write_bytes"
+
+
+@rule(
+    "L017",
+    "snapshot persistence outside the backend layer",
+    waivable=True,
+    applies=lambda ctx: ctx.is_package and ctx.name != "snapshot.py",
+)
+def check_snapshot_outside_backend(ctx: FileContext) -> Iterator[Finding]:
+    for node, in_backend in walk_with_scope(
+        ctx.tree, lambda name: "snapshot_backend" in name
+    ):
+        if (
+            isinstance(node, ast.Call)
+            and not in_backend
+            and _is_atomic_write_call(node)
+        ):
+            yield Finding(
+                ctx.rel,
+                node.lineno,
+                "L017",
+                "snapshot persistence outside the backend "
+                "layer: go through the SnapshotBackend "
+                "interface (utils/snapshot) so CAS + writer "
+                "fencing police the write (or waive with "
+                "`# noqa: L017`)",
+            )
+
+
+# --- L018 resident-buffer assignment outside audited helpers -------------
+
+#: Resident-state fields whose assignment must stay inside audited
+#: helpers.  Engine-side fields apply to both warm-path modules; the
+#: batch-member names only to the coalescer (where the stacked
+#: _ResidentBatch lives — "lags" etc. are too generic to police in
+#: streaming.py, whose engine keeps them inside _resident).
+_L018_ENGINE_FIELDS = frozenset({"_resident", "_lag_mirror"})
+_L018_BATCH_FIELDS = frozenset({"choice", "row_tab", "counts", "lags"})
+
+
+def _assign_targets(node) -> list:
+    if isinstance(node, ast.Assign):
+        raw = node.targets
+    elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+        raw = [node.target]
+    else:
+        return []
+    # Flatten tuple/list unpacking: `a.choice, a.lags = c, l` must
+    # not be an unpoliced route around the invariant.
+    flat: list = []
+    for target in raw:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            flat.extend(target.elts)
+        else:
+            flat.append(target)
+    return flat
+
+
+@rule(
+    "L018",
+    "resident-buffer assignment outside an audited helper",
+    waivable=True,
+    applies=lambda ctx: ctx.is_package
+    and ctx.name in ("coalesce.py", "streaming.py"),
+)
+def check_resident_assignment(ctx: FileContext) -> Iterator[Finding]:
+    fields = set(_L018_ENGINE_FIELDS)
+    if ctx.name == "coalesce.py":
+        fields |= _L018_BATCH_FIELDS
+    for node, in_helper in walk_with_scope(
+        ctx.tree,
+        lambda name: "resident" in name or name == "__init__",
+    ):
+        if in_helper:
+            continue
+        for target in _assign_targets(node):
+            if (
+                isinstance(target, ast.Attribute)
+                and target.attr in fields
+            ):
+                yield Finding(
+                    ctx.rel,
+                    node.lineno,
+                    "L018",
+                    f"resident-buffer field .{target.attr} "
+                    "assigned outside an audited helper: "
+                    "route it through an *resident* helper "
+                    "so the scrubber's host-mirror truth "
+                    "cannot drift from the device (or "
+                    "waive with `# noqa: L018`)",
+                )
+
+
+# --- L019 peer payloads outside the audited serializer -------------------
+
+#: The payload-envelope keys whose dict-literal construction is
+#: confined to the audited serializer.
+_L019_PAYLOAD_KEYS = frozenset({"duals", "marginals"})
+
+
+@rule(
+    "L019",
+    "peer-bound federation payload outside federated/wire.py",
+    waivable=True,
+    applies=lambda ctx: ctx.is_package
+    and not (ctx.in_federated and ctx.name == "wire.py"),
+)
+def check_peer_payload(ctx: FileContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Dict):
+            keys = {
+                k.value for k in node.keys
+                if isinstance(k, ast.Constant)
+                and isinstance(k.value, str)
+            }
+            if keys & _L019_PAYLOAD_KEYS:
+                yield Finding(
+                    ctx.rel,
+                    node.lineno,
+                    "L019",
+                    "peer payload envelope (duals/marginals dict) "
+                    "built outside federated/wire.py: use the "
+                    "audited serializer so the no-raw-lags "
+                    "contract stays enforceable (or waive with "
+                    "`# noqa: L019`)",
+                )
+        elif ctx.in_federated and isinstance(node, ast.Call):
+            func = node.func
+            is_dumps = (
+                isinstance(func, ast.Attribute) and func.attr == "dumps"
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "json"
+            )
+            if is_dumps:
+                yield Finding(
+                    ctx.rel,
+                    node.lineno,
+                    "L019",
+                    "raw json.dumps in the federated package: "
+                    "peer-bound bytes must go through "
+                    "federated/wire.encode (or waive with "
+                    "`# noqa: L019`)",
+                )
+
+
+# --- L020 mesh construction outside sharded/ -----------------------------
+
+#: The mesh-construction entry points confined to sharded/.
+_L020_MESH_CTORS = frozenset(
+    {"Mesh", "NamedSharding", "shard_map", "make_mesh"}
+)
+
+
+@rule(
+    "L020",
+    "mesh/shard_map construction outside the sharded subsystem",
+    waivable=True,
+    applies=lambda ctx: ctx.is_package and "sharded" not in ctx.parts,
+)
+def check_mesh_outside_sharded(ctx: FileContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _call_name(node) not in _L020_MESH_CTORS:
+            continue
+        yield Finding(
+            ctx.rel,
+            node.lineno,
+            "L020",
+            f"mesh construction ({_call_name(node)}) outside the "
+            "sharded/ subsystem: topology decisions live in "
+            "kafka_lag_based_assignor_tpu/sharded (selected via "
+            "ops/dispatch) — or waive with `# noqa: L020`",
+        )
+
+
+# --- L021 dense [P, C] materialization -----------------------------------
+
+#: BinOp node types whose complementary axis-expanded operands
+#: materialize a dense rank-2 block.
+_L021_OPS = (ast.Mult, ast.Add, ast.Sub, ast.Div, ast.Mod)
+
+
+def _axis_expanded(node, none_last: bool) -> bool:
+    """True for a Subscript whose index tuple carries ``None`` in the
+    trailing (``a[:, None]``; ``none_last``) or leading
+    (``b[None, :]``) position — numpy/jax's rank-expansion idiom.  A
+    leading ``-`` (UnaryOp) is transparent."""
+    if isinstance(node, ast.UnaryOp):
+        node = node.operand
+    if not isinstance(node, ast.Subscript):
+        return False
+    idx = node.slice
+    if not isinstance(idx, ast.Tuple) or len(idx.elts) < 2:
+        return False
+    elt = idx.elts[-1] if none_last else idx.elts[0]
+    return isinstance(elt, ast.Constant) and elt.value is None
+
+
+def _is_dense_outer_binop(node: ast.BinOp) -> bool:
+    """True when the BinOp's direct operands are complementary
+    axis-expanded rank-1s: ``x[:, None] <op> y[None, :]`` (either
+    order) — the construction of a dense (rows, consumers) block."""
+    if not isinstance(node.op, _L021_OPS):
+        return False
+    left, right = node.left, node.right
+    return (
+        _axis_expanded(left, True) and _axis_expanded(right, False)
+    ) or (
+        _axis_expanded(left, False) and _axis_expanded(right, True)
+    )
+
+
+@rule(
+    "L021",
+    "[P, C]-proportional dense materialization outside a tile body",
+    waivable=True,
+    applies=lambda ctx: ctx.is_package and ctx.name != "sinkhorn.py",
+)
+def check_dense_materialization(ctx: FileContext) -> Iterator[Finding]:
+    for node, in_tile_body in walk_with_scope(
+        ctx.tree, lambda name: "tile" in name
+    ):
+        if (
+            isinstance(node, ast.BinOp)
+            and not in_tile_body
+            and _is_dense_outer_binop(node)
+        ):
+            yield Finding(
+                ctx.rel,
+                node.lineno,
+                "L021",
+                "[P, C]-proportional dense broadcast outside a "
+                "tile body: stream it in fixed-size tiles "
+                "(ops/linear_ot pattern) or waive with "
+                "`# noqa: L021` stating why the block is not "
+                "[P, C]-proportional",
+            )

@@ -1,0 +1,202 @@
+"""Legacy style rules L001-L010, ported from the tools/lint.py
+monolith onto the engine (behavior-identical; pinned by
+tests/test_lint.py and the tests/test_analyze.py parity test).
+
+  L001  syntax error (file does not parse) — engine-raised
+  L002  star import (``from x import *``)
+  L003  unused import (exempt: ``__init__.py`` re-export surfaces)
+  L004  mutable default argument (list/dict/set literal)
+  L005  bare ``except:``
+  L006  comparison to None with ``==`` / ``!=``
+  L007  line longer than 100 characters
+  L008  trailing whitespace
+  L009  duplicate top-level definition name
+  L010  f-string without placeholders
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .core import MAX_LINE, FileContext, Finding, Rule, register, rule
+
+# L001 has no per-file checker: the engine raises it when ast.parse
+# fails (there is no tree for a checker to walk).
+register(
+    Rule(
+        code="L001",
+        summary="syntax error (file does not parse)",
+        severity="error",
+    )
+)
+
+
+@rule("L002", "star import", severity="warning")
+def check_star_import(ctx: FileContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom) and any(
+            a.name == "*" for a in node.names
+        ):
+            yield Finding(ctx.rel, node.lineno, "L002", "star import")
+
+
+def _imported_names(node: ast.AST) -> Iterator[tuple]:
+    for child in ast.walk(node):
+        if isinstance(child, ast.Import):
+            for alias in child.names:
+                name = alias.asname or alias.name.split(".")[0]
+                yield name, child.lineno
+        elif isinstance(child, ast.ImportFrom):
+            if child.module == "__future__":
+                continue
+            for alias in child.names:
+                if alias.name == "*":
+                    continue
+                yield (alias.asname or alias.name), child.lineno
+
+
+def _used_names(tree: ast.AST) -> set:
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            # the root of a dotted access counts as a use of the import
+            root = node
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name):
+                used.add(root.id)
+    # `__all__` strings are re-export uses
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Name) and target.id == "__all__":
+                    for elt in ast.walk(node.value):
+                        if isinstance(elt, ast.Constant) and isinstance(
+                            elt.value, str
+                        ):
+                            used.add(elt.value)
+    return used
+
+
+@rule(
+    "L003",
+    "unused import",
+    severity="warning",
+    applies=lambda ctx: not ctx.is_init,
+)
+def check_unused_import(ctx: FileContext) -> Iterator[Finding]:
+    used = _used_names(ctx.tree)
+    for name, lineno in _imported_names(ctx.tree):
+        if name not in used:
+            yield Finding(
+                ctx.rel, lineno, "L003", f"unused import {name!r}"
+            )
+
+
+@rule("L004", "mutable default argument", severity="warning")
+def check_mutable_default(ctx: FileContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        defaults = list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None
+        ]
+        for d in defaults:
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                yield Finding(
+                    ctx.rel,
+                    d.lineno,
+                    "L004",
+                    f"mutable default argument in {node.name}()",
+                )
+
+
+@rule("L005", "bare except", severity="error")
+def check_bare_except(ctx: FileContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            yield Finding(ctx.rel, node.lineno, "L005", "bare except")
+
+
+@rule("L006", "comparison to None with ==/!=", severity="warning")
+def check_none_compare(ctx: FileContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        for op, comparator in zip(node.ops, node.comparators):
+            if isinstance(op, (ast.Eq, ast.NotEq)) and (
+                (
+                    isinstance(comparator, ast.Constant)
+                    and comparator.value is None
+                )
+                or (
+                    isinstance(node.left, ast.Constant)
+                    and node.left.value is None
+                )
+            ):
+                yield Finding(
+                    ctx.rel,
+                    node.lineno,
+                    "L006",
+                    "comparison to None with ==/!= (use is/is not)",
+                )
+
+
+@rule("L007", "line too long", severity="warning")
+def check_line_length(ctx: FileContext) -> Iterator[Finding]:
+    for i, line in enumerate(ctx.source.splitlines(), start=1):
+        if len(line) > MAX_LINE:
+            yield Finding(
+                ctx.rel, i, "L007",
+                f"line too long ({len(line)} > {MAX_LINE})",
+            )
+
+
+@rule("L008", "trailing whitespace", severity="warning")
+def check_trailing_whitespace(ctx: FileContext) -> Iterator[Finding]:
+    for i, line in enumerate(ctx.source.splitlines(), start=1):
+        if line != line.rstrip():
+            yield Finding(ctx.rel, i, "L008", "trailing whitespace")
+
+
+@rule("L009", "duplicate top-level definition", severity="error")
+def check_duplicate_toplevel(ctx: FileContext) -> Iterator[Finding]:
+    seen: dict = {}
+    for node in ctx.tree.body:
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            if node.name in seen:
+                yield Finding(
+                    ctx.rel,
+                    node.lineno,
+                    "L009",
+                    f"duplicate top-level definition {node.name!r} "
+                    f"(first at line {seen[node.name]})",
+                )
+            else:
+                seen[node.name] = node.lineno
+
+
+@rule("L010", "f-string without placeholders", severity="warning")
+def check_placeholderless_fstring(ctx: FileContext) -> Iterator[Finding]:
+    # A format spec (the ":02d" in f"{j:02d}") parses as a nested
+    # JoinedStr of constants — not a placeholder-less f-string.
+    format_specs = {
+        id(node.format_spec)
+        for node in ast.walk(ctx.tree)
+        if isinstance(node, ast.FormattedValue)
+        and node.format_spec is not None
+    }
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.JoinedStr):
+            if id(node) not in format_specs and not any(
+                isinstance(v, ast.FormattedValue) for v in node.values
+            ):
+                yield Finding(
+                    ctx.rel, node.lineno, "L010",
+                    "f-string without placeholders",
+                )

@@ -18,10 +18,38 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def analyzer_summary_line(sarif_path) -> str:
+    """One ``analyze:`` row from a klba-analyze SARIF artifact, or ""
+    when the file is absent/unreadable (the summary must never fail
+    because no analyzer run happened on this host)."""
+    try:
+        doc = json.loads(Path(sarif_path).read_text(encoding="utf-8"))
+        run = doc["runs"][0]
+        results = run.get("results", [])
+        props = run.get("properties", {})
+        by_level: dict = {}
+        for r in results:
+            lvl = r.get("level", "none")
+            by_level[lvl] = by_level.get(lvl, 0) + 1
+    except (OSError, ValueError, KeyError, IndexError, TypeError,
+            AttributeError):
+        return ""
+    levels = ", ".join(
+        f"{k}={v}" for k, v in sorted(by_level.items())
+    ) or "clean"
+    return (
+        f"analyze: {len(results)} finding(s) ({levels}), "
+        f"{props.get('suppressed', 0)} suppressed, "
+        f"{props.get('unused_waivers', 0)} unused waiver(s) "
+        f"over {props.get('files', '?')} file(s)"
+    )
 
 
 def main() -> int:
@@ -47,6 +75,13 @@ def main() -> int:
     parser.add_argument(
         "--timeout", type=float, default=10.0,
         help="socket timeout in seconds (default 10)",
+    )
+    parser.add_argument(
+        "--analyze-sarif", type=Path, default=None,
+        help=(
+            "SARIF artifact for the --summary static-analysis row "
+            "(default: $KLBA_ANALYZE_SARIF or <repo>/analyze.sarif)"
+        ),
     )
     args = parser.parse_args()
 
@@ -397,6 +432,20 @@ def main() -> int:
                     f"{k}={int(v)}" for k, v in sorted(stale.items())
                 )
                 print(f"stale/fenced duals rejected: {rows}")
+
+        # Static-analysis view (DEPLOYMENT.md "Static analysis"): the
+        # last analyzer run's finding/suppression counts, sourced from
+        # the SARIF artifact when one is present (CI uploads
+        # analyze.sarif; operators can `klba-analyze --sarif`) — the
+        # "did anything merge past the invariant gate" look, next to
+        # the lint line the tier-1 gate prints.
+        line = analyzer_summary_line(
+            args.analyze_sarif
+            or os.environ.get("KLBA_ANALYZE_SARIF")
+            or Path(__file__).resolve().parent.parent / "analyze.sarif"
+        )
+        if line:
+            print(line)
         return 0
     print(json.dumps(result["json"], indent=2, sort_keys=True))
     return 0

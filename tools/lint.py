@@ -1,1038 +1,54 @@
-"""Self-contained lint gate (stdlib-only).
+"""Self-contained lint gate — compatibility shim over tools/analyze.
 
-The reference builds with ``-Xlint:all`` + ``failOnWarning``
-(/root/reference/pom.xml:143-146): warnings fail the build.  This image has
-no ruff/mypy (and installs are not allowed), so this module enforces the
-core rules with ``ast``/``tokenize`` alone and runs inside the pytest gate
-(tests/test_lint.py) — a warning here fails the suite.  The full ruff/mypy
-configuration for richer environments lives in pyproject.toml.
+The 1,048-line monolith this file used to be lives on as the engine's
+legacy ruleset: L001-L021 are registered in
+tools/analyze/rules_style.py and tools/analyze/rules_invariants.py,
+behavior-identical (pinned by tests/test_lint.py and byte-for-byte by
+the parity test in tests/test_analyze.py against the frozen monolith
+copy in tests/fixtures/legacy_lint_monolith.py).  The rule catalog
+itself is documented in DEPLOYMENT.md "Static analysis".
 
-Rules:
-  L001  syntax error (file does not parse)
-  L002  star import (``from x import *``)
-  L003  unused import (exempt: ``__init__.py`` re-export surfaces)
-  L004  mutable default argument (list/dict/set literal)
-  L005  bare ``except:``
-  L006  comparison to None with ``==`` / ``!=``
-  L007  line longer than 100 characters
-  L008  trailing whitespace
-  L009  duplicate top-level definition name
-  L010  f-string without placeholders
-  L011  silent ``except Exception`` in package code: the handler must
-        re-raise, log with ``exc_info`` (or ``logger.exception``), or be
-        explicitly waived with ``# noqa: L011`` — a module-boundary
-        catch-all that swallows the traceback hides exactly the failures
-        the degraded-mode ladder is supposed to surface
-  L012  direct ``time.time()`` / ``time.perf_counter()`` call in package
-        code outside utils/metrics.py and utils/observability.py: use
-        ``stopwatch`` / ``metrics.span`` (or an injectable clock
-        parameter) so durations land in the unified registry and tests
-        can fake the clock — the same discipline the breaker tests rely
-        on.  Waivable with ``# noqa: L012``.
-  L013  blocking device sync (``jax.device_get`` / ``block_until_ready``)
-        in the coalescer (ops/coalesce.py) outside a readback-stage
-        function: the admission/grouping/upload/dispatch path must stay
-        async so wave k+1's admission can overlap wave k's D2H — the
-        double-buffered flush pipeline's contract.  Blocking fetches
-        belong in functions whose name contains ``readback`` (the
-        pipeline's readback stage).  Waivable with ``# noqa: L013``.
-  L014  unbounded buffer in package code: a ``deque()`` without
-        ``maxlen``, a ``queue.Queue``/``LifoQueue``/``PriorityQueue``
-        without a positive ``maxsize``, or an instance-attribute list
-        buffer (assigned ``[]`` and ``.append``-ed in the same class)
-        with no visible trim (``del self.x[...]`` / ``self.x =
-        self.x[...]`` re-slice).  The overload paths exist because
-        queues fill — a buffer that can grow without bound under
-        backpressure is the outage, so every one must carry an explicit
-        bound or a ``# noqa: L014`` waiver stating its bound.
-  L015  bare write-mode ``open(...)`` in package code: durable state
-        (snapshots, flight-recorder dumps) must go through the atomic
-        write helper (``utils/snapshot.atomic_write_bytes``: temp file
-        + fsync + ``os.rename``) so a crash mid-write can never leave
-        a torn file for the recovery/post-mortem path to trip over.
-        Write-mode opens are allowed only INSIDE a function whose name
-        contains ``atomic_write`` (the helper's own implementation);
-        anything else needs a ``# noqa: L015`` waiver stating why the
-        write is not durable state.  Read-mode opens are untouched.
-  L016  raw host->device upload (``jax.device_put(...)`` /
-        ``jnp.asarray(...)``) in the WARM-path modules
-        (ops/streaming.py, ops/coalesce.py) outside the designated
-        dense-upload helpers (functions named ``_stage_upload`` /
-        ``_stage_delta_upload`` / ``_cold_solve_inner``): per-wave H2D
-        bytes are the binding cost the delta-epoch machinery exists to
-        cut, and ``klba_h2d_bytes_total{path=...}`` is only honest if
-        every full-vector upload flows through the counted sites.  New
-        upload code must route through (or become) a designated
-        helper, or carry a ``# noqa: L016`` waiver stating why its
-        bytes need no accounting.
-  L017  snapshot persistence outside the backend layer: package code
-        may not call ``atomic_write_bytes`` outside utils/snapshot.py
-        — snapshot payloads (and any other durable state that could be
-        adopted by a replacement instance) must flow through the
-        ``SnapshotBackend`` interface so versioned CAS and writer
-        fencing actually police EVERY write (a raw atomic write from
-        a fenced-off instance would silently clobber the adopted
-        state).  Allowed inside functions whose name contains
-        ``snapshot_backend`` (an out-of-module backend implementation
-        is the legitimate extension point); anything else needs a
-        ``# noqa: L017`` waiver stating why the write is not
-        snapshot-shaped state.  Raw write-mode opens of snapshot
-        payloads are already L015's territory.
-  L018  resident-buffer assignment outside an audited helper: in the
-        warm-path modules (ops/streaming.py, ops/coalesce.py) the
-        device-resident state fields — ``_resident`` / ``_lag_mirror``
-        on the engine, and the ``choice`` / ``row_tab`` / ``counts`` /
-        ``lags`` members of the coalescer's ``_ResidentBatch`` — may
-        only be assigned inside audited helper functions (a function
-        whose name contains ``resident``, e.g. ``_adopt_resident`` /
-        ``_drop_resident`` / ``adopt_resident_buffers``, or an
-        ``__init__``).  The resident-state scrubber (utils/scrub)
-        audits these buffers against host-mirror truth; an unaudited
-        write site could install device state the mirror never saw —
-        exactly the silent drift the scrubber exists to catch — or
-        drop a mirror without its buffer.  Waivable with
-        ``# noqa: L018`` stating why the write cannot go through an
-        audited helper.
-  L019  peer-bound federation payload constructed outside the audited
-        serializer (federated/wire.py): the privacy contract — raw
-        partition lags never leave the cluster — is only auditable if
-        every ``peer_sync`` payload flows through wire.py's
-        whitelisted, C-bounded builders.  Flagged: a dict literal
-        carrying a ``"duals"`` or ``"marginals"`` key anywhere in
-        package code outside wire.py (the payload envelope being
-        hand-rolled), and any ``json.dumps`` call inside the
-        ``federated/`` package outside wire.py (serialization that
-        bypasses the audit).  Waivable with ``# noqa: L019`` stating
-        why the payload is not peer-bound.
-  L020  mesh/shard_map construction outside the sharded subsystem:
-        ``Mesh(...)`` / ``NamedSharding(...)`` / ``shard_map(...)`` /
-        ``make_mesh(...)`` calls in package code outside
-        ``kafka_lag_based_assignor_tpu/sharded/`` — every multi-device
-        topology decision (axis names, placement, degradation) lives
-        in the sharded/ backend and is selected through ops/dispatch,
-        so a stray mesh in a side module cannot drift from the mesh
-        manager's validate/degrade lifecycle (the dead-end the old
-        ``parallel/`` module was).  Waivable with ``# noqa: L020``
-        stating why the construction cannot live in sharded/.
-  L021  [P, C]-proportional dense materialization in package code: an
-        arithmetic broadcast of two complementary axis-expanded
-        rank-1 operands (``a[:, None] * b[None, :]`` and friends —
-        THE idiom that builds a dense (rows, consumers) block) outside
-        the Sinkhorn legacy path (models/sinkhorn.py) and the
-        quality-mode tile bodies (functions whose name contains
-        ``tile`` — ops/linear_ot streams fixed-size tiles so the peak
-        stays O(tile*C + P + C); ops/plan_stats' tile kernels
-        likewise).  At the 1M x 10k north star a [P, C] f32 buffer is
-        ~40 GB and can never ship — new dense blocks must be
-        tile-streamed, or carry a ``# noqa: L021`` waiver stating why
-        the block is NOT [P, C]-proportional (enclosing-function-aware
-        walker).
+``python tools/lint.py`` and every existing CI invocation keep
+working unchanged and still run EXACTLY the L001-L021 set.  The full
+analyzer — deep whole-program rules A001-A003, W001 unused-waiver
+accounting, SARIF output, the incremental cache — is
+``python -m tools.analyze`` / ``klba-analyze``.
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
-from typing import Iterator, List, NamedTuple, Optional
+from typing import Iterator, List
 
-MAX_LINE = 100
+_ROOT = Path(__file__).resolve().parent.parent
+if str(_ROOT) not in sys.path:
+    sys.path.insert(0, str(_ROOT))
 
+from tools.analyze import core as _core
+from tools.analyze.core import LEGACY_CODES, Finding
 
-class Finding(NamedTuple):
-    path: str
-    line: int
-    code: str
-    message: str
-
-    def __str__(self) -> str:
-        return f"{self.path}:{self.line}: {self.code} {self.message}"
-
-
-def _imported_names(node: ast.AST) -> Iterator[tuple[str, int]]:
-    for child in ast.walk(node):
-        if isinstance(child, ast.Import):
-            for alias in child.names:
-                name = alias.asname or alias.name.split(".")[0]
-                yield name, child.lineno
-        elif isinstance(child, ast.ImportFrom):
-            if child.module == "__future__":
-                continue
-            for alias in child.names:
-                if alias.name == "*":
-                    continue
-                yield (alias.asname or alias.name), child.lineno
-
-
-def _used_names(tree: ast.AST) -> set:
-    used = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
-            used.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            # the root of a dotted access counts as a use of the import
-            root = node
-            while isinstance(root, ast.Attribute):
-                root = root.value
-            if isinstance(root, ast.Name):
-                used.add(root.id)
-    # `__all__` strings are re-export uses
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Assign):
-            for target in node.targets:
-                if isinstance(target, ast.Name) and target.id == "__all__":
-                    for elt in ast.walk(node.value):
-                        if isinstance(elt, ast.Constant) and isinstance(
-                            elt.value, str
-                        ):
-                            used.add(elt.value)
-    return used
-
-
-def _catches_exception(handler: ast.ExceptHandler) -> bool:
-    """True when the handler type names bare ``Exception`` (directly or
-    in a tuple)."""
-    node = handler.type
-    types = node.elts if isinstance(node, ast.Tuple) else [node]
-    return any(
-        isinstance(t, ast.Name) and t.id == "Exception" for t in types
-    )
-
-
-def _handler_is_loud(handler: ast.ExceptHandler) -> bool:
-    """True when the body re-raises or logs the traceback: a ``raise``
-    statement, any call with an ``exc_info`` keyword, or a
-    ``logger.exception(...)`` call."""
-    for node in ast.walk(handler):
-        if isinstance(node, ast.Raise):
-            return True
-        if isinstance(node, ast.Call):
-            if any(kw.arg == "exc_info" for kw in node.keywords):
-                return True
-            if (
-                isinstance(node.func, ast.Attribute)
-                and node.func.attr == "exception"
-            ):
-                return True
-    return False
-
-
-def _is_blocking_sync_call(node: ast.Call, from_jax_names: set) -> bool:
-    """True for ``jax.device_get(...)`` / ``jax.block_until_ready(...)``,
-    any ``x.block_until_ready()`` method call, and bare calls of those
-    names when imported via ``from jax import ...``."""
-    func = node.func
-    if isinstance(func, ast.Attribute):
-        return func.attr in ("device_get", "block_until_ready")
-    if isinstance(func, ast.Name):
-        return func.id in from_jax_names
-    return False
-
-
-def _l013_findings(rel: str, tree: ast.AST, lines: List[str]) -> List[Finding]:
-    """Walk with enclosing-function context: blocking syncs are allowed
-    only inside functions whose name marks the readback stage."""
-    from_jax = {
-        alias.asname or alias.name
-        for node in ast.walk(tree)
-        if isinstance(node, ast.ImportFrom) and node.module == "jax"
-        for alias in node.names
-        if alias.name in ("device_get", "block_until_ready")
-    }
-    findings: List[Finding] = []
-
-    def visit(node: ast.AST, in_readback: bool) -> None:
-        for child in ast.iter_child_nodes(node):
-            child_scope = in_readback
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                child_scope = in_readback or "readback" in child.name
-            if (
-                isinstance(child, ast.Call)
-                and not in_readback
-                and _is_blocking_sync_call(child, from_jax)
-                and "noqa: L013" not in lines[child.lineno - 1]
-            ):
-                findings.append(
-                    Finding(
-                        rel,
-                        child.lineno,
-                        "L013",
-                        "blocking device sync on the coalescer's "
-                        "admission/dispatch path: move it to the "
-                        "readback stage (or waive with `# noqa: L013`)",
-                    )
-                )
-            visit(child, child_scope)
-
-    visit(tree, False)
-    return findings
-
-
-#: L016: the counted upload sites — the only functions in the warm-path
-#: modules allowed to start a host->device transfer explicitly.
-_L016_UPLOAD_SITES = (
-    "_stage_upload", "_stage_delta_upload", "_cold_solve_inner",
-)
-
-
-def _is_upload_call(node: ast.Call) -> bool:
-    """True for ``jax.device_put(...)`` (any base) and
-    ``jnp.asarray(...)`` / ``jax.numpy.asarray(...)`` — the explicit
-    H2D entry points.  ``np.asarray`` (a D2H materialization in this
-    codebase) is deliberately not matched."""
-    func = node.func
-    if not isinstance(func, ast.Attribute):
-        return False
-    if func.attr == "device_put":
-        return True
-    if func.attr != "asarray":
-        return False
-    base = func.value
-    if isinstance(base, ast.Name):
-        return base.id == "jnp"
-    return (
-        isinstance(base, ast.Attribute)
-        and base.attr == "numpy"
-        and isinstance(base.value, ast.Name)
-        and base.value.id == "jax"
-    )
-
-
-def _l016_findings(rel: str, tree: ast.AST, lines: List[str]) -> List[Finding]:
-    """Walk with enclosing-function context (the L013 pattern): explicit
-    uploads are allowed only inside the designated dense-upload
-    helpers."""
-    findings: List[Finding] = []
-
-    def visit(node: ast.AST, in_upload_site: bool) -> None:
-        for child in ast.iter_child_nodes(node):
-            child_scope = in_upload_site
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                child_scope = in_upload_site or any(
-                    site in child.name for site in _L016_UPLOAD_SITES
-                )
-            if (
-                isinstance(child, ast.Call)
-                and not in_upload_site
-                and _is_upload_call(child)
-                and "noqa: L016" not in lines[child.lineno - 1]
-            ):
-                findings.append(
-                    Finding(
-                        rel,
-                        child.lineno,
-                        "L016",
-                        "raw host->device upload outside the counted "
-                        "dense-upload helpers: route it through "
-                        "_stage_upload/_stage_delta_upload/"
-                        "_cold_solve_inner so "
-                        "klba_h2d_bytes_total stays honest (or waive "
-                        "with `# noqa: L016`)",
-                    )
-                )
-            visit(child, child_scope)
-
-    visit(tree, False)
-    return findings
-
-
-def _open_write_mode(node: ast.Call) -> bool:
-    """True for ``open(...)`` / ``io.open(...)`` calls whose mode is a
-    string CONSTANT selecting a write/append/create/update mode.  A
-    missing mode is a read; a computed mode is taken on faith (the rule
-    targets the literal ``open(p, "w")`` idiom)."""
-    func = node.func
-    name = (
-        func.id if isinstance(func, ast.Name)
-        else func.attr if isinstance(func, ast.Attribute)
-        else ""
-    )
-    if name != "open":
-        return False
-    mode = node.args[1] if len(node.args) > 1 else None
-    for kw in node.keywords:
-        if kw.arg == "mode":
-            mode = kw.value
-    if not isinstance(mode, ast.Constant) or not isinstance(
-        mode.value, str
-    ):
-        return False
-    return any(ch in mode.value for ch in "wax+")
-
-
-def _l015_findings(rel: str, tree: ast.AST, lines: List[str]) -> List[Finding]:
-    """Walk with enclosing-function context: write-mode opens are
-    allowed only inside the atomic-write helper's implementation."""
-    findings: List[Finding] = []
-
-    def visit(node: ast.AST, in_helper: bool) -> None:
-        for child in ast.iter_child_nodes(node):
-            child_scope = in_helper
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                child_scope = in_helper or "atomic_write" in child.name
-            if (
-                isinstance(child, ast.Call)
-                and not in_helper
-                and _open_write_mode(child)
-                and "noqa: L015" not in lines[child.lineno - 1]
-            ):
-                findings.append(
-                    Finding(
-                        rel,
-                        child.lineno,
-                        "L015",
-                        "bare write-mode open() in package code: go "
-                        "through utils/snapshot.atomic_write_bytes "
-                        "(or waive with `# noqa: L015`)",
-                    )
-                )
-            visit(child, child_scope)
-
-    visit(tree, False)
-    return findings
-
-
-def _is_atomic_write_call(node: ast.Call) -> bool:
-    """True for ``atomic_write_bytes(...)`` however addressed
-    (bare name or any dotted base)."""
-    func = node.func
-    if isinstance(func, ast.Attribute):
-        return func.attr == "atomic_write_bytes"
-    if isinstance(func, ast.Name):
-        return func.id == "atomic_write_bytes"
-    return False
-
-
-def _l017_findings(rel: str, tree: ast.AST, lines: List[str]) -> List[Finding]:
-    """Walk with enclosing-function context (the L013 pattern):
-    ``atomic_write_bytes`` calls in package code outside
-    utils/snapshot.py are allowed only inside a function implementing
-    a snapshot backend."""
-    findings: List[Finding] = []
-
-    def visit(node: ast.AST, in_backend: bool) -> None:
-        for child in ast.iter_child_nodes(node):
-            child_scope = in_backend
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                child_scope = in_backend or "snapshot_backend" in child.name
-            if (
-                isinstance(child, ast.Call)
-                and not in_backend
-                and _is_atomic_write_call(child)
-                and "noqa: L017" not in lines[child.lineno - 1]
-            ):
-                findings.append(
-                    Finding(
-                        rel,
-                        child.lineno,
-                        "L017",
-                        "snapshot persistence outside the backend "
-                        "layer: go through the SnapshotBackend "
-                        "interface (utils/snapshot) so CAS + writer "
-                        "fencing police the write (or waive with "
-                        "`# noqa: L017`)",
-                    )
-                )
-            visit(child, child_scope)
-
-    visit(tree, False)
-    return findings
-
-
-#: L018: resident-state fields whose assignment must stay inside
-#: audited helpers.  Engine-side fields apply to both warm-path
-#: modules; the batch-member names only to the coalescer (where the
-#: stacked _ResidentBatch lives — "lags" etc. are too generic to
-#: police in streaming.py, whose engine keeps them inside _resident).
-_L018_ENGINE_FIELDS = frozenset({"_resident", "_lag_mirror"})
-_L018_BATCH_FIELDS = frozenset({"choice", "row_tab", "counts", "lags"})
-
-
-def _l018_findings(
-    rel: str, tree: ast.AST, lines: List[str], batch_fields: bool
-) -> List[Finding]:
-    """Walk with enclosing-function context (the L013 pattern):
-    resident-buffer field assignments are allowed only inside audited
-    helpers — a function whose name contains ``resident`` or an
-    ``__init__`` (construction is the one write that cannot pre-date a
-    mirror)."""
-    fields = set(_L018_ENGINE_FIELDS)
-    if batch_fields:
-        fields |= _L018_BATCH_FIELDS
-    findings: List[Finding] = []
-
-    def targets_of(node) -> list:
-        if isinstance(node, ast.Assign):
-            raw = node.targets
-        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
-            raw = [node.target]
-        else:
-            return []
-        # Flatten tuple/list unpacking: `a.choice, a.lags = c, l` must
-        # not be an unpoliced route around the invariant.
-        flat: list = []
-        for target in raw:
-            if isinstance(target, (ast.Tuple, ast.List)):
-                flat.extend(target.elts)
-            else:
-                flat.append(target)
-        return flat
-
-    def visit(node: ast.AST, in_helper: bool) -> None:
-        for child in ast.iter_child_nodes(node):
-            child_scope = in_helper
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                child_scope = (
-                    in_helper
-                    or "resident" in child.name
-                    or child.name == "__init__"
-                )
-            if not in_helper:
-                for target in targets_of(child):
-                    if (
-                        isinstance(target, ast.Attribute)
-                        and target.attr in fields
-                        and "noqa: L018" not in lines[child.lineno - 1]
-                    ):
-                        findings.append(
-                            Finding(
-                                rel,
-                                child.lineno,
-                                "L018",
-                                f"resident-buffer field .{target.attr} "
-                                "assigned outside an audited helper: "
-                                "route it through an *resident* helper "
-                                "so the scrubber's host-mirror truth "
-                                "cannot drift from the device (or "
-                                "waive with `# noqa: L018`)",
-                            )
-                        )
-            visit(child, child_scope)
-
-    visit(tree, False)
-    return findings
-
-
-#: L019: the payload-envelope keys whose dict-literal construction is
-#: confined to the audited serializer.
-_L019_PAYLOAD_KEYS = frozenset({"duals", "marginals"})
-
-
-def _l019_findings(
-    rel: str, tree: ast.AST, lines: List[str], in_federated: bool
-) -> List[Finding]:
-    """Peer-payload audit (docstring rule L019): envelope-shaped dict
-    literals anywhere in package code, plus raw ``json.dumps`` inside
-    the federated package — both belong in federated/wire.py."""
-    findings: List[Finding] = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Dict):
-            keys = {
-                k.value for k in node.keys
-                if isinstance(k, ast.Constant) and isinstance(k.value, str)
-            }
-            if keys & _L019_PAYLOAD_KEYS and (
-                "noqa: L019" not in lines[node.lineno - 1]
-            ):
-                findings.append(
-                    Finding(
-                        rel,
-                        node.lineno,
-                        "L019",
-                        "peer payload envelope (duals/marginals dict) "
-                        "built outside federated/wire.py: use the "
-                        "audited serializer so the no-raw-lags "
-                        "contract stays enforceable (or waive with "
-                        "`# noqa: L019`)",
-                    )
-                )
-        elif in_federated and isinstance(node, ast.Call):
-            func = node.func
-            is_dumps = (
-                isinstance(func, ast.Attribute) and func.attr == "dumps"
-                and isinstance(func.value, ast.Name)
-                and func.value.id == "json"
-            )
-            if is_dumps and "noqa: L019" not in lines[node.lineno - 1]:
-                findings.append(
-                    Finding(
-                        rel,
-                        node.lineno,
-                        "L019",
-                        "raw json.dumps in the federated package: "
-                        "peer-bound bytes must go through "
-                        "federated/wire.encode (or waive with "
-                        "`# noqa: L019`)",
-                    )
-                )
-    return findings
-
-
-#: L020: the mesh-construction entry points confined to sharded/.
-_L020_MESH_CTORS = frozenset(
-    {"Mesh", "NamedSharding", "shard_map", "make_mesh"}
-)
-
-
-def _l020_findings(
-    rel: str, tree: ast.AST, lines: List[str]
-) -> List[Finding]:
-    """Mesh-topology audit (docstring rule L020): mesh/shard_map
-    construction calls in package code outside the sharded/ package."""
-    findings: List[Finding] = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        if _call_name(node) not in _L020_MESH_CTORS:
-            continue
-        if "noqa: L020" in lines[node.lineno - 1]:
-            continue
-        findings.append(
-            Finding(
-                rel,
-                node.lineno,
-                "L020",
-                f"mesh construction ({_call_name(node)}) outside the "
-                "sharded/ subsystem: topology decisions live in "
-                "kafka_lag_based_assignor_tpu/sharded (selected via "
-                "ops/dispatch) — or waive with `# noqa: L020`",
-            )
-        )
-    return findings
-
-
-#: L021: BinOp node types whose complementary axis-expanded operands
-#: materialize a dense rank-2 block.
-_L021_OPS = (ast.Mult, ast.Add, ast.Sub, ast.Div, ast.Mod)
-
-
-def _axis_expanded(node, none_last: bool) -> bool:
-    """True for a Subscript whose index tuple carries ``None`` in the
-    trailing (``a[:, None]``; ``none_last``) or leading
-    (``b[None, :]``) position — numpy/jax's rank-expansion idiom.  A
-    leading ``-`` (UnaryOp) is transparent."""
-    if isinstance(node, ast.UnaryOp):
-        node = node.operand
-    if not isinstance(node, ast.Subscript):
-        return False
-    idx = node.slice
-    if not isinstance(idx, ast.Tuple) or len(idx.elts) < 2:
-        return False
-    elt = idx.elts[-1] if none_last else idx.elts[0]
-    return isinstance(elt, ast.Constant) and elt.value is None
-
-
-def _is_dense_outer_binop(node: ast.BinOp) -> bool:
-    """True when the BinOp's direct operands are complementary
-    axis-expanded rank-1s: ``x[:, None] <op> y[None, :]`` (either
-    order) — the construction of a dense (rows, consumers) block."""
-    if not isinstance(node.op, _L021_OPS):
-        return False
-    left, right = node.left, node.right
-    return (
-        _axis_expanded(left, True) and _axis_expanded(right, False)
-    ) or (
-        _axis_expanded(left, False) and _axis_expanded(right, True)
-    )
-
-
-def _l021_findings(rel: str, tree: ast.AST, lines: List[str]) -> List[Finding]:
-    """Walk with enclosing-function context (the L013 pattern): dense
-    rank-2 materialization is allowed only inside the tile-streaming
-    bodies (functions whose name contains ``tile``), where the block
-    is bounded at (tile, C) by construction."""
-    findings: List[Finding] = []
-
-    def visit(node: ast.AST, in_tile_body: bool) -> None:
-        for child in ast.iter_child_nodes(node):
-            child_scope = in_tile_body
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                child_scope = in_tile_body or "tile" in child.name
-            if (
-                isinstance(child, ast.BinOp)
-                and not in_tile_body
-                and _is_dense_outer_binop(child)
-                and "noqa: L021" not in lines[child.lineno - 1]
-            ):
-                findings.append(
-                    Finding(
-                        rel,
-                        child.lineno,
-                        "L021",
-                        "[P, C]-proportional dense broadcast outside a "
-                        "tile body: stream it in fixed-size tiles "
-                        "(ops/linear_ot pattern) or waive with "
-                        "`# noqa: L021` stating why the block is not "
-                        "[P, C]-proportional",
-                    )
-                )
-            visit(child, child_scope)
-
-    visit(tree, False)
-    return findings
-
-
-_UNBOUNDED_QUEUE_TYPES = ("Queue", "LifoQueue", "PriorityQueue")
-
-
-def _call_name(node: ast.Call) -> str:
-    """Terminal name of the called object: ``deque`` for both
-    ``deque(...)`` and ``collections.deque(...)``."""
-    func = node.func
-    if isinstance(func, ast.Attribute):
-        return func.attr
-    if isinstance(func, ast.Name):
-        return func.id
-    return ""
-
-
-def _is_unbounded_buffer_ctor(node: ast.Call) -> Optional[str]:
-    """L014 constructor check: returns the offending type name for a
-    ``deque`` without a (non-None) ``maxlen`` or a queue.Queue family
-    call without a positive ``maxsize``; None when bounded/unrelated."""
-    name = _call_name(node)
-    if name == "deque":
-        for kw in node.keywords:
-            if kw.arg == "maxlen" and not (
-                isinstance(kw.value, ast.Constant) and kw.value.value is None
-            ):
-                return None
-        if len(node.args) >= 2:  # deque(iterable, maxlen) positional
-            return None
-        return "deque"
-    if name in _UNBOUNDED_QUEUE_TYPES:
-        bound = None
-        if node.args:
-            bound = node.args[0]
-        for kw in node.keywords:
-            if kw.arg == "maxsize":
-                bound = kw.value
-        if bound is None:
-            return name
-        # A literal bound must be positive (maxsize=0 means unbounded);
-        # a computed bound is taken on faith — the rule targets the
-        # default-unbounded constructors, not arithmetic.
-        if isinstance(bound, ast.Constant) and (
-            not isinstance(bound.value, int) or bound.value <= 0
-        ):
-            return name
-        return None
-    return None
-
-
-def _l014_list_buffer_findings(
-    rel: str, tree: ast.AST, lines: List[str]
-) -> List[Finding]:
-    """Instance-attribute list buffers: within one class, an attribute
-    assigned an empty list literal AND ``.append``-ed, with no visible
-    trim (``del self.x[...]`` or a ``self.x = self.x[...]`` re-slice),
-    must carry an explicit ``# noqa: L014`` waiver stating its bound."""
-    findings: List[Finding] = []
-    for cls in ast.walk(tree):
-        if not isinstance(cls, ast.ClassDef):
-            continue
-        assigns: dict = {}  # attr -> first empty-list assignment node
-        appended: set = set()
-        trimmed: set = set()
-
-        def self_attr(node) -> Optional[str]:
-            if (
-                isinstance(node, ast.Attribute)
-                and isinstance(node.value, ast.Name)
-                and node.value.id == "self"
-            ):
-                return node.attr
-            return None
-
-        for node in ast.walk(cls):
-            if isinstance(node, (ast.Assign, ast.AnnAssign)):
-                value = node.value
-                targets = (
-                    node.targets if isinstance(node, ast.Assign)
-                    else [node.target]
-                )
-                for target in targets:
-                    attr = self_attr(target)
-                    if attr is None:
-                        continue
-                    if isinstance(value, ast.List) and not value.elts:
-                        assigns.setdefault(attr, node)
-                    elif isinstance(value, ast.Subscript):
-                        inner = self_attr(value.value)
-                        if inner == attr:
-                            trimmed.add(attr)  # self.x = self.x[...]
-            elif isinstance(node, ast.Delete):
-                for target in node.targets:
-                    if isinstance(target, ast.Subscript):
-                        attr = self_attr(target.value)
-                        if attr is not None:
-                            trimmed.add(attr)  # del self.x[...]
-            elif isinstance(node, ast.Call):
-                func = node.func
-                if isinstance(func, ast.Attribute) and func.attr in (
-                    "append", "extend", "insert",
-                ):
-                    attr = self_attr(func.value)
-                    if attr is not None:
-                        appended.add(attr)
-        for attr, node in assigns.items():
-            if attr not in appended or attr in trimmed:
-                continue
-            if "noqa: L014" in lines[node.lineno - 1]:
-                continue
-            findings.append(
-                Finding(
-                    rel,
-                    node.lineno,
-                    "L014",
-                    f"unbounded list buffer self.{attr} (assigned [] and "
-                    "appended, no visible trim): add an explicit bound "
-                    "or waive with `# noqa: L014` stating the bound",
-                )
-            )
-    return findings
-
-
-def _is_banned_clock_call(node: ast.Call, from_time_names: set) -> bool:
-    """True for ``time.time(...)`` / ``time.perf_counter(...)`` and for
-    bare calls of those names when imported via ``from time import``."""
-    func = node.func
-    if isinstance(func, ast.Attribute):
-        return (
-            func.attr in ("time", "perf_counter")
-            and isinstance(func.value, ast.Name)
-            and func.value.id == "time"
-        )
-    if isinstance(func, ast.Name):
-        return func.id in from_time_names
-    return False
+MAX_LINE = _core.MAX_LINE
 
 
 def lint_source(path: Path, source: str) -> List[Finding]:
-    findings: List[Finding] = []
-    rel = str(path)
-    lines = source.splitlines()
-
-    try:
-        tree = ast.parse(source, filename=rel)
-    except SyntaxError as exc:
-        return [Finding(rel, exc.lineno or 0, "L001", f"syntax error: {exc.msg}")]
-
-    is_init = path.name == "__init__.py"
-    # L011/L012 apply to the package (the module boundaries the failure
-    # model depends on), not to tests/tools/bench scaffolding.
-    is_package = "kafka_lag_based_assignor_tpu" in path.parts
-    # L013 applies to the coalescer module only: its flush pipeline is
-    # the one place the async-dispatch discipline is load-bearing.
-    if is_package and path.name == "coalesce.py":
-        findings.extend(_l013_findings(rel, tree, lines))
-    # L016 applies to the warm-path modules: the H2D byte accounting
-    # (delta epochs) is only honest if every explicit upload routes
-    # through the designated counted helpers.
-    if is_package and path.name in ("coalesce.py", "streaming.py"):
-        findings.extend(_l016_findings(rel, tree, lines))
-        # L018: the resident-state scrubber's host-mirror truth is
-        # only as good as the discipline around who may install or
-        # drop resident buffers.
-        findings.extend(
-            _l018_findings(
-                rel, tree, lines,
-                batch_fields=path.name == "coalesce.py",
-            )
-        )
-    if is_package:
-        findings.extend(_l014_list_buffer_findings(rel, tree, lines))
-        findings.extend(_l015_findings(rel, tree, lines))
-    # L019 applies to package code outside the audited serializer: the
-    # federation privacy contract is enforceable only while every
-    # peer-bound payload is built (and serialized) in wire.py.
-    in_federated = is_package and "federated" in path.parts
-    if is_package and not (in_federated and path.name == "wire.py"):
-        findings.extend(
-            _l019_findings(rel, tree, lines, in_federated=in_federated)
-        )
-    # L020 applies to package code OUTSIDE the sharded/ subsystem (the
-    # one home for mesh topology construction).
-    if is_package and "sharded" not in path.parts:
-        findings.extend(_l020_findings(rel, tree, lines))
-    # L021 applies to package code outside the Sinkhorn legacy path
-    # (models/sinkhorn.py keeps its measured dense rounding); tile-
-    # streaming bodies are exempted inside the walker.
-    if is_package and path.name != "sinkhorn.py":
-        findings.extend(_l021_findings(rel, tree, lines))
-    # L017 applies to package code OUTSIDE utils/snapshot.py (the
-    # backend layer owns the raw atomic write; everyone else must go
-    # through a SnapshotBackend so fencing polices the write).
-    if is_package and path.name != "snapshot.py":
-        findings.extend(_l017_findings(rel, tree, lines))
-    # The two clock-owning modules: stopwatch/span live there, so direct
-    # perf_counter use is their implementation, not a violation.
-    clock_exempt = path.name in ("metrics.py", "observability.py")
-    # Names bound to the banned callables via `from time import ...`.
-    banned_from_time = {
-        alias.asname or alias.name
-        for node in ast.walk(tree)
-        if isinstance(node, ast.ImportFrom) and node.module == "time"
-        for alias in node.names
-        if alias.name in ("time", "perf_counter")
-    }
-
-    # A format spec (the ":02d" in f"{j:02d}") parses as a nested JoinedStr
-    # of constants — not a placeholder-less f-string.
-    format_specs = {
-        id(node.format_spec)
-        for node in ast.walk(tree)
-        if isinstance(node, ast.FormattedValue) and node.format_spec is not None
-    }
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom) and any(
-            a.name == "*" for a in node.names
-        ):
-            findings.append(Finding(rel, node.lineno, "L002", "star import"))
-        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            defaults = list(node.args.defaults) + [
-                d for d in node.args.kw_defaults if d is not None
-            ]
-            for d in defaults:
-                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
-                    findings.append(
-                        Finding(
-                            rel,
-                            d.lineno,
-                            "L004",
-                            f"mutable default argument in {node.name}()",
-                        )
-                    )
-        elif isinstance(node, ast.ExceptHandler) and node.type is None:
-            findings.append(Finding(rel, node.lineno, "L005", "bare except"))
-        elif (
-            isinstance(node, ast.ExceptHandler)
-            and is_package
-            and _catches_exception(node)
-            and not _handler_is_loud(node)
-            and "noqa: L011" not in lines[node.lineno - 1]
-        ):
-            findings.append(
-                Finding(
-                    rel,
-                    node.lineno,
-                    "L011",
-                    "silent `except Exception`: re-raise, log with "
-                    "exc_info, or waive with `# noqa: L011`",
-                )
-            )
-        elif (
-            isinstance(node, ast.Call)
-            and is_package
-            and not clock_exempt
-            and _is_banned_clock_call(node, banned_from_time)
-            and "noqa: L012" not in lines[node.lineno - 1]
-        ):
-            findings.append(
-                Finding(
-                    rel,
-                    node.lineno,
-                    "L012",
-                    "direct time.time()/time.perf_counter() call: use "
-                    "stopwatch/metrics.span or an injectable clock "
-                    "(waive with `# noqa: L012`)",
-                )
-            )
-        elif (
-            isinstance(node, ast.Call)
-            and is_package
-            and (unbounded := _is_unbounded_buffer_ctor(node)) is not None
-            and "noqa: L014" not in lines[node.lineno - 1]
-        ):
-            findings.append(
-                Finding(
-                    rel,
-                    node.lineno,
-                    "L014",
-                    f"unbounded {unbounded} buffer: "
-                    "pass maxlen/maxsize (or waive with `# noqa: L014` "
-                    "stating the bound)",
-                )
-            )
-        elif isinstance(node, ast.Compare):
-            for op, comparator in zip(node.ops, node.comparators):
-                if isinstance(op, (ast.Eq, ast.NotEq)) and (
-                    (
-                        isinstance(comparator, ast.Constant)
-                        and comparator.value is None
-                    )
-                    or (
-                        isinstance(node.left, ast.Constant)
-                        and node.left.value is None
-                    )
-                ):
-                    findings.append(
-                        Finding(
-                            rel,
-                            node.lineno,
-                            "L006",
-                            "comparison to None with ==/!= (use is/is not)",
-                        )
-                    )
-        elif isinstance(node, ast.JoinedStr):
-            if id(node) not in format_specs and not any(
-                isinstance(v, ast.FormattedValue) for v in node.values
-            ):
-                findings.append(
-                    Finding(
-                        rel, node.lineno, "L010", "f-string without placeholders"
-                    )
-                )
-
-    if not is_init:
-        used = _used_names(tree)
-        for name, lineno in _imported_names(tree):
-            if name not in used:
-                findings.append(
-                    Finding(rel, lineno, "L003", f"unused import {name!r}")
-                )
-
-    seen: dict = {}
-    for node in tree.body:
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
-            if node.name in seen:
-                findings.append(
-                    Finding(
-                        rel,
-                        node.lineno,
-                        "L009",
-                        f"duplicate top-level definition {node.name!r} "
-                        f"(first at line {seen[node.name]})",
-                    )
-                )
-            else:
-                seen[node.name] = node.lineno
-
-    for i, line in enumerate(source.splitlines(), start=1):
-        if len(line) > MAX_LINE:
-            findings.append(
-                Finding(rel, i, "L007", f"line too long ({len(line)} > {MAX_LINE})")
-            )
-        if line != line.rstrip():
-            findings.append(Finding(rel, i, "L008", "trailing whitespace"))
-
-    return findings
+    """The monolith's per-file entry point: run the L001-L021 ruleset
+    over one source blob (noqa suppression applied, no waiver
+    accounting — that is the analyzer's job)."""
+    return _core.analyze_source(path, source, codes=LEGACY_CODES).findings
 
 
 def lint_paths(paths: Iterator[Path]) -> List[Finding]:
     findings: List[Finding] = []
     for path in paths:
-        findings.extend(lint_source(path, path.read_text(encoding="utf-8")))
+        findings.extend(
+            lint_source(path, path.read_text(encoding="utf-8"))
+        )
     return findings
 
 
 def repo_python_files(root: Path) -> List[Path]:
-    files = [root / "bench.py", root / "__graft_entry__.py"]
-    files += sorted((root / "kafka_lag_based_assignor_tpu").rglob("*.py"))
-    files += sorted((root / "tests").glob("*.py"))
-    files += sorted((root / "tools").glob("*.py"))
-    return [f for f in files if f.exists() and "__pycache__" not in f.parts]
+    return _core.repo_python_files(root)
 
 
 def main() -> int:
