@@ -162,6 +162,67 @@ def quality_tile() -> int:
     return _QUALITY["tile"]
 
 
+# How the process-wide tile was last chosen ("default" until boot
+# autotune runs; then "autotuned" or "cpu-default") plus the memory
+# figure the choice was derived from — quality_status surfaces it.
+_TILE_SOURCE = {"source": "default", "memory_bytes": None}
+
+
+def autotune_quality_tile(memory_stats=None) -> int:
+    """Boot-time autotune of ``tpu.assignor.quality.tile`` from the
+    device's ``memory_stats`` instead of the static default (called
+    from :func:`...warmup.warmup` before the quality jobs compile, so
+    the chosen geometry is the one that gets warmed).
+
+    Sizing rule: the linear-OT tile scan keeps ~3 live (tile, C) f32
+    blocks per step (:func:`.linear_ot._peak_bytes_estimate`), so the
+    tile is the largest pow2 with ``3 * tile * 1024 * 4`` (C sized at
+    the north-star 1000-consumer lane pad) under 1/8th of the
+    device's free memory — conservative, because the [P2] row vectors
+    and the refine buffers share the same HBM.  On CPU (no
+    ``memory_stats``) the static default stays: tier-1 runs must keep
+    one deterministic geometry.  The choice is logged through the
+    metrics registry (``klba_quality_tile_autotuned{source}``)."""
+    from ..utils import metrics
+
+    if memory_stats is None:
+        try:
+            dev = jax.devices()[0]
+            memory_stats = (
+                dev.memory_stats() if dev.platform != "cpu" else None
+            )
+        except Exception:  # backends without memory introspection
+            LOGGER.debug(
+                "device memory_stats unavailable; keeping the static "
+                "quality tile", exc_info=True,
+            )
+            memory_stats = None
+    if not memory_stats:
+        _TILE_SOURCE.update(source="cpu-default", memory_bytes=None)
+        metrics.REGISTRY.gauge(
+            "klba_quality_tile_autotuned", {"source": "cpu-default"}
+        ).set(quality_tile())
+        return quality_tile()
+    free = int(
+        memory_stats.get("bytes_limit", 0)
+        - memory_stats.get("bytes_in_use", 0)
+    )
+    budget = max(free // 8, 1)
+    tile = 8
+    while tile * 2 <= 65536 and 3 * (tile * 2) * 1024 * 4 <= budget:
+        tile *= 2
+    chosen = set_quality_tile(tile)
+    _TILE_SOURCE.update(source="autotuned", memory_bytes=free)
+    metrics.REGISTRY.gauge(
+        "klba_quality_tile_autotuned", {"source": "autotuned"}
+    ).set(chosen)
+    LOGGER.info(
+        "quality tile autotuned to %d rows (device free memory %d "
+        "bytes)", chosen, free,
+    )
+    return chosen
+
+
 @contextmanager
 def quality_scope(mode, tile: Optional[int] = None):
     """Scope a quality mode (and optionally a tile size) to a block —
@@ -202,15 +263,22 @@ def resolve_quality_mode(num_rows: int, num_consumers: int) -> str:
 
 def quality_status() -> Dict:
     """The service ``stats.quality`` section (and dump_metrics
-    --summary's quality rows): mode/tile knobs plus the last linear
-    solve's tile count and peak-memory estimate."""
+    --summary's quality rows): mode/tile knobs (plus how the tile was
+    chosen), the last linear solve's tile count and peak-memory
+    estimate, and the kernel-plane gate verdicts."""
     from .linear_ot import last_solve_info
+    from .linear_ot_pallas import linear_pallas_available
 
     return {
         "mode": quality_mode(),
         "tile": quality_tile(),
+        "tile_source": dict(_TILE_SOURCE),
         "auto_min_rows": LINEAR_AUTO_MIN_ROWS,
         "last_linear_solve": last_solve_info(),
+        "kernel": dict(
+            duals=linear_pallas_available(kind="duals"),
+            digest=linear_pallas_available(kind="digest"),
+        ),
     }
 
 
@@ -442,6 +510,7 @@ __all__ = [
     "assign_device",
     "assign_group_device",
     "assign_topic_device",
+    "autotune_quality_tile",
     "ensure_x64",
     "pad_bucket",
     "quality_mode",

@@ -1,0 +1,97 @@
+"""Shared VMEM admission model for the Pallas kernel plane.
+
+Every grid-less kernel in this package (:mod:`.plan_stats`,
+:mod:`.rounds_pallas`, :mod:`.linear_ot_pallas`) keeps its ENTIRE
+working set resident in VMEM for the whole invocation — that is the
+design (no grid, no double-buffered HBM streaming), so admission is a
+host-side byte estimate against one conservative per-core budget.
+Before this module each kernel re-derived the budget and the padding
+rules locally (and the prose in ``pallas_rounds_mode``'s docstring had
+already drifted from the code once); the constants and the per-kernel
+byte models now live HERE, and the dispatch sites consume them, so the
+numbers cannot fork again.
+
+The estimates deliberately over-count: Mosaic reuses buffers and
+overlaps DMA, but a kernel rejected by a pessimistic model just runs
+the XLA path — a kernel ADMITTED by an optimistic model OOMs VMEM at
+compile time on a serving path.
+"""
+
+from __future__ import annotations
+
+#: Conservative per-core VMEM budget (physical VMEM is ~16 MB; leave
+#: headroom for Mosaic's own buffers and double-buffered DMA).
+#: Calibrated so the hardware-verified north-star shape
+#: (P=131072, C=1000) passes every kernel's model below.
+VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+
+#: Mosaic tile geometry: the minor-most axis is padded to LANE lanes,
+#: the second-minor to SUBLANE sublanes (f32/int32; wider dtypes only
+#: appear on the probe-gated digest path).
+LANE = 128
+SUBLANE = 8
+
+
+def lane_pad(n: int) -> int:
+    """``n`` padded up to a full lane multiple (>= one lane)."""
+    return max(LANE, -(-int(n) // LANE) * LANE)
+
+
+def sublane_pad(n: int) -> int:
+    """``n`` padded up to a full sublane multiple (>= one sublane)."""
+    return max(SUBLANE, -(-int(n) // SUBLANE) * SUBLANE)
+
+
+def fits_vmem(bytes_needed: int, budget: int = VMEM_BUDGET_BYTES) -> bool:
+    return int(bytes_needed) <= int(budget)
+
+
+def rounds_scan_bytes(num_rounds: int, c_pad: int) -> int:
+    """Byte model of the Pallas round scan (:mod:`.rounds_pallas`): the
+    [R, C_PAD] int32 gains and choice planes plus the resident
+    (total, id) state planes (two extra pairs for the WIDE variant's
+    carry planes — folded into the same estimate)."""
+    return 2 * int(num_rounds) * int(c_pad) * 4 + 8 * int(c_pad) * 4
+
+
+def plan_stats_bytes(num_rows: int, num_consumers: int, tile_p: int) -> int:
+    """Byte model of the plan-stats marginal kernel
+    (:mod:`.plan_stats`): ws/count/wsum inputs at [nt, TILE_P]
+    (true-sized), ~4 live (C_pad, TILE_P) f32 temporaries per tile step
+    (Mosaic reuses buffers), and the (C_pad, 1) dual/accumulator
+    vectors at full lane padding."""
+    c_pad = lane_pad(num_consumers)
+    u_pad = -(-int(num_rows) // int(tile_p)) * int(tile_p)
+    inputs = 3 * u_pad * 4
+    temps = 4 * c_pad * int(tile_p) * 4
+    vectors = 4 * c_pad * LANE * 4
+    return inputs + temps + vectors
+
+
+def linear_ot_bytes(num_rows_padded: int, num_consumers: int,
+                    tile: int) -> int:
+    """Byte model of the fused linear-OT mirror-prox kernel
+    (:mod:`.linear_ot_pallas`): the ws/count inputs as [n_tiles, tile]
+    f32 planes (sublane-padded), ~4 live (C_pad, tile) f32 logits
+    temporaries per tile step, and ~8 (C_pad, 1) dual/marginal vectors
+    at full lane padding (A, B, A_half, both marginal pairs, and the
+    block accumulators)."""
+    c_pad = lane_pad(num_consumers)
+    nt = sublane_pad(int(num_rows_padded) // int(tile))
+    inputs = 2 * nt * int(tile) * 4
+    temps = 4 * c_pad * int(tile) * 4
+    vectors = 8 * c_pad * LANE * 4
+    return inputs + temps + vectors
+
+
+def digest_bytes(num_rows_padded: int, num_consumers: int) -> int:
+    """Byte model of the fused integrity-digest epilogue
+    (:mod:`.linear_ot_pallas`): the int64 lag rows + int32 choice rows
+    at [P_pad/LANE, LANE], one (C_pad, LANE) one-hot temporary pair per
+    row step, and the (C_pad, 1) count vectors (int64)."""
+    p_pad = lane_pad(num_rows_padded)
+    c_pad = lane_pad(num_consumers)
+    inputs = p_pad * (8 + 4)
+    temps = 2 * c_pad * LANE * 4
+    vectors = 3 * c_pad * LANE * 8
+    return inputs + temps + vectors

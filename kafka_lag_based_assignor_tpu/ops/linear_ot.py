@@ -84,6 +84,17 @@ DEFAULT_TILE = 1024
 # knob surface and this executable cannot drift.
 from ..utils.config import validate_quality_tile as validate_tile
 
+# Lane padding comes from the shared kernel admission model: the XLA
+# tile body below runs the SAME consumer-padded transposed geometry as
+# the Pallas kernel plane (ops/linear_ot_pallas), which is what makes
+# the two lowerings bit-identical.
+from .kernel_admission import lane_pad as _lane_pad
+
+#: Mirror-prox extragradient step size — shared by the XLA loop body
+#: and the fused kernel (the kernel bakes it in as a compile-time
+#: constant, so it must be THE same literal).
+MIRROR_PROX_ETA = 8.0
+
 
 def plan_shape(num_rows: int, tile: int):
     """Padded solve geometry: ``(P2, tile_eff, n_tiles)``.  ``P2`` is
@@ -118,33 +129,57 @@ def _to_blocks(x, P2: int, nblocks: int, tile: int):
     return x.reshape(nblocks, (P2 // nblocks) // tile, tile)
 
 
+def _tile_softmax(w_row, A_col, B_col, j_idx, C: int):
+    """THE tile body, shared op-for-op by the XLA scan and the Pallas
+    kernels: masked softmax over the implicit-plan logits block
+    ``-w * A + B`` in the TRANSPOSED padded geometry — consumers on
+    the sublane axis as a (C_pad, 1) column, rows on the lane axis as
+    a (1, tile) row, so the (C_pad, tile) logits block is exactly the
+    VMEM-resident layout of :mod:`.linear_ot_pallas`.  Pad consumers
+    (``j_idx >= C``) are masked to -1e30, which underflows to an exact
+    0 after the exp — every padded marginal entry is a true f32 zero.
+    (Lint L021 confines dense rank-1 x rank-1 broadcasts to functions
+    like this one.)"""
+    logits = -w_row * A_col + B_col
+    logits = jnp.where(j_idx < C, logits, jnp.float32(-1e30))
+    mx = jnp.max(logits, axis=0, keepdims=True)
+    e = jnp.exp(logits - mx)
+    return e / jnp.sum(e, axis=0, keepdims=True)
+
+
 def _superblock_partials(ws_blocks, cnt_blocks, A, B):
     """Per-superblock marginal partials: ``(load[Sb, C], colsum[Sb, C])``
     with each block's tiles accumulated SEQUENTIALLY (``lax.scan``
     carries the f32 accumulators, so the addition order per block is
-    fixed regardless of backend fusion)."""
+    fixed regardless of backend fusion).  Runs the same consumer-padded
+    transposed tile body as the kernel plane (:func:`_tile_softmax`),
+    so the partials are bit-identical to
+    :func:`.linear_ot_pallas.superblock_partials_pallas`."""
     C = A.shape[0]
+    C_pad = _lane_pad(C)
+    A_p = jnp.pad(A, (0, C_pad - C)).reshape(C_pad, 1)
+    B_p = jnp.pad(B, (0, C_pad - C)).reshape(C_pad, 1)
+    j_idx = lax.broadcasted_iota(jnp.int32, (C_pad, 1), 0)
 
     def one_block(args):
         ws_t, cnt_t = args  # [tiles_per_block, tile]
 
         def tile_step(carry, wc):
-            # THE tile body — the only place a (tile, C) block lives
-            # (lint L021 confines dense rank-1 x rank-1 broadcasts to
-            # functions like this one).
             acc_l, acc_c = carry
             w_t, c_t = wc
-            logits = -w_t[:, None] * A[None, :] + B[None, :]
-            x = jax.nn.softmax(logits, axis=1)
-            acc_l = acc_l + (w_t[:, None] * x).sum(axis=0)
-            acc_c = acc_c + (c_t[:, None] * x).sum(axis=0)
+            w_row = w_t.reshape(1, -1)
+            c_row = c_t.reshape(1, -1)
+            x = _tile_softmax(w_row, A_p, B_p, j_idx, C)
+            acc_l = acc_l + jnp.sum(w_row * x, axis=1, keepdims=True)
+            acc_c = acc_c + jnp.sum(c_row * x, axis=1, keepdims=True)
             return (acc_l, acc_c), None
 
-        zero = jnp.zeros((C,), jnp.float32)
+        zero = jnp.zeros((C_pad, 1), jnp.float32)
         (l_b, c_b), _ = lax.scan(tile_step, (zero, zero), (ws_t, cnt_t))
-        return l_b, c_b
+        return l_b[:, 0], c_b[:, 0]
 
-    return lax.map(one_block, (ws_blocks, cnt_blocks))
+    pl_, pc_ = lax.map(one_block, (ws_blocks, cnt_blocks))
+    return pl_[:, :C], pc_[:, :C]
 
 
 def _ordered_sum(parts):
@@ -157,8 +192,21 @@ def _ordered_sum(parts):
     return acc
 
 
+def _mean_padded(v):
+    """Mean of a [C] f32 vector computed as a zero-padded lane-width
+    sum over C_pad elements divided by C.  f32 sums over the SAME
+    element count reduce identically regardless of layout, but padded
+    vs unpadded sums do NOT — so both the XLA loop body and the fused
+    kernel (whose marginals live as exact-zero-padded (C_pad, 1)
+    columns) must use THIS reduction shape for the extrapolation mean,
+    or their trajectories fork at the first iteration."""
+    C = v.shape[0]
+    return jnp.sum(jnp.pad(v, (0, _lane_pad(C) - C))) / jnp.float32(C)
+
+
 def mirror_prox(stats_fn, num_consumers: int, iters: int, n_valid,
-                eta: float = 8.0, tol: float = 2e-5):
+                eta: float = MIRROR_PROX_ETA, tol: float = 2e-5,
+                fused_fn=None):
     """The shared mirror-prox dual loop (single-device AND sharded —
     ``stats_fn(A, B) -> (load, colsum)`` is the only thing that
     differs, and both implementations are bit-identical by
@@ -171,6 +219,14 @@ def mirror_prox(stats_fn, num_consumers: int, iters: int, n_valid,
     Sinkhorn iteration (:func:`..models.sinkhorn._sinkhorn_duals_jit`)
     so the two quality modes share one convergence contract.
 
+    ``fused_fn(A, B, sc, prev_spread) -> (load1, load2, colsum2)``,
+    when given, replaces BOTH marginal evaluations AND the in-between
+    extrapolation with one fused kernel invocation
+    (:func:`.linear_ot_pallas.mirror_prox_step_pallas`); the loop body
+    then re-derives the (exact: compares and f32 scalar arithmetic)
+    step scale from ``load1`` so the while-loop carry stays in plain
+    XLA — the carries are bit-identical to the unfused path.
+
     Returns ``(A, B, rounds)``."""
     C = int(num_consumers)
     cap = jnp.maximum(n_valid.astype(jnp.float32), 1.0) / C
@@ -180,7 +236,10 @@ def mirror_prox(stats_fn, num_consumers: int, iters: int, n_valid,
 
     def body(state):
         i, sc, prev_spread, _, A, B = state
-        load1, _ = stats_fn(A, B)
+        if fused_fn is not None:
+            load1, load2, colsum2 = fused_fn(A, B, sc, prev_spread)
+        else:
+            load1, _ = stats_fn(A, B)
         spread = jnp.max(load1) - jnp.min(load1)
         grew = spread > prev_spread
         sc = jnp.where(
@@ -188,13 +247,16 @@ def mirror_prox(stats_fn, num_consumers: int, iters: int, n_valid,
             sc * jnp.float32(0.5),
             jnp.minimum(sc * jnp.float32(1.2), jnp.float32(1.0)),
         )
-        # Predictor: extrapolate the consumer duals along the centered
-        # load gradient, then re-evaluate BOTH marginals there.
-        A_half = A + eta32 * sc * (load1 - jnp.mean(load1))
-        load2, colsum2 = stats_fn(A_half, B)
+        if fused_fn is None:
+            # Predictor: extrapolate the consumer duals along the
+            # centered load gradient, then re-evaluate BOTH marginals
+            # there.  (The fused kernel runs this same extrapolation
+            # in VMEM with the same reduction shapes.)
+            A_half = A + eta32 * sc * (load1 - _mean_padded(load1))
+            load2, colsum2 = stats_fn(A_half, B)
         # Corrector: commit the update with the look-ahead gradient;
         # one Sinkhorn column scaling toward the balanced marginal.
-        A2 = A + eta32 * sc * (load2 - jnp.mean(load2))
+        A2 = A + eta32 * sc * (load2 - _mean_padded(load2))
         upd = jnp.log(cap / (colsum2 + jnp.float32(1e-9)))
         B2 = B + upd
         delta = jnp.maximum(spread, jnp.max(jnp.abs(upd)))
@@ -216,15 +278,25 @@ def mirror_prox(stats_fn, num_consumers: int, iters: int, n_valid,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("num_consumers", "iters", "tile")
+    jax.jit,
+    static_argnames=("num_consumers", "iters", "tile", "kernel"),
 )
 def _linear_duals_jit(lags, valid, scale, n_valid, *,
-                      num_consumers: int, iters: int, tile: int):
+                      num_consumers: int, iters: int, tile: int,
+                      kernel=False):
     """ONE fused executable for the whole dual solve: the mirror-prox
     outer loop with tile-streamed marginal scans inside.  Peak live
-    memory is the [P2] f32 ws/count vectors + one (tile, C) block +
+    memory is the [P2] f32 ws/count vectors + one (C_pad, tile) block +
     the [_SUPERBLOCKS, C] partials + a handful of [C] vectors —
-    O(P + tile*C + C), never [P, C]."""
+    O(P + tile*C + C), never [P, C].
+
+    ``kernel`` (static) selects the marginal-scan lowering: ``False``
+    is the XLA tile scan; ``True`` swaps in the fused Pallas
+    mirror-prox step (callers must hold a probe verdict from
+    :func:`.linear_ot_pallas.linear_pallas_available` AND pass host
+    admission first); ``"interpret"`` runs the same kernel trace as
+    plain jnp ops (CPU parity tests).  All three produce bit-identical
+    duals."""
     C = int(num_consumers)
     P2, t, _ = plan_shape(lags.shape[0], tile)
     ws, cnt = _ws_cnt(lags, valid, scale)
@@ -235,7 +307,19 @@ def _linear_duals_jit(lags, valid, scale, n_valid, *,
         pl, pc = _superblock_partials(ws_b, cnt_b, A, B)
         return _ordered_sum(pl), _ordered_sum(pc)
 
-    return mirror_prox(stats_fn, C, iters, n_valid)
+    fused_fn = None
+    if kernel:
+        from .linear_ot_pallas import mirror_prox_step_pallas
+
+        interp = kernel == "interpret"
+
+        def fused_fn(A, B, sc, prev_spread):
+            return mirror_prox_step_pallas(
+                ws_b, cnt_b, A, B, sc, prev_spread,
+                eta=MIRROR_PROX_ETA, interpret=interp,
+            )
+
+    return mirror_prox(stats_fn, C, iters, n_valid, fused_fn=fused_fn)
 
 
 @functools.partial(
@@ -312,6 +396,7 @@ def finish_from_duals(
     tile: int,
     rounds: int,
     backend: str,
+    kernel: bool = False,
 ):
     """Shared host tail of both linear entries: run the rounding
     executable, ASSERT the additive bound, record the quality-plane
@@ -326,10 +411,12 @@ def finish_from_duals(
 
     global _LAST
     C = int(num_consumers)
-    choice, counts, totals = _finish_linear_jit(
-        lags_p, pids_p, valid_p, A, B,
-        num_consumers=C, refine_iters=int(refine_iters),
-    )
+    with metrics.device_phase("rounding"):
+        choice, counts, totals = _finish_linear_jit(
+            lags_p, pids_p, valid_p, A, B,
+            num_consumers=C, refine_iters=int(refine_iters),
+        )
+        jax.block_until_ready((choice, counts, totals))
     choice_np, counts_np, totals_np = (
         np.asarray(x) for x in jax.device_get((choice, counts, totals))
     )
@@ -349,6 +436,7 @@ def finish_from_duals(
         "tile": int(tile),
         "tiles": int(tiles),
         "duals_rounds": int(rounds),
+        "duals_kernel": bool(kernel),
         "peak_bytes_estimate": _peak_bytes_estimate(P2, C, int(tile)),
     }
     metrics.REGISTRY.counter(
@@ -421,15 +509,47 @@ def assign_topic_linear(
             else _AUTO_REFINE_SCAN
         )
     scale = _scale_np(lags_np, valid_np, C)
-    A, B, rounds = _linear_duals_jit(
-        lags_np, valid_np,
-        np.float64(scale), np.float32(n_valid),
-        num_consumers=C, iters=int(iters), tile=tile_e,
+    from ..utils import metrics
+    from . import linear_ot_pallas
+
+    # Kernel plane dispatch: probe verdict first (False until warm-up
+    # has probed, and after any runtime failure), then host admission
+    # on the EFFECTIVE solve geometry.  The probe is never run from
+    # here — this is a (possibly cold) rebalance path.
+    kernel = bool(
+        linear_ot_pallas.linear_pallas_available(kind="duals")
+        and linear_ot_pallas.linear_pallas_admit(P, C, tile_e)
     )
+    with metrics.device_phase("h2d"):
+        lags_d, valid_d = jax.device_put((lags_np, valid_np))
+        jax.block_until_ready((lags_d, valid_d))
+    duals_args = (
+        lags_d, valid_d, np.float64(scale), np.float32(n_valid),
+    )
+    duals_kw = dict(num_consumers=C, iters=int(iters), tile=tile_e)
+    try:
+        with metrics.device_phase("duals"):
+            A, B, rounds = _linear_duals_jit(
+                *duals_args, kernel=kernel, **duals_kw
+            )
+            jax.block_until_ready((A, B, rounds))
+    except Exception as exc:
+        if not kernel:
+            raise
+        # The probe vouched for the probe shape only; a dispatch that
+        # faults at THIS shape falls back to the XLA tile scan and
+        # pins the kernel off for the rest of the process.
+        linear_ot_pallas.mark_linear_kernel_bad("duals", repr(exc))
+        kernel = False
+        with metrics.device_phase("duals"):
+            A, B, rounds = _linear_duals_jit(
+                *duals_args, kernel=False, **duals_kw
+            )
+            jax.block_until_ready((A, B, rounds))
     return finish_from_duals(
         lags_np, pids_np, valid_np, A, B, C, refine_iters,
         tiles=n_tiles, tile=tile_e, rounds=int(rounds),
-        backend="single",
+        backend="single", kernel=kernel,
     )
 
 

@@ -1,0 +1,731 @@
+"""Pallas TPU kernel plane for the linear-OT mirror-prox solve.
+
+Why: the round-18 linear-space quality mode is memory-right (O(P + C)
+peak) but its marginal scan is a plain ``lax.scan`` over XLA-lowered
+tile bodies — every mirror-prox iteration re-streams the ws/count
+vectors through HBM TWICE (predictor and corrector evaluations are
+separate executable regions), paying the same per-pass sequencing
+overhead that motivated the round-14 Pallas round scan
+(:mod:`.rounds_pallas`).  This module keeps the whole extragradient
+step resident in VMEM:
+
+* **Fused mirror-prox step** (:func:`mirror_prox_step_pallas`): ONE
+  grid-less invocation evaluates the predictor marginals at the
+  current duals, derives the damped step scale and the extrapolated
+  dual point IN-KERNEL, and immediately re-evaluates both marginals
+  there (the corrector) — the ws/count planes are loaded into VMEM
+  once per iteration instead of twice, and the (C_pad, tile) logits
+  block never leaves VMEM (the FlashSinkhorn IO-bound framing,
+  arXiv:2602.03067 — pattern only).
+
+* **Bit-parity by construction**: the kernel's tile body is the SAME
+  traced helper the XLA scan uses (:func:`.linear_ot._tile_softmax` —
+  one definition, transposed C_pad-padded geometry, masked softmax),
+  the per-superblock partials accumulate tile-sequentially and combine
+  in the same left-to-right order as :func:`.linear_ot._ordered_sum`,
+  and the in-kernel extrapolation mean is the same padded-lane
+  reduction as :func:`.linear_ot._mean_padded` — so the duals
+  trajectory is bit-identical to the XLA tile scan (pinned and fuzzed
+  in interpret mode by tests/test_linear_ot_pallas.py), and the
+  mesh-1 vs 2-8 parity contract of :mod:`..sharded.solve` survives
+  with the kernel enabled (:func:`superblock_partials_pallas` is the
+  per-shard drop-in behind the same all-gather + ordered combine).
+
+* **Fused integrity-digest epilogue** (:func:`state_digest_pallas`):
+  the round-15 resident-state digest — int64[4]
+  ``[counts_sum, range_violations, lags_sum, counts_vs_choice_L1]`` —
+  folded into one kernel pass instead of a separate XLA reduction
+  tree.  All-integer arithmetic, so it is order-exact with the XLA
+  reference (:func:`.refine.state_digest` is the dispatch seam).
+
+Production dispatch reuses the :mod:`.rounds_pallas` safety
+scaffolding verbatim: host admission against the shared VMEM model
+(:mod:`.kernel_admission`), a probe-once device gate
+(:func:`linear_pallas_available`) that bit-compares the real Mosaic
+lowering against the XLA tile scan AND races it (margin 1.0 — the
+kernel must be at least as fast on the probe shape), automatic
+fall-back to the XLA path on ANY failure (including a runtime
+dispatch error, :func:`mark_linear_kernel_bad`), and a probe that is
+only ever invoked by warm-up/bench (``run_probe=True``), never on a
+cold rebalance.
+
+Toolchain shape (same constraints as :mod:`.plan_stats` /
+:mod:`.rounds_pallas`): this image's Mosaic AOT path rejects any
+``grid``, so every kernel is a single grid-less invocation with
+``lax.fori_loop`` over tiles, explicit int32 loop offsets, and
+full-array VMEM BlockSpecs; ``interpret=True`` runs the same trace as
+plain jnp ops for CPU tests.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import logging
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .kernel_admission import (
+    LANE,
+    digest_bytes,
+    fits_vmem,
+    lane_pad,
+    linear_ot_bytes,
+)
+
+LOGGER = logging.getLogger(__name__)
+
+#: The device-gate probe instance (north-star-adjacent: C=1000 pads to
+#: one (1024, tile) logits plane; tile=256 is the largest pow2 the
+#: shared VMEM model admits at that C).  The bench's
+#: ``linear_ot_kernel`` gate races exactly this shape.
+PROBE_ROWS = 65536
+PROBE_CONSUMERS = 1000
+PROBE_TILE = 256
+_PROBE_ITERS = 12
+
+_linear_pallas_ok: dict | None = None  # {"duals": bool, "digest": bool}
+# Probe-once means once PER PROCESS (threaded sidecar: concurrent
+# configure-time warm-ups must not race two multi-compile probes, or
+# read a partially-decided verdict).  Double-checked under this lock.
+_linear_pallas_lock = threading.Lock()
+
+# Most recent speed-race timings (ms) — surfaced in the kernel report
+# and the bench's linear_ot_kernel config.
+_LAST_RACE: Optional[dict] = None
+
+
+# ---------------------------------------------------------------------------
+# admission (host-side; shared VMEM model)
+# ---------------------------------------------------------------------------
+
+
+def linear_pallas_admit(num_rows: int, num_consumers: int,
+                        tile: int) -> bool:
+    """Host admission for the fused duals kernel: the effective solve
+    geometry (:func:`.linear_ot.plan_shape` — the same geometry the
+    XLA scan uses, because tile size is part of the bit-parity
+    contract) must fit the shared VMEM byte model.  One definition for
+    the single-device entry, the sharded per-shard check
+    (:func:`linear_pallas_admit_sharded`), and the probes."""
+    if int(num_consumers) < 2:
+        return False
+    from .linear_ot import plan_shape
+
+    P2, t, _ = plan_shape(num_rows, tile)
+    return fits_vmem(linear_ot_bytes(P2, num_consumers, t))
+
+
+def linear_pallas_admit_sharded(rows_per_shard: int, num_consumers: int,
+                                tile: int) -> bool:
+    """Per-shard admission for the sharded composition: each shard runs
+    the partials kernel over its LOCAL superblocks, so the byte model
+    applies to the local row slice."""
+    if int(num_consumers) < 2:
+        return False
+    return fits_vmem(linear_ot_bytes(rows_per_shard, num_consumers, tile))
+
+
+def digest_pallas_admit(num_rows: int, num_consumers: int) -> bool:
+    """Host admission for the fused digest epilogue (int64 rows are the
+    dominant term — the resident buffers are already padded)."""
+    if int(num_consumers) < 1:
+        return False
+    return fits_vmem(digest_bytes(num_rows, num_consumers))
+
+
+# ---------------------------------------------------------------------------
+# kernels
+# ---------------------------------------------------------------------------
+
+
+def _pad_cols(v, C_pad: int):
+    """[C] f32 -> (C_pad, 1) zero-padded column (consumers on
+    sublanes — the transposed geometry shared with the XLA scan)."""
+    C = v.shape[0]
+    return jnp.pad(v.astype(jnp.float32), (0, C_pad - C)).reshape(C_pad, 1)
+
+
+def _block_specs(shapes, dtypes=None):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def spec(shape):
+        ndim = len(shape)
+        return pl.BlockSpec(
+            shape, lambda *a, n=ndim: (0,) * n, memory_space=pltpu.VMEM
+        )
+
+    return [spec(s) for s in shapes]
+
+
+def superblock_partials_pallas(ws_b, cnt_b, A, B, *,
+                               interpret: bool = False):
+    """Grid-less drop-in for :func:`.linear_ot._superblock_partials`:
+    per-superblock marginal partials ``(load[Sb, C], colsum[Sb, C])``
+    with each block's tiles accumulated sequentially in VMEM.  The
+    tile body is the SAME traced helper the XLA scan uses, so the
+    partials are bit-identical — the sharded composition swaps this in
+    per shard and keeps its all-gather + ordered combine unchanged."""
+    from jax.experimental import pallas as pl
+
+    from .linear_ot import _tile_softmax
+
+    Sb, tpb, tile = ws_b.shape
+    C = A.shape[0]
+    C_pad = lane_pad(C)
+    nt = Sb * tpb
+    ws2 = ws_b.reshape(nt, tile)
+    cnt2 = cnt_b.reshape(nt, tile)
+    A_p = _pad_cols(A, C_pad)
+    B_p = _pad_cols(B, C_pad)
+
+    def kernel(ws_ref, cnt_ref, A_ref, B_ref, l_ref, c_ref):
+        j_idx = lax.broadcasted_iota(jnp.int32, (C_pad, 1), 0)
+        A_col = A_ref[:]
+        B_col = B_ref[:]
+        zero = jnp.zeros((C_pad, 1), jnp.float32)
+        for s in range(Sb):
+            def tile_fn(t, acc, s=s):
+                acc_l, acc_c = acc
+                w_t = ws_ref[pl.ds(jnp.int32(s * tpb) + t, 1), :]
+                c_t = cnt_ref[pl.ds(jnp.int32(s * tpb) + t, 1), :]
+                x = _tile_softmax(w_t, A_col, B_col, j_idx, C)
+                acc_l = acc_l + jnp.sum(w_t * x, axis=1, keepdims=True)
+                acc_c = acc_c + jnp.sum(c_t * x, axis=1, keepdims=True)
+                return acc_l, acc_c
+
+            l_b, c_b = lax.fori_loop(
+                jnp.int32(0), jnp.int32(tpb), tile_fn, (zero, zero)
+            )
+            l_ref[pl.ds(s, 1), :] = l_b.reshape(1, C_pad)
+            c_ref[pl.ds(s, 1), :] = c_b.reshape(1, C_pad)
+
+    l, c = pl.pallas_call(
+        kernel,
+        in_specs=_block_specs(
+            [(nt, tile), (nt, tile), (C_pad, 1), (C_pad, 1)]
+        ),
+        out_specs=_block_specs([(Sb, C_pad), (Sb, C_pad)]),
+        out_shape=[
+            jax.ShapeDtypeStruct((Sb, C_pad), jnp.float32),
+            jax.ShapeDtypeStruct((Sb, C_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(ws2, cnt2, A_p, B_p)
+    return l[:, :C], c[:, :C]
+
+
+def mirror_prox_step_pallas(ws_b, cnt_b, A, B, sc, prev_spread, *,
+                            eta: float, interpret: bool = False):
+    """ONE fused extragradient step: predictor marginals at ``(A, B)``,
+    in-kernel step-scale damping + extrapolation to ``A_half``, and
+    corrector marginals at ``(A_half, B)`` — a single VMEM-resident
+    invocation per mirror-prox iteration.
+
+    Returns ``(load1[C], load2[C], colsum2[C])``; the (cheap, exact)
+    scale/commit arithmetic is recomputed by the XLA loop body from
+    ``load1`` so the while-loop carry stays in plain XLA.  Every
+    reduction shape matches the XLA path's (same tile helper, same
+    left-to-right block combine, same padded-lane mean), which is what
+    makes the two trajectories bit-identical."""
+    from jax.experimental import pallas as pl
+
+    from .linear_ot import _tile_softmax
+
+    Sb, tpb, tile = ws_b.shape
+    C = A.shape[0]
+    C_pad = lane_pad(C)
+    nt = Sb * tpb
+    ws2 = ws_b.reshape(nt, tile)
+    cnt2 = cnt_b.reshape(nt, tile)
+    A_p = _pad_cols(A, C_pad)
+    B_p = _pad_cols(B, C_pad)
+    sc2 = jnp.asarray(sc, jnp.float32).reshape(1, 1)
+    sp2 = jnp.asarray(prev_spread, jnp.float32).reshape(1, 1)
+    eta_f = float(eta)  # baked into the kernel as a literal
+
+    def kernel(ws_ref, cnt_ref, A_ref, B_ref, sc_ref, sp_ref,
+               l1_ref, l2_ref, c2_ref):
+        j_idx = lax.broadcasted_iota(jnp.int32, (C_pad, 1), 0)
+        B_col = B_ref[:]
+        zero = jnp.zeros((C_pad, 1), jnp.float32)
+
+        def eval_load(A_col):
+            # Predictor marginal: per-superblock tile-sequential
+            # partials, then the SAME left-to-right block combine as
+            # _ordered_sum (parts[0] seeds the fold — not zero — so
+            # the addition sequence matches exactly).
+            parts = []
+            for s in range(Sb):
+                def tile_fn(t, acc, s=s):
+                    w_t = ws_ref[pl.ds(jnp.int32(s * tpb) + t, 1), :]
+                    x = _tile_softmax(w_t, A_col, B_col, j_idx, C)
+                    return acc + jnp.sum(w_t * x, axis=1, keepdims=True)
+
+                parts.append(lax.fori_loop(
+                    jnp.int32(0), jnp.int32(tpb), tile_fn, zero
+                ))
+            total = parts[0]
+            for s in range(1, Sb):
+                total = total + parts[s]
+            return total
+
+        def eval_pair(A_col):
+            parts = []
+            for s in range(Sb):
+                def tile_fn(t, acc, s=s):
+                    acc_l, acc_c = acc
+                    w_t = ws_ref[pl.ds(jnp.int32(s * tpb) + t, 1), :]
+                    c_t = cnt_ref[pl.ds(jnp.int32(s * tpb) + t, 1), :]
+                    x = _tile_softmax(w_t, A_col, B_col, j_idx, C)
+                    acc_l = acc_l + jnp.sum(w_t * x, axis=1, keepdims=True)
+                    acc_c = acc_c + jnp.sum(c_t * x, axis=1, keepdims=True)
+                    return acc_l, acc_c
+
+                parts.append(lax.fori_loop(
+                    jnp.int32(0), jnp.int32(tpb), tile_fn, (zero, zero)
+                ))
+            tl = parts[0][0]
+            tc = parts[0][1]
+            for s in range(1, Sb):
+                tl = tl + parts[s][0]
+                tc = tc + parts[s][1]
+            return tl, tc
+
+        A_col = A_ref[:]
+        load1 = eval_load(A_col)
+        # Step-scale damping — value-exact ops (masked max/min,
+        # compares, f32 multiplies) that the XLA body reproduces from
+        # the returned load1.
+        valid_j = j_idx < C
+        lmax = jnp.max(
+            jnp.where(valid_j, load1, -jnp.inf), axis=0, keepdims=True
+        )
+        lmin = jnp.min(
+            jnp.where(valid_j, load1, jnp.inf), axis=0, keepdims=True
+        )
+        spread = lmax - lmin
+        sc_cur = sc_ref[:]
+        grew = spread > sp_ref[:]
+        sc_new = jnp.where(
+            grew,
+            sc_cur * jnp.float32(0.5),
+            jnp.minimum(sc_cur * jnp.float32(1.2), jnp.float32(1.0)),
+        )
+        # Extrapolation mean: the padded-lane reduction of
+        # _mean_padded — load1's pad rows are exact zeros, so the
+        # element set (and the reduce shape) matches the XLA side.
+        mean1 = jnp.sum(load1, axis=0, keepdims=True) / jnp.float32(C)
+        A_half = A_col + jnp.float32(eta_f) * sc_new * (load1 - mean1)
+        load2, colsum2 = eval_pair(A_half)
+        l1_ref[:] = load1
+        l2_ref[:] = load2
+        c2_ref[:] = colsum2
+
+    l1, l2, c2 = pl.pallas_call(
+        kernel,
+        in_specs=_block_specs(
+            [(nt, tile), (nt, tile), (C_pad, 1), (C_pad, 1), (1, 1),
+             (1, 1)]
+        ),
+        out_specs=_block_specs([(C_pad, 1), (C_pad, 1), (C_pad, 1)]),
+        out_shape=[
+            jax.ShapeDtypeStruct((C_pad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((C_pad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((C_pad, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(ws2, cnt2, A_p, B_p, sc2, sp2)
+    return l1[:C, 0], l2[:C, 0], c2[:C, 0]
+
+
+def state_digest_pallas(lags_p, choice_p, counts, num_consumers: int, *,
+                        interpret: bool = False):
+    """Fused integrity-digest epilogue: the round-15 resident-state
+    digest — int64[4] ``[counts_sum, range_violations, lags_sum,
+    counts_vs_choice_L1]`` — in ONE kernel pass over the resident
+    buffers, replacing the separate XLA reduction tree + bincount
+    scatter.  The per-consumer occupancy is rebuilt as a one-hot
+    lane reduction per row tile (no scatter — Mosaic-friendly), and
+    every slot is integer arithmetic, so the result is order-exact
+    against the XLA reference for ANY accumulation schedule (the probe
+    still bit-compares the real lowering; int64 lanes are the risky
+    part)."""
+    from jax.experimental import pallas as pl
+
+    C = int(num_consumers)
+    P = lags_p.shape[0]
+    P_pad = lane_pad(P)
+    rows = P_pad // LANE
+    C_pad = lane_pad(C)
+    # Pad rows are digest-neutral: lag 0 adds nothing to the sum,
+    # choice -1 is neither a violation nor in-range, and the padded
+    # count rows are zero on both sides of the L1.
+    lags2 = jnp.pad(
+        lags_p.astype(jnp.int64), (0, P_pad - P)
+    ).reshape(rows, LANE)
+    ch2 = jnp.pad(
+        choice_p.astype(jnp.int32), (0, P_pad - P), constant_values=-1
+    ).reshape(rows, LANE)
+    counts_p = jnp.pad(
+        counts.astype(jnp.int64), (0, C_pad - C)
+    ).reshape(C_pad, 1)
+
+    def kernel(lags_ref, ch_ref, counts_ref, d0, d1, d2, d3):
+        j_idx = lax.broadcasted_iota(jnp.int32, (C_pad, LANE), 0)
+
+        def row_fn(t, acc):
+            lag_sum, viol, cnt = acc
+            lag_row = lags_ref[pl.ds(t, 1), :]
+            ch_row = ch_ref[pl.ds(t, 1), :]
+            lag_sum = lag_sum + jnp.sum(
+                lag_row, axis=1, keepdims=True, dtype=jnp.int64
+            )
+            viol = viol + jnp.sum(
+                (ch_row < -1) | (ch_row >= C),
+                axis=1, keepdims=True, dtype=jnp.int32,
+            )
+            in_range = (ch_row >= 0) & (ch_row < C)
+            onehot = (ch_row == j_idx) & in_range
+            cnt = cnt + jnp.sum(
+                onehot, axis=1, keepdims=True, dtype=jnp.int32
+            )
+            return lag_sum, viol, cnt
+
+        lag_sum, viol, cnt = lax.fori_loop(
+            jnp.int32(0), jnp.int32(rows), row_fn,
+            (
+                jnp.zeros((1, 1), jnp.int64),
+                jnp.zeros((1, 1), jnp.int32),
+                jnp.zeros((C_pad, 1), jnp.int32),
+            ),
+        )
+        counts64 = counts_ref[:]
+        d0[:] = jnp.sum(counts64, axis=0, keepdims=True, dtype=jnp.int64)
+        d1[:] = viol.astype(jnp.int64)
+        d2[:] = lag_sum
+        d3[:] = jnp.sum(
+            jnp.abs(cnt.astype(jnp.int64) - counts64),
+            axis=0, keepdims=True, dtype=jnp.int64,
+        )
+
+    d0, d1, d2, d3 = pl.pallas_call(
+        kernel,
+        in_specs=_block_specs(
+            [(rows, LANE), (rows, LANE), (C_pad, 1)]
+        ),
+        out_specs=_block_specs([(1, 1)] * 4),
+        out_shape=[jax.ShapeDtypeStruct((1, 1), jnp.int64)] * 4,
+        interpret=interpret,
+    )(lags2, ch2, counts_p)
+    return jnp.stack([d0[0, 0], d1[0, 0], d2[0, 0], d3[0, 0]])
+
+
+# ---------------------------------------------------------------------------
+# probe-once device gate (the rounds_pallas scaffolding, verbatim)
+# ---------------------------------------------------------------------------
+
+
+def _probe_instance():
+    from .dispatch import ensure_x64
+
+    ensure_x64()  # the production entries always run in x64 mode
+    rng = np.random.default_rng(0)
+    lags = rng.integers(0, 10**6, size=PROBE_ROWS).astype(np.int64)
+    valid = np.ones(PROBE_ROWS, bool)
+    from ..models.sinkhorn import _scale_np
+
+    scale = _scale_np(lags, valid, PROBE_CONSUMERS)
+    return lags, valid, np.float64(scale), np.float32(PROBE_ROWS)
+
+
+def _probe_parity_duals() -> bool:
+    """Bit-compare the real Mosaic lowering of the fused step against
+    the XLA tile scan over a full multi-iteration duals solve — a
+    kernel that compiles but miscompiles must never reach a rebalance,
+    because duals wrongness is silent assignment skew, not an error."""
+    from .linear_ot import _linear_duals_jit
+
+    assert linear_pallas_admit(
+        PROBE_ROWS, PROBE_CONSUMERS, PROBE_TILE
+    ), "probe shape no longer admits — fix PROBE_* or the byte model"
+    lags, valid, scale, nv = _probe_instance()
+    kw = dict(
+        num_consumers=PROBE_CONSUMERS, iters=_PROBE_ITERS,
+        tile=PROBE_TILE,
+    )
+    A0, B0, r0 = _linear_duals_jit(lags, valid, scale, nv, **kw)
+    A1, B1, r1 = _linear_duals_jit(
+        lags, valid, scale, nv, kernel=True, **kw
+    )
+    return bool(
+        (np.asarray(A0) == np.asarray(A1)).all()
+        and (np.asarray(B0) == np.asarray(B1)).all()
+        and int(r0) == int(r1)
+    )
+
+
+def _probe_speed_duals(margin: float = 1.0) -> bool:
+    """Race the fused kernel against the XLA tile scan on the probe
+    shape (batched in-executable repeats, scalar fetch — the only
+    valid clock on this platform).  margin=1.0: the kernel must be at
+    least as fast — a correct-but-slow lowering must not regress the
+    quality plane just because it compiled."""
+    global _LAST_RACE
+    from ..utils.observability import stopwatch
+    from .linear_ot import _linear_duals_jit
+
+    lags, valid, scale, nv = _probe_instance()
+    n = 4
+    batch = jax.device_put(
+        np.stack([np.roll(lags, 7919 * i) for i in range(n)])
+    )
+
+    @functools.partial(jax.jit, static_argnames=("kernel",))
+    def many(b, kernel: bool):
+        def one(v):
+            A, B, r = _linear_duals_jit(
+                v, valid, scale, nv, num_consumers=PROBE_CONSUMERS,
+                iters=_PROBE_ITERS, tile=PROBE_TILE, kernel=kernel,
+            )
+            return A.sum() + B.sum() + r.astype(jnp.float32)
+
+        return lax.map(one, b).sum()
+
+    def timed(kernel: bool) -> float:
+        float(many(batch, kernel=kernel))  # warm-up/compile
+        ts = []
+        for _ in range(5):
+            with stopwatch() as t:
+                float(many(batch, kernel=kernel))
+            ts.append(t[0])
+        return float(np.median(ts))
+
+    t_xla, t_pal = timed(False), timed(True)
+    _LAST_RACE = {"xla_ms": t_xla, "pallas_ms": t_pal, "margin": margin}
+    LOGGER.info(
+        "linear-OT kernel race: xla %.2f ms vs pallas %.2f ms (x%d "
+        "in-executable)", t_xla, t_pal, n,
+    )
+    return t_pal < t_xla * margin
+
+
+def _probe_parity_digest() -> bool:
+    """Bit-compare the fused digest against the XLA reference on the
+    real lowering (int64 lanes may not legalize on every Mosaic
+    toolchain — failure here just keeps the XLA reduction tree)."""
+    from .dispatch import ensure_x64
+    from .refine import _state_digest_xla
+
+    ensure_x64()
+    rng = np.random.default_rng(2)
+    P, C = 4096, 1000
+    lags = jnp.asarray(rng.integers(0, 2**40, size=P).astype(np.int64))
+    choice = jnp.asarray(
+        rng.integers(-1, C, size=P).astype(np.int32)
+    )
+    counts = jnp.asarray(
+        np.bincount(
+            np.asarray(choice)[np.asarray(choice) >= 0], minlength=C
+        ).astype(np.int64)
+    )
+    ref = _state_digest_xla(lags, choice, counts, C)
+    got = state_digest_pallas(lags, choice, counts, C)
+    return bool((np.asarray(ref) == np.asarray(got)).all())
+
+
+def linear_pallas_available(
+    run_probe: bool = False, kind: str = "duals"
+) -> bool:
+    """Probe-once gate for PRODUCTION dispatch of the linear-OT kernel
+    plane (``kind`` in {"duals", "digest"}).
+
+    The probe (full-trajectory parity bit-compare + a speed race vs
+    the XLA tile scan, plus the digest parity, all on the real device)
+    costs several executable compiles — minutes through a
+    remote-compile transport — so it NEVER runs implicitly on a
+    rebalance path: callers that can afford it (configure-time
+    warm-up, the benchmark harness) pass ``run_probe=True`` once;
+    until then, and on any failure, the answer is False and the XLA
+    tile scan serves.  Resolve EAGERLY before any jit trace (same
+    contract as rounds_pallas_available)."""
+    global _linear_pallas_ok
+    if _linear_pallas_ok is None:
+        from .plan_stats import _trace_state_clean
+
+        if not run_probe or not _trace_state_clean():
+            return False  # unprobed (or mid-trace): stay on the XLA scan
+        with _linear_pallas_lock:
+            if _linear_pallas_ok is not None:  # lost the race: decided
+                return _linear_pallas_ok.get(kind, False)
+            if jax.default_backend() == "cpu":
+                _linear_pallas_ok = dict(duals=False, digest=False)
+                return False
+            try:
+                duals = _probe_parity_duals()
+                if not duals:
+                    LOGGER.warning(
+                        "linear-OT Pallas kernel compiled but FAILED "
+                        "device parity; staying on the XLA tile scan"
+                    )
+                duals = duals and _probe_speed_duals()
+            except Exception:
+                LOGGER.warning(
+                    "linear-OT Pallas kernel unavailable; using the "
+                    "XLA tile scan", exc_info=True,
+                )
+                duals = False
+            try:
+                digest = _probe_parity_digest()
+                if not digest:
+                    LOGGER.warning(
+                        "fused digest epilogue FAILED device parity; "
+                        "keeping the XLA digest reduction"
+                    )
+            except Exception:
+                LOGGER.warning(
+                    "fused digest epilogue unavailable; keeping the "
+                    "XLA digest reduction", exc_info=True,
+                )
+                digest = False
+            _linear_pallas_ok = dict(duals=duals, digest=digest)
+    return _linear_pallas_ok.get(kind, False)
+
+
+def mark_linear_kernel_bad(kind: str, reason: str = "") -> None:
+    """Permanently disable one kernel plane for this process after a
+    RUNTIME failure (the probe can only vouch for the shapes it ran;
+    a dispatch that faults later must fall back AND stay fallen
+    back)."""
+    global _linear_pallas_ok
+    with _linear_pallas_lock:
+        state = dict(_linear_pallas_ok or
+                     dict(duals=False, digest=False))
+        state[kind] = False
+        _linear_pallas_ok = state
+    LOGGER.warning(
+        "linear-OT %s kernel disabled after runtime failure%s; the XLA "
+        "path serves from here on", kind,
+        f": {reason}" if reason else "",
+    )
+
+
+def _reset_gate_for_tests() -> None:
+    """Test hook: forget the probe verdict (mirrors the rounds_pallas
+    test idiom of monkeypatching the module flag)."""
+    global _linear_pallas_ok, _LAST_RACE
+    with _linear_pallas_lock:
+        _linear_pallas_ok = None
+        _LAST_RACE = None
+
+
+# ---------------------------------------------------------------------------
+# kernel report (CI artifact + dump_metrics --summary `kernel:` row)
+# ---------------------------------------------------------------------------
+
+#: Where the report lands unless overridden (env wins — the CI step
+#: and dump_metrics --summary read the same resolution).
+KERNEL_REPORT_ENV = "KLBA_KERNEL_REPORT"
+KERNEL_REPORT_DEFAULT = "kernel_report.json"
+
+
+def interpret_parity_check() -> dict:
+    """CPU-runnable bit-parity self-check (interpret mode executes the
+    kernel trace as plain jnp ops): the fused duals step and the
+    digest epilogue against their XLA references on a small
+    non-lane-aligned shape.  This is what the CI artifact records on
+    backends where the device probe cannot run."""
+    from .dispatch import ensure_x64
+    from .linear_ot import _linear_duals_jit
+    from .refine import _state_digest_xla
+
+    ensure_x64()
+    rng = np.random.default_rng(5)
+    P, C, tile = 512, 37, 64
+    lags = rng.integers(0, 10**6, size=P).astype(np.int64)
+    valid = np.ones(P, bool)
+    from ..models.sinkhorn import _scale_np
+
+    scale = np.float64(_scale_np(lags, valid, C))
+    nv = np.float32(P)
+    kw = dict(num_consumers=C, iters=8, tile=tile)
+    A0, B0, r0 = _linear_duals_jit(lags, valid, scale, nv, **kw)
+    A1, B1, r1 = _linear_duals_jit(
+        lags, valid, scale, nv, kernel="interpret", **kw
+    )
+    duals_ok = bool(
+        (np.asarray(A0) == np.asarray(A1)).all()
+        and (np.asarray(B0) == np.asarray(B1)).all()
+        and int(r0) == int(r1)
+    )
+    choice = jnp.asarray(rng.integers(-1, C, size=P).astype(np.int32))
+    counts = jnp.asarray(
+        np.bincount(
+            np.asarray(choice)[np.asarray(choice) >= 0], minlength=C
+        ).astype(np.int64)
+    )
+    lags_j = jnp.asarray(lags)
+    ref = _state_digest_xla(lags_j, choice, counts, C)
+    got = state_digest_pallas(lags_j, choice, counts, C, interpret=True)
+    digest_ok = bool((np.asarray(ref) == np.asarray(got)).all())
+    return dict(duals=duals_ok, digest=digest_ok)
+
+
+def kernel_report(run_probe: bool = False) -> dict:
+    """The probe/parity report: gate verdicts, race timings, the
+    interpret-mode parity self-check, and the phase-metric names — the
+    payload behind the CI artifact and the ``kernel:`` summary row."""
+    duals = linear_pallas_available(run_probe=run_probe, kind="duals")
+    digest = linear_pallas_available(kind="digest")
+    report = {
+        "backend": jax.default_backend(),
+        "probed": _linear_pallas_ok is not None,
+        "duals_kernel": duals,
+        "digest_kernel": digest,
+        "probe_shape": {
+            "rows": PROBE_ROWS,
+            "consumers": PROBE_CONSUMERS,
+            "tile": PROBE_TILE,
+            "iters": _PROBE_ITERS,
+        },
+        "race_ms": _LAST_RACE,
+        "interpret_parity": interpret_parity_check(),
+        "phase_metric": (
+            "klba_device_phase_ms{phase=h2d|duals|rounding|refine}"
+        ),
+    }
+    from ..utils import metrics
+
+    for plane, on in (("linear_duals", duals), ("digest", digest)):
+        metrics.REGISTRY.gauge(
+            "klba_kernel_plane_enabled", {"plane": plane}
+        ).set(1 if on else 0)
+    return report
+
+
+def write_kernel_report(
+    path: Optional[str] = None, run_probe: bool = False
+) -> str:
+    """Serialize :func:`kernel_report` where the CI artifact step and
+    ``dump_metrics --summary`` expect it (``$KLBA_KERNEL_REPORT`` or
+    ./kernel_report.json).  Returns the path written."""
+    from ..utils.snapshot import atomic_write_bytes
+
+    out = path or os.environ.get(
+        KERNEL_REPORT_ENV, KERNEL_REPORT_DEFAULT
+    )
+    report = kernel_report(run_probe=run_probe)
+    payload = json.dumps(report, indent=2, sort_keys=True) + "\n"
+    # noqa-reason: a CI diagnostics artifact, not resident snapshot
+    # state — no CAS/fencing story applies, atomicity alone suffices.
+    atomic_write_bytes(out, payload.encode("utf-8"))  # noqa: L017
+    LOGGER.info("kernel plane report written to %s", out)
+    return out

@@ -315,25 +315,17 @@ def _pallas_available() -> bool:
     return _pallas_ok
 
 
-# Conservative VMEM budget for the kernel (per-core VMEM is ~16 MB; leave
-# headroom for Mosaic's own buffers and double-buffered DMA).  Calibrated
-# so the hardware-verified north-star shape (P=131072, C=1000) passes.
-_VMEM_BUDGET_BYTES = 12 * 1024 * 1024
-
-
+# THE VMEM budget and this kernel's byte model live with the other
+# kernels' admission math (ops/kernel_admission) so the constants
+# cannot drift across kernels.
 def _fits_vmem(U: int, C: int) -> bool:
     """Shape guard for the grid-less kernel: ALL inputs live in VMEM at
     once plus the per-tile temporaries, so availability of the kernel is
-    shape-dependent — the probe's verdict alone is not enough.  Estimate:
-    ws+count+wsum [nt, TILE] (true-sized), ~4 live (C_pad, TILE) f32
-    temporaries per tile step (Mosaic reuses buffers), and the (C_pad, 1)
-    vectors at 128-lane padding."""
-    C_pad = max(128, -(-C // 128) * 128)
-    U_pad = -(-U // _TILE_P) * _TILE_P
-    inputs = 3 * U_pad * 4
-    temps = 4 * C_pad * _TILE_P * 4
-    vectors = 4 * C_pad * 128 * 4
-    return inputs + temps + vectors <= _VMEM_BUDGET_BYTES
+    shape-dependent — the probe's verdict alone is not enough (byte
+    model: :func:`..ops.kernel_admission.plan_stats_bytes`)."""
+    from .kernel_admission import fits_vmem, plan_stats_bytes
+
+    return fits_vmem(plan_stats_bytes(U, C, _TILE_P))
 
 
 def plan_stats(ws_u, count_u, wsum_u, A, B, need: str = "both"):

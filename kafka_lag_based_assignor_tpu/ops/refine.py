@@ -857,3 +857,66 @@ def refine_assignment_resident(
         exchange_budget=exchange_budget, quality_limit=quality_limit,
     )
     return choice, counts, totals
+
+
+# ---------------------------------------------------------------------------
+# Resident-state integrity digest (the refine epilogue's seam)
+# ---------------------------------------------------------------------------
+
+
+def _state_digest_xla(lags_p, choice_p, counts, num_consumers: int):
+    """XLA reference for the resident-state integrity digest — int64[4]
+    ``[counts_sum, range_violations, lags_sum, counts_vs_choice_L1]``
+    (see :mod:`..utils.scrub` for the host truths each slot must
+    match).  A few reductions plus one bincount scatter on buffers the
+    refine executable already holds.  All-integer arithmetic: the
+    result is exact under ANY accumulation order, which is what lets
+    the fused kernel epilogue replace this tree without a bit-parity
+    caveat."""
+    C = num_consumers
+    in_range = (choice_p >= 0) & (choice_p < C)
+    viol = ((choice_p < -1) | (choice_p >= C)).sum(dtype=jnp.int64)
+    cnt = (
+        jnp.zeros(C, jnp.int64)
+        .at[jnp.where(in_range, choice_p, C)]
+        .add(1, mode="drop")
+    )
+    mismatch = jnp.abs(cnt - counts.astype(jnp.int64)).sum(
+        dtype=jnp.int64
+    )
+    return jnp.stack(
+        [
+            counts.sum(dtype=jnp.int64),
+            viol,
+            lags_p.sum(dtype=jnp.int64),
+            mismatch,
+        ]
+    )
+
+
+def state_digest(lags_p, choice_p, counts, num_consumers: int):
+    """THE digest seam: every refine epilogue (streaming's five fused
+    executables and the coalesce path) computes the integrity digest
+    through here.  Dispatch is decided at TRACE time from the
+    probe-once device gate (:func:`.linear_ot_pallas.
+    linear_pallas_available` — resolved by warm-up before the first
+    trace; unprobed means False) plus host admission on the padded
+    buffer shape; any trace-time kernel failure falls back to the XLA
+    reduction tree and pins the digest kernel off for the process.
+    The digest is all-integer, so both lowerings return identical
+    bits (the device probe still verifies the real Mosaic lowering —
+    int64 lanes are the risky part)."""
+    from . import linear_ot_pallas as _lp
+
+    if _lp.linear_pallas_available(kind="digest") and _lp.digest_pallas_admit(
+        int(lags_p.shape[0]), int(num_consumers)
+    ):
+        try:
+            return _lp.state_digest_pallas(
+                lags_p, choice_p, counts, int(num_consumers)
+            )
+        except Exception as exc:  # noqa: L011 — verdict pinned off and
+            # the failure logged (with the repr) by mark_linear_kernel_bad;
+            # the XLA tree below serves the same exact digest.
+            _lp.mark_linear_kernel_bad("digest", repr(exc))
+    return _state_digest_xla(lags_p, choice_p, counts, num_consumers)

@@ -220,8 +220,11 @@ def _rounds_kernel(gains_ref, t0_ref, choice_ref, tout_ref, idout_ref):
     idout_ref[:] = ids
 
 
-# Conservative VMEM budget (per-core ~16 MB; leave Mosaic headroom).
-_VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+# THE VMEM budget and the byte model live with the other kernels'
+# admission math (ops/kernel_admission) so the constants cannot drift
+# between prose, this gate, and the linear-OT kernel's gate.
+from .kernel_admission import fits_vmem as _fits_vmem_budget
+from .kernel_admission import rounds_scan_bytes as _rounds_scan_bytes
 
 _pallas_rounds_ok: dict | None = None  # {"narrow": bool, "wide": bool}
 # Probe-once means once PER PROCESS: a threaded service (the sidecar
@@ -407,8 +410,7 @@ def pallas_rounds_mode(
     scan serves)."""
     if num_consumers > C_PAD:
         return None
-    bytes_needed = 2 * num_rounds * C_PAD * 4 + 8 * C_PAD * 4
-    if bytes_needed > _VMEM_BUDGET_BYTES:
+    if not _fits_vmem_budget(_rounds_scan_bytes(num_rounds, C_PAD)):
         return None
     if total_lag_bound < TOTALS_BOUND:
         return "narrow"

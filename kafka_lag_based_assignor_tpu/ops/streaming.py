@@ -190,29 +190,17 @@ def _state_digest(lags_p, choice_p, counts, num_consumers: int):
     """Device-computed integrity digest of the resident state — int64[4]
     ``[counts_sum, range_violations, lags_sum, counts_vs_choice_L1]``
     (see :mod:`..utils.scrub` for the host truths each slot must
-    match).  Fused into every refine dispatch: a few reductions plus
-    one bincount scatter on buffers the executable already holds —
-    ~free next to the sort/while-loop work, per the FlashSinkhorn
-    IO-bound framing (the dispatch is upload/readback-bound anyway)."""
-    C = num_consumers
-    in_range = (choice_p >= 0) & (choice_p < C)
-    viol = ((choice_p < -1) | (choice_p >= C)).sum(dtype=jnp.int64)
-    cnt = (
-        jnp.zeros(C, jnp.int64)
-        .at[jnp.where(in_range, choice_p, C)]
-        .add(1, mode="drop")
-    )
-    mismatch = jnp.abs(cnt - counts.astype(jnp.int64)).sum(
-        dtype=jnp.int64
-    )
-    return jnp.stack(
-        [
-            counts.sum(dtype=jnp.int64),
-            viol,
-            lags_p.sum(dtype=jnp.int64),
-            mismatch,
-        ]
-    )
+    match).  Fused into every refine dispatch: ~free next to the
+    sort/while-loop work, per the FlashSinkhorn IO-bound framing (the
+    dispatch is upload/readback-bound anyway).  The actual reduction
+    now lives behind the kernel-plane seam in :func:`..ops.refine.
+    state_digest` (fused Pallas epilogue when the probe-once gate has
+    vouched, the XLA tree otherwise — all-integer, so identical bits
+    either way); this name stays as the import surface for the
+    coalesce path."""
+    from .refine import state_digest
+
+    return state_digest(lags_p, choice_p, counts, num_consumers)
 
 
 def _refine_core(
@@ -1089,9 +1077,10 @@ class StreamingAssignor:
                     iters=self.cold_refine_iters, max_pairs=None,
                     bucket=self._bucket(P), wide=(mode == "wide"),
                 )
-                narrow_np, digest_np = jax.device_get(
-                    (narrow, resident[7])
-                )
+                with metrics.device_phase("refine"):
+                    narrow_np, digest_np = jax.device_get(
+                        (narrow, resident[7])
+                    )
                 self._verify_digest(
                     digest_np, P, int(lags.sum(dtype=np.int64)),
                     source="cold",
@@ -1111,7 +1100,8 @@ class StreamingAssignor:
             iters=self.cold_refine_iters, max_pairs=None,
             bucket=self._bucket(P),
         )
-        narrow_np, digest_np = jax.device_get((narrow, resident[7]))
+        with metrics.device_phase("refine"):
+            narrow_np, digest_np = jax.device_get((narrow, resident[7]))
         self._verify_digest(
             digest_np, P, int(lags.sum(dtype=np.int64)), source="cold"
         )
@@ -1345,7 +1335,11 @@ class StreamingAssignor:
         # readback blocks on the dispatch anyway, so the integrity
         # check's marginal per-epoch cost is the 32-byte ride-along
         # plus a few host comparisons (the bench's <1%-of-noop gate).
-        narrow_np, digest_np = jax.device_get((narrow, digest))
+        # The `refine` device phase covers the blocking fetch — i.e.
+        # the refine executable INCLUDING its readback (the dispatch
+        # above is async; documented in DEPLOYMENT.md "Kernel plane").
+        with metrics.device_phase("refine"):
+            narrow_np, digest_np = jax.device_get((narrow, digest))
         # THE per-epoch integrity gate (utils/scrub): the fused digest
         # must match host truth before the successors are adopted or
         # the answer served — a mismatch quarantines the stream and the

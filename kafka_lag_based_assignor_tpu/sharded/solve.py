@@ -528,12 +528,19 @@ def plan_stats_sharded(mesh, lags, valid, choice, num_consumers: int):
 
 @functools.lru_cache(maxsize=32)
 def _linear_duals_executable(
-    mesh, num_consumers: int, iters: int, tile: int
+    mesh, num_consumers: int, iters: int, tile: int,
+    kernel: bool = False,
 ):
     """Build + jit the P-sharded mirror-prox dual program: one
-    executable per (mesh, C, iters, tile) — shapes re-specialize via
-    the jit cache like every other sharded program here."""
+    executable per (mesh, C, iters, tile, kernel) — shapes
+    re-specialize via the jit cache like every other sharded program
+    here.  ``kernel`` swaps the shard-local marginal partials for the
+    Pallas tile kernel (:func:`..ops.linear_ot_pallas.
+    superblock_partials_pallas` — bit-identical partials, same
+    all-gather + ordered combine, so mesh parity is untouched);
+    callers gate it on the probe-once verdict + per-shard admission."""
     from ..ops import linear_ot
+    from ..ops import linear_ot_pallas
 
     D = mesh.shape[SOLVE_AXIS]
     S = linear_ot._SUPERBLOCKS
@@ -550,7 +557,14 @@ def _linear_duals_executable(
         cnt_b = linear_ot._to_blocks(cnt, L, S // D, tile)
 
         def stats_fn(A, B):
-            pl, pc = linear_ot._superblock_partials(ws_b, cnt_b, A, B)
+            if kernel:
+                pl, pc = linear_ot_pallas.superblock_partials_pallas(
+                    ws_b, cnt_b, A, B
+                )
+            else:
+                pl, pc = linear_ot._superblock_partials(
+                    ws_b, cnt_b, A, B
+                )
             # Consumer-axis all-reduce per outer iteration: gather the
             # per-block partials into GLOBAL block order, then the
             # same fixed left-to-right combine as the single-device
@@ -626,14 +640,44 @@ def solve_linear_sharded(
     valid = np.zeros(P2, dtype=bool)
     valid[:P_len] = True
     scale = _scale_np(lags_p, valid, C)
-    step = _linear_duals_executable(mesh, C, int(iters), tile_e)
+    # Kernel plane: probe-once verdict + per-shard admission (each
+    # shard's partials kernel sees P2/D rows).  Any dispatch failure
+    # falls back to the XLA executable and pins the kernel off.
+    from ..ops import linear_ot_pallas
+
+    kernel = bool(
+        linear_ot_pallas.linear_pallas_available(kind="duals")
+        and linear_ot_pallas.linear_pallas_admit_sharded(
+            P2 // D, C, tile_e
+        )
+    )
+    step = _linear_duals_executable(
+        mesh, C, int(iters), tile_e, kernel=kernel
+    )
     lags_d, valid_d = _place_inputs(mesh, lags_p, valid)
     with metrics.span("sharded.linear_duals"):
-        A, B, rounds = step(
-            lags_d, valid_d,
-            np.float64(scale), np.float32(int(valid.sum())),
-        )
-        A, B, rounds_np = jax.device_get((A, B, rounds))
+        with metrics.device_phase("duals"):
+            try:
+                A, B, rounds = step(
+                    lags_d, valid_d,
+                    np.float64(scale), np.float32(int(valid.sum())),
+                )
+                A, B, rounds_np = jax.device_get((A, B, rounds))
+            except Exception as exc:
+                if not kernel:
+                    raise
+                linear_ot_pallas.mark_linear_kernel_bad(
+                    "duals", repr(exc)
+                )
+                kernel = False
+                step = _linear_duals_executable(
+                    mesh, C, int(iters), tile_e, kernel=False
+                )
+                A, B, rounds = step(
+                    lags_d, valid_d,
+                    np.float64(scale), np.float32(int(valid.sum())),
+                )
+                A, B, rounds_np = jax.device_get((A, B, rounds))
     metrics.REGISTRY.counter(
         "klba_sharded_dispatch_total", {"path": "linear"}
     ).inc()
@@ -642,6 +686,7 @@ def solve_linear_sharded(
         lags_p, pids_p, valid, np.asarray(A), np.asarray(B), C,
         int(refine_iters), tiles=n_tiles, tile=tile_e,
         rounds=int(rounds_np), backend=f"sharded:{D}",
+        kernel=kernel,
     )
     return (
         choice[:P_len].astype(np.int32),

@@ -548,6 +548,53 @@ def span(name: str) -> _Span:
     return _Span(name)
 
 
+# Per-phase device timing for the kernel plane (the linear-OT solve's
+# h2d / duals / rounding and the streaming refine readback).  Same
+# cached-child pattern as the span histograms — these wrap device
+# dispatches on serving paths.
+_device_phase_hists: Dict[str, Histogram] = {}
+
+
+def _device_phase_hist(phase: str) -> Histogram:
+    h = _device_phase_hists.get(phase)
+    if h is None:
+        h = _device_phase_hists[phase] = REGISTRY.histogram(
+            "klba_device_phase_ms", {"phase": phase}
+        )
+    return h
+
+
+class _DevicePhase:
+    """``with device_phase("duals"):`` — wall-clock the enclosed DEVICE
+    work into ``klba_device_phase_ms{phase=...}``.  The contract is on
+    the CALLER: the block must end with the relevant buffers blocked on
+    (``jax.block_until_ready``) or fetched, otherwise the async
+    dispatch returns immediately and the phase under-reports.  Phases
+    in production: ``h2d`` (host-to-device transfer of the solve
+    inputs), ``duals`` (the mirror-prox executable), ``rounding`` (the
+    rounding/refine-portfolio executable), ``refine`` (the streaming
+    refine step INCLUDING its digest readback — documented in
+    DEPLOYMENT.md "Kernel plane")."""
+
+    __slots__ = ("phase", "_start")
+
+    def __init__(self, phase: str):
+        self.phase = phase
+
+    def __enter__(self) -> "_DevicePhase":
+        self._start = REGISTRY.clock()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        dur = (REGISTRY.clock() - self._start) * 1000.0
+        _device_phase_hist(self.phase).observe(dur)
+        return False
+
+
+def device_phase(phase: str) -> _DevicePhase:
+    return _DevicePhase(phase)
+
+
 class RequestIdLogFilter(logging.Filter):
     """Echo the active request id on log lines: attach to a HANDLER you
     own and every record emitted on a request thread grows a

@@ -147,6 +147,12 @@ def warmup(
     from .utils.observability import install_compile_counter
 
     ensure_x64()
+    # Boot-time tile autotune BEFORE any quality job compiles: the
+    # jobs below must warm the geometry production will actually run
+    # (on CPU this records the static default and changes nothing).
+    from .ops.dispatch import autotune_quality_tile
+
+    autotune_quality_tile()
     # Compiles from here on are observable: deployments (and the bench)
     # snapshot utils/observability.compile_count() after warm-up and
     # assert the steady-state loop's delta is ZERO.
@@ -495,7 +501,16 @@ def warmup(
 
                     def linear_job(lags1d=lags1d, C=C):
                         from .ops.linear_ot import assign_topic_linear
+                        from .ops.linear_ot_pallas import (
+                            linear_pallas_available,
+                        )
 
+                        # Resolve the linear-OT kernel-plane gate here
+                        # (duals parity + speed race AND the digest
+                        # parity, several compiles on the device) so no
+                        # rebalance ever pays it; the solve below then
+                        # warms whichever lowering the gate selected.
+                        linear_pallas_available(run_probe=True)
                         return assign_topic_linear(
                             lags1d, pids1d, valid1d, num_consumers=C,
                             iters=sinkhorn_iters,

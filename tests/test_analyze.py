@@ -94,9 +94,9 @@ def test_shim_runs_exactly_the_legacy_ruleset():
 
 
 def test_registry_covers_catalog():
-    for code in LEGACY_CODES + ("A001", "A002", "A003"):
+    for code in LEGACY_CODES + ("A001", "A002", "A003", "A004"):
         assert code in REGISTRY, code
-    for code in ("A001", "A002", "A003"):
+    for code in ("A001", "A002", "A003", "A004"):
         assert REGISTRY[code].waivable
     assert not REGISTRY["L007"].waivable  # monolith semantics kept
 
@@ -575,6 +575,101 @@ def test_a003_non_static_arg_not_flagged():
     )
     rep = run_snippet(STREAMING, src)
     assert codes_of(rep, "A003") == []
+
+
+# --- A004 wire-method span coverage ---------------------------------------
+
+A004_POSITIVE = """\
+_KNOWN_METHODS = frozenset({"ping", "stats"})
+
+
+def handle(method, metrics):
+    if method == "ping":
+        with metrics.span("wire.ping"):
+            return {}
+    if method == "stats":
+        return {}
+"""
+
+A004_DYNAMIC = """\
+_KNOWN_METHODS = frozenset({"ping", "stats"})
+
+
+def handle(method, metrics):
+    label = "unknown"
+    if method in _KNOWN_METHODS:
+        label = method
+    with metrics.span(f"wire.{label}"):
+        if method == "ping":
+            return {}
+        if method == "stats":
+            return {}
+"""
+
+
+def test_a004_detects_uncovered_wire_method():
+    rep = run_snippet(SERVICE, A004_POSITIVE)
+    found = codes_of(rep, "A004")
+    assert len(found) == 1
+    assert found[0].line == 1
+    assert "`stats`" in found[0].message
+    assert "wire.stats" in found[0].message
+
+
+def test_a004_guarded_dynamic_span_covers_surface():
+    """The service's real pattern — a label clamped through a
+    `method in _KNOWN_METHODS` test before `span(f"wire.{label}")` —
+    covers every known method at once."""
+    rep = run_snippet(SERVICE, A004_DYNAMIC)
+    assert codes_of(rep, "A004") == []
+
+
+def test_a004_unguarded_fstring_is_not_coverage():
+    """An f-string span with no membership clamp can emit any label —
+    it proves nothing about the known surface."""
+    src = A004_DYNAMIC.replace(
+        "    if method in _KNOWN_METHODS:\n        label = method\n", ""
+    )
+    rep = run_snippet(SERVICE, src)
+    names = {f.message.split("`")[1] for f in codes_of(rep, "A004")}
+    assert names == {"ping", "stats"}
+
+
+def test_a004_dispatch_branch_missing_from_surface():
+    src = A004_DYNAMIC + (
+        "\n"
+        "\n"
+        "def dispatch(method):\n"
+        "    if method == \"drain\":\n"
+        "        return {}\n"
+    )
+    rep = run_snippet(SERVICE, src)
+    found = codes_of(rep, "A004")
+    assert len(found) == 1
+    assert "`drain`" in found[0].message
+    assert "unattributable" in found[0].message
+
+
+def test_a004_waived_with_reason():
+    src = A004_POSITIVE.replace(
+        '_KNOWN_METHODS = frozenset({"ping", "stats"})',
+        '_KNOWN_METHODS = frozenset({"ping", "stats"})'
+        "  # noqa: A004 — stats latency tracked out-of-band",
+    )
+    rep = run_snippet(SERVICE, src)
+    assert codes_of(rep, "A004") == []
+    assert codes_of(rep, "W001") == []
+
+
+def test_a004_no_wire_surface_is_vacuous():
+    """Files without a _KNOWN_METHODS definition assert nothing."""
+    src = """\
+    def run(metrics):
+        with metrics.span("wire.ping"):
+            return {}
+    """
+    rep = run_snippet(STREAMING, src)
+    assert codes_of(rep, "A004") == []
 
 
 # --- W001 waiver accounting -----------------------------------------------

@@ -1,0 +1,425 @@
+"""Interpret-mode parity + gate semantics for the linear-OT kernel
+plane (ops/linear_ot_pallas).
+
+The fused mirror-prox step and the digest epilogue must be
+BIT-identical to the XLA tile scan / XLA digest reduction on every
+admissible instance — the same theorem the round-scan kernel proves
+(tests/test_rounds_pallas.py), ported to the quality plane.  Parity
+runs the kernels in the Pallas interpreter on CPU; hardware timing is
+probed separately (the `linear_ot_kernel` bench config).
+"""
+
+import numpy as np
+import pytest
+
+# Same extras policy as test_rounds_pallas: without hypothesis ONLY
+# the fuzz tests are skipped; interpret-mode parity is @slow (too
+# costly for tier-1), while the gate/admission/fallback tests below
+# stay in tier-1.
+try:
+    from hypothesis import given, settings, strategies as st
+    HAVE_HYPOTHESIS = True
+except ImportError:  # the tier-1 image lacks the extra
+    HAVE_HYPOTHESIS = False
+
+import jax
+import jax.numpy as jnp
+
+from kafka_lag_based_assignor_tpu.models.sinkhorn import _scale_np
+from kafka_lag_based_assignor_tpu.ops import linear_ot_pallas as lp
+from kafka_lag_based_assignor_tpu.ops import refine
+from kafka_lag_based_assignor_tpu.ops.dispatch import ensure_x64
+from kafka_lag_based_assignor_tpu.ops.linear_ot import (
+    _SUPERBLOCKS,
+    _linear_duals_jit,
+    _ordered_sum,
+    _superblock_partials,
+    _to_blocks,
+    _ws_cnt,
+    assign_topic_linear,
+    last_solve_info,
+    plan_shape,
+)
+
+
+@pytest.fixture(scope="module")
+def _drop_interpreter_executables():
+    """Same hygiene as test_rounds_pallas: the interpreter mints many
+    tiny XLA:CPU executables; drop them when the module finishes so
+    later modules' compiles stay off the flaky-JIT path.  Requested by
+    the interpret-mode (slow) tests only."""
+    yield
+    jax.clear_caches()
+
+
+@pytest.fixture()
+def _gate_sandbox():
+    """Save/restore the probe-once verdict around tests that pin or
+    race it."""
+    saved = lp._linear_pallas_ok
+    saved_race = lp._LAST_RACE
+    yield
+    with lp._linear_pallas_lock:
+        lp._linear_pallas_ok = saved
+        lp._LAST_RACE = saved_race
+
+
+def duals_case(seed, P, C, max_lag=10**6, n_valid=None):
+    """A quality-solve instance: arbitrary-order lags, prefix valid."""
+    ensure_x64()
+    rng = np.random.default_rng(seed)
+    nv = P if n_valid is None else n_valid
+    lags = rng.integers(0, max_lag, size=P).astype(np.int64)
+    valid = np.arange(P) < nv
+    lags[~valid] = 0
+    scale = np.float64(_scale_np(lags, valid, C))
+    return lags, valid, scale, np.float32(nv)
+
+
+def duals_pair(lags, valid, scale, nv, *, C, iters, tile):
+    kw = dict(num_consumers=C, iters=iters, tile=tile)
+    ref = _linear_duals_jit(lags, valid, scale, nv, **kw)
+    got = _linear_duals_jit(
+        lags, valid, scale, nv, kernel="interpret", **kw
+    )
+    return ref, got
+
+
+def assert_duals_equal(ref, got):
+    A0, B0, r0 = ref
+    A1, B1, r1 = got
+    np.testing.assert_array_equal(np.asarray(A1), np.asarray(A0))
+    np.testing.assert_array_equal(np.asarray(B1), np.asarray(B0))
+    assert int(r1) == int(r0)
+
+
+# --- interpret-mode parity (slow) -----------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.usefixtures("_drop_interpreter_executables")
+@pytest.mark.parametrize(
+    "P,C,tile,max_lag,n_valid",
+    [
+        (512, 37, 64, 10**6, None),       # non-lane-aligned C
+        (1000, 16, 128, 10**12, None),    # WIDE lag magnitudes
+        (257, 8, 64, 10**6, 130),         # non-pow2 P + valid tail
+        (96, 96, 8, 10**4, None),         # tiny tile, C on the lane
+    ],
+)
+def test_fused_duals_match_xla_scan(P, C, tile, max_lag, n_valid):
+    """The full solve trajectory — predictor, damping, extrapolation,
+    corrector, convergence round count — through the fused kernel is
+    bit-identical to the XLA tile scan's."""
+    lags, valid, scale, nv = duals_case(
+        P * 7 + C, P, C, max_lag=max_lag, n_valid=n_valid
+    )
+    ref, got = duals_pair(lags, valid, scale, nv, C=C, iters=8, tile=tile)
+    assert_duals_equal(ref, got)
+    assert int(ref[2]) >= 1
+
+
+@pytest.mark.slow
+@pytest.mark.usefixtures("_drop_interpreter_executables")
+@pytest.mark.parametrize("P,C,tile", [(512, 37, 64), (1024, 130, 128)])
+def test_superblock_partials_interpret_parity(P, C, tile):
+    """The sharded composition's per-shard ingredient: the standalone
+    partials kernel reproduces the XLA superblock partials exactly, so
+    the all-gather + ordered combine above it is untouched."""
+    ensure_x64()
+    lags, valid, scale, _ = duals_case(3, P, C)
+    P2, t, _ = plan_shape(P, tile)
+    ws, cnt = _ws_cnt(
+        jnp.asarray(lags), jnp.asarray(valid), jnp.float64(scale)
+    )
+    ws_b = _to_blocks(ws, P2, _SUPERBLOCKS, t)
+    cnt_b = _to_blocks(cnt, P2, _SUPERBLOCKS, t)
+    rng = np.random.default_rng(11)
+    A = jnp.asarray(rng.normal(size=C).astype(np.float32))
+    B = jnp.asarray(rng.normal(size=C).astype(np.float32))
+    ref_l, ref_c = _superblock_partials(ws_b, cnt_b, A, B)
+    got_l, got_c = lp.superblock_partials_pallas(
+        ws_b, cnt_b, A, B, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(got_l), np.asarray(ref_l))
+    np.testing.assert_array_equal(np.asarray(got_c), np.asarray(ref_c))
+    # and the ordered fold over them (what the solve consumes)
+    np.testing.assert_array_equal(
+        np.asarray(_ordered_sum(got_l)), np.asarray(_ordered_sum(ref_l))
+    )
+
+
+def digest_case(seed, P, C, corrupt=None):
+    ensure_x64()
+    rng = np.random.default_rng(seed)
+    lags = rng.integers(0, 10**9, size=P).astype(np.int64)
+    choice = rng.integers(-1, C, size=P).astype(np.int32)
+    counts = np.bincount(choice[choice >= 0], minlength=C).astype(
+        np.int64
+    )
+    if corrupt == "range":
+        choice[0] = C + 3
+        choice[P // 2] = -7
+    elif corrupt == "counts":
+        counts[0] += 5
+        counts[C - 1] -= 2
+    return (
+        jnp.asarray(lags), jnp.asarray(choice), jnp.asarray(counts)
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.usefixtures("_drop_interpreter_executables")
+@pytest.mark.parametrize("corrupt", [None, "range", "counts"])
+@pytest.mark.parametrize("P,C", [(384, 13), (4096, 1000), (130, 3)])
+def test_digest_epilogue_interpret_parity(P, C, corrupt):
+    """The fused digest must equal the XLA reduction component-wise on
+    clean AND corrupted states (all four integrity channels), at
+    non-multiple-of-128 row counts (padding neutrality)."""
+    lags, choice, counts = digest_case(P + C, P, C, corrupt=corrupt)
+    ref = refine._state_digest_xla(lags, choice, counts, C)
+    got = lp.state_digest_pallas(lags, choice, counts, C, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    if corrupt == "range":
+        assert int(np.asarray(got)[1]) > 0
+    if corrupt == "counts":
+        assert int(np.asarray(got)[3]) > 0
+
+
+if HAVE_HYPOTHESIS:
+
+    @st.composite
+    def duals_instances(draw):
+        """Admissible fused-duals instances: random P/C/tile, uniform
+        or WIDE lag styles, random valid prefix — Hypothesis shrinks
+        any parity violation."""
+        C = draw(st.integers(2, 96))
+        P = draw(st.integers(C, 600))
+        tile = draw(st.sampled_from([8, 64, 128]))
+        hi = draw(st.sampled_from([10**3, 10**6, 10**12]))
+        n_valid = draw(st.integers(1, P))
+        seed = draw(st.integers(0, 2**31))
+        return P, C, tile, hi, n_valid, seed
+
+    @pytest.mark.slow
+    @pytest.mark.usefixtures("_drop_interpreter_executables")
+    @settings(max_examples=10, deadline=None)
+    @given(duals_instances())
+    def test_fused_duals_fuzz_matches_xla(instance):
+        P, C, tile, hi, n_valid, seed = instance
+        lags, valid, scale, nv = duals_case(
+            seed, P, C, max_lag=hi, n_valid=n_valid
+        )
+        ref, got = duals_pair(
+            lags, valid, scale, nv, C=C, iters=6, tile=tile
+        )
+        assert_duals_equal(ref, got)
+
+    @st.composite
+    def digest_instances(draw):
+        C = draw(st.integers(1, 256))
+        P = draw(st.integers(1, 2048))
+        corrupt = draw(st.sampled_from([None, "range", "counts"]))
+        seed = draw(st.integers(0, 2**31))
+        return P, C, corrupt, seed
+
+    @pytest.mark.slow
+    @pytest.mark.usefixtures("_drop_interpreter_executables")
+    @settings(max_examples=15, deadline=None)
+    @given(digest_instances())
+    def test_digest_fuzz_matches_xla(instance):
+        P, C, corrupt, seed = instance
+        lags, choice, counts = digest_case(seed, P, C, corrupt=corrupt)
+        ref = refine._state_digest_xla(lags, choice, counts, C)
+        got = lp.state_digest_pallas(
+            lags, choice, counts, C, interpret=True
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+# --- host admission (tier-1 fast) -----------------------------------------
+
+
+def test_admission_gate():
+    # The probe's own shape must admit (the gate it certifies).
+    assert lp.linear_pallas_admit(
+        lp.PROBE_ROWS, lp.PROBE_CONSUMERS, lp.PROBE_TILE
+    )
+    # tile=1024 at C=1000 needs (C_pad, tile) f32 temps past the VMEM
+    # budget — the autotuned tile must shrink, not the budget stretch.
+    assert not lp.linear_pallas_admit(lp.PROBE_ROWS, 1000, 1024)
+    # C < 2 is the trivial-assignment path: no solve, no kernel.
+    assert not lp.linear_pallas_admit(4096, 1, 256)
+    assert not lp.linear_pallas_admit_sharded(4096, 1, 256)
+    assert not lp.digest_pallas_admit(4096, 0)
+    # per-shard admission covers the local row slice
+    assert lp.linear_pallas_admit_sharded(
+        lp.PROBE_ROWS // 8, lp.PROBE_CONSUMERS, lp.PROBE_TILE
+    )
+    # resident int64 rows are the digest's dominant VMEM term
+    assert lp.digest_pallas_admit(lp.PROBE_ROWS, lp.PROBE_CONSUMERS)
+    assert not lp.digest_pallas_admit(2**21, lp.PROBE_CONSUMERS)
+    assert not lp.linear_pallas_admit(2**21, 1000, lp.PROBE_TILE)
+
+
+# --- probe-once gate (tier-1 fast) ----------------------------------------
+
+
+@pytest.mark.usefixtures("_gate_sandbox")
+def test_probe_once_gate_is_thread_safe_single_decision():
+    """Same contract as rounds_pallas_available: unprobed production
+    dispatch stays on XLA with NO implicit probe; 8 racers asking for
+    the probe settle ONE verdict (CPU: both planes off)."""
+    import threading
+
+    with lp._linear_pallas_lock:
+        lp._linear_pallas_ok = None
+    assert lp.linear_pallas_available() is False
+    assert lp.linear_pallas_available(kind="digest") is False
+    assert lp._linear_pallas_ok is None  # no implicit probe
+    results = []
+    barrier = threading.Barrier(8)
+
+    def race():
+        barrier.wait()
+        results.append(lp.linear_pallas_available(run_probe=True))
+
+    threads = [threading.Thread(target=race) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results == [False] * 8
+    assert lp._linear_pallas_ok == dict(duals=False, digest=False)
+
+
+@pytest.mark.usefixtures("_gate_sandbox")
+def test_mark_linear_kernel_bad_pins_one_plane():
+    with lp._linear_pallas_lock:
+        lp._linear_pallas_ok = dict(duals=True, digest=True)
+    lp.mark_linear_kernel_bad("duals", "synthetic")
+    assert lp.linear_pallas_available(kind="duals") is False
+    assert lp.linear_pallas_available(kind="digest") is True
+    # An unprobed process that faults pins EVERYTHING conservatively.
+    with lp._linear_pallas_lock:
+        lp._linear_pallas_ok = None
+    lp.mark_linear_kernel_bad("digest")
+    assert lp._linear_pallas_ok == dict(duals=False, digest=False)
+
+
+# --- runtime fallback seams (tier-1 fast) ---------------------------------
+
+
+@pytest.mark.usefixtures("_gate_sandbox")
+def test_digest_seam_falls_back_and_pins():
+    """A digest dispatch that faults (here: the CPU backend rejecting a
+    compiled pallas_call) must serve the identical XLA digest AND pin
+    the plane off for the process."""
+    with lp._linear_pallas_lock:
+        lp._linear_pallas_ok = dict(duals=False, digest=True)
+    lags, choice, counts = digest_case(7, 384, 13)
+    got = refine.state_digest(lags, choice, counts, 13)
+    ref = refine._state_digest_xla(lags, choice, counts, 13)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    assert lp.linear_pallas_available(kind="digest") is False
+
+
+@pytest.mark.usefixtures("_gate_sandbox")
+def test_duals_seam_falls_back_and_pins(monkeypatch):
+    """assign_topic_linear with a vouched-for kernel that faults at
+    dispatch: the XLA tile scan serves the SAME contract-valid
+    assignment and the plane is pinned off."""
+    with lp._linear_pallas_lock:
+        lp._linear_pallas_ok = dict(duals=True, digest=False)
+
+    def boom(*a, **k):
+        raise RuntimeError("synthetic kernel fault")
+
+    monkeypatch.setattr(lp, "mirror_prox_step_pallas", boom)
+    rng = np.random.default_rng(7)
+    P, C = 2048, 16
+    lags = rng.integers(0, 10**6, size=P).astype(np.int64)
+    pids = np.arange(P, dtype=np.int32)
+    valid = np.ones(P, bool)
+    choice, counts, totals = assign_topic_linear(
+        lags, pids, valid, num_consumers=C, iters=8, refine_iters=16
+    )
+    counts = np.asarray(counts)
+    assert counts.sum() == P
+    assert counts.max() - counts.min() <= 1
+    assert lp.linear_pallas_available(kind="duals") is False
+    assert last_solve_info().get("duals_kernel") is False
+
+
+# --- kernel report (tier-1: also the interpret self-check) ----------------
+
+
+@pytest.mark.usefixtures("_gate_sandbox")
+def test_kernel_report_and_artifact(tmp_path, monkeypatch):
+    """The CI artifact payload: gate verdicts, probe shape, the
+    interpret-mode parity self-check (which must PASS on CPU), and the
+    phase-metric pointer; written where $KLBA_KERNEL_REPORT says."""
+    import json
+
+    from kafka_lag_based_assignor_tpu.utils import metrics
+
+    with lp._linear_pallas_lock:
+        lp._linear_pallas_ok = None
+    report = lp.kernel_report()
+    assert report["backend"] == jax.default_backend()
+    assert report["probed"] is False
+    assert report["duals_kernel"] is False
+    assert report["digest_kernel"] is False
+    assert report["probe_shape"]["rows"] == lp.PROBE_ROWS
+    assert report["interpret_parity"] == dict(duals=True, digest=True)
+    assert "klba_device_phase_ms" in report["phase_metric"]
+    snap = metrics.REGISTRY.snapshot()
+    series = snap["klba_kernel_plane_enabled"]["series"]
+    planes = {s["labels"]["plane"]: s["value"] for s in series}
+    assert planes == {"linear_duals": 0, "digest": 0}
+
+    out = tmp_path / "kernel_report.json"
+    monkeypatch.setenv(lp.KERNEL_REPORT_ENV, str(out))
+    # interpret_parity_check already ran above — stub it so the
+    # artifact test doesn't pay the solve twice.
+    monkeypatch.setattr(
+        lp, "interpret_parity_check",
+        lambda: dict(duals=True, digest=True),
+    )
+    assert lp.write_kernel_report() == str(out)
+    payload = json.loads(out.read_text())
+    assert payload["duals_kernel"] is False
+    assert payload["interpret_parity"] == {
+        "duals": True, "digest": True
+    }
+    # an explicit path overrides the env resolution
+    out2 = tmp_path / "elsewhere.json"
+    assert lp.write_kernel_report(str(out2)) == str(out2)
+    assert out2.exists()
+
+
+def test_kernel_summary_line_survives_malformed_report(tmp_path):
+    """The dump_metrics --summary `kernel:` row renders the report and
+    never fails on an absent/garbage file (same contract as the SARIF
+    row)."""
+    import sys
+
+    sys.path.insert(0, "tools")
+    import dump_metrics
+
+    assert dump_metrics.kernel_summary_line(tmp_path / "no.json") == ""
+    bad = tmp_path / "bad.json"
+    bad.write_text("not json")
+    assert dump_metrics.kernel_summary_line(bad) == ""
+    bad.write_text('{"unrelated": 1}')
+    assert dump_metrics.kernel_summary_line(bad) == ""
+    good = tmp_path / "good.json"
+    good.write_text(
+        '{"backend": "tpu", "probed": true, "duals_kernel": true,'
+        ' "digest_kernel": false,'
+        ' "interpret_parity": {"duals": true, "digest": true},'
+        ' "race_ms": {"xla_ms": 12.5, "pallas_ms": 9.1}}'
+    )
+    line = dump_metrics.kernel_summary_line(good)
+    assert line.startswith("kernel: duals=on digest=off (probed")
+    assert "pallas=9.1ms" in line

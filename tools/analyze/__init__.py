@@ -2,7 +2,7 @@
 
 Importing the package registers the full rule catalog: the L001-L021
 legacy rules (behavior-identical to the retired tools/lint.py
-monolith), the deep invariant analyses A001-A003, and the engine's
+monolith), the deep invariant analyses A001-A004, and the engine's
 W001 unused-waiver accounting.  See DEPLOYMENT.md "Static analysis"
 for the catalog, the waiver policy, and how to add a rule."""
 

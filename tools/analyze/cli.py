@@ -1,7 +1,7 @@
 """The ``klba-analyze`` command line (also ``python -m tools.analyze``).
 
 Default run: every repo python file through the full ruleset
-(L001-L021 legacy + A001-A003 deep + W001 waiver accounting), text
+(L001-L021 legacy + A001-A004 deep + W001 waiver accounting), text
 report to stdout, exit 1 on any finding.  ``--changed`` keeps the
 hot-loop invocation incremental via the mtime-keyed cache (unchanged
 files are never re-parsed); ``--sarif PATH`` writes the CI artifact

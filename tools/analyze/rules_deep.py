@@ -1,4 +1,4 @@
-"""Deep whole-program analyses A001-A003 — the invariants the bench
+"""Deep whole-program analyses A001-A004 — the invariants the bench
 gates and chaos soaks only catch at runtime, proven at review time.
 
   A001  donation safety: a value passed at a ``donate_argnums`` /
@@ -22,8 +22,16 @@ gates and chaos soaks only catch at runtime, proven at review time.
         gates only catch at runtime.  Static args must be constants or
         flow through the pow2 ladder helpers (``pad_bucket`` /
         ``delta_bucket`` / ``table_rows`` / ``pad_chunk`` / ladders).
+  A004  wire-method span coverage: every method named in the service's
+        ``_KNOWN_METHODS`` wire surface must have its dispatch wrapped
+        in ``metrics.span`` — either a literal ``span("wire.X")`` or
+        the label-clamped f-string form (``span(f"wire.{label}")``
+        guarded by a ``_KNOWN_METHODS`` membership test); and every
+        ``method == "X"`` dispatch branch must be IN ``_KNOWN_METHODS``
+        — a branch outside it serves under the span/metric label
+        "unknown", making its latency unattributable.
 
-All three collect JSON-serializable per-file facts (cacheable) and
+All of these collect JSON-serializable per-file facts (cacheable) and
 finalize over the merged set, so a donor defined in ops/streaming.py is
 matched at its coalescer call sites.  Waivable with ``# noqa: A00x``
 stating a reason.  Known limits (deliberate — reviewer aid, not a
@@ -1002,3 +1010,179 @@ def _finalize_a002(facts: Dict[str, Any]) -> Iterator[Finding]:
 )
 def collect_a002(ctx: FileContext) -> Dict[str, Any]:
     return collect_a002_facts(ctx)
+
+
+# --- A004 wire-method span coverage ---------------------------------------
+
+
+def _a004_known_methods(tree: ast.Module) -> Optional[Dict[str, Any]]:
+    """The file's ``_KNOWN_METHODS = frozenset({...})`` definition, as
+    ``{"names": [...], "line": n}`` — the wire surface whose coverage
+    A004 proves."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == "_KNOWN_METHODS"
+            for t in node.targets
+        ):
+            continue
+        call = node.value
+        if not (
+            isinstance(call, ast.Call)
+            and _expr_terminal(call.func) == "frozenset"
+            and call.args
+        ):
+            continue
+        elts = getattr(call.args[0], "elts", None)
+        if elts is None:
+            continue
+        names = [
+            e.value
+            for e in elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        ]
+        if names:
+            return {"names": sorted(names), "line": node.lineno}
+    return None
+
+
+def _a004_span_arg(call: ast.Call) -> Optional[Tuple[str, str]]:
+    """Classify a ``metrics.span(...)`` first argument: ``("literal",
+    name)`` for ``span("wire.X")``, ``("dynamic", "")`` for an f-string
+    beginning with the ``wire.`` prefix, None otherwise."""
+    if _expr_terminal(call.func) != "span" or not call.args:
+        return None
+    a = call.args[0]
+    if isinstance(a, ast.Constant) and isinstance(a.value, str):
+        if a.value.startswith("wire."):
+            return ("literal", a.value[len("wire."):])
+        return None
+    if isinstance(a, ast.JoinedStr) and a.values:
+        head = a.values[0]
+        if (
+            isinstance(head, ast.Constant)
+            and isinstance(head.value, str)
+            and head.value.startswith("wire.")
+        ):
+            return ("dynamic", "")
+    return None
+
+
+def _a004_has_known_guard(fn: ast.AST) -> bool:
+    """True when ``fn`` contains a membership test against
+    ``_KNOWN_METHODS`` (the label-clamping guard that makes a dynamic
+    ``span(f"wire.{label}")`` cover every known method)."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Compare):
+            continue
+        if not any(isinstance(op, (ast.In, ast.NotIn)) for op in node.ops):
+            continue
+        for comp in node.comparators:
+            if _expr_terminal(comp) == "_KNOWN_METHODS":
+                return True
+    return False
+
+
+def _finalize_a004(facts: Dict[str, Any]) -> Iterator[Finding]:
+    known: Optional[Dict[str, Any]] = None
+    known_rel = ""
+    for f in facts.values():
+        if f.get("known"):
+            known = f["known"]
+            known_rel = f["rel"]
+            break
+    if known is None:
+        return  # no wire surface in the analyzed set: nothing to prove
+    known_names = set(known["names"])
+    literal: Dict[str, Tuple[str, int]] = {}
+    dynamic = False
+    for f in facts.values():
+        for name, line in f.get("literal_spans", []):
+            literal.setdefault(name, (f["rel"], line))
+        dynamic = dynamic or f.get("dynamic_span", False)
+    for name in sorted(known_names):
+        if dynamic or name in literal:
+            continue
+        yield Finding(
+            known_rel,
+            known["line"],
+            "A004",
+            f"wire method `{name}` is in _KNOWN_METHODS but no "
+            "metrics.span wraps its dispatch — its latency is "
+            "invisible to klba_span_duration_ms and the flight "
+            "recorder; wrap the dispatch in `metrics.span(\"wire."
+            f"{name}\")` (or the guarded f-string form) or waive "
+            "with `# noqa: A004`",
+        )
+    for f in facts.values():
+        for name, line in f.get("dispatch_eq", []):
+            if name in known_names:
+                continue
+            yield Finding(
+                f["rel"],
+                line,
+                "A004",
+                f"dispatch branch for wire method `{name}` is absent "
+                "from _KNOWN_METHODS — it is served under the span/"
+                "metric label \"unknown\", so its latency and request "
+                "counts are unattributable; add it to _KNOWN_METHODS "
+                "(or waive with `# noqa: A004`)",
+            )
+
+
+@deep_rule(
+    "A004",
+    "wire method without metrics.span coverage",
+    finalize=_finalize_a004,
+    applies=lambda ctx: ctx.is_package,
+)
+def collect_a004(ctx: FileContext) -> Dict[str, Any]:
+    known = _a004_known_methods(ctx.tree)
+    literal_spans: List[Tuple[str, int]] = []
+    dynamic = False
+    for node in ast.walk(ctx.tree):
+        if not isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            continue
+        guarded = _a004_has_known_guard(node)
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            kind = _a004_span_arg(sub)
+            if kind is None:
+                continue
+            if kind[0] == "literal":
+                literal_spans.append((kind[1], sub.lineno))
+            elif guarded:
+                # span(f"wire.{label}") under a _KNOWN_METHODS
+                # membership clamp covers the whole known surface.
+                dynamic = True
+    dispatch_eq: List[Tuple[str, int]] = []
+    if known is not None:
+        # Dispatch branches live with the surface definition: string
+        # equality against the `method` binding.
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Compare):
+                continue
+            if not (
+                isinstance(node.left, ast.Name)
+                and node.left.id == "method"
+                and len(node.ops) == 1
+                and isinstance(node.ops[0], ast.Eq)
+                and len(node.comparators) == 1
+            ):
+                continue
+            comp = node.comparators[0]
+            if isinstance(comp, ast.Constant) and isinstance(
+                comp.value, str
+            ):
+                dispatch_eq.append((comp.value, node.lineno))
+    return {
+        "rel": ctx.rel,
+        "known": known,
+        "literal_spans": literal_spans,
+        "dynamic_span": dynamic,
+        "dispatch_eq": dispatch_eq,
+    }

@@ -52,6 +52,36 @@ def analyzer_summary_line(sarif_path) -> str:
     )
 
 
+def kernel_summary_line(report_path) -> str:
+    """One ``kernel:`` row from a kernel-plane report artifact
+    (ops/linear_ot_pallas.write_kernel_report — the bench writes one,
+    CI uploads it), or "" when the file is absent/unreadable (the
+    summary must never fail because no probe ran on this host)."""
+    try:
+        doc = json.loads(Path(report_path).read_text(encoding="utf-8"))
+        duals = bool(doc["duals_kernel"])
+        digest = bool(doc["digest_kernel"])
+        parity = doc.get("interpret_parity") or {}
+        race = doc.get("race_ms") or {}
+    except (OSError, ValueError, KeyError, TypeError):
+        return ""
+    parity_txt = ",".join(
+        f"{k}={'ok' if v else 'FAIL'}" for k, v in sorted(parity.items())
+    ) or "unchecked"
+    race_txt = (
+        f", race xla={race.get('xla_ms')}ms "
+        f"pallas={race.get('pallas_ms')}ms"
+        if race else ""
+    )
+    probed = "probed" if doc.get("probed") else "unprobed"
+    return (
+        f"kernel: duals={'on' if duals else 'off'} "
+        f"digest={'on' if digest else 'off'} ({probed}, backend "
+        f"{doc.get('backend')}), interpret parity "
+        f"{parity_txt}{race_txt}"
+    )
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(
         prog="dump_metrics",
@@ -81,6 +111,13 @@ def main() -> int:
         help=(
             "SARIF artifact for the --summary static-analysis row "
             "(default: $KLBA_ANALYZE_SARIF or <repo>/analyze.sarif)"
+        ),
+    )
+    parser.add_argument(
+        "--kernel-report", type=Path, default=None,
+        help=(
+            "kernel-plane report for the --summary kernel row "
+            "(default: $KLBA_KERNEL_REPORT or <repo>/kernel_report.json)"
         ),
     )
     args = parser.parse_args()
@@ -443,6 +480,21 @@ def main() -> int:
             args.analyze_sarif
             or os.environ.get("KLBA_ANALYZE_SARIF")
             or Path(__file__).resolve().parent.parent / "analyze.sarif"
+        )
+        if line:
+            print(line)
+
+        # Kernel-plane view (DEPLOYMENT.md "Kernel plane"): gate
+        # verdicts, probe race, and interpret parity from the last
+        # kernel report (bench writes one; CI uploads it) — the "is
+        # the Pallas plane serving, and did it earn it" look.  The
+        # per-phase device timings themselves print above as
+        # klba_device_phase_ms{phase=...} histogram rows.
+        line = kernel_summary_line(
+            args.kernel_report
+            or os.environ.get("KLBA_KERNEL_REPORT")
+            or Path(__file__).resolve().parent.parent
+            / "kernel_report.json"
         )
         if line:
             print(line)
