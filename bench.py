@@ -2949,6 +2949,57 @@ def config15_linear_kernel():
     return out
 
 
+def config16_scenarios():
+    """Adversarial scenario fleet (ISSUE 17): the full scenarios/
+    corpus — seeded trace generators (hot-partition storms, flapping
+    rosters, correlated lag waves, zipf tenant mixes, diurnal ramps,
+    step loads) composed with fault-schedule planes and replayed
+    wire-level against a real sidecar, each run gated by its
+    declarative degradation envelope.  What must hold (gated in main):
+    every scenario stays inside its envelope — zero invalid
+    assignments, zero critical-class sheds, shed ordering respected,
+    zero steady-state warm-loop compiles where gated, planted
+    corruptions detected by the integrity plane, and the mid-trace
+    crash/restart scenario bit-exact against its unfaulted twin.  The
+    artifact lands in scenario_fleet.json (every row carries its
+    reproduction command + seed; see DEPLOYMENT.md "Adversarial
+    scenarios")."""
+    from scenarios import run_fleet
+
+    fleet = run_fleet(log=log)
+    with open("scenario_fleet.json", "w") as f:
+        json.dump(fleet, f, indent=2, default=str)
+    rows = fleet["scenarios"]
+    return {
+        "config": "scenario_fleet",
+        "scenarios": len(rows),
+        "composed_fault_scenarios": sum(
+            1 for r in rows
+            if len(r["planes"]) >= 2 or (
+                r["planes"] and r["crash_epoch"] is not None
+            )
+        ),
+        "crash_restart_scenarios": sum(
+            1 for r in rows if r["crash_epoch"] is not None
+        ),
+        "served": sum(r["served"] for r in rows),
+        "sheds": sum(r["sheds"] for r in rows),
+        "invalid": sum(r["invalid"] for r in rows),
+        "quarantines": sum(r["quarantines"] for r in rows),
+        "corruptions_planted": sum(
+            r["corruptions_planted"] for r in rows
+        ),
+        "wall_s": round(sum(r["wall_s"] for r in rows), 3),
+        "violations": fleet["violations"],
+        "failed_scenarios": [
+            {"scenario": r["scenario"], "violations": r["violations"],
+             "reproduce": r["reproduce"]}
+            for r in rows if r["violations"]
+        ],
+        "ok": fleet["ok"],
+    }
+
+
 def main():
     # A wedged accelerator tunnel must degrade the benchmark, not hang it
     # (the framework's own watchdog philosophy, SURVEY §5 failure row):
@@ -3012,7 +3063,8 @@ def main():
                config5_northstar, config6_multistream, config7_overload,
                config8_restart, config9_delta, config10_handoff,
                config11_scrub, config12_federated, config13_sharded,
-               config14_linear, config15_linear_kernel):
+               config14_linear, config15_linear_kernel,
+               config16_scenarios):
         before = klba_metrics.REGISTRY.snapshot()
         r = fn()
         deltas = klba_metrics.histogram_deltas(
@@ -3612,6 +3664,34 @@ def main():
                 f"linear_ot_kernel interpret parity {ip} — the kernel "
                 "trace diverged bitwise from the XLA lowering"
             )
+    # The adversarial fleet's verdict: every scenario inside its
+    # declarative envelope, with the corpus-shape floors that make the
+    # gate meaningful (a corpus edit silently dropping the composed-
+    # fault or crash/restart scenarios must fail here, not pass
+    # vacuously).
+    sf = results.get("scenario_fleet", {})
+    if sf:
+        if sf.get("scenarios", 0) < 8:
+            failures.append(
+                f"scenario_fleet ran {sf.get('scenarios')} scenario(s) "
+                "< 8 — the corpus lost coverage"
+            )
+        if sf.get("composed_fault_scenarios", 0) < 3:
+            failures.append(
+                f"scenario_fleet has {sf.get('composed_fault_scenarios')} "
+                "composed-fault scenario(s) < 3"
+            )
+        if sf.get("crash_restart_scenarios", 0) < 1:
+            failures.append(
+                "scenario_fleet has no mid-trace crash/restart scenario"
+            )
+        if not sf.get("ok", False):
+            for row in sf.get("failed_scenarios", []):
+                failures.append(
+                    f"scenario_fleet {row['scenario']} violated its "
+                    f"envelope: {'; '.join(row['violations'])} "
+                    f"(reproduce: {row['reproduce']})"
+                )
     for msg in failures:
         log(f"bench: REGRESSION GATE FAILED: {msg}")
     sys.exit(1 if failures else 0)

@@ -223,7 +223,7 @@ def _epoch_rows(
 
     Returns ``(narrow [N, B], choice int32 [N, B], row_tab [N, C, M],
     counts [N, C], lags int64 [N, B], totals [N, C], rounds [N],
-    exchanges [N], digest int64 [N, 4])`` — the widened lag rows ride
+    exchanges [N], digest int64 [N, 5])`` — the widened lag rows ride
     along device-resident so a locked batch can carry them and accept
     stacked deltas (:func:`_megabatch_fused_locked_delta`), and each
     row's fused integrity digest
@@ -244,7 +244,10 @@ def _epoch_rows(
         # resident row the wave STARTED from, so a corrupted locked
         # row is detected on its first dispatch deterministically —
         # the refine could silently repair the very entry it moved.
-        digest = _state_digest(lags64, choice_b, counts_b, num_consumers)
+        # The row TABLE rides in the fifth lane (utils/scrub).
+        digest = _state_digest(
+            lags64, choice_b, counts_b, num_consumers, row_tab=tab_b
+        )
         choice_b, tab_b, counts_b, totals, rounds, ex = (
             refine_rounds_resident(
                 lags64, choice_b, tab_b, counts_b, totals,
@@ -1565,6 +1568,7 @@ class MegabatchCoalescer:
                 return
             arrays = {
                 "choice": batch.choice,
+                "row_tab": batch.row_tab,
                 "counts": batch.counts,
                 "lags": batch.lags,
             }
@@ -1579,7 +1583,7 @@ class MegabatchCoalescer:
                 sub = rows[int(rng.integers(len(rows)))]
                 r = sub.resident.row
                 limit = (
-                    None if buffer == "counts"
+                    None if buffer in ("counts", "row_tab")
                     else sub.payload.shape[0]
                 )
                 arr = arrays[buffer]
@@ -1592,7 +1596,7 @@ class MegabatchCoalescer:
                     "row %d (seed %d)", buffer, r, seed,
                 )
             batch.adopt_resident_buffers(
-                arrays["choice"], batch.row_tab, arrays["counts"],
+                arrays["choice"], arrays["row_tab"], arrays["counts"],
                 arrays["lags"],
             )
 

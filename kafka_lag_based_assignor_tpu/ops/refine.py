@@ -894,7 +894,50 @@ def _state_digest_xla(lags_p, choice_p, counts, num_consumers: int):
     )
 
 
-def state_digest(lags_p, choice_p, counts, num_consumers: int):
+def _row_tab_lane_xla(lags_p, choice_p, row_tab, counts, num_consumers: int):
+    """The row-TABLE integrity lane (int64 scalar, host truth 0): a
+    slot-level checksum over the resident ``[C, M]`` row table —
+    ROADMAP "state integrity" follow-on.  The first four lanes audit
+    (lags, choice, counts); the table itself was previously audited
+    only host-side by the scrubber, so a flipped table slot surfaced
+    as a silently-misrouted refine, not a serving-time quarantine.
+
+    Four all-integer violations summed into one lane (any one is
+    nonzero exactly when the table diverged from the choice vector it
+    mirrors, so a single bit flip anywhere in the table is caught):
+
+    * a VALID slot (``j < counts[c]``) whose row index is outside
+      ``[0, B)``;
+    * a valid slot naming a row whose ``choice`` is not ``c``;
+    * an EMPTY slot not holding the sentinel ``B``;
+    * the checksum ``|sum(valid-slot row indices) - sum(assigned row
+      indices)|`` — catches in-range flips that land on another row
+      of the same consumer (the owner check alone would pass a
+      duplicate entry)."""
+    B = lags_p.shape[0]
+    C, M = int(num_consumers), row_tab.shape[1]
+    slot_j = jnp.arange(M, dtype=jnp.int32)[None, :]
+    valid_slot = slot_j < jnp.minimum(counts, M)[:, None]
+    r = jnp.clip(row_tab, 0, B - 1)
+    owner_bad = (
+        valid_slot & (choice_p[r] != jnp.arange(C, dtype=jnp.int32)[:, None])
+    ).sum(dtype=jnp.int64)
+    range_bad = (
+        valid_slot & ((row_tab < 0) | (row_tab >= B))
+    ).sum(dtype=jnp.int64)
+    sentinel_bad = (~valid_slot & (row_tab != B)).sum(dtype=jnp.int64)
+    slot_sum = jnp.where(valid_slot, r, 0).sum(dtype=jnp.int64)
+    assigned = (choice_p >= 0) & (choice_p < C)
+    row_sum = jnp.where(
+        assigned, jnp.arange(B, dtype=jnp.int64), 0
+    ).sum(dtype=jnp.int64)
+    return owner_bad + range_bad + sentinel_bad + jnp.abs(
+        slot_sum - row_sum
+    )
+
+
+def state_digest(lags_p, choice_p, counts, num_consumers: int,
+                 row_tab=None):
     """THE digest seam: every refine epilogue (streaming's five fused
     executables and the coalesce path) computes the integrity digest
     through here.  Dispatch is decided at TRACE time from the
@@ -905,18 +948,33 @@ def state_digest(lags_p, choice_p, counts, num_consumers: int):
     reduction tree and pins the digest kernel off for the process.
     The digest is all-integer, so both lowerings return identical
     bits (the device probe still verifies the real Mosaic lowering —
-    int64 lanes are the risky part)."""
+    int64 lanes are the risky part).
+
+    ``row_tab`` extends the digest with a fifth lane — the row-TABLE
+    slot checksum (:func:`_row_tab_lane_xla`, host truth 0) — so
+    table corruption is caught at serving time, not only by the
+    host-side scrubber.  The lane is an XLA reduction appended to
+    whichever lowering produced the base four (the Pallas digest
+    kernel's probe contract stays int64[4])."""
     from . import linear_ot_pallas as _lp
 
+    base = None
     if _lp.linear_pallas_available(kind="digest") and _lp.digest_pallas_admit(
         int(lags_p.shape[0]), int(num_consumers)
     ):
         try:
-            return _lp.state_digest_pallas(
+            base = _lp.state_digest_pallas(
                 lags_p, choice_p, counts, int(num_consumers)
             )
         except Exception as exc:  # noqa: L011 — verdict pinned off and
             # the failure logged (with the repr) by mark_linear_kernel_bad;
             # the XLA tree below serves the same exact digest.
             _lp.mark_linear_kernel_bad("digest", repr(exc))
-    return _state_digest_xla(lags_p, choice_p, counts, num_consumers)
+    if base is None:
+        base = _state_digest_xla(lags_p, choice_p, counts, num_consumers)
+    if row_tab is None:
+        return base
+    lane = _row_tab_lane_xla(
+        lags_p, choice_p, row_tab, counts, num_consumers
+    )
+    return jnp.concatenate([base, lane[None]])

@@ -186,11 +186,14 @@ def _pad_choice(choice, B: int):
     return jnp.pad(choice.astype(jnp.int32), (0, B - P), constant_values=-1)
 
 
-def _state_digest(lags_p, choice_p, counts, num_consumers: int):
-    """Device-computed integrity digest of the resident state — int64[4]
-    ``[counts_sum, range_violations, lags_sum, counts_vs_choice_L1]``
-    (see :mod:`..utils.scrub` for the host truths each slot must
-    match).  Fused into every refine dispatch: ~free next to the
+def _state_digest(lags_p, choice_p, counts, num_consumers: int,
+                  row_tab=None):
+    """Device-computed integrity digest of the resident state — int64[5]
+    ``[counts_sum, range_violations, lags_sum, counts_vs_choice_L1,
+    row_tab_checksum]`` (see :mod:`..utils.scrub` for the host truths
+    each slot must match; the fifth lane audits the [C, M] row TABLE
+    slot-by-slot and is int64[4]-compatible when ``row_tab`` is not
+    passed).  Fused into every refine dispatch: ~free next to the
     sort/while-loop work, per the FlashSinkhorn IO-bound framing (the
     dispatch is upload/readback-bound anyway).  The actual reduction
     now lives behind the kernel-plane seam in :func:`..ops.refine.
@@ -200,7 +203,9 @@ def _state_digest(lags_p, choice_p, counts, num_consumers: int):
     coalesce path."""
     from .refine import state_digest
 
-    return state_digest(lags_p, choice_p, counts, num_consumers)
+    return state_digest(
+        lags_p, choice_p, counts, num_consumers, row_tab=row_tab
+    )
 
 
 def _refine_core(
@@ -211,7 +216,7 @@ def _refine_core(
     """Shared tail of every fused refine executable: the resident round
     loop plus the narrowed host-facing output.  Returns
     (narrow choice[P], choice int32[B], row_tab, counts, lags int64[B],
-    totals int64[C], rounds int32, exchanges int32, digest int64[4]) —
+    totals int64[C], rounds int32, exchanges int32, digest int64[5]) —
     everything after the first element stays device-resident with the
     caller; the padded lag vector rides along as the fourth resident
     buffer so the NEXT epoch can scatter-apply a sparse delta instead
@@ -230,7 +235,9 @@ def _refine_core(
     # matters (nondeterministically, by whether the round loop touched
     # the flipped row).  Input-side, any divergence is caught on the
     # FIRST dispatch over the corrupt buffer, deterministically.
-    digest = _state_digest(lags_p, choice_p, counts, num_consumers)
+    digest = _state_digest(
+        lags_p, choice_p, counts, num_consumers, row_tab=row_tab
+    )
     choice_p, row_tab, counts, totals, rounds, ex = refine_rounds_resident(
         lags_p, choice_p, row_tab, counts, totals,
         num_consumers=num_consumers, iters=iters, max_pairs=max_pairs,
@@ -841,13 +848,16 @@ class StreamingAssignor:
         plan = scrub_mod.corruption_plan(limit=P)
         if not plan:
             return resident
-        slot = {"choice": 0, "counts": 2, "lags": 3}
+        slot = {"choice": 0, "row_tab": 1, "counts": 2, "lags": 3}
         bufs = list(resident)
         for buffer, seed in plan:
             i = slot[buffer]
             host = scrub_mod.flip_bit(
                 np.asarray(bufs[i]), seed,
-                limit=None if buffer == "counts" else P,
+                # counts and the [C, M] row table are audited over
+                # their FULL extent (every table slot carries either a
+                # row index or the sentinel), so no prefix bound.
+                limit=None if buffer in ("counts", "row_tab") else P,
             )
             # noqa-justification: this re-upload is injected corruption
             # (drill machinery), not a counted lag payload — the H2D

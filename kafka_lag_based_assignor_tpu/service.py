@@ -1583,6 +1583,19 @@ class AssignorService:
             from .ops.dispatch import quality_status
 
             result["quality"] = quality_status()
+            # Fault injection (utils/faults; scenarios/ drills): the
+            # active injector's seed + per-point {calls, fired}
+            # counters so a wire-level driver can verify its planned
+            # faults actually landed; None when no drill is active.
+            inj = faults.active()
+            result["faults"] = (
+                None if inj is None
+                else {
+                    "seed": inj.seed,
+                    "epoch": inj.epoch,
+                    "points": inj.snapshot(),
+                }
+            )
             return result, None
         if method == "metrics":
             # The registry, both ways: structured JSON for programmatic

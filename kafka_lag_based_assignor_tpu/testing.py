@@ -111,3 +111,31 @@ def assert_valid_assignment(assignments, expect_partitions: int) -> None:
     assert sorted(got) == sorted(set(got)), "duplicate partitions"
     assert len(got) == expect_partitions, (len(got), expect_partitions)
     assert max(sizes) - min(sizes) <= 1, sizes
+
+
+def choice_from_assignments(assignments, members, partitions: int):
+    """Decode a wire ``assignments`` dict back into the dense
+    partition->consumer-index vector the engine reasons in (int32[P],
+    -1 for unassigned) — the shape bit-exactness comparisons and churn
+    measurements need.  Shared by bench.py's restart probe and the
+    scenario fleet's replay engine so the two decoders cannot drift."""
+    import numpy as np
+
+    midx = {m: j for j, m in enumerate(members)}
+    choice = np.full(partitions, -1, np.int32)
+    for m, tps in assignments.items():
+        for _t, p in tps:
+            choice[p] = midx[m]
+    return choice
+
+
+def moved_fraction(prev_choice, choice) -> float:
+    """Fraction of partitions whose owner changed between two epochs'
+    decoded choice vectors (the wire-level churn observable)."""
+    import numpy as np
+
+    prev = np.asarray(prev_choice)
+    cur = np.asarray(choice)
+    if prev.shape != cur.shape or prev.size == 0:
+        return 1.0
+    return float(np.count_nonzero(prev != cur)) / prev.size

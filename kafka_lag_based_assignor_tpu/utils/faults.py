@@ -47,7 +47,8 @@ Named fault points (every one threaded through production code):
                     falls back to the dense upload within the same
                     request budget
 ``device.corrupt.choice`` / ``device.corrupt.counts`` /
-``device.corrupt.lags``  seeded BIT-FLIP injection into the named
+``device.corrupt.lags`` / ``device.corrupt.row_tab``
+                    seeded BIT-FLIP injection into the named
                     device-resident buffer at a readback boundary
                     (:meth:`..ops.streaming.StreamingAssignor.
                     _adopt_resident` and the megabatch coalescer's
@@ -138,6 +139,15 @@ Determinism: plans fire by *call count* (``after`` skips, ``times``
 bounds), and the optional ``probability`` coin uses the injector's own
 seeded :class:`random.Random` — the same seed replays the same schedule.
 
+Exact schedules (:meth:`FaultInjector.schedule`): where a drill needs a
+fault at a *known* boundary rather than a seeded coin — the scenario
+fleet's fault-schedule composer (scenarios/compose.py), a soak's phase
+boundary — a plan can pin firing to exact call numbers (``at_calls``)
+and/or to trace epochs (``at_epochs``, advanced by the driver via
+:meth:`FaultInjector.set_epoch`; ``per_epoch`` bounds firings inside
+each eligible epoch).  Scheduled plans are fully deterministic: no
+probability coin, no hand-counted ``after`` warm-up offsets.
+
 Activation: programmatic (``activate`` / the ``injected`` context
 manager) or by environment for staging drills::
 
@@ -157,7 +167,7 @@ import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass
-from typing import Dict, Iterator, List, Mapping, Optional
+from typing import Dict, Iterator, List, Mapping, Optional, Sequence
 
 from . import metrics
 
@@ -179,6 +189,7 @@ FAULT_POINTS = frozenset(
         "device.corrupt.choice",
         "device.corrupt.counts",
         "device.corrupt.lags",
+        "device.corrupt.row_tab",
         "mesh.collective",
         "peer.partition",
         "peer.slow_link",
@@ -217,7 +228,13 @@ class FaultPlan:
     """One point's schedule: fire on eligible calls ``after`` < n <=
     ``after + times`` (call counting starts at 1; ``times`` <= 0 means
     every call past ``after``), each firing gated by the seeded
-    ``probability`` coin."""
+    ``probability`` coin.
+
+    Exact-schedule plans (:meth:`FaultInjector.schedule`) instead pin
+    firing to specific call numbers (``at_calls``) and/or to driver-
+    advanced trace epochs (``at_epochs`` + ``per_epoch``); those fields
+    replace the probability coin entirely — a scheduled plan fires
+    deterministically or not at all."""
 
     point: str
     mode: str = "raise"
@@ -226,6 +243,12 @@ class FaultPlan:
     delay_s: float = 0.05
     probability: float = 1.0
     fired: int = 0
+    at_calls: Optional[frozenset] = None
+    at_epochs: Optional[frozenset] = None
+    per_epoch: int = 0
+    # epoch-local firing bookkeeping (``per_epoch`` accounting)
+    epoch_seen: int = -1
+    epoch_fired: int = 0
 
 
 class FaultInjector:
@@ -241,6 +264,7 @@ class FaultInjector:
         self._rng = random.Random(self.seed)
         self._plans: Dict[str, FaultPlan] = {}
         self._calls: Dict[str, int] = {}
+        self._epoch = 0
         self._lock = threading.Lock()
 
     def plan(
@@ -270,6 +294,71 @@ class FaultInjector:
             probability=float(probability),
         )
         return self
+
+    def schedule(
+        self,
+        point: str,
+        mode: str = "raise",
+        *,
+        at_calls: Optional[Sequence[int]] = None,
+        at_epochs: Optional[Sequence[int]] = None,
+        per_epoch: int = 1,
+        delay_s: float = 0.05,
+    ) -> "FaultInjector":
+        """Register an EXACT schedule for ``point``; chainable.
+
+        Unlike :meth:`plan` (seeded probability + after/times call
+        windows), a scheduled plan fires deterministically: at the
+        listed call numbers (``at_calls``, 1-based — the injector's own
+        per-point counter), and/or only inside the listed trace epochs
+        (``at_epochs`` — the driver advances the clock via
+        :meth:`set_epoch`; ``per_epoch`` bounds firings per eligible
+        epoch, <= 0 = every eligible call).  With only ``at_epochs``
+        given, the first ``per_epoch`` calls of each listed epoch
+        fire — the scenario fleet's composer (scenarios/compose.py)
+        builds its merged fault overlays exactly this way, and a soak
+        can pin a phase boundary without hand-counting warm-up calls."""
+        if at_calls is None and at_epochs is None:
+            raise ValueError(
+                "schedule() needs at_calls and/or at_epochs; use plan() "
+                "for probabilistic/windowed firing"
+            )
+        if point not in FAULT_POINTS:
+            raise ValueError(
+                f"unknown fault point {point!r}; valid: {sorted(FAULT_POINTS)}"
+            )
+        if mode not in _MODES:
+            raise ValueError(f"unknown fault mode {mode!r}; valid: {_MODES}")
+        for name, seq in (("at_calls", at_calls), ("at_epochs", at_epochs)):
+            if seq is not None and any(int(n) < 0 for n in seq):
+                raise ValueError(f"{name} entries must be >= 0: {seq!r}")
+        self._plans[point] = FaultPlan(
+            point=point,
+            mode=mode,
+            times=0,  # unlimited: the schedule itself bounds firing
+            delay_s=min(float(delay_s), MAX_HANG_S),
+            at_calls=(
+                None if at_calls is None
+                else frozenset(int(n) for n in at_calls)
+            ),
+            at_epochs=(
+                None if at_epochs is None
+                else frozenset(int(n) for n in at_epochs)
+            ),
+            per_epoch=int(per_epoch),
+        )
+        return self
+
+    def set_epoch(self, epoch: int) -> None:
+        """Advance the schedule clock: ``at_epochs`` plans are eligible
+        only while the driver-declared epoch is in their set."""
+        with self._lock:
+            self._epoch = int(epoch)
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
 
     def calls(self, point: str) -> int:
         """Times ``fire`` was reached for ``point`` (fault or not)."""
@@ -302,6 +391,17 @@ class FaultInjector:
             plan = self._plans.get(point)
             if plan is None or n <= plan.after:
                 return
+            if plan.at_epochs is not None:
+                if self._epoch not in plan.at_epochs:
+                    return
+                if plan.per_epoch > 0:
+                    if plan.epoch_seen != self._epoch:
+                        plan.epoch_seen = self._epoch
+                        plan.epoch_fired = 0
+                    if plan.epoch_fired >= plan.per_epoch:
+                        return
+            if plan.at_calls is not None and n not in plan.at_calls:
+                return
             if plan.times > 0 and plan.fired >= plan.times:
                 return
             if plan.probability < 1.0 and (
@@ -309,6 +409,8 @@ class FaultInjector:
             ):
                 return
             plan.fired += 1
+            if plan.at_epochs is not None and plan.per_epoch > 0:
+                plan.epoch_fired += 1
             mode, delay = plan.mode, plan.delay_s
         # Registry export (utils/metrics): fault activations as a
         # queryable series.  Recorded OUTSIDE the injector lock and only

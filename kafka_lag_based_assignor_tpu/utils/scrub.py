@@ -59,7 +59,8 @@ healing epoch between strikes succeeds, so consecutive-failure
 counting could never fire on exactly this pattern).
 
 **Chaos surface** — fault points ``device.corrupt.choice`` /
-``device.corrupt.counts`` / ``device.corrupt.lags`` inject seeded
+``device.corrupt.counts`` / ``device.corrupt.lags`` /
+``device.corrupt.row_tab`` inject seeded
 bit-flips into the resident buffers at readback boundaries
 (:func:`corruption_plan` / :func:`flip_bit`), so the whole plane is
 drill-testable: the ``corruption_storm`` bench probe gates detection
@@ -87,14 +88,18 @@ from .watchdog import SolveRejected
 
 LOGGER = logging.getLogger(__name__)
 
-#: The digest vector's length (int64[4]; see the module docstring).
+#: The digest vector's base length (int64[4]; see the module
+#: docstring).  Fused epilogues that also audit the [C, M] row table
+#: append a fifth lane (``ops.refine._row_tab_lane_xla``, host truth
+#: 0) — :func:`digest_failures` accepts both shapes.
 DIGEST_LEN = 4
 
-#: The three corrupted-buffer fault points, by buffer class.
+#: The corrupted-buffer fault points, by buffer class.
 CORRUPT_POINTS = {
     "choice": "device.corrupt.choice",
     "counts": "device.corrupt.counts",
     "lags": "device.corrupt.lags",
+    "row_tab": "device.corrupt.row_tab",
 }
 
 #: Quarantine outcomes (the ``klba_quarantine_total`` label values).
@@ -145,6 +150,11 @@ def digest_failures(
         fails.append("choice")
     if expected_lag_sum is not None and int(d[2]) != int(expected_lag_sum):
         fails.append("lags")
+    # The optional fifth lane: the row TABLE's slot-level checksum
+    # (ops/refine._row_tab_lane_xla — host truth 0).  Digests from
+    # epilogues predating (or not holding) a table stay int64[4].
+    if d.shape[0] > DIGEST_LEN and int(d[DIGEST_LEN]) != 0:
+        fails.append("row_tab")
     return fails
 
 

@@ -1,0 +1,97 @@
+"""``python -m scenarios`` — run the adversarial fleet from a shell.
+
+Exit code 0 iff every selected scenario stayed inside its envelope;
+1 on violations (the CI gate), 2 on usage errors.  ``--json`` writes
+the same artifact the tier1.yml scenario-fleet step uploads — every
+row carries its ``reproduce`` command line with the exact seed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from .corpus import CORPUS, run_fleet
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m scenarios",
+        description=(
+            "adversarial scenario fleet (DEPLOYMENT.md 'Adversarial "
+            "scenarios'): composable trace replay against a real "
+            "sidecar, gated by per-scenario degradation envelopes"
+        ),
+    )
+    parser.add_argument(
+        "--fast", action="store_true",
+        help="run only the fast CI subset of the corpus",
+    )
+    parser.add_argument(
+        "--only", nargs="+", metavar="NAME",
+        help="run only the named scenario(s)",
+    )
+    parser.add_argument(
+        "--seed", type=int,
+        help=(
+            "override every selected scenario's seed (reproducing a "
+            "CI failure from its artifact row)"
+        ),
+    )
+    parser.add_argument(
+        "--json", type=Path, metavar="FILE",
+        help="write the fleet artifact (scenario rows + verdicts)",
+    )
+    parser.add_argument(
+        "--list", action="store_true",
+        help="list the catalog and exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list:
+        for sc in CORPUS:
+            planes = ",".join(p.name for p in sc.planes) or "-"
+            flags = []
+            if sc.fast:
+                flags.append("fast")
+            if sc.crash_epoch is not None:
+                flags.append(f"crash@{sc.crash_epoch}")
+            if sc.parallel:
+                flags.append("parallel")
+            print(
+                f"{sc.name:22s} trace={sc.trace:20s} seed={sc.seed} "
+                f"planes={planes:30s} [{','.join(flags) or '-'}]"
+            )
+        return 0
+
+    try:
+        fleet = run_fleet(
+            fast_only=args.fast, only=args.only, seed=args.seed,
+            log=lambda m: print(m, flush=True),
+        )
+    except KeyError as exc:
+        print(f"scenarios: {exc}", file=sys.stderr)
+        return 2
+
+    if args.json is not None:
+        args.json.write_text(
+            json.dumps(fleet, indent=2, default=str), encoding="utf-8"
+        )
+        print(f"artifact written to {args.json}")
+
+    failed = [r for r in fleet["scenarios"] if r["violations"]]
+    print(
+        f"{len(fleet['scenarios'])} scenario(s), "
+        f"{len(failed)} failed, {fleet['violations']} violation(s)"
+    )
+    for row in failed:
+        print(f"  {row['scenario']}: {'; '.join(row['violations'])}")
+        print(f"    reproduce: {row['reproduce']}")
+    return 0 if fleet["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

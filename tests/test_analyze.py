@@ -967,6 +967,79 @@ def test_subset_run_skips_waiver_accounting(tmp_path, capsys):
     assert "W001" not in out
 
 
+# --- the --changed git delta (ISSUE 17 satellite) -------------------------
+
+
+def _git(cwd, *args):
+    import subprocess
+
+    r = subprocess.run(
+        ["git", "-c", "user.email=t@example.com", "-c", "user.name=t",
+         "-c", "init.defaultBranch=main", *args],
+        cwd=cwd, capture_output=True, text=True,
+    )
+    assert r.returncode == 0, (args, r.stderr)
+    return r.stdout
+
+
+def test_git_changed_files_union_filters_and_sentinels(tmp_path):
+    """The changed set diffs against GIT (working tree + commits past
+    the merge base), not file mtimes: non-python and deleted files are
+    dropped, a rename contributes its new side, ``[]`` means 'checkout
+    with nothing changed' and ``None`` means 'no git here — run the
+    mtime sweep'."""
+    from tools.analyze.cli import git_changed_files
+
+    assert git_changed_files(tmp_path) is None  # not a checkout
+
+    _git(tmp_path, "init")
+    (tmp_path / "a.py").write_text("A = 1\n")
+    (tmp_path / "b.py").write_text("B = 1\n")
+    (tmp_path / "note.txt").write_text("not python\n")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-m", "seed")
+    assert git_changed_files(tmp_path) == []  # clean, NOT None
+
+    (tmp_path / "a.py").write_text("A = 2\n")          # modified
+    (tmp_path / "c.py").write_text("C = 1\n")          # untracked
+    (tmp_path / "note.txt").write_text("still not\n")  # non-python
+    (tmp_path / "b.py").unlink()                       # deleted
+    got = git_changed_files(tmp_path)
+    assert [p.name for p in got] == ["a.py", "c.py"]
+
+    _git(tmp_path, "checkout", "--", "b.py")
+    _git(tmp_path, "mv", "b.py", "renamed.py")         # staged rename
+    assert "renamed.py" in {p.name for p in git_changed_files(tmp_path)}
+    assert "b.py" not in {p.name for p in git_changed_files(tmp_path)}
+
+
+def test_cli_changed_analyzes_only_the_git_delta(
+    tmp_path, monkeypatch, capsys
+):
+    from tools.analyze import cli
+
+    _git(tmp_path, "init")
+    (tmp_path / "clean.py").write_text("X = 1\n")
+    (tmp_path / "dirty.py").write_text("Y = 1\n")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-m", "seed")
+    (tmp_path / "dirty.py").write_text("Y = 2\n")
+
+    monkeypatch.setattr(cli, "_repo_root", lambda: tmp_path)
+    rc = cli.main(["--changed", "--no-cache", "--stats"])
+    captured = capsys.readouterr()
+    assert rc == 0, captured.out
+    assert "analyzed 1 file(s)" in captured.err
+
+    # A clean checkout is a fast green no-op, not a full sweep.
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-m", "update")
+    rc = cli.main(["--changed", "--no-cache", "--stats"])
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert "no changed python files" in captured.err
+
+
 # --- dump_metrics SARIF row -----------------------------------------------
 
 

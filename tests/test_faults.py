@@ -107,6 +107,78 @@ def test_probability_schedule_replays_with_same_seed():
     assert schedule(8) != a  # and the seed matters
 
 
+def test_schedule_at_calls_fires_exactly():
+    """The exact-schedule API (ISSUE 17): at_calls pins firings to the
+    injector's own 1-based per-point call numbers, deterministically."""
+    inj = faults.FaultInjector().schedule(
+        "device.solve", at_calls=(2, 4)
+    )
+    outcomes = []
+    with faults.injected(inj):
+        for _ in range(5):
+            try:
+                faults.fire("device.solve")
+                outcomes.append("ok")
+            except faults.FaultError:
+                outcomes.append("fault")
+    assert outcomes == ["ok", "fault", "ok", "fault", "ok"]
+    assert inj.fired("device.solve") == 2
+
+
+def test_schedule_at_epochs_gated_by_clock_and_per_epoch():
+    """at_epochs plans are inert until the driver's set_epoch lands
+    inside the set, and per_epoch bounds firings within each eligible
+    epoch (<= 0 = every eligible call)."""
+    inj = (
+        faults.FaultInjector()
+        .schedule("stream.refine", at_epochs=(1, 3), per_epoch=2)
+        .schedule("wire.read", at_epochs=(3,), per_epoch=0)
+    )
+    per_epoch_faults = {}
+    with faults.injected(inj):
+        for epoch in range(5):
+            inj.set_epoch(epoch)
+            n = 0
+            for _ in range(4):
+                try:
+                    faults.fire("stream.refine")
+                except faults.FaultError:
+                    n += 1
+            per_epoch_faults[epoch] = n
+        # per_epoch=0: every call of the eligible epoch fires.
+        inj.set_epoch(3)
+        for _ in range(3):
+            with pytest.raises(faults.FaultError):
+                faults.fire("wire.read")
+        inj.set_epoch(4)
+        faults.fire("wire.read")  # no longer eligible
+    assert per_epoch_faults == {0: 0, 1: 2, 2: 0, 3: 2, 4: 0}
+    assert inj.fired("stream.refine") == 4
+    assert inj.fired("wire.read") == 3
+
+
+def test_schedule_combined_calls_and_epochs_and_validation():
+    # Both given: the call number AND the epoch must both be eligible.
+    inj = faults.FaultInjector().schedule(
+        "device.solve", at_calls=(1, 2, 3), at_epochs=(1,), per_epoch=0
+    )
+    with faults.injected(inj):
+        faults.fire("device.solve")            # call 1, epoch 0: inert
+        inj.set_epoch(1)
+        with pytest.raises(faults.FaultError):
+            faults.fire("device.solve")        # call 2, epoch 1
+        with pytest.raises(faults.FaultError):
+            faults.fire("device.solve")        # call 3, epoch 1
+        faults.fire("device.solve")            # call 4: off-schedule
+    assert inj.fired("device.solve") == 2
+    with pytest.raises(ValueError, match="at_calls and/or at_epochs"):
+        faults.FaultInjector().schedule("device.solve")
+    with pytest.raises(ValueError, match=">= 0"):
+        faults.FaultInjector().schedule("device.solve", at_calls=(-1,))
+    with pytest.raises(ValueError, match="unknown fault point"):
+        faults.FaultInjector().schedule("device.warp", at_calls=(1,))
+
+
 def test_fire_is_noop_when_inactive():
     faults.deactivate()
     faults.fire("device.solve")  # must not raise
@@ -964,17 +1036,27 @@ def test_chaos_soak_random_schedule_bounded_p99():
         c = client_for(svc)
         served = rejected = 0
         base = (np.arange(96) + 1) * 40
-        for wave in range(12):
-            inj = faults.FaultInjector(seed=rng.randrange(2**31))
-            for point in ("stream.refine", "coalesce.flush",
-                          "admit.park", "shed.decide"):
-                if rng.random() < 0.25:
-                    inj.plan(point, mode="raise",
-                             times=rng.randrange(1, 3))
-            drift = base + np.asarray(
-                [rng.randrange(0, 20000) for _ in range(96)]
+        # ONE exact-schedule injector for the whole stampede (ISSUE 17
+        # backfill): instead of rebuilding a seeded injector per wave,
+        # the fault overlay is declared once — each point hits every
+        # third wave, staggered, twice per eligible wave — and the
+        # driver advances the schedule clock in lockstep (set_epoch),
+        # exactly how the scenario fleet's composer drives its planes.
+        # A failure now names a printable (point, wave) schedule
+        # instead of an rng replay.
+        stampede_points = ("stream.refine", "coalesce.flush",
+                          "admit.park", "shed.decide")
+        inj = faults.FaultInjector(seed=rng.randrange(2**31))
+        for i, point in enumerate(stampede_points):
+            inj.schedule(
+                point, at_epochs=tuple(range(i, 12, 3)), per_epoch=2
             )
-            with faults.injected(inj):
+        with faults.injected(inj):
+            for wave in range(12):
+                inj.set_epoch(wave)
+                drift = base + np.asarray(
+                    [rng.randrange(0, 20000) for _ in range(96)]
+                )
                 for sid, klass in classes.items():
                     try:
                         r = c.stream_assign(
@@ -999,6 +1081,11 @@ def test_chaos_soak_random_schedule_bounded_p99():
                     shed = r["stream"].get("shed")
                     if shed is not None:
                         assert klass != "critical", (sid, shed)
+        # The declared overlay actually landed: every point fired in
+        # at least one of its scheduled waves.
+        assert all(inj.fired(p) > 0 for p in stampede_points), (
+            inj.snapshot()
+        )
         c.close()
     assert served > 0
     shed_delta = {

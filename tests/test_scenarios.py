@@ -1,0 +1,340 @@
+"""Adversarial scenario fleet tests (ISSUE 17): seeded trace-generator
+determinism (same ``(name, seed)`` -> byte-identical trace, golden
+digests pinned), the fault-plane composer's overlay semantics (epoch
+union, bound maxima, mode-conflict rejection), the declarative envelope
+evaluator, and the replay engine's twin contract — the same trace
+driven twice through a clean sidecar yields bit-identical assignment
+sequences.
+
+The full corpus (composed fault planes, corruption detection, the
+mid-trace crash/restart twin) runs wire-level in tier1.yml's
+scenario-fleet step and bench.py's ``scenario_fleet`` config; these
+tests pin the pieces those gates are built from.
+"""
+
+import json
+from dataclasses import asdict
+
+import numpy as np
+import pytest
+
+from kafka_lag_based_assignor_tpu.testing import (
+    choice_from_assignments,
+    moved_fraction,
+)
+from kafka_lag_based_assignor_tpu.utils import faults
+from scenarios import compose
+from scenarios.corpus import CORPUS, get_scenario, run_fleet
+from scenarios.envelopes import RUNG_ORDER, Envelope, evaluate
+from scenarios.replay import (
+    EpochRecord,
+    ReplayResult,
+    replay,
+    twin_mismatches,
+)
+from scenarios.traces import GENERATORS, PHASES, generate
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    yield
+    faults.deactivate()
+
+
+# -- trace generator determinism ------------------------------------------
+
+#: Golden digests: ``(name, seed=424242)`` -> these exact bytes.  A
+#: digest change means every CI artifact's ``reproduce`` command stops
+#: replaying the workload it recorded — bump deliberately, never
+#: incidentally.
+GOLDEN_DIGESTS = {
+    "diurnal_ramp": "4e23b6cfc9558ccc9d2044a81d95c21e564eb4048fda642a93e480b74ff479f7",
+    "flapping_consumers": "9c54133132aef23ce8e398693042a8dae0a35f1b8210afb33ca42da39275f1f4",
+    "hot_skew_storm": "38ccf39743647e68d6c44604c6b5106b2b8dd6bc4d032aa600f999e30890fb81",
+    "lag_wave_multi": "7f2a0af87edc401dcd3402579d0aed70417c06aff96c255346c08a717482a15a",
+    "step_load": "8cadddf5f9880e6ec2737f9e2b0202a2c7026db70ff82dbfda2047f95e46634f",
+    "zipf_tenants": "bdf7eef4496f6bff3205318c76cbff45166995f4c7cab76b4f31cc85eeb15785",
+}
+
+
+@pytest.mark.parametrize("name", sorted(GENERATORS))
+def test_trace_generation_is_seed_deterministic(name):
+    """Same (name, seed) -> byte-identical traces; the seed matters."""
+    a, b = generate(name, 777), generate(name, 777)
+    assert a == b
+    payload = lambda t: json.dumps(asdict(t), sort_keys=True)  # noqa: E731
+    assert payload(a) == payload(b)
+    assert a.digest() == b.digest()
+    assert generate(name, 778).digest() != a.digest()
+
+
+@pytest.mark.parametrize("name", sorted(GENERATORS))
+def test_trace_golden_digest_pinned(name):
+    assert generate(name, 424242).digest() == GOLDEN_DIGESTS[name]
+
+
+@pytest.mark.parametrize("name", sorted(GENERATORS))
+def test_trace_structure_invariants(name):
+    """Phase tags are from the declared set, warm epochs lead, every
+    lag fits int32 (the wire dtype the zero-compile gate depends on),
+    and the epoch indices are dense from zero."""
+    t = generate(name, 99)
+    assert [ev.index for ev in t.epochs] == list(range(len(t.epochs)))
+    assert t.epochs[0].phase == "warm"
+    for ev in t.epochs:
+        assert ev.phase in PHASES
+        for se in ev.streams:
+            assert len(se.lags) == t.partitions
+            assert se.members
+            assert max(se.lags) < 2**31
+            assert min(se.lags) >= 0
+    assert t.consumer_counts  # warm-up shape planning has work to do
+
+
+def test_generate_unknown_name_lists_valid():
+    with pytest.raises(KeyError, match="hot_skew_storm"):
+        generate("no_such_trace", 1)
+
+
+def test_zipf_trace_has_all_slo_classes_every_epoch():
+    """The shed-ordering envelope needs every class present in every
+    epoch — otherwise 'critical never shed' would pass vacuously."""
+    t = generate("zipf_tenants", 5, tenants=8)
+    for ev in t.epochs:
+        assert {se.slo_class for se in ev.streams} == {
+            "critical", "standard", "best_effort"
+        }
+
+
+# -- the fault-plane composer ---------------------------------------------
+
+
+def test_compose_merges_same_point_epoch_union_and_bounds():
+    inj = compose.build_injector([
+        compose.solver_flake(epochs=(2,)),
+        compose.solver_flake(epochs=(3,), per_epoch=2),
+    ])
+    fired = []
+    with faults.injected(inj):
+        for epoch in range(5):
+            inj.set_epoch(epoch)
+            for _ in range(3):
+                try:
+                    faults.fire("stream.refine")
+                except faults.FaultError:
+                    fired.append(epoch)
+    # Union of epochs {2, 3}; per_epoch max(1, 2) = 2 in BOTH.
+    assert fired == [2, 2, 3, 3]
+
+
+def test_compose_rejects_mode_conflict():
+    with pytest.raises(ValueError, match="must agree on mode"):
+        compose.build_injector([
+            compose.solver_flake(epochs=(2,)),      # raise
+            compose.refine_hang(epochs=(3,)),       # hang, same point
+        ])
+
+
+def test_compose_planes_are_epoch_gated():
+    """A composed injector is inert outside its declared epochs — and
+    until the driver advances the clock into them."""
+    inj = compose.build_injector(
+        [compose.wire_latency(epochs=(4,), delay_s=0.0)]
+    )
+    with faults.injected(inj):
+        faults.fire("wire.read")            # epoch 0: not scheduled
+        assert inj.fired("wire.read") == 0
+        inj.set_epoch(4)
+        faults.fire("wire.read")
+        assert inj.fired("wire.read") == 1
+
+
+# -- the envelope evaluator -----------------------------------------------
+
+
+def _rec(**kw):
+    base = dict(
+        epoch=0, phase="steady", stream_id="s", slo_class="standard",
+        ok=True, valid=True,
+    )
+    base.update(kw)
+    return EpochRecord(**base)
+
+
+def _result(records, **kw):
+    r = ReplayResult(trace_name="t", seed=0, trace_sha256="x")
+    r.records = records
+    for k, v in kw.items():
+        setattr(r, k, v)
+    return r
+
+
+def test_envelope_invalid_and_critical_sheds_are_non_negotiable():
+    res = _result([
+        _rec(valid=False),
+        _rec(slo_class="critical", ok=False,
+             shed={"class": "critical", "rung": "r"}),
+    ])
+    v = evaluate(res, Envelope(max_steady_compiles=None))
+    assert any("invalid assignments: 1" in s for s in v)
+    assert any("critical-class sheds: 1" in s for s in v)
+
+
+def test_envelope_shed_ordering_bottom_up():
+    # standard shed while best_effort was present AND served: violation.
+    res = _result([
+        _rec(slo_class="standard", ok=False, shed={"class": "standard"}),
+        _rec(slo_class="best_effort"),
+    ])
+    v = evaluate(res, Envelope(max_steady_compiles=None))
+    assert any("shed ordering violated" in s for s in v)
+    # best_effort shed too in the same epoch: ordering respected.
+    res = _result([
+        _rec(slo_class="standard", ok=False, shed={"class": "standard"}),
+        _rec(slo_class="best_effort", ok=False,
+             shed={"class": "best_effort"}),
+    ])
+    assert not any(
+        "shed ordering" in s
+        for s in evaluate(res, Envelope(max_steady_compiles=None))
+    )
+
+
+def test_envelope_rung_and_steady_gates_are_phase_aware():
+    assert list(RUNG_ORDER) == [
+        "none", "kept_previous", "cold_device", "host_snake"
+    ]
+    res = _result([
+        _rec(phase="warm", rung="host_snake", churn=1.0),
+        _rec(phase="transition", churn=1.0),
+        _rec(phase="steady", rung="kept_previous", churn=0.1),
+    ])
+    env = Envelope(
+        max_rung="none", max_steady_compiles=1, max_steady_churn=0.5
+    )
+    res.compiles_by_phase = {"warm": 7, "steady": 1, "transition": 3}
+    v = evaluate(res, env)
+    # The warm-epoch host_snake still trips max_rung (rung bounds are
+    # trace-wide)...
+    assert any("exceeds envelope 'none'" in s for s in v)
+    # ...but churn/compile gates see only steady epochs.
+    assert not any("churn" in s for s in v)
+    assert not any("compiles" in s for s in v)
+    res.compiles_by_phase["steady"] = 2
+    assert any("compiles: 2 > 1" in s for s in evaluate(res, env))
+
+
+def test_envelope_corruption_and_recovery_gates():
+    res = _result([_rec()], quarantines=0, corruptions_planted=2)
+    v = evaluate(
+        res,
+        Envelope(max_steady_compiles=None, min_detected_corruptions=1),
+    )
+    assert any("detected 0 corruption(s) < 1" in s for s in v)
+    env = Envelope(
+        max_steady_compiles=None, require_bit_exact_recovery=True
+    )
+    # No twin recorded at all is itself a violation (a gate that
+    # silently skipped is not a pass) ...
+    res = _result([_rec()], twin_mismatches=None)
+    assert any("no twin comparison" in s for s in evaluate(res, env))
+    res.twin_mismatches = 3
+    assert any("3 epoch(s) diverged" in s for s in evaluate(res, env))
+    res.twin_mismatches = 0
+    assert evaluate(res, env) == []
+
+
+def test_twin_mismatches_counts_missing_cells():
+    a = _result([_rec(epoch=1, choice=np.zeros(4, np.int32))])
+    b = _result([
+        _rec(epoch=1, choice=np.zeros(4, np.int32)),
+        _rec(epoch=2, choice=np.ones(4, np.int32)),
+    ])
+    assert twin_mismatches(a, b) == 1          # epoch 2 missing in a
+    assert twin_mismatches(a, b, from_epoch=2) == 1
+    assert twin_mismatches(a, b, from_epoch=3) == 0
+
+
+# -- wire-decode helpers --------------------------------------------------
+
+
+def test_choice_from_assignments_and_moved_fraction():
+    members = ["A", "B"]
+    assignments = {"A": [["t", 0], ["t", 2]], "B": [["t", 1]]}
+    ch = choice_from_assignments(assignments, members, 4)
+    np.testing.assert_array_equal(ch, [0, 1, 0, -1])
+    same = ch.copy()
+    assert moved_fraction(ch, same) == 0.0
+    flipped = ch.copy()
+    flipped[0] = 1
+    assert moved_fraction(ch, flipped) == pytest.approx(0.25)
+    assert moved_fraction(ch, np.zeros(3, np.int32)) == 1.0  # shape
+
+
+# -- the corpus catalog ---------------------------------------------------
+
+
+def test_corpus_satisfies_the_fleet_floor():
+    """The bench gate demands >= 8 scenarios, >= 3 with composed fault
+    planes, >= 1 crash/restart; the catalog must keep clearing it."""
+    names = [sc.name for sc in CORPUS]
+    assert len(names) == len(set(names))
+    assert len(names) >= 8
+    composed = [
+        sc for sc in CORPUS
+        if len(sc.planes) >= 2
+        or (sc.planes and sc.crash_epoch is not None)
+    ]
+    assert len(composed) >= 3
+    assert any(sc.crash_epoch is not None for sc in CORPUS)
+    assert sum(1 for sc in CORPUS if sc.fast) >= 8  # the CI subset
+    for sc in CORPUS:
+        assert sc.trace in GENERATORS
+        assert sc.envelope.max_rung in RUNG_ORDER
+    assert get_scenario(names[0]) is CORPUS[0]
+    with pytest.raises(KeyError, match="valid"):
+        get_scenario("nope")
+
+
+def test_run_fleet_rejects_unknown_only():
+    with pytest.raises(KeyError, match="unknown scenario"):
+        run_fleet(only=["definitely_not_a_scenario"])
+
+
+# -- the replay twin contract ---------------------------------------------
+
+
+def test_replay_twin_bit_identical_assignments():
+    """The determinism keystone (satellite of ISSUE 17): the same
+    trace, faults off, replayed twice wire-level against fresh
+    sidecars yields BIT-identical assignment sequences — this is what
+    makes the crash-recovery twin comparison meaningful at all."""
+    trace = generate(
+        "step_load", 31337, partitions=48, consumers=3, epochs=5,
+        step_at=3,
+    )
+    a = replay(trace)
+    b = replay(trace)
+    assert a.trace_sha256 == b.trace_sha256 == trace.digest()
+    assert len(a.records) == len(trace.epochs)
+    for rec in a.records:
+        assert rec.ok and rec.valid, (rec.epoch, rec.error)
+    ca, cb = a.choices(), b.choices()
+    assert set(ca) == set(cb) and ca == cb
+    # And the decoded choice vectors are real assignments, not padding.
+    for rec in a.records:
+        assert rec.choice.shape == (48,)
+        assert rec.choice.min() >= 0
+
+
+# -- the full fast fleet (slow tier: tier1.yml runs it wire-level) --------
+
+
+@pytest.mark.slow
+def test_fast_fleet_has_no_envelope_violations():
+    fleet = run_fleet(fast_only=True)
+    assert fleet["ok"], [
+        (r["scenario"], r["violations"])
+        for r in fleet["scenarios"] if r["violations"]
+    ]
+    assert len(fleet["scenarios"]) >= 8

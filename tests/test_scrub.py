@@ -84,6 +84,87 @@ def test_digest_failures_slot_mapping():
     assert set(many) == {"counts", "choice", "lags"}
 
 
+def test_digest_failures_row_tab_fifth_lane():
+    """The optional int64[5] shape: lane 4 is the row-TABLE slot
+    checksum (host truth 0); four-lane digests from epilogues without
+    a table still decode identically."""
+    clean5 = np.array([100, 0, 555, 0, 0], dtype=np.int64)
+    assert digest_failures(clean5, 100, 555) == []
+    assert digest_failures(
+        np.array([100, 0, 555, 0, 3], dtype=np.int64), 100, 555
+    ) == ["row_tab"]
+    mixed = digest_failures(
+        np.array([100, 1, 555, 0, 1], dtype=np.int64), 100, 555
+    )
+    assert mixed == ["choice", "row_tab"]
+
+
+def test_row_tab_lane_xla_catches_every_flip_class():
+    """Unit semantics of ops.refine._row_tab_lane_xla: zero on a
+    consistent (choice, row_tab, counts) triple, nonzero for each of
+    the four violation classes — owner mismatch, out-of-range row,
+    clobbered empty-slot sentinel, and the duplicate-entry checksum
+    (a flip landing on another row of the SAME consumer, which the
+    owner check alone would pass)."""
+    import jax.numpy as jnp
+
+    from kafka_lag_based_assignor_tpu.ops.refine import _row_tab_lane_xla
+
+    B, C, M = 8, 2, 6
+    lags = jnp.arange(B, dtype=jnp.int64)
+    choice = jnp.array([0, 0, 0, 0, 1, 1, 1, 1], dtype=jnp.int32)
+    counts = jnp.array([4, 4], dtype=jnp.int32)
+    tab = np.full((C, M), B, dtype=np.int32)  # empty slots = sentinel B
+    tab[0, :4] = [0, 1, 2, 3]
+    tab[1, :4] = [4, 5, 6, 7]
+
+    def lane(t):
+        return int(_row_tab_lane_xla(
+            lags, choice, jnp.asarray(t), counts, C
+        ))
+
+    assert lane(tab) == 0
+    owner = tab.copy()
+    owner[0, 0] = 4               # row 4 belongs to consumer 1
+    assert lane(owner) > 0
+    oob = tab.copy()
+    oob[1, 2] = B + 3             # valid slot naming a row outside [0, B)
+    assert lane(oob) > 0
+    sentinel = tab.copy()
+    sentinel[0, 5] = 2            # empty slot lost its sentinel
+    assert lane(sentinel) > 0
+    dupe = tab.copy()
+    dupe[0, 1] = 0                # duplicate of consumer 0's row 0
+    assert lane(dupe) > 0
+
+
+def test_row_tab_corruption_detected_at_dispatch_and_heals():
+    """End-to-end over the fifth lane: a ``device.corrupt.row_tab``
+    bit flip at adoption is caught by the NEXT dispatch's fused
+    digest (serving-time quarantine — previously only the host-side
+    scrubber audited the table), host truth stays intact, and the
+    heal epoch rebuilds bit-exact vs a twin."""
+    rng = np.random.default_rng(11)
+    e = _engine()
+    e.rebalance(_lags(rng))
+    e.rebalance(_lags(rng))
+    _corrupt(e, "row_tab")
+    q_before = _quarantine_total("quarantined")
+    prev = np.array(e._prev_choice, copy=True)
+    with pytest.raises(CorruptStateDetected) as exc:
+        e.rebalance(_lags(np.random.default_rng(171)))
+    assert "row_tab" in exc.value.buffers
+    assert e.quarantined
+    assert _quarantine_total("quarantined") - q_before >= 1
+    np.testing.assert_array_equal(e._prev_choice, prev)
+    heal_lags = _lags(np.random.default_rng(172))
+    healed = e.rebalance(heal_lags)
+    assert not e.quarantined
+    twin = _engine()
+    twin.seed_choice(prev)
+    np.testing.assert_array_equal(healed, twin.rebalance(heal_lags))
+
+
 def test_clean_epochs_audit_clean_and_digest_passes():
     rng = np.random.default_rng(0)
     e = _engine()

@@ -2,14 +2,18 @@
 
 Default run: every repo python file through the full ruleset
 (L001-L021 legacy + A001-A004 deep + W001 waiver accounting), text
-report to stdout, exit 1 on any finding.  ``--changed`` keeps the
-hot-loop invocation incremental via the mtime-keyed cache (unchanged
-files are never re-parsed); ``--sarif PATH`` writes the CI artifact
+report to stdout, exit 1 on any finding.  ``--changed`` analyzes only
+the files git reports as changed (working tree + commits past the
+merge base, :func:`git_changed_files`) — a pre-commit hook touches a
+handful of files, not the 100+-file stat sweep the mtime cache still
+walks; when the tree is not a git checkout the flag degrades to that
+cache-backed full sweep.  ``--sarif PATH`` writes the CI artifact
 next to whatever ``--format`` goes to stdout."""
 
 from __future__ import annotations
 
 import argparse
+import subprocess
 import sys
 import time
 from pathlib import Path
@@ -27,6 +31,61 @@ def _repo_root() -> Path:
     # installed console script (site-packages): analyze the checkout
     # the operator is standing in
     return Path.cwd()
+
+
+def _git_lines(root: Path, *args: str) -> Optional[List[str]]:
+    try:
+        out = subprocess.run(
+            ["git", *args], cwd=root, capture_output=True,
+            text=True, timeout=30,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if out.returncode != 0:
+        return None
+    return out.stdout.splitlines()
+
+
+def git_changed_files(root: Path) -> Optional[List[Path]]:
+    """Python files git considers changed, or ``None`` off a checkout.
+
+    The changed set is the union of the working-tree delta
+    (``git status --porcelain``: staged, unstaged, and untracked) and
+    the commits past the upstream merge base (``git diff --name-only
+    <base>...HEAD``) so a CI run on a feature branch sees the same
+    set a pre-commit hook saw locally.  Deleted files are dropped —
+    there is nothing left to parse.  ``None`` (as opposed to an empty
+    list, which means "a checkout with nothing changed") tells the
+    caller git itself is unavailable and the mtime sweep must run.
+    """
+    status = _git_lines(root, "status", "--porcelain")
+    if status is None:
+        return None
+    rel: set = set()
+    for line in status:
+        if len(line) < 4:
+            continue
+        path = line[3:]
+        # a rename line is "R  old -> new"; only the new side exists
+        if " -> " in path:
+            path = path.split(" -> ", 1)[1]
+        rel.add(path.strip().strip('"'))
+    base = _git_lines(root, "merge-base", "HEAD", "@{upstream}")
+    if not base:
+        base = _git_lines(root, "merge-base", "HEAD", "origin/HEAD")
+    if base and base[0].strip():
+        diffed = _git_lines(root, "diff", "--name-only",
+                            base[0].strip(), "HEAD")
+        if diffed:
+            rel.update(p.strip() for p in diffed if p.strip())
+    files = []
+    for p in sorted(rel):
+        if not p.endswith(".py") or "__pycache__" in p:
+            continue
+        full = root / p
+        if full.is_file():
+            files.append(full)
+    return files
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -52,8 +111,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument(
         "--changed", action="store_true",
         help=(
-            "incremental mode: reuse the mtime-keyed cache so only "
-            "files changed since the last run are re-analyzed"
+            "analyze only the files git reports as changed (working "
+            "tree + commits past the merge base); degrades to the "
+            "mtime-cached full sweep outside a git checkout"
         ),
     )
     parser.add_argument(
@@ -75,6 +135,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = parser.parse_args(argv)
 
     root = _repo_root()
+    if args.changed and not args.paths:
+        changed = git_changed_files(root)
+        if changed is not None:
+            if not changed:
+                print("klba-analyze: no changed python files",
+                      file=sys.stderr)
+                return 0
+            try:
+                args.paths = [
+                    p.relative_to(Path.cwd()) for p in changed
+                ]
+            except ValueError:
+                args.paths = changed
     if args.paths:
         files = []
         missing = []

@@ -82,6 +82,31 @@ def kernel_summary_line(report_path) -> str:
     )
 
 
+def scenario_summary_line(report_path) -> str:
+    """One ``scenarios:`` row from a scenario-fleet artifact
+    (``python -m scenarios --json`` / bench config16 — CI uploads
+    scenario_fleet.json), or "" when the file is absent/unreadable
+    (the summary must never fail because no fleet ran on this host)."""
+    try:
+        doc = json.loads(Path(report_path).read_text(encoding="utf-8"))
+        rows = doc["scenarios"]
+        failed = [r["scenario"] for r in rows if r.get("violations")]
+        quarantines = sum(r.get("quarantines", 0) for r in rows)
+        planted = sum(r.get("corruptions_planted", 0) for r in rows)
+        sheds = sum(r.get("sheds", 0) for r in rows)
+    except (OSError, ValueError, KeyError, TypeError):
+        return ""
+    verdict = (
+        "all inside envelopes" if not failed
+        else f"FAILED: {', '.join(failed)}"
+    )
+    return (
+        f"scenarios: {len(rows)} run(s), "
+        f"{doc.get('violations', 0)} violation(s) ({verdict}), "
+        f"sheds={sheds}, corruption detect {quarantines}/{planted}"
+    )
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(
         prog="dump_metrics",
@@ -118,6 +143,14 @@ def main() -> int:
         help=(
             "kernel-plane report for the --summary kernel row "
             "(default: $KLBA_KERNEL_REPORT or <repo>/kernel_report.json)"
+        ),
+    )
+    parser.add_argument(
+        "--scenario-report", type=Path, default=None,
+        help=(
+            "scenario-fleet artifact for the --summary scenarios row "
+            "(default: $KLBA_SCENARIO_REPORT or "
+            "<repo>/scenario_fleet.json)"
         ),
     )
     args = parser.parse_args()
@@ -495,6 +528,20 @@ def main() -> int:
             or os.environ.get("KLBA_KERNEL_REPORT")
             or Path(__file__).resolve().parent.parent
             / "kernel_report.json"
+        )
+        if line:
+            print(line)
+
+        # Adversarial-fleet view (DEPLOYMENT.md "Adversarial
+        # scenarios"): the last fleet run's envelope verdicts from its
+        # artifact (CI uploads scenario_fleet.json; bench config16 and
+        # `python -m scenarios --json` both write one) — the "did the
+        # service degrade inside its envelopes" look.
+        line = scenario_summary_line(
+            args.scenario_report
+            or os.environ.get("KLBA_SCENARIO_REPORT")
+            or Path(__file__).resolve().parent.parent
+            / "scenario_fleet.json"
         )
         if line:
             print(line)
