@@ -174,9 +174,16 @@ class LagBasedPartitionAssignor:
         )
         with stopwatch() as wall:
             with profile_trace(self._config.profile):
-                group_assignment = self._assign_inner(
-                    metadata, subscriptions, stats
-                )
+                # Client wire edge: the rebalance mints the trace, so
+                # the lag read, the solve, and any sidecar call from
+                # this thread ride ONE client-rooted trace (the sidecar
+                # joins via the request's traceparent).
+                with metrics.request_scope(
+                    kind="client", root_name="client"
+                ):
+                    group_assignment = self._assign_inner(
+                        metadata, subscriptions, stats
+                    )
         stats.wall_ms = wall[0]
         log_rebalance(stats)
         self.last_stats = stats

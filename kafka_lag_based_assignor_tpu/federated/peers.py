@@ -437,6 +437,12 @@ class FederationCoordinator:
         counts consecutive failures toward the peer's breaker, and the
         round loop abandons the global attempt."""
         pid = link.spec.peer_id
+        with metrics.span("federation.sync"):
+            return self._sync_once_inner(link, pid, params)
+
+    def _sync_once_inner(
+        self, link: _PeerLink, pid: str, params: Dict[str, Any]
+    ) -> Dict[str, Any]:
         try:
             # Fault point peer.sync: a protocol-level failure inside
             # the exchange (distinct from the transport-level
@@ -598,6 +604,7 @@ class FederationCoordinator:
             lambda pid: wire.sync_request(
                 self.self_id, epoch, 0, C, scale=1.0,
                 fence_token=token, phase="hello",
+                traceparent=metrics.current_traceparent(),
             ),
             remaining_s,
         )
@@ -669,6 +676,7 @@ class FederationCoordinator:
                         self.self_id, epoch, r, C, scale=scale,
                         duals_a=A, duals_b=B, fence_token=token,
                         phase="exchange",
+                        traceparent=metrics.current_traceparent(),
                     ),
                     remaining_s,
                 )

@@ -36,7 +36,7 @@ PROTOCOL_VERSION = 1
 _REQUEST_KEYS = frozenset(
     {
         "version", "peer_id", "epoch", "fence_token", "round",
-        "num_consumers", "scale", "phase", "duals",
+        "num_consumers", "scale", "phase", "duals", "traceparent",
     }
 )
 _RESPONSE_KEYS = frozenset(
@@ -86,6 +86,23 @@ def _check_payload(
         raise PayloadViolation(
             f"peer payload carries non-whitelisted keys {sorted(unknown)}"
         )
+    tp = payload.get("traceparent")
+    if tp is not None:
+        # Trace context rides the peer wire as ONE fixed-length scalar
+        # string (W3C traceparent) — length-checked and re-parsed here
+        # so the tracing plane cannot become a covert channel for
+        # anything wider than two ids and a flag byte.
+        from ..utils import trace as trace_mod
+
+        if (
+            not isinstance(tp, str)
+            or len(tp) != trace_mod.TRACEPARENT_LEN
+            or trace_mod.parse_traceparent(tp) is None
+        ):
+            raise PayloadViolation(
+                "traceparent must be a single W3C traceparent scalar "
+                f"({trace_mod.TRACEPARENT_LEN} chars)"
+            )
     duals = payload.get("duals")
     if duals is not None:
         if set(duals) - _DUALS_KEYS:
@@ -137,13 +154,17 @@ def sync_request(
     duals_b: Optional[Any] = None,
     fence_token: Optional[int] = None,
     phase: str = "exchange",
+    traceparent: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Build (and audit) one ``peer_sync`` request's params.
 
     ``phase`` is ``"hello"`` for the handshake round (no duals yet —
     the response's ``total_lag``/``n_valid`` scalars fix the shared
     scale) or ``"exchange"`` for a marginal round under the carried
-    duals."""
+    duals.  ``traceparent`` (optional) carries the initiator's W3C
+    trace context so both sidecars' segments of a federated assign
+    reconstruct as one trace; it is audited as a fixed-length scalar
+    by :func:`_check_payload`."""
     if phase not in ("hello", "exchange"):
         raise PayloadViolation(f"unknown phase {phase!r}")
     params: Dict[str, Any] = {
@@ -159,6 +180,8 @@ def sync_request(
         params["fence_token"] = int(fence_token)
     if duals_a is not None:
         params["duals"] = {"A": duals_a, "B": duals_b}
+    if traceparent is not None:
+        params["traceparent"] = str(traceparent)
     _check_payload(params, _REQUEST_KEYS, int(num_consumers))
     return params
 

@@ -250,11 +250,16 @@ def read_topic_partition_lags(
     retried callables so injection drills exercise the retry path.
     """
     topic_partition_lags: Dict[str, List[TopicPartitionLag]] = {}
-    with metrics.span("lag.read"):
-        _read_all(
-            topic_partition_lags, metadata_consumer, cluster,
-            all_subscribed_topics, auto_offset_reset_mode, retry,
-        )
+    # Client wire edge: called under the assignor's rebalance scope the
+    # outer trace wins (flatten) and this only contributes the span;
+    # called standalone (operator tooling, tests) it self-roots a
+    # client-kind trace so lag reads are traceable on their own.
+    with metrics.request_scope(kind="client", root_name="lag.read"):
+        with metrics.span("lag.read"):
+            _read_all(
+                topic_partition_lags, metadata_consumer, cluster,
+                all_subscribed_topics, auto_offset_reset_mode, retry,
+            )
     return topic_partition_lags
 
 

@@ -975,6 +975,7 @@ class MegabatchCoalescer:
                             s.scope.request_id
                             if s.scope is not None else None
                         ),
+                        scope=s.scope,
                     )
                     if not s.future.done():
                         s.future.set_exception(DeadlineShed(
@@ -1031,7 +1032,9 @@ class MegabatchCoalescer:
         try:
             faults.fire("coalesce.flush")
             if len(rows) > 1:
-                job = self._dispatch_megabatch(rows)
+                job = self._traced_wave(
+                    rows, lambda: self._dispatch_megabatch(rows)
+                )
                 self._m_path["megabatch"].inc()
                 self._enqueue_readback(job)
                 return
@@ -1314,6 +1317,57 @@ class MegabatchCoalescer:
                 raise
         return slot, idx_dev, vals_dev, limits_dev
 
+    def _link_wave(self, wave, rows: List[EpochSubmission]) -> None:
+        """Bidirectional fan-in links between the wave's own trace and
+        every submitting request trace: each request trace records the
+        wave it rode (``relation="wave"``) and the wave trace records
+        every request it served (``relation="request"``) — including
+        rows that later fall out through the single-row isolation path,
+        whose re-dispatch still happened because of this wave."""
+        wtr = getattr(wave, "trace", None)
+        if wtr is None:
+            return
+        for s in rows:
+            tr = (
+                getattr(s.scope, "trace", None)
+                if s.scope is not None else None
+            )
+            if tr is None:
+                continue
+            wtr.link(tr.trace_id, tr.root_span_id, relation="request")
+            tr.link(wtr.trace_id, wtr.root_span_id, relation="wave")
+
+    def _traced_wave(
+        self,
+        rows: List[EpochSubmission],
+        dispatch: Callable[[], Callable[[], None]],
+    ) -> Callable[[], None]:
+        """Run ``dispatch`` (staging + device dispatch) and its returned
+        readback job under ONE wave-rooted trace.  The wave spans two
+        threads — the flusher stages/dispatches, the readback worker
+        fetches — so each thread adopts the shared scope and the scope
+        finishes exactly once: on the readback's exit, or here when the
+        dispatch itself raises (the readback never runs; the submitters'
+        isolation re-dispatches resolve under their own request traces
+        because the wave scope is no longer active on the flusher)."""
+        wave = metrics.begin_scope(kind="wave", root_name="coalesce.wave")
+        self._link_wave(wave, rows)
+        try:
+            with metrics.adopt_scope(wave):
+                inner = dispatch()
+        except Exception:
+            metrics.finish_scope(wave)
+            raise
+
+        def readback() -> None:
+            try:
+                with metrics.adopt_scope(wave):
+                    inner()
+            finally:
+                metrics.finish_scope(wave)
+
+        return readback
+
     def _dispatch_megabatch(
         self, rows: List[EpochSubmission]
     ) -> Callable[[], None]:
@@ -1440,13 +1494,16 @@ class MegabatchCoalescer:
             try:
                 with metrics.span("coalesce.readback"):
                     with batch.lock:
-                        jax.block_until_ready((narrow, totals, rounds, ex))
-                        narrow_np = np.asarray(narrow)
-                        totals_np = np.asarray(totals)
-                        counts_np = np.asarray(counts_b)
-                        rounds_np = np.asarray(rounds)
-                        ex_np = np.asarray(ex)
-                        digest_np = np.asarray(digest)
+                        with metrics.device_phase("megabatch"):
+                            jax.block_until_ready(
+                                (narrow, totals, rounds, ex)
+                            )
+                            narrow_np = np.asarray(narrow)
+                            totals_np = np.asarray(totals)
+                            counts_np = np.asarray(counts_b)
+                            rounds_np = np.asarray(rounds)
+                            ex_np = np.asarray(ex)
+                            digest_np = np.asarray(digest)
                 for s in rows:
                     r = s.resident.row
                     if s.future.done():
@@ -1688,13 +1745,14 @@ class MegabatchCoalescer:
         def readback() -> None:
             try:
                 with metrics.span("coalesce.readback"):
-                    jax.block_until_ready((narrow, totals, rounds, ex))
-                    narrow_np = np.asarray(narrow)
-                    totals_np = np.asarray(totals)
-                    counts_np = np.asarray(counts_b)
-                    rounds_np = np.asarray(rounds)
-                    ex_np = np.asarray(ex)
-                    digest_np = np.asarray(digest)
+                    with metrics.device_phase("megabatch"):
+                        jax.block_until_ready((narrow, totals, rounds, ex))
+                        narrow_np = np.asarray(narrow)
+                        totals_np = np.asarray(totals)
+                        counts_np = np.asarray(counts_b)
+                        rounds_np = np.asarray(rounds)
+                        ex_np = np.asarray(ex)
+                        digest_np = np.asarray(digest)
                 for i, s in enumerate(rows):
                     if s.future.done():
                         continue
@@ -1752,6 +1810,11 @@ class MegabatchCoalescer:
                 "request_ids": [
                     s.scope.request_id for s in rows
                     if s.scope is not None
+                ],
+                "trace_ids": [
+                    s.scope.trace.trace_id for s in rows
+                    if s.scope is not None
+                    and getattr(s.scope, "trace", None) is not None
                 ],
             },
         )

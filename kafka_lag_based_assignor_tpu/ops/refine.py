@@ -33,7 +33,7 @@ the segmented argmin) plus cumulative scans, elementwise ops, and a few
 gathers — versus the previous generation's five sort passes
 (light-key sort, a 2P sort-based searchsorted, and two segmented
 argmins); fetch-synchronized probes on the target TPU
-(tools/probe_round5c.py — ``block_until_ready`` is NOT a valid clock on
+(retired probe, git history — ``block_until_ready`` is NOT a valid clock on
 this platform) put a P=131072 sort at ~0.4 ms, making op count, not
 element count, the budget.  Churn is bounded by ``2 * iters * max_pairs``.
 

@@ -69,7 +69,7 @@ def _rounds_body_packed(carry, xs, C: int, rank_bits: int):
     partition belongs to the j-th smallest key — which after the sort is
     position j — so the gain add is POSITIONAL: no scatter, no gather,
     and the sort carries one array instead of two.  At ~90 us/round of
-    tiny-op overhead in the scan body (tools/probe_round5d.py), dropping
+    tiny-op overhead in the scan body (retired probe, git history), dropping
     ops per round is exactly what makes the 100-round north-star scan
     cheaper.
     """
@@ -140,10 +140,10 @@ def _rounds_scan(
     P = sorted_lags.shape[0]
     xs = (lags_h.reshape(R, C), valid_h.reshape(R, C))
     # Unrolling amortizes the scan's per-iteration bookkeeping — the round
-    # body is ~90 us of tiny ops (tools/probe_round5d.py), so loop
+    # body is ~90 us of tiny ops (retired probe, git history), so loop
     # overhead is a real fraction of it.  Purely a lowering choice:
     # results are bit-identical.  ``scan_unroll`` (static) overrides the
-    # default factor so the hardware probe (tools/probe_round6.py) can
+    # default factor so the (retired) hardware probe — git history — can
     # sweep it; None keeps the measured default.
     unroll = min(scan_unroll if scan_unroll else 4, max(R, 1))
     if totals_rank_bits > 0:
@@ -175,7 +175,7 @@ def _unsort_choice(perm, sorted_choice, P: int, C: int):
     """Sorted-order choices back to input row order plus per-consumer
     counts (-1 padding rows excluded) — both scatter-free (sort-based, see
     :mod:`.sortops`; a P-sized sort is ~0.4 ms measured,
-    tools/probe_round5d.py, vs XLA:TPU's serialized dynamic-index
+    a retired probe (git history), vs XLA:TPU's serialized dynamic-index
     scatters)."""
     choice = unsort(perm, sorted_choice)
     counts = bincount_sorted(sorted_choice, C)

@@ -2,7 +2,7 @@
 grid-less kernel with an in-VMEM bitonic sort per round.
 
 Why: the XLA lowering of the rounds scan costs ~90 us per round of
-sequencing overhead (tools/probe_round5d.py) — each round's C-sized
+sequencing overhead (retired probe, git history) — each round's C-sized
 ``lax.sort`` lowers to a multi-pass comparator network with HBM traffic
 between passes, and at the north star that's ~100 sequential rounds =
 ~9 ms, essentially the whole device budget (BASELINE.md).  Keeping the
@@ -42,7 +42,7 @@ headline) — the probe is only ever invoked by warm-up/bench
 (run_probe=True), never on a cold rebalance, and any failure falls back
 to the XLA scan.  Bit-parity is pinned by interpret-mode tests
 (tests/test_rounds_pallas.py: fixed shape classes, Hypothesis fuzz,
-carry stress); hardware timing goes through tools/probe_round6.py.
+carry stress); hardware timing went through a retired probe (git history).
 """
 
 from __future__ import annotations

@@ -91,7 +91,7 @@ def sort_partitions_with(
     """:func:`sort_partitions` with the lags and validity co-sorted in the
     same ``lax.sort`` call — payloads ride the sort instead of two
     post-sort P-sized gathers ``lags[perm]`` / ``valid[perm]`` (the co-sort
-    itself is ~0.4 ms at north-star scale, tools/probe_round5d.py).
+    itself is ~0.4 ms at north-star scale, retired probe, git history).
 
     Returns (perm int32[P], sorted_lags, sorted_valid) — identical values
     to ``(p := sort_partitions(...), lags[p], valid[p])``.
@@ -190,7 +190,7 @@ def assign_topic_scan(
     )
 
     # Back to input row order — sort-based permutation inversion
-    # (P-sized sorts are ~0.4 ms measured, tools/probe_round5d.py; XLA:TPU
+    # (P-sized sorts are ~0.4 ms measured, retired probe, git history; XLA:TPU
     # serializes dynamic-index scatters).
     from .sortops import unsort
 

@@ -1,7 +1,7 @@
 """Sort-based, scatter-free array primitives for the TPU kernels.
 
-Measurement status (tools/probe_round5c/d.py — fetch-synchronized; the
-earlier probe_ops.py numbers were dispatch times, because
+Measurement status (retired probes, git history — fetch-synchronized; the
+earlier probe numbers were dispatch times, because
 ``block_until_ready`` returns at dispatch on this platform): a P-sized
 ``lax.sort`` costs ~0.4 ms at P=131072, which is cheap enough that
 sort-based formulations set the floor for every primitive here.  XLA:TPU

@@ -104,6 +104,7 @@ import jax.numpy as jnp
 
 from ..utils import faults, metrics
 from ..utils import scrub as scrub_mod
+from ..utils import trace as trace_mod
 from ..utils.observability import count_constrained_bound
 from ..utils.watchdog import capture_abandon_check
 from .batched import _narrow_choice, _stream_device, assign_stream, stream_payload
@@ -652,6 +653,7 @@ class StreamingAssignor:
         metrics.FLIGHT.record("stream_epoch", rec)
         if s.guardrail_tripped:
             self._m_guardrail.inc()
+            trace_mod.mark("guardrail")
             metrics.FLIGHT.auto_dump(
                 "guardrail", {"epoch": self._epoch_num,
                               "quality_ratio": ratio}
@@ -1099,8 +1101,12 @@ class StreamingAssignor:
                 return narrow_np.astype(np.int32)
             observe_pack_shift(("stream", lags.shape, C), (shift, rb))
             with metrics.span("stream.h2d"):
-                # ONE upload, shared by both kernels.
-                payload = jax.device_put(payload)
+                # ONE upload, shared by both kernels.  The device phase
+                # rides inside the span (same pairing as linear_ot's
+                # h2d) so the epoch trace separates transfer dispatch
+                # from compute even on the cold chain.
+                with metrics.device_phase("h2d"):
+                    payload = jax.device_put(payload)
             choice0 = _stream_device(
                 payload, num_consumers=C, pack_shift=shift,
                 totals_rank_bits=rb,

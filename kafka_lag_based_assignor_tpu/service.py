@@ -204,6 +204,7 @@ from .models.greedy import assign_greedy, host_fallback_for
 from .types import TopicPartitionLag
 from .utils import faults, metrics
 from .utils import scrub as scrub_lib
+from .utils import trace as trace_mod
 from .utils.config import VALID_SOLVERS
 from .utils.observability import (
     RebalanceStats,
@@ -264,7 +265,7 @@ _KNOWN_METHODS = frozenset(
     {
         "ping", "stats", "metrics", "assign", "stream_assign",
         "stream_reset", "stream_flight", "recommend", "drain",
-        "peer_sync", "federation", "federated_assign",
+        "peer_sync", "federation", "federated_assign", "trace",
     }
 )
 
@@ -1471,12 +1472,35 @@ class AssignorService:
                 self._active_cond.notify_all()
 
     def _handle_line_counted(self, line: bytes) -> bytes:
-        with metrics.request_scope() as rid:
-            req_id = None
+        # Parse BEFORE opening the scope: the trace context rides the
+        # request line (top-level ``traceparent``, or inside ``params``
+        # for the audited federated envelope), and the scope is the
+        # trace root — it must adopt the caller's context at birth.  A
+        # parse failure still answers from inside a (self-rooted)
+        # scope, so the error envelope shape is unchanged.
+        req: Dict[str, Any] = {}
+        parse_error: Optional[Exception] = None
+        try:
+            req = json.loads(line)
+            if not isinstance(req, dict):
+                req, parse_error = {}, TypeError(
+                    f"request must be a JSON object, got "
+                    f"{type(req).__name__}"
+                )
+        except Exception as exc:  # noqa: L011 — re-raised in-scope below
+            parse_error = exc
+        traceparent = req.get("traceparent")
+        if traceparent is None:
+            params = req.get("params")
+            if isinstance(params, dict):
+                traceparent = params.get("traceparent")
+        with metrics.request_scope(traceparent=traceparent) as rid:
+            trace_id = metrics.current_trace_id()
+            req_id = req.get("id")
             label = "unknown"
             try:
-                req = json.loads(line)
-                req_id = req.get("id")
+                if parse_error is not None:
+                    raise parse_error
                 method = req.get("method")
                 if method in _KNOWN_METHODS:
                     label = method
@@ -1491,7 +1515,10 @@ class AssignorService:
                         {"method": label},
                     ).observe(budget.consumed_ms())
                 return json.dumps(
-                    {"id": req_id, "request_id": rid, "result": result}
+                    {
+                        "id": req_id, "request_id": rid,
+                        "trace_id": trace_id, "result": result,
+                    }
                 ).encode()
             except ShedReject as exc:
                 # An overload shed is a DECISION, not a failure: counted
@@ -1506,6 +1533,7 @@ class AssignorService:
                     {
                         "id": req_id,
                         "request_id": rid,
+                        "trace_id": trace_id,
                         "error": {
                             "message": str(exc),
                             "shed": {
@@ -1517,6 +1545,7 @@ class AssignorService:
                     }
                 ).encode()
             except Exception as exc:  # noqa: BLE001 — wire boundary
+                trace_mod.mark("error")
                 metrics.REGISTRY.counter(
                     "klba_request_errors_total", {"method": label}
                 ).inc()
@@ -1525,6 +1554,7 @@ class AssignorService:
                     {
                         "id": req_id,
                         "request_id": rid,
+                        "trace_id": trace_id,
                         "error": {"message": str(exc)},
                     }
                 ).encode()
@@ -1632,6 +1662,26 @@ class AssignorService:
                     "last_dump": last,
                 }
             return result, None
+        if method == "trace":
+            # The tail-sampler's wire view (utils/trace): retention
+            # stats plus kept traces — ``params.trace_id`` narrows to
+            # one trace's segments (a cross-process trace has one
+            # segment per participating scope), ``params.limit`` caps
+            # the kept-trace payload (default 8, newest last).
+            params = req.get("params") or {}
+            want = params.get("trace_id")
+            if want is not None and not isinstance(want, str):
+                raise ValueError(
+                    f"trace_id must be a string, got "
+                    f"{type(want).__name__}"
+                )
+            limit = params.get("limit", 8)
+            limit = None if limit is None else int(limit)
+            coll = trace_mod.COLLECTOR
+            return {
+                "stats": coll.stats(),
+                "traces": coll.traces(trace_id=want, limit=limit),
+            }, None
         if method == "drain":
             # Graceful drain over the wire (same path as SIGTERM): the
             # response answers IMMEDIATELY with the lifecycle state —
@@ -1685,6 +1735,7 @@ class AssignorService:
                 metrics.REGISTRY.counter(
                     "klba_fallbacks_total", {"method": "assign"}
                 ).inc()
+                trace_mod.mark("ladder")
                 metrics.FLIGHT.auto_dump(
                     "ladder",
                     {"method": "assign", "rung": rung, "solver": solver},
@@ -1739,6 +1790,7 @@ class AssignorService:
                 # Descended past the first ladder rung: a flight-recorder
                 # incident (at most one dump per request — a breaker trip
                 # in the same request already dumped this ring).
+                trace_mod.mark("ladder")
                 metrics.FLIGHT.auto_dump(
                     "ladder", {"method": "stream_assign", "rung": rung}
                 )
@@ -1850,6 +1902,7 @@ class AssignorService:
                 {"method": "federated_assign", "rung": rung},
             ).inc()
             if rung != "global":
+                trace_mod.mark("ladder")
                 metrics.FLIGHT.auto_dump(
                     "ladder",
                     {"method": "federated_assign", "rung": rung},
@@ -2028,6 +2081,7 @@ class AssignorService:
                 # next epoch (test-pinned).
                 resolved = self._apply_wire_delta(st, delta)
                 if isinstance(resolved, str):
+                    trace_mod.mark("resync")
                     metrics.REGISTRY.counter(
                         "klba_delta_epochs_total", {"outcome": "resync"}
                     ).inc()
@@ -3444,6 +3498,10 @@ class AssignorServiceClient:
         # state (a late half-response would desynchronize every subsequent
         # request), so the socket is closed and rebuilt, never reused.
         self.reconnects = 0
+        # Trace id echoed by the LAST response envelope (success, shed,
+        # or error) — the client-side pivot from a wire outcome to the
+        # sidecar's kept trace (``{"method": "trace"}``).
+        self.last_trace_id: Optional[str] = None
         self._connect()
 
     def _connect(self) -> None:
@@ -3470,11 +3528,19 @@ class AssignorServiceClient:
         return line
 
     def request(self, method: str, params: Optional[Dict] = None) -> Any:
+        # Client echo of the causal context: a client calling from
+        # inside an active scope (the shim's lag-read trace, a peer
+        # coordinator's request scope) propagates it on the wire, so
+        # the sidecar's segment joins the caller's trace instead of
+        # rooting a new one.
+        traceparent = metrics.current_traceparent()
         with self._lock:
             self._next_id += 1
             req = {"id": self._next_id, "method": method}
             if params is not None:
                 req["params"] = params
+            if traceparent is not None:
+                req["traceparent"] = traceparent
             payload = json.dumps(req).encode() + b"\n"
             if self._file.closed:
                 # A previous request's reconnect died inside _connect()
@@ -3510,16 +3576,19 @@ class AssignorServiceClient:
                     ) from exc
                 line = self._round_trip(payload)
         resp = json.loads(line)
+        self.last_trace_id = resp.get("trace_id")
         if "error" in resp:
             shed = resp["error"].get("shed")
             if shed is not None:
                 # Rebuild the typed rejection so callers implement the
                 # backoff contract from fields, not by parsing the
                 # human-readable message.
-                raise ShedReject(
+                exc = ShedReject(
                     shed["class"], shed["rung"],
                     int(shed["retry_after_ms"]),
                 )
+                exc.trace_id = resp.get("trace_id")
+                raise exc
             raise RuntimeError(resp["error"]["message"])
         return resp["result"]
 

@@ -49,6 +49,7 @@ L012 (tools/lint.py) enforces it; this file and utils/observability.py
 
 from __future__ import annotations
 
+import itertools
 import json
 import logging
 import math
@@ -57,6 +58,8 @@ import threading
 import time
 from contextlib import contextmanager
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+from . import trace as trace_mod
 
 LOGGER = logging.getLogger(__name__)
 
@@ -387,22 +390,32 @@ def registry() -> Registry:
 # --- request scopes + spans ---------------------------------------------
 
 _tls = threading.local()
-_req_seq = [0]
-_req_seq_lock = threading.Lock()
+# itertools.count, not a locked cell: next() is one C-level call
+# (GIL-atomic) and a request id is minted per wire request inside the
+# <1% epoch budget.
+_req_seq = itertools.count(1)
 
 
 class _RequestCtx:
-    __slots__ = ("request_id", "spans", "stack", "start", "dumped_cell")
+    __slots__ = (
+        "request_id", "spans", "stack", "start", "dumped_cell",
+        "trace", "adopt_parent_rec", "device_ms",
+    )
 
     def __init__(
         self,
         request_id: str,
         start: float,
         dumped_cell: Optional[List[bool]] = None,
+        trace: Optional[trace_mod.TraceState] = None,
+        adopt_parent_rec: Optional[Dict[str, Any]] = None,
     ):
         self.request_id = request_id
         self.spans: List[Dict[str, Any]] = []
-        self.stack: List[str] = []
+        # Open-span stack of span RECORD dicts (innermost last): spans
+        # read their parent's name/span_id off the top, device phases
+        # accumulate device_ms onto every open record.
+        self.stack: List[Dict[str, Any]] = []
         self.start = start
         # One-auto-dump-per-request state, a shared CELL rather than a
         # plain bool: a scope adopted onto a worker thread
@@ -411,12 +424,21 @@ class _RequestCtx:
         self.dumped_cell = (
             dumped_cell if dumped_cell is not None else [False]
         )
+        # The trace this scope feeds (shared ACROSS threads by
+        # adopt_scope — TraceState mutation is GIL-atomic by design)
+        # and, on adopted worker scopes, the capture point's innermost
+        # open span RECORD: the worker's spans parent under it (by
+        # reference — ids are minted only if the trace is kept).
+        self.trace = trace
+        self.adopt_parent_rec = adopt_parent_rec
+        # This THREAD's device-phase time; folded into the trace at
+        # scope teardown (per-thread so concurrent phases never race a
+        # float read-modify-write).
+        self.device_ms = 0.0
 
 
 def mint_request_id() -> str:
-    with _req_seq_lock:
-        _req_seq[0] += 1
-        return f"req-{os.getpid()}-{_req_seq[0]}"
+    return f"req-{os.getpid()}-{next(_req_seq)}"
 
 
 def current_request_id() -> Optional[str]:
@@ -432,27 +454,131 @@ def current_timeline() -> List[Dict[str, Any]]:
 
 
 def current_open_spans() -> List[str]:
-    """The active request's still-open span stack, outermost first —
+    """The active request's still-open span NAMES, outermost first —
     at incident time (a dump) this names the phase the request died in."""
     ctx = getattr(_tls, "ctx", None)
-    return list(ctx.stack) if ctx is not None else []
+    if ctx is None:
+        return []
+    return [rec["name"] for rec in ctx.stack]
 
 
-@contextmanager
-def request_scope(request_id: Optional[str] = None) -> Iterator[str]:
-    """Scope a wire request: mints (or adopts) a request id, carries the
-    span timeline, and bounds the one-auto-dump-per-request rule.
-    Nested scopes are flattened: the outermost wins."""
-    outer = getattr(_tls, "ctx", None)
-    if outer is not None:
-        yield outer.request_id
+def current_trace() -> Optional[trace_mod.TraceState]:
+    """The active scope's trace state (None outside a traced scope)."""
+    ctx = getattr(_tls, "ctx", None)
+    return ctx.trace if ctx is not None else None
+
+
+def current_trace_id() -> Optional[str]:
+    tr = current_trace()
+    return tr.trace_id if tr is not None else None
+
+
+def current_traceparent() -> Optional[str]:
+    """The W3C context an OUTBOUND hop should carry: the active trace
+    id plus the innermost open span's id (falling back to the adopted
+    parent, then the trace root) — so the remote segment parents under
+    the span that made the call."""
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is None or ctx.trace is None:
+        return None
+    stack = ctx.stack
+    rec = stack[-1] if stack else ctx.adopt_parent_rec
+    if rec is None:
+        return ctx.trace.traceparent()
+    # Span ids are minted lazily (kept traces only) — an outbound hop
+    # forces the id here so the remote segment has a real parent.
+    sid = rec.get("span_id")
+    if sid is None:
+        sid = rec["span_id"] = trace_mod.mint_span_id()
+    return ctx.trace.traceparent(sid)
+
+
+def _teardown_ctx(ctx: _RequestCtx, finish: bool) -> None:
+    """Fold one thread's spans/device time into the shared trace; the
+    scope that OWNS the trace (request_scope / finish_scope) also runs
+    the tail-sampling decision."""
+    tr = ctx.trace
+    if tr is None:
         return
-    rid = request_id or mint_request_id()
-    _tls.ctx = _RequestCtx(rid, REGISTRY.clock())
-    try:
-        yield rid
-    finally:
-        _tls.ctx = None
+    if finish:
+        # Decide-first: a mint-doomed healthy trace (the dominant
+        # outcome at production sample rates) exits via fast_drop
+        # without duration math, span absorption, or span-id minting;
+        # only kept/undecided traces pay the full finish.
+        coll = trace_mod.COLLECTOR
+        if coll.fast_drop(tr):
+            return
+        duration_ms = (REGISTRY.clock() - ctx.start) * 1000.0
+        coll.finish(
+            tr, duration_ms, spans=ctx.spans, device_ms=ctx.device_ms
+        )
+    else:
+        tr.absorb(ctx.spans, ctx.device_ms)
+
+
+class _RequestScope:
+    """The :func:`request_scope` context manager, hand-rolled for the
+    same reason as :class:`_Span`: the ``@contextmanager`` generator
+    protocol costs ~2x per enter/exit, and the service opens one of
+    these per wire request inside the <1% epoch budget."""
+
+    __slots__ = ("_request_id", "_traceparent", "_kind", "_root_name",
+                 "_ctx")
+
+    def __init__(
+        self,
+        request_id: Optional[str],
+        traceparent: Optional[str],
+        kind: str,
+        root_name: Optional[str],
+    ):
+        self._request_id = request_id
+        self._traceparent = traceparent
+        self._kind = kind
+        self._root_name = root_name
+
+    def __enter__(self) -> str:
+        outer = getattr(_tls, "ctx", None)
+        if outer is not None:
+            # Nested scope: flatten — the outermost wins, and __exit__
+            # must not tear down a ctx it does not own.
+            self._ctx = None
+            return outer.request_id
+        rid = self._request_id or mint_request_id()
+        # Positional calls: this pair runs per wire request inside the
+        # <1% epoch budget, and CPython kwargs cost a dict build.
+        ctx = self._ctx = _RequestCtx(
+            rid, REGISTRY.clock(), None,
+            trace_mod.TraceState(
+                self._kind, self._root_name, rid, self._traceparent
+            ),
+        )
+        _tls.ctx = ctx
+        return rid
+
+    def __exit__(self, *exc: Any) -> bool:
+        ctx = self._ctx
+        if ctx is not None:
+            _tls.ctx = None
+            _teardown_ctx(ctx, finish=True)
+        return False
+
+
+def request_scope(
+    request_id: Optional[str] = None,
+    traceparent: Optional[str] = None,
+    kind: str = "request",
+    root_name: Optional[str] = None,
+) -> _RequestScope:
+    """Scope a wire request: mints (or adopts) a request id, roots a
+    trace (adopting ``traceparent``'s trace id when the caller sent a
+    valid one — the cross-process join), carries the span timeline, and
+    bounds the one-auto-dump-per-request rule.  ``kind``/``root_name``
+    name self-rooted non-wire traces (``background`` scrubber passes
+    and snapshot writes, ``client`` lag reads).  Nested scopes are
+    flattened: the outermost wins.  Scope exit runs the tail-sampling
+    retention decision on the finished trace."""
+    return _RequestScope(request_id, traceparent, kind, root_name)
 
 
 def capture_scope() -> Optional[_RequestCtx]:
@@ -469,19 +595,62 @@ def adopt_scope(token: Optional[_RequestCtx]) -> Iterator[Optional[str]]:
     would bypass the one-dump-per-request cap).  The worker gets its OWN
     span timeline — the parent may abandon the worker and dump while it
     still runs, so sharing the parent's mutable span list would race —
-    but shares the request id and the dump-dedup cell."""
+    but shares the request id, the dump-dedup cell, and the TRACE: the
+    worker's spans parent under the capture point's innermost open span
+    and land in the same tree.  The adopting side never finishes the
+    trace — the owning scope's exit does."""
     if token is None or getattr(_tls, "ctx", None) is not None:
         yield current_request_id()
         return
+    adopt_parent = None
+    if token.trace is not None:
+        # Best-effort snapshot: the capturing thread is normally parked
+        # in watchdog.call, but an abandoning parent may already be
+        # unwinding its stack — a copy keeps the read safe either way.
+        # The adoption point is the capture's innermost open span
+        # RECORD (ids stay lazy until the trace is kept).
+        stack = list(token.stack)
+        adopt_parent = stack[-1] if stack else token.adopt_parent_rec
     ctx = _RequestCtx(
         token.request_id, REGISTRY.clock(),
         dumped_cell=token.dumped_cell,
+        trace=token.trace,
+        adopt_parent_rec=adopt_parent,
     )
     _tls.ctx = ctx
     try:
         yield ctx.request_id
     finally:
         _tls.ctx = None
+        _teardown_ctx(ctx, finish=False)
+
+
+def begin_scope(
+    kind: str = "wave",
+    root_name: Optional[str] = None,
+    request_id: Optional[str] = None,
+) -> _RequestCtx:
+    """Mint a scope token WITHOUT installing it on any thread — the
+    coalescer's unit of work is a wave that spans the flusher thread
+    (dispatch) and a readback worker, with no single ``with`` block
+    covering both.  Each participating thread joins via
+    :func:`adopt_scope`; :func:`finish_scope` closes the trace exactly
+    once when the wave's last act (the readback) completes."""
+    rid = request_id or mint_request_id()
+    return _RequestCtx(
+        rid, REGISTRY.clock(),
+        trace=trace_mod.TraceState(
+            kind=kind, root_name=root_name, request_id=rid,
+        ),
+    )
+
+
+def finish_scope(token: Optional[_RequestCtx]) -> None:
+    """Run the retention decision for a :func:`begin_scope` token (the
+    token's own span list is empty — every participating thread already
+    absorbed its spans at ``adopt_scope`` exit)."""
+    if token is not None:
+        _teardown_ctx(token, finish=True)
 
 
 # Per-name cache of the span-duration histogram children: the span
@@ -520,12 +689,23 @@ class _Span:
         ctx = getattr(_tls, "ctx", None)
         self._ctx = ctx
         if ctx is not None:
-            self.rec = {
+            parent = ctx.stack[-1] if ctx.stack else None
+            rec = self.rec = {
                 "name": self.name,
-                "parent": ctx.stack[-1] if ctx.stack else None,
+                "parent": parent["name"] if parent is not None else None,
                 "duration_ms": 0.0,
             }
-            ctx.stack.append(self.name)
+            if ctx.trace is not None:
+                # The causal tree, deferred: the parent travels by
+                # REFERENCE (innermost open span, else the thread's
+                # adoption point, else None = the trace root) and real
+                # ids are minted only if the trace is KEPT
+                # (trace._resolve_span_ids) — a dropped healthy trace
+                # never pays for id minting on the warm path.
+                rec["_parent_rec"] = (
+                    parent if parent is not None else ctx.adopt_parent_rec
+                )
+            ctx.stack.append(rec)
         else:
             self.rec = None
         self._start = REGISTRY.clock()
@@ -574,19 +754,29 @@ class _DevicePhase:
     inputs), ``duals`` (the mirror-prox executable), ``rounding`` (the
     rounding/refine-portfolio executable), ``refine`` (the streaming
     refine step INCLUDING its digest readback — documented in
-    DEPLOYMENT.md "Kernel plane")."""
+    DEPLOYMENT.md "Kernel plane"), ``megabatch`` (the coalescer's
+    locked/restacked wave readback).  Inside a traced scope the phase
+    additionally accumulates ``device_ms`` onto every OPEN span record,
+    so epoch spans carry ``{host_ms: duration_ms, device_ms}`` and the
+    ROADMAP's "tunnel-confounded" host timings become separable."""
 
-    __slots__ = ("phase", "_start")
+    __slots__ = ("phase", "_start", "_ctx")
 
     def __init__(self, phase: str):
         self.phase = phase
 
     def __enter__(self) -> "_DevicePhase":
+        self._ctx = getattr(_tls, "ctx", None)
         self._start = REGISTRY.clock()
         return self
 
     def __exit__(self, *exc) -> bool:
         dur = (REGISTRY.clock() - self._start) * 1000.0
+        ctx = self._ctx
+        if ctx is not None:
+            for rec in ctx.stack:
+                rec["device_ms"] = rec.get("device_ms", 0.0) + dur
+            ctx.device_ms += dur
         _device_phase_hist(self.phase).observe(dur)
         return False
 
@@ -665,15 +855,18 @@ _REDACTED_KEYS = frozenset(
 def _redact(obj: Any) -> Any:
     if isinstance(obj, dict):
         if _REDACTED_KEYS.isdisjoint(obj) and not any(
-            isinstance(v, (dict, list, tuple)) for v in obj.values()
+            isinstance(v, (dict, list, tuple)) or k.startswith("_")
+            for k, v in obj.items()
         ):
             # Flat, clean dict (the per-epoch hot case): nothing to
             # strip, no copy.  The recorder takes ownership of records,
             # so aliasing the caller's dict is safe by contract.
             return obj
+        # Underscore keys are in-process plumbing (a span record's
+        # ``_parent_rec`` reference), never export material.
         return {
             k: _redact(v) for k, v in obj.items()
-            if k not in _REDACTED_KEYS
+            if k not in _REDACTED_KEYS and not k.startswith("_")
         }
     if isinstance(obj, (list, tuple)):
         return [_redact(v) for v in obj]
@@ -729,9 +922,15 @@ class FlightRecorder:
         per warm epoch inside the <1% overhead budget, dumping runs once
         per incident."""
         rec["kind"] = kind
-        rid = current_request_id()
-        if rid is not None and "request_id" not in rec:
-            rec["request_id"] = rid
+        ctx = getattr(_tls, "ctx", None)
+        if ctx is not None:
+            if "request_id" not in rec:
+                rec["request_id"] = ctx.request_id
+            # Satellite of the tracing plane: every flight record made
+            # inside a traced scope names its trace, so an incident
+            # dump links straight to the kept trace.
+            if ctx.trace is not None and "trace_id" not in rec:
+                rec["trace_id"] = ctx.trace.trace_id
         with self._lock:
             rec["seq"] = self._total
             self._ring[self._idx] = rec
@@ -787,7 +986,8 @@ class FlightRecorder:
             "reason": reason,
             "dump_seq": seq,
             "request_id": current_request_id(),
-            "in_flight_spans": current_timeline(),
+            "trace_id": current_trace_id(),
+            "in_flight_spans": _redact(current_timeline()),
             "open_spans": current_open_spans(),
             "detail": _redact(detail) if detail else None,
             # Redacted HERE (stats only leave the process), so the hot

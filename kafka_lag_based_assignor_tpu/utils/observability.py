@@ -22,6 +22,7 @@ from dataclasses import asdict, dataclass, field
 from typing import Dict, Iterator, List, Optional
 
 from . import metrics
+from . import trace as trace_mod
 
 LOGGER = logging.getLogger("kafka_lag_based_assignor_tpu")
 
@@ -113,7 +114,10 @@ _TRIPS_NAME = "klba_breaker_trips_total"
 
 def note_breaker_trip(key: str) -> None:
     """Record one breaker trip (called by utils/watchdog on every
-    closed/half-open -> open transition)."""
+    closed/half-open -> open transition).  Also an always-keep anomaly
+    on the active trace — the request that tripped the breaker is
+    exactly the one tail sampling must retain."""
+    trace_mod.mark("breaker")
     metrics.REGISTRY.counter(_TRIPS_NAME, {"key": key}).inc()
     metrics.FLIGHT.auto_dump("breaker_trip", {"key": key})
 

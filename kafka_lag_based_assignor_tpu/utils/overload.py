@@ -89,6 +89,7 @@ import threading
 from typing import Any, Callable, Dict, Mapping, Optional, Sequence, Tuple
 
 from . import faults, metrics
+from . import trace as trace_mod
 
 LOGGER = logging.getLogger(__name__)
 
@@ -175,15 +176,19 @@ def record_shed(
     served: Optional[str],
     stream_id: Optional[str] = None,
     request_id: Optional[str] = None,
+    scope: Optional[Any] = None,
 ) -> None:
     """Account one shed event — ``klba_shed_total{class,rung}`` plus a
-    flight record — with ONE schema no matter which layer shed the
-    request (the controller's ladder or the coalescer's deadline
-    triage).  ``served`` is what the client got (``kept_previous`` /
-    ``rejected``), or None when the shedding layer cannot know (the
-    coalescer sheds before the submitter's recovery picks the answer).
-    ``request_id`` is only needed from threads outside the request
-    scope (the flight recorder attaches the in-scope id itself)."""
+    flight record and a ``shed`` anomaly mark on the indicted trace
+    (tail sampling ALWAYS keeps shed traces) — with ONE schema no
+    matter which layer shed the request (the controller's ladder or
+    the coalescer's deadline triage).  ``served`` is what the client
+    got (``kept_previous`` / ``rejected``), or None when the shedding
+    layer cannot know (the coalescer sheds before the submitter's
+    recovery picks the answer).  ``request_id``/``scope`` are only
+    needed from threads outside the request scope — the coalescer
+    flusher shedding a parked submitter's row passes the submitter's
+    captured scope token so the mark lands on THAT trace."""
     key = (klass, rung_name)
     counter = _SHED_COUNTERS.get(key)
     if counter is None:
@@ -191,6 +196,10 @@ def record_shed(
             "klba_shed_total", {"class": klass, "rung": rung_name}
         )
     counter.inc()
+    if scope is not None:
+        trace_mod.mark_state(getattr(scope, "trace", None), "shed")
+    else:
+        trace_mod.mark("shed")
     rec: Dict[str, Any] = {
         "class": klass,
         "rung": rung_name,
@@ -199,6 +208,8 @@ def record_shed(
     }
     if request_id is not None:
         rec["request_id"] = request_id
+    if scope is not None and getattr(scope, "trace", None) is not None:
+        rec.setdefault("trace_id", scope.trace.trace_id)
     metrics.FLIGHT.record("shed", rec)
 
 
@@ -215,6 +226,9 @@ class ShedReject(RuntimeError):
         self.klass = klass
         self.rung = rung
         self.retry_after_ms = retry_after_ms
+        # Stamped by the service CLIENT when it rebuilds the rejection
+        # from an error envelope: the shedding sidecar's trace id.
+        self.trace_id: Optional[str] = None
 
 
 class SloPolicy:

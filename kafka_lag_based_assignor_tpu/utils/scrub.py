@@ -84,6 +84,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from . import faults, metrics
+from . import trace as trace_mod
 from .watchdog import SolveRejected
 
 LOGGER = logging.getLogger(__name__)
@@ -167,8 +168,12 @@ def record_quarantine(
     """Account one quarantine-plane event with ONE schema no matter
     which layer detected it (per-epoch digest, scrubber audit, or the
     coalescer's row check): ``klba_quarantine_total{buffer,outcome}``
-    plus a ``quarantine`` flight record.  Runs only on failure/heal
-    paths, so the registry's own get-or-create is plenty."""
+    plus a ``quarantine`` flight record and a ``quarantine`` anomaly
+    mark on the active trace (quarantines are always-keep for the tail
+    sampler, whichever scope — request, scrub pass, or coalescer wave —
+    detected them).  Runs only on failure/heal paths, so the registry's
+    own get-or-create is plenty."""
+    trace_mod.mark("quarantine")
     for buffer in buffers:
         metrics.REGISTRY.counter(
             "klba_quarantine_total",
@@ -383,12 +388,22 @@ class StateScrubber:
 
     def scrub_once(self) -> Dict[str, int]:
         """One deadline-budgeted pass (also the drill/test entry point);
-        returns ``{audited, busy, suppressed}`` counts."""
+        returns ``{audited, busy, suppressed}`` counts.  Runs as a
+        self-rooted ``background`` trace (root ``scrub.pass``) linked
+        to every stream it audits — a quarantine found here marks the
+        pass anomalous, so tail sampling keeps it.  An outer scope, if
+        already active (a drill inside a request), wins instead."""
         if self._suppress():
             # Overload rung >= 2: the device has no spare bandwidth for
             # audits — integrity resumes when the ladder steps down.
             self._m_skipped["overload"].inc()
             return {"audited": 0, "busy": 0, "suppressed": 1}
+        with metrics.request_scope(
+            kind="background", root_name="scrub.pass"
+        ):
+            return self._scrub_pass()
+
+    def _scrub_pass(self) -> Dict[str, int]:
         started = self._clock()
         deadline = started + self.budget_s
         jobs = self._targets()
@@ -410,6 +425,9 @@ class StateScrubber:
             if outcome == "audited":
                 audited += 1
                 self._m_audited.inc()
+                tr = metrics.current_trace()
+                if tr is not None:
+                    tr.link_stream(sid)
             elif outcome == "busy":
                 busy += 1
                 self._m_skipped["busy"].inc()

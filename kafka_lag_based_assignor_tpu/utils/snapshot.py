@@ -1366,15 +1366,26 @@ class SnapshotWriter:
 
     def write_now(self) -> Dict[str, Any]:
         """One synchronous snapshot through the store (the drain's
-        final write and the operator's on-demand path)."""
-        try:
-            return self._store.save(self._collect())
-        except Exception as exc:  # noqa: BLE001 — collector fail-open
-            LOGGER.warning(
-                "snapshot collection failed; skipping this write",
-                exc_info=True,
-            )
-            return {"ok": False, "error": str(exc)}
+        final write and the operator's on-demand path).  Runs as a
+        self-rooted ``background`` trace (root ``snapshot.write``)
+        linked to every stream whose warm state it persisted — lease
+        activity inside the store's save lands in the same trace."""
+        with metrics.request_scope(
+            kind="background", root_name="snapshot.write"
+        ):
+            try:
+                payload = self._collect()
+                tr = metrics.current_trace()
+                if tr is not None:
+                    for sid in (payload.get("streams") or {}):
+                        tr.link_stream(sid)
+                return self._store.save(payload)
+            except Exception as exc:  # noqa: BLE001 — collector fail-open
+                LOGGER.warning(
+                    "snapshot collection failed; skipping this write",
+                    exc_info=True,
+                )
+                return {"ok": False, "error": str(exc)}
 
     def _run(self) -> None:
         while True:

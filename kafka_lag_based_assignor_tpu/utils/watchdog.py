@@ -48,6 +48,7 @@ import time
 from typing import Any, Callable, Dict, Optional, TypeVar
 
 from . import metrics
+from . import trace as trace_mod
 from .observability import note_breaker_trip
 
 LOGGER = logging.getLogger(__name__)
@@ -439,6 +440,10 @@ class Watchdog:
             worker.start()
             if not done.wait(effective):
                 metrics.REGISTRY.counter(_TIMEOUTS, {"key": key}).inc()
+                # An abandoned solve is an always-keep trace anomaly:
+                # the caller's thread still owns the request scope
+                # here (the worker only ADOPTED it).
+                trace_mod.mark("timeout")
                 # "Truncated" = the ladder handed the device a residual
                 # budget well below the request's full window — the
                 # configured timeout, or the caller's (smaller) initial

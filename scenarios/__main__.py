@@ -46,6 +46,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="write the fleet artifact (scenario rows + verdicts)",
     )
     parser.add_argument(
+        "--trace-json", type=Path, metavar="FILE",
+        help=(
+            "write one kept anomalous trace (utils/trace tail sampler) "
+            "from the run — the CI step uploads it so every fleet run "
+            "leaves a reconstructable causal trace behind"
+        ),
+    )
+    parser.add_argument(
         "--list", action="store_true",
         help="list the catalog and exit",
     )
@@ -81,6 +89,28 @@ def main(argv: Optional[List[str]] = None) -> int:
             json.dumps(fleet, indent=2, default=str), encoding="utf-8"
         )
         print(f"artifact written to {args.json}")
+
+    if args.trace_json is not None:
+        from kafka_lag_based_assignor_tpu.utils import trace as trace_mod
+
+        coll = trace_mod.collector()
+        want = coll.last_anomalous_trace_id
+        entries = coll.traces(trace_id=want) if want is not None else []
+        args.trace_json.write_text(
+            json.dumps(
+                {
+                    "trace_id": want,
+                    "stats": coll.stats(),
+                    "entries": entries,
+                },
+                indent=2, default=str,
+            ),
+            encoding="utf-8",
+        )
+        print(
+            f"anomalous trace {want or '<none kept>'} written to "
+            f"{args.trace_json}"
+        )
 
     failed = [r for r in fleet["scenarios"] if r["violations"]]
     print(

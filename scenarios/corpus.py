@@ -270,6 +270,13 @@ def run_scenario(
         "restarted_at": result.restarted_at,
         "recovery": result.recovery,
         "twin_mismatches": result.twin_mismatches,
+        "trace_stats": result.trace_stats,
+        "anomalous_trace_ids": sorted({
+            r.trace_id for r in result.records
+            if r.trace_id is not None and (
+                r.shed is not None or r.resync or r.rung != "none"
+            )
+        }),
         "violations": violations,
         "reproduce": (
             f"python -m scenarios --only {sc.name} --seed {seed}"

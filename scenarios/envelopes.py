@@ -61,6 +61,14 @@ class Envelope:
     # Crash/restart drills: every compared epoch must be bit-exact
     # against the unfaulted, uninterrupted twin replay.
     require_bit_exact_recovery: bool = False
+    # Anomaly-biased tail sampling (utils/trace): every record that
+    # degraded — shed, served a rung below "none", or resynced — must
+    # have its trace in the kept set (100% anomaly retention), while
+    # healthy-trace retention stays near the configured sample rate
+    # (bounded at rate * healthy + slack, so a sampler that silently
+    # keeps everything fails the envelope too).
+    require_anomaly_traces: bool = True
+    healthy_trace_slack: int = 8
 
 
 def evaluate(result, envelope: Envelope) -> List[str]:
@@ -181,4 +189,43 @@ def evaluate(result, envelope: Envelope) -> List[str]:
                 f"{result.twin_mismatches} epoch(s) diverged from the "
                 "unfaulted twin after recovery"
             )
+
+    if envelope.require_anomaly_traces:
+        kept = set(result.kept_trace_ids)
+        anomalous = [
+            r for r in recs
+            if r.shed is not None or r.resync
+            or (r.ok and RUNG_ORDER.get(r.rung, 0) > 0)
+        ]
+        missing = sorted({
+            r.trace_id for r in anomalous
+            if r.trace_id is not None and r.trace_id not in kept
+        })
+        unstamped = sum(1 for r in anomalous if r.trace_id is None)
+        if missing:
+            v.append(
+                f"{len(missing)} anomalous trace(s) not retained by "
+                f"the tail sampler (e.g. {missing[0]})"
+            )
+        if unstamped:
+            v.append(
+                f"{unstamped} anomalous record(s) carried no trace id"
+            )
+        stats = result.trace_stats or {}
+        rate = stats.get("sample_rate")
+        if rate is not None and rate < 0.5:
+            # Healthy retention must track the configured rate: the
+            # 0.5x coefficient is deliberately loose (the hash keep is
+            # binomial) while still failing a sampler that keeps all.
+            healthy = (
+                int(stats.get("kept_sampled", 0))
+                + int(stats.get("dropped", 0))
+            )
+            bound = 0.5 * healthy + envelope.healthy_trace_slack
+            if stats.get("kept_sampled", 0) > bound:
+                v.append(
+                    "healthy-trace retention "
+                    f"{stats.get('kept_sampled')} of {healthy} exceeds "
+                    f"the rate-{rate} envelope bound {bound:.0f}"
+                )
     return v

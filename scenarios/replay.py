@@ -47,6 +47,7 @@ from kafka_lag_based_assignor_tpu.testing import (
     shed_totals_by_class,
 )
 from kafka_lag_based_assignor_tpu.utils import faults, metrics
+from kafka_lag_based_assignor_tpu.utils import trace as trace_mod
 from kafka_lag_based_assignor_tpu.utils.observability import (
     compile_count,
     install_compile_counter,
@@ -75,6 +76,7 @@ class EpochRecord:
     quality_ratio: Optional[float] = None
     latency_ms: Optional[float] = None
     choice: Optional[np.ndarray] = None
+    trace_id: Optional[str] = None
 
 
 @dataclass
@@ -94,6 +96,8 @@ class ReplayResult:
     recovery: Dict[str, Any] = field(default_factory=dict)
     wall_s: float = 0.0
     twin_mismatches: Optional[int] = None
+    trace_stats: Dict[str, Any] = field(default_factory=dict)
+    kept_trace_ids: List[str] = field(default_factory=list)
 
     def choices(self) -> Dict[Tuple[int, str], bytes]:
         """(epoch, stream) -> choice bytes, for twin comparison."""
@@ -133,6 +137,7 @@ def replay(
     client_timeout_s: float = 300.0,
     tune: Optional[Callable[[AssignorService], None]] = None,
     epoch_sleep_s: float = 0.0,
+    trace_sample_rate: float = 0.125,
 ) -> ReplayResult:
     """Run one trace against a fresh sidecar; see the module docstring.
 
@@ -149,7 +154,11 @@ def replay(
     snapshot file (scenarios that exercise snapshot-write fault
     planes without a crash).  ``epoch_sleep_s`` paces epochs apart —
     time-based background planes (the periodic snapshot writer) need
-    wall time to fire at all on a CPU-fast trace."""
+    wall time to fire at all on a CPU-fast trace.  ``trace_sample_rate``
+    pins the tail sampler's healthy-trace rate for the run (anomalous
+    traces are always kept regardless); the per-record ``trace_id`` plus
+    ``ReplayResult.trace_stats``/``kept_trace_ids`` deltas are what the
+    retention envelope gates on."""
     install_compile_counter()
     kwargs: Dict[str, Any] = dict(service_kwargs or {})
     if kwargs.get("snapshot_path") == "auto" or (
@@ -169,6 +178,16 @@ def replay(
     )
     shed_before = shed_totals_by_class()
     quarantine_before = _quarantine_total()
+    # The sidecar runs in-process, so the global trace collector sees
+    # this replay's traces; pin the healthy sample rate, widen the ring
+    # past any plausible scenario volume (retention must be judged on
+    # the FULL run, not the ring tail), and bracket by deltas.
+    coll = trace_mod.collector()
+    trace_prev = (coll.sample_rate, coll.capacity)
+    coll.sample_rate = float(trace_sample_rate)
+    coll.capacity = max(coll.capacity, 8192)
+    trace_counts_before = coll.stats()
+    kept_before = set(coll.kept_ids())
 
     svc = AssignorService(port=0, **kwargs).start()
     if tune is not None:
@@ -208,18 +227,21 @@ def replay(
             "lags": [[i, v] for i, v in enumerate(se.lags)],
             "slo_class": se.slo_class,
         }
+        cl = client_for(se.stream_id)
         t0 = time.perf_counter()
         try:
-            r = client_for(se.stream_id).request("stream_assign", params)
+            r = cl.request("stream_assign", params)
         except ShedReject as exc:
             rec.shed = {
                 "class": exc.klass, "rung": exc.rung,
                 "retry_after_ms": exc.retry_after_ms,
             }
+            rec.trace_id = getattr(exc, "trace_id", None)
             return rec
         except (ConnectionError, RuntimeError) as exc:
             rec.error = f"{type(exc).__name__}: {exc}"
             return rec
+        rec.trace_id = cl.last_trace_id
         rec.latency_ms = (time.perf_counter() - t0) * 1000.0
         rec.ok = True
         s = r["stream"]
@@ -294,6 +316,16 @@ def replay(
         if pool is not None:
             pool.shutdown(wait=True)
         svc.stop()
+        after = coll.stats()
+        result.trace_stats = {
+            k: int(after[k]) - int(trace_counts_before[k])
+            for k in ("kept_anomalous", "kept_sampled", "dropped")
+        }
+        result.trace_stats["sample_rate"] = float(trace_sample_rate)
+        result.kept_trace_ids = [
+            t for t in coll.kept_ids() if t not in kept_before
+        ]
+        coll.sample_rate, coll.capacity = trace_prev
 
     result.sheds_by_class = {
         str(k): v - shed_before.get(k, 0)

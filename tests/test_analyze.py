@@ -94,9 +94,9 @@ def test_shim_runs_exactly_the_legacy_ruleset():
 
 
 def test_registry_covers_catalog():
-    for code in LEGACY_CODES + ("A001", "A002", "A003", "A004"):
+    for code in LEGACY_CODES + ("A001", "A002", "A003", "A004", "A005"):
         assert code in REGISTRY, code
-    for code in ("A001", "A002", "A003", "A004"):
+    for code in ("A001", "A002", "A003", "A004", "A005"):
         assert REGISTRY[code].waivable
     assert not REGISTRY["L007"].waivable  # monolith semantics kept
 
@@ -670,6 +670,78 @@ def test_a004_no_wire_surface_is_vacuous():
     """
     rep = run_snippet(STREAMING, src)
     assert codes_of(rep, "A004") == []
+
+
+# --- A005 span-name catalog -----------------------------------------------
+
+TRACE = "kafka_lag_based_assignor_tpu/utils/trace.py"
+
+A005_CATALOG = """\
+SPAN_CATALOG = frozenset({
+    "stream.epoch",
+    "stream.refine",
+})
+"""
+
+
+def test_a005_detects_unregistered_span_name():
+    rep = analyze_sources({
+        TRACE: A005_CATALOG,
+        STREAMING: textwrap.dedent("""\
+        def epoch(metrics):
+            with metrics.span("stream.epoch"):
+                with metrics.span("stream.mystery"):
+                    return {}
+        """),
+    })
+    found = codes_of(rep, "A005")
+    assert len(found) == 1
+    assert found[0].path == STREAMING
+    assert found[0].line == 3
+    assert "`stream.mystery`" in found[0].message
+    assert "SPAN_CATALOG" in found[0].message
+
+
+def test_a005_wire_and_dynamic_spans_exempt():
+    """``wire.*`` literals are A004's surface and f-string names are
+    dynamic by design — neither reads against the catalog."""
+    rep = analyze_sources({
+        TRACE: A005_CATALOG,
+        SERVICE: textwrap.dedent("""\
+        def handle(metrics, label):
+            with metrics.span("wire.ping"):
+                with metrics.span(f"peer.{label}"):
+                    return {}
+        """),
+    })
+    assert codes_of(rep, "A005") == []
+
+
+def test_a005_without_catalog_is_vacuous():
+    """An analyzed set not containing utils/trace.py (e.g. a --changed
+    pre-commit slice) asserts nothing rather than flagging every span."""
+    rep = run_snippet(
+        STREAMING,
+        """\
+        def epoch(metrics):
+            with metrics.span("stream.mystery"):
+                return {}
+        """,
+    )
+    assert codes_of(rep, "A005") == []
+
+
+def test_a005_waived_with_reason():
+    rep = analyze_sources({
+        TRACE: A005_CATALOG,
+        STREAMING: textwrap.dedent("""\
+        def epoch(metrics):
+            with metrics.span("stream.mystery"):  # noqa: A005 — probe
+                return {}
+        """),
+    })
+    assert codes_of(rep, "A005") == []
+    assert codes_of(rep, "W001") == []
 
 
 # --- W001 waiver accounting -----------------------------------------------

@@ -5,7 +5,7 @@ XLA round scan (`ops/rounds_kernel._rounds_scan`) on every admissible
 instance — same theorem, same per-round contract.  These tests run the
 kernel in the Pallas interpreter on CPU (the same strategy that
 validates the plan-stats kernel); hardware timing is probed separately
-(tools/probe_round6.py).
+(retired probe, git history).
 """
 
 import numpy as np

@@ -2,8 +2,8 @@
 
 These back the latency-critical kernels (rounds/scan/refine) on the TPU
 target, where XLA serializes dynamic-index scatters while a P-sized sort
-is ~0.4 ms (fetch-synchronized measurement, tools/probe_round5d.py — the
-earlier probe_ops.py numbers were dispatch-time artifacts); correctness
+is ~0.4 ms (fetch-synchronized measurement, retired probe, git history — the
+earlier probe numbers were dispatch-time artifacts); correctness
 here is what makes the scatter->sort rewrites safe.
 """
 

@@ -1,7 +1,7 @@
 """The ``klba-analyze`` command line (also ``python -m tools.analyze``).
 
 Default run: every repo python file through the full ruleset
-(L001-L021 legacy + A001-A004 deep + W001 waiver accounting), text
+(L001-L021 legacy + A001-A005 deep + W001 waiver accounting), text
 report to stdout, exit 1 on any finding.  ``--changed`` analyzes only
 the files git reports as changed (working tree + commits past the
 merge base, :func:`git_changed_files`) — a pre-commit hook touches a

@@ -1,4 +1,4 @@
-"""Deep whole-program analyses A001-A004 — the invariants the bench
+"""Deep whole-program analyses A001-A005 — the invariants the bench
 gates and chaos soaks only catch at runtime, proven at review time.
 
   A001  donation safety: a value passed at a ``donate_argnums`` /
@@ -30,6 +30,13 @@ gates and chaos soaks only catch at runtime, proven at review time.
         ``method == "X"`` dispatch branch must be IN ``_KNOWN_METHODS``
         — a branch outside it serves under the span/metric label
         "unknown", making its latency unattributable.
+  A005  span-name catalog: every literal ``span("...")`` name in
+        package code must be registered in ``utils/trace.py``'s
+        ``SPAN_CATALOG`` — an unregistered name fragments the trace
+        vocabulary (dashboards, the trace wire view, and the span
+        histograms key on these strings), and a typo'd name silently
+        mints a new series instead of failing.  ``wire.*`` names are
+        A004's surface; f-string spans are dynamic by design.
 
 All of these collect JSON-serializable per-file facts (cacheable) and
 finalize over the merged set, so a donor defined in ops/streaming.py is
@@ -1185,4 +1192,90 @@ def collect_a004(ctx: FileContext) -> Dict[str, Any]:
         "literal_spans": literal_spans,
         "dynamic_span": dynamic,
         "dispatch_eq": dispatch_eq,
+    }
+
+
+# --- A005 span-name catalog ------------------------------------------------
+
+
+def _a005_span_catalog(tree: ast.Module) -> Optional[Dict[str, Any]]:
+    """The file's ``SPAN_CATALOG = frozenset({...})`` definition, as
+    ``{"names": [...], "line": n}`` — the registered span vocabulary
+    A005 checks literal ``span("...")`` names against."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == "SPAN_CATALOG"
+            for t in node.targets
+        ):
+            continue
+        call = node.value
+        if not (
+            isinstance(call, ast.Call)
+            and _expr_terminal(call.func) == "frozenset"
+            and call.args
+        ):
+            continue
+        elts = getattr(call.args[0], "elts", None)
+        if elts is None:
+            continue
+        names = [
+            e.value
+            for e in elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        ]
+        if names:
+            return {"names": sorted(names), "line": node.lineno}
+    return None
+
+
+def _finalize_a005(facts: Dict[str, Any]) -> Iterator[Finding]:
+    catalog: Optional[Dict[str, Any]] = None
+    for f in facts.values():
+        if f.get("catalog"):
+            catalog = f["catalog"]
+            break
+    if catalog is None:
+        return  # no span catalog in the analyzed set: nothing to prove
+    registered = set(catalog["names"])
+    for f in facts.values():
+        for name, line in f.get("span_literals", []):
+            if name in registered:
+                continue
+            yield Finding(
+                f["rel"],
+                line,
+                "A005",
+                f"span name `{name}` is not registered in utils/"
+                "trace.py SPAN_CATALOG — an unregistered literal "
+                "fragments the trace vocabulary (and a typo mints a "
+                "new series instead of failing); add it to "
+                "SPAN_CATALOG or waive with `# noqa: A005`",
+            )
+
+
+@deep_rule(
+    "A005",
+    "literal span name outside the registered catalog",
+    finalize=_finalize_a005,
+    applies=lambda ctx: ctx.is_package,
+)
+def collect_a005(ctx: FileContext) -> Dict[str, Any]:
+    span_literals: List[Tuple[str, int]] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _expr_terminal(node.func) != "span" or not node.args:
+            continue
+        a = node.args[0]
+        if not (isinstance(a, ast.Constant) and isinstance(a.value, str)):
+            continue  # f-string / computed names are dynamic by design
+        if a.value.startswith("wire."):
+            continue  # the wire surface is A004's contract
+        span_literals.append((a.value, node.lineno))
+    return {
+        "rel": ctx.rel,
+        "catalog": _a005_span_catalog(ctx.tree),
+        "span_literals": span_literals,
     }

@@ -173,6 +173,12 @@ def main() -> int:
             # age, last recovery, scrub coverage) come from the stats
             # surface, not the registry.
             stats = client.request("stats") if args.summary else {}
+            trace_view = None
+            if args.summary:
+                try:
+                    trace_view = client.request("trace", {"limit": 1})
+                except Exception:  # noqa: BLE001 — older sidecar
+                    trace_view = None
             lifecycle = stats.get("lifecycle")
             scrub = stats.get("scrub")
             federation = stats.get("federation")
@@ -354,6 +360,21 @@ def main() -> int:
                     f"rung={s['labels'].get('rung')}: {s['value']}"
                 )
             print(f"shed total: {int(total)}")
+
+        # Tracing view (DEPLOYMENT.md "Distributed tracing"): the tail
+        # sampler's retention split and the last anomalous trace id —
+        # the "is anything degrading, and which trace explains it"
+        # look.  Sourced from the {"method": "trace"} wire view.
+        if trace_view:
+            ts = trace_view.get("stats") or {}
+            last = ts.get("last_anomalous_trace_id")
+            print(
+                f"trace: kept_anomalous={ts.get('kept_anomalous', 0)} "
+                f"kept_sampled={ts.get('kept_sampled', 0)} "
+                f"dropped={ts.get('dropped', 0)} "
+                f"(rate {ts.get('sample_rate')}), "
+                f"last anomalous {last or '<none>'}"
+            )
 
         # Lifecycle view: serving/draining state, snapshot freshness,
         # and the last recovery's outcome — the "would a restart be a
