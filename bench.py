@@ -3315,6 +3315,291 @@ def config17_tracing():
     }
 
 
+def config18_delta_roundtrip():
+    """Delta round-trip probe (ISSUE 19): the O(changed) READBACK half
+    of the delta plane plus the async-gossip federated serve path, over
+    a steady-state 1% churn drift.  What must hold (gated in main AND
+    in the tier-1 workflow's probe step via
+    :func:`delta_roundtrip_gates`, every backend — bytes and warm-cache
+    routing are shape/config facts, not hardware ones): every epoch of
+    the delta engine is BIT-IDENTICAL to an always-dense twin over the
+    same seeded drift, every epoch takes the O(changed) readback
+    (klba_rb_delta_epochs_total{outcome=applied}, zero dense d2h bytes
+    charged to the delta engine), the per-epoch d2h bytes
+    (klba_d2h_bytes_total{path=delta}) are >= 20x below the dense
+    twin's, zero fresh XLA compiles in either measured loop (the
+    compaction tail rides the resident refine executables' existing
+    compile keys), and — with a warm gossip cache —
+    ``federated_assign`` serves rung global from the cache in ONE
+    local round (p50 warm_cache true, no synchronous peer RTT) at
+    quality within 1.001x of the synchronous exchange on identical
+    lags."""
+    import socket as socket_mod
+
+    from kafka_lag_based_assignor_tpu.ops.streaming import (
+        StreamingAssignor,
+    )
+    from kafka_lag_based_assignor_tpu.service import (
+        AssignorService,
+        AssignorServiceClient,
+    )
+    from kafka_lag_based_assignor_tpu.utils import metrics as klba_metrics
+    from kafka_lag_based_assignor_tpu.utils.observability import (
+        compile_count,
+        install_compile_counter,
+    )
+    from kafka_lag_based_assignor_tpu.warmup import warmup
+
+    install_compile_counter()
+
+    # ---- Phase A: O(changed) readback at 1% churn ----------------------
+    # refine_iters=64 keeps the compaction width K = pow2(2*64) = 128
+    # well under the 1%-churn dense narrow vector at this P, so the
+    # >= 20x gate reads the plane's design margin, not a knife edge.
+    P, C, epochs = 16384, 16, 12
+    churn = max(1, int(0.01 * P))
+    iters = 64
+    rng = np.random.default_rng(18)
+    base = rng.integers(10**5, 10**6, P).astype(np.int64)
+
+    # Dense + delta executables (incl. the K-tailed readback variants)
+    # off the measured path; must match refine_iters — the exchange
+    # budget is a static compile key.
+    warmup(
+        max_partitions=P, consumers=[C], solvers=("stream",),
+        stream_refine_iters=iters,
+    )
+
+    d2h_dense_c = klba_metrics.REGISTRY.counter(
+        "klba_d2h_bytes_total", {"path": "dense"}
+    )
+    d2h_delta_c = klba_metrics.REGISTRY.counter(
+        "klba_d2h_bytes_total", {"path": "delta"}
+    )
+    rb_applied_c = klba_metrics.REGISTRY.counter(
+        "klba_rb_delta_epochs_total", {"outcome": "applied"}
+    )
+
+    def drive(delta_enabled: bool):
+        eng = StreamingAssignor(
+            num_consumers=C, refine_iters=iters, refine_threshold=None,
+            delta_enabled=delta_enabled,
+        )
+        seq = np.random.default_rng(1899)  # IDENTICAL drift both drives
+        lags = base.copy()
+        choices = [np.asarray(eng.rebalance(lags))]  # cold, unmeasured
+        before = (
+            d2h_dense_c.value, d2h_delta_c.value, rb_applied_c.value,
+            compile_count(),
+        )
+        for _ in range(epochs):
+            idx = seq.choice(P, size=churn, replace=False)
+            lags = lags.copy()
+            lags[idx] = seq.integers(10**5, 10**6, churn)
+            choices.append(np.asarray(eng.rebalance(lags)))
+        after = (
+            d2h_dense_c.value, d2h_delta_c.value, rb_applied_c.value,
+            compile_count(),
+        )
+        return choices, [a - b for a, b in zip(after, before)]
+
+    dense_choices, dense_counts = drive(False)
+    delta_choices, delta_counts = drive(True)
+    mismatched = sum(
+        int(not np.array_equal(a, b))
+        for a, b in zip(dense_choices, delta_choices)
+    )
+    dense_per_epoch = dense_counts[0] / epochs
+    delta_per_epoch = delta_counts[1] / epochs
+    log(
+        f"delta_roundtrip: d2h dense {dense_per_epoch:.0f} B/epoch vs "
+        f"delta {delta_per_epoch:.0f} B/epoch "
+        f"({dense_per_epoch / max(delta_per_epoch, 1e-9):.1f}x), "
+        f"rb applied {delta_counts[2]}/{epochs}"
+    )
+
+    # ---- Phase B: federated serve from the warm gossip cache -----------
+    # Two sidecars, sidecar a with the gossip daemon on a 100 ms
+    # jittered cadence (freshness window 2.5x that — comfortably wider
+    # than a CPU-backend local round, so a loaded runner still serves
+    # warm).  Fixed lags per side: the sync reference and the warm
+    # serves then answer the SAME problem, so the quality ratio isolates
+    # the cache (converged duals are identical -> ratio 1.0 by design).
+    Pf, Cf = 2048, 8
+    members = [f"m{j}" for j in range(Cf)]
+    frng = np.random.default_rng(0x18F)
+    shards = [
+        frng.integers(0, 10**6, Pf).astype(np.int64) for _ in range(2)
+    ]
+
+    def rows(arr):
+        return [[i, int(v)] for i, v in enumerate(arr)]
+
+    def quality(assignments, lags):
+        loads = [
+            sum(int(lags[p]) for _t, p in tps)
+            for tps in assignments.values()
+        ]
+        mean = sum(int(v) for v in lags) / Cf
+        return max(loads) / mean if mean > 0 else 1.0
+
+    socks = [socket_mod.socket() for _ in range(2)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    ids = ["dr0", "dr1"]
+    svcs, clients = [], []
+    for i in range(2):
+        j = 1 - i
+        svc = AssignorService(
+            port=ports[i], coalesce_max_batch=1,
+            scrub_interval_ms=0.0, breaker_cooldown_s=0.5,
+            federation_self_id=ids[i],
+            federation_peers=f"{ids[j]}=127.0.0.1:{ports[j]}",
+            federation_rounds=8, federation_sync_timeout_s=300.0,
+            federation_gossip_interval_s=0.1 if i == 0 else 0.0,
+        ).start()
+        svcs.append(svc)
+        clients.append(
+            AssignorServiceClient(*svc.address, timeout_s=600.0)
+        )
+
+    def fed(i):
+        return clients[i].federated_assign(
+            "t0", rows(shards[i]), members
+        )
+
+    try:
+        # b registers its shard first (its serve path must hold a local
+        # view before a's exchange can converge), then a's first call IS
+        # the synchronous-exchange reference the warm serves compare to.
+        fed(1)
+        t0 = time.perf_counter()
+        sync_r = fed(0)
+        sync_ms = (time.perf_counter() - t0) * 1000.0
+        sync_quality = quality(sync_r["assignments"], shards[0])
+        sync_rung = sync_r["federation"]["rung"]
+        # The daemon's first converged tick seeds the warm cache.
+        gossip = svcs[0]._federation
+        t0 = time.perf_counter()
+        while (
+            (gossip.last_gossip or {}).get("outcome") != "ok"
+            and time.perf_counter() - t0 < 10.0
+        ):
+            time.sleep(0.01)
+        fed(0)  # rehearsal: first warm-cache serve off the clock
+        warm_ms, warm_flags, rungs, qualities = [], [], [], []
+        for _ in range(9):
+            t0 = time.perf_counter()
+            r = fed(0)
+            warm_ms.append((time.perf_counter() - t0) * 1000.0)
+            warm_flags.append(
+                bool(r["federation"].get("warm_cache", False))
+            )
+            rungs.append(r["federation"]["rung"])
+            qualities.append(quality(r["assignments"], shards[0]))
+    finally:
+        for c in clients:
+            c.close()
+        for svc in svcs:
+            svc.stop()
+
+    warm_fraction = sum(warm_flags) / len(warm_flags)
+    worst_quality_ratio = max(qualities) / sync_quality
+    log(
+        f"delta_roundtrip: federated warm fraction "
+        f"{warm_fraction:.2f} (sync {sync_ms:.1f}ms rung {sync_rung}, "
+        f"warm p50 {float(np.percentile(warm_ms, 50)):.1f}ms), "
+        f"quality ratio {worst_quality_ratio:.6f}"
+    )
+    return {
+        "config": "delta_roundtrip",
+        "partitions": P,
+        "consumers": C,
+        "epochs": epochs,
+        "churn_fraction": churn / P,
+        "refine_iters": iters,
+        "d2h_dense_bytes_per_epoch": dense_per_epoch,
+        "d2h_delta_bytes_per_epoch": delta_per_epoch,
+        "d2h_reduction_x": dense_per_epoch / max(delta_per_epoch, 1e-9),
+        "rb_applied": delta_counts[2],
+        # Dense d2h bytes charged DURING the delta engine's loop: any
+        # nonzero value means an epoch fell off the O(changed) readback.
+        "delta_engine_dense_d2h_bytes": delta_counts[0],
+        "mismatched_epochs": mismatched,
+        "warm_compile_count": dense_counts[3] + delta_counts[3],
+        "reduction_target_x": 20.0,
+        "fed_sync_rung": sync_rung,
+        "fed_sync_ms": sync_ms,
+        "fed_warm_p50_ms": float(np.percentile(warm_ms, 50)),
+        "fed_warm_fraction": warm_fraction,
+        "fed_rungs": sorted(set(rungs)),
+        "fed_quality_ratio": worst_quality_ratio,
+    }
+
+
+def delta_roundtrip_gates(dr) -> list:
+    """The delta_roundtrip regression gates, shared verbatim by
+    bench main() and the tier-1 workflow's probe step (the config17
+    precedent: one definition, two call sites)."""
+    failures = []
+    if dr.get("mismatched_epochs", 0) > 0:
+        failures.append(
+            f"delta_roundtrip produced {dr['mismatched_epochs']} "
+            "epoch(s) differing from the dense-readback twin — the "
+            "O(changed) readback is not bit-exact"
+        )
+    if dr.get("rb_applied", 0) < dr.get("epochs", 0):
+        failures.append(
+            f"delta_roundtrip applied only {dr.get('rb_applied')}"
+            f"/{dr.get('epochs')} epochs via the O(changed) readback "
+            f"(dense d2h bytes charged: "
+            f"{dr.get('delta_engine_dense_d2h_bytes')})"
+        )
+    red = dr.get("d2h_reduction_x")
+    if red is None or red < dr.get("reduction_target_x", 20.0):
+        failures.append(
+            f"delta_roundtrip d2h_reduction_x {red} < "
+            f"{dr.get('reduction_target_x', 20.0)}x — the readback is "
+            "not O(changed) at 1% churn"
+        )
+    if dr.get("warm_compile_count", 1) != 0:
+        failures.append(
+            f"delta_roundtrip warm_compile_count "
+            f"{dr['warm_compile_count']} != 0 — the compaction tail "
+            "minted fresh executables inside the steady-state loop"
+        )
+    if dr.get("fed_sync_rung") != "global":
+        failures.append(
+            f"delta_roundtrip federated sync reference served rung "
+            f"{dr.get('fed_sync_rung')!r} — the exchange never "
+            "converged, so the warm-cache gate read a degraded mesh"
+        )
+    if dr.get("fed_warm_fraction", 0.0) < 0.5:
+        failures.append(
+            f"delta_roundtrip fed_warm_fraction "
+            f"{dr.get('fed_warm_fraction')} < 0.5 — federated_assign "
+            "p50 is not serving from the warm gossip cache in one "
+            "local round"
+        )
+    if dr.get("fed_rungs") != ["global"]:
+        failures.append(
+            f"delta_roundtrip federated serves hit rung(s) "
+            f"{dr.get('fed_rungs')} != ['global'] — the warm-cache "
+            "path degraded under a healthy mesh"
+        )
+    q = dr.get("fed_quality_ratio")
+    if q is None or q > 1.001:
+        failures.append(
+            f"delta_roundtrip fed_quality_ratio {q} > 1.001 — the "
+            "gossip-cached duals lost quality vs the synchronous "
+            "exchange"
+        )
+    return failures
+
+
 def main():
     # A wedged accelerator tunnel must degrade the benchmark, not hang it
     # (the framework's own watchdog philosophy, SURVEY §5 failure row):
@@ -3379,7 +3664,8 @@ def main():
                config8_restart, config9_delta, config10_handoff,
                config11_scrub, config12_federated, config13_sharded,
                config14_linear, config15_linear_kernel,
-               config16_scenarios, config17_tracing):
+               config16_scenarios, config17_tracing,
+               config18_delta_roundtrip):
         before = klba_metrics.REGISTRY.snapshot()
         r = fn()
         deltas = klba_metrics.histogram_deltas(
@@ -4058,6 +4344,11 @@ def main():
                 f"{tr.get('warm_compile_count')} != 0 — fresh XLA "
                 "compiles inside the traced warm no-op loop"
             )
+    # Delta round-trip gates (ISSUE 19): shared with the tier-1
+    # workflow's probe step — see delta_roundtrip_gates.
+    dr = results.get("delta_roundtrip", {})
+    if dr:
+        failures.extend(delta_roundtrip_gates(dr))
     for msg in failures:
         log(f"bench: REGRESSION GATE FAILED: {msg}")
     sys.exit(1 if failures else 0)

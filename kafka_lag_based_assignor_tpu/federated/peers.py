@@ -181,9 +181,15 @@ class FederationCoordinator:
         fence_token: Optional[Callable[[], Optional[int]]] = None,
         clock: Optional[Callable[[], float]] = None,
         capacity: Optional[Any] = None,
+        gossip_interval_s: float = 0.0,
+        gossip_freshness_s: Optional[float] = None,
     ):
         if not self_id:
             raise ValueError("federation self_id must be non-empty")
+        if float(gossip_interval_s) < 0:
+            raise ValueError(
+                f"gossip_interval_s={gossip_interval_s} must be >= 0"
+            )
         if any(p.peer_id == self_id for p in peers):
             raise ValueError(
                 f"peer list names this sidecar's own id {self_id!r}"
@@ -246,6 +252,36 @@ class FederationCoordinator:
             )
             for pid in self._links
         }
+        # Async gossip duals (the background convergence plane): a
+        # daemon thread re-converges the consumer-axis duals with peers
+        # at a jittered cadence, continuously refreshing the last-good
+        # cache, so assign() can serve rung "global" from warm duals in
+        # ONE local round — no synchronous peer RTT on the serve path.
+        # Off by default (interval 0 = today's synchronous exchange).
+        # The freshness window bounds how old a gossiped dual set may
+        # be and still serve AS "global"; past it the ordinary ladder
+        # (synchronous exchange -> last-good -> local-only) takes over.
+        self.gossip_interval_s = float(gossip_interval_s)
+        self.gossip_freshness_s = (
+            float(gossip_freshness_s)
+            if gossip_freshness_s is not None
+            else min(2.5 * self.gossip_interval_s, self.max_staleness_s)
+        )
+        self.last_gossip: Optional[Dict[str, Any]] = None
+        self._m_gossip = {
+            o: metrics.REGISTRY.counter(
+                "klba_gossip_rounds_total", {"outcome": o}
+            )
+            for o in ("ok", "degraded", "idle", "error")
+        }
+        self._gossip_stop = threading.Event()
+        self._gossip_thread: Optional[threading.Thread] = None
+        if self.gossip_interval_s > 0 and self._links:
+            self._gossip_thread = threading.Thread(
+                target=self._gossip_loop,
+                name=f"klba-gossip-{self.self_id}", daemon=True,
+            )
+            self._gossip_thread.start()
 
     # -- local shard --------------------------------------------------------
 
@@ -559,15 +595,25 @@ class FederationCoordinator:
         result: Dict[str, Any] = {
             "rung": "local_only", "choice": None, "rounds": 0,
             "peers_ok": 0, "staleness_s": None, "converged": False,
+            "warm_cache": False,
         }
         with metrics.span("federation.assign"):
+            # Warm-cache fast path: with the gossip daemon keeping the
+            # duals converged in the background, a fresh-enough cache
+            # serves rung "global" in ONE local rounding call — no
+            # synchronous peer RTT on the serve path.  A stale or
+            # missing cache falls through to the ordinary ladder.
             attempt = (
-                self._try_global(
+                self._round_from_gossip(
+                    fedsolve, lags, int(C), refine_iters
+                )
+                if self.gossip_interval_s > 0 else None
+            )
+            if attempt is None and self._links:
+                attempt = self._try_global(
                     fedsolve, lags, int(C), epoch, token, remaining_s,
                     refine_iters,
                 )
-                if self._links else None
-            )
             if attempt is not None:
                 result.update(attempt)
             else:
@@ -599,6 +645,37 @@ class FederationCoordinator:
     ) -> Optional[Dict[str, Any]]:
         """The synchronized exchange; None when any round lost a peer
         or the budget ran out (the caller then consults the cache)."""
+        conv = self._converge_duals(
+            fedsolve, C, epoch, token, remaining_s, phase="exchange"
+        )
+        if conv is None:
+            return None
+        self.last_rounds = conv["rounds"]
+        choice, _, _ = fedsolve.round_local_shard(
+            lags, C, conv["A"], conv["B"], conv["scale"],
+            conv["base_load"], refine_iters=refine_iters,
+            capacity_frac=conv["cap_frac"],
+        )
+        self._m_staleness.set(0.0)
+        return {
+            "rung": "global", "choice": choice,
+            "rounds": conv["rounds"], "peers_ok": len(self._links),
+            "staleness_s": 0.0, "converged": conv["converged"],
+        }
+
+    def _converge_duals(
+        self, fedsolve, C, epoch, token, remaining_s,
+        phase: str = "exchange",
+    ) -> Optional[Dict[str, Any]]:
+        """Hello + synchronized dual-exchange rounds against EVERY
+        peer, refreshing the last-good cache on completion; None when
+        any round lost a peer or the budget ran out.  This ONE body is
+        shared verbatim by the synchronous serve path
+        (``phase="exchange"``) and the background gossip daemon
+        (``phase="gossip"``) — same per-peer breakers, same monotone
+        epoch/fence staleness fencing, same complete-round discipline —
+        so the only difference between the two planes is who pays the
+        RTTs and when."""
         # Handshake: every peer's scalars fix the shared scale/cap.
         hello = self._exchange_round(
             lambda pid: wire.sync_request(
@@ -612,6 +689,11 @@ class FederationCoordinator:
             return None
         with self._shard_lock:
             shard = self._shard
+            if shard is None or shard["C"] != C:
+                # The gossip daemon races shard registration: no local
+                # shard (or a roster flip mid-convergence) simply skips
+                # this attempt — nothing to converge against.
+                return None
             total = shard["total"]
             n = shard["n"]
         # Weighted shards: every shard's capacity vector (uniform ones
@@ -675,7 +757,7 @@ class FederationCoordinator:
                     lambda pid: wire.sync_request(
                         self.self_id, epoch, r, C, scale=scale,
                         duals_a=A, duals_b=B, fence_token=token,
-                        phase="exchange",
+                        phase=phase,
                         traceparent=metrics.current_traceparent(),
                     ),
                     remaining_s,
@@ -740,17 +822,15 @@ class FederationCoordinator:
                 # same capacity apportionment the exchange converged
                 # under.
                 "cap_frac": cap_frac,
+                # Whether the exchange hit DUAL_TOL (vs exhausting the
+                # round budget) — the gossip warm-serve path reports it
+                # as the served assignment's convergence.
+                "converged": converged,
             }
-        self.last_rounds = rounds
-        choice, _, _ = fedsolve.round_local_shard(
-            lags, C, A, B, scale, remote_load,
-            refine_iters=refine_iters, capacity_frac=cap_frac,
-        )
-        self._m_staleness.set(0.0)
         return {
-            "rung": "global", "choice": choice, "rounds": rounds,
-            "peers_ok": len(self._links), "staleness_s": 0.0,
-            "converged": converged,
+            "A": A, "B": B, "scale": scale, "base_load": remote_load,
+            "rounds": rounds, "converged": converged,
+            "cap_frac": cap_frac,
         }
 
     def _round_from_cache(
@@ -778,6 +858,87 @@ class FederationCoordinator:
             "rounds": cached["rounds"], "peers_ok": 0,
             "staleness_s": age, "converged": False,
         }
+
+    def _round_from_gossip(
+        self, fedsolve, lags, C, refine_iters
+    ) -> Optional[Dict[str, Any]]:
+        """The gossip warm-cache fast path: round the local shard with
+        the background-converged duals when the cache is inside the
+        gossip FRESHNESS window (much tighter than the last-good rung's
+        bounded staleness — these duals must be current enough to
+        *count as* rung "global").  None falls through to the ordinary
+        ladder."""
+        with self._cache_lock:
+            cached = dict(self._last_good) if self._last_good else None
+        if cached is None or cached["C"] != C:
+            return None
+        age = self._clock() - cached["at"]
+        if age > self.gossip_freshness_s:
+            return None
+        choice, _, _ = fedsolve.round_local_shard(
+            lags, C, cached["A"], cached["B"], cached["scale"],
+            cached["base_load"], refine_iters=refine_iters,
+            capacity_frac=cached.get("cap_frac"),
+        )
+        self._m_staleness.set(age)
+        return {
+            "rung": "global", "choice": choice,
+            "rounds": cached["rounds"],
+            "peers_ok": len(self._links), "staleness_s": age,
+            "converged": bool(cached.get("converged", False)),
+            "warm_cache": True,
+        }
+
+    # -- the gossip daemon --------------------------------------------------
+
+    def gossip_now(self) -> str:
+        """One background convergence attempt (the daemon's body, also
+        callable directly by tests and the scenario runner for
+        deterministic cadence).  Returns the outcome counted into
+        ``klba_gossip_rounds_total``: ``ok`` (cache refreshed),
+        ``degraded`` (a peer was lost — the cache keeps its previous
+        entry and ages), or ``idle`` (no shard registered / no peers
+        yet — nothing to converge against)."""
+        from ..ops import fedsolve
+
+        with self._shard_lock:
+            shard = self._shard
+            C = int(shard["C"]) if shard is not None else None
+        if C is None or not self._links:
+            outcome = "idle"
+        else:
+            with metrics.span("federation.gossip"):
+                conv = self._converge_duals(
+                    fedsolve, C, self.local_epoch, self._fence_token(),
+                    lambda: None, phase="gossip",
+                )
+            outcome = "ok" if conv is not None else "degraded"
+        self._m_gossip[outcome].inc()
+        self.last_gossip = {"outcome": outcome, "at": self._clock()}
+        return outcome
+
+    def _gossip_loop(self) -> None:
+        # Jittered cadence (0.75x-1.25x the configured interval, from a
+        # per-sidecar deterministic stream): peers started together must
+        # not phase-lock their gossip rounds into synchronized RTT
+        # bursts against each other.
+        import random
+
+        rng = random.Random(f"gossip:{self.self_id}")
+        while not self._gossip_stop.is_set():
+            wait_s = self.gossip_interval_s * (0.75 + 0.5 * rng.random())
+            if self._gossip_stop.wait(wait_s):
+                return
+            try:
+                self.gossip_now()
+            except Exception:
+                # The daemon must survive anything a round can throw
+                # (the serve path never depends on it succeeding).
+                LOGGER.warning("gossip round failed", exc_info=True)
+                self._m_gossip["error"].inc()
+                self.last_gossip = {
+                    "outcome": "error", "at": self._clock()
+                }
 
     # -- operator surface ---------------------------------------------------
 
@@ -812,6 +973,23 @@ class FederationCoordinator:
             "sync_timeout_s": self.sync_timeout_s,
             "max_staleness_s": self.max_staleness_s,
             "last_good": cache_info,
+            "gossip": {
+                "interval_s": self.gossip_interval_s,
+                "freshness_s": self.gossip_freshness_s,
+                "thread_alive": (
+                    self._gossip_thread is not None
+                    and self._gossip_thread.is_alive()
+                ),
+                "last": (
+                    {
+                        "outcome": self.last_gossip["outcome"],
+                        "age_s": (
+                            self._clock() - self.last_gossip["at"]
+                        ),
+                    }
+                    if self.last_gossip is not None else None
+                ),
+            },
             "peers": peers,
         }
 
@@ -924,5 +1102,11 @@ class FederationCoordinator:
                 )
 
     def close(self) -> None:
+        self._gossip_stop.set()
+        thread = self._gossip_thread
+        if thread is not None and thread.is_alive():
+            # Bounded join: a gossip round mid-RTT finishes within the
+            # per-peer sync timeout; don't hang shutdown past it.
+            thread.join(timeout=self.sync_timeout_s + 1.0)
         for link in self._links.values():
             link.close()

@@ -160,12 +160,15 @@ def sync_request(
 
     ``phase`` is ``"hello"`` for the handshake round (no duals yet —
     the response's ``total_lag``/``n_valid`` scalars fix the shared
-    scale) or ``"exchange"`` for a marginal round under the carried
-    duals.  ``traceparent`` (optional) carries the initiator's W3C
-    trace context so both sidecars' segments of a federated assign
-    reconstruct as one trace; it is audited as a fixed-length scalar
-    by :func:`_check_payload`."""
-    if phase not in ("hello", "exchange"):
+    scale), ``"exchange"`` for a marginal round under the carried
+    duals, or ``"gossip"`` for the SAME marginal round issued by the
+    background dual-gossip daemon (identical payload shape and audit —
+    consumer-axis duals only, lag-free — the distinct phase tag exists
+    so captures and peers can tell the planes apart).  ``traceparent``
+    (optional) carries the initiator's W3C trace context so both
+    sidecars' segments of a federated assign reconstruct as one trace;
+    it is audited as a fixed-length scalar by :func:`_check_payload`."""
+    if phase not in ("hello", "exchange", "gossip"):
         raise PayloadViolation(f"unknown phase {phase!r}")
     params: Dict[str, Any] = {
         "version": PROTOCOL_VERSION,

@@ -183,6 +183,113 @@ class LagDeltaTracker:
         self._pending = None
 
 
+class AssignmentDeltaTracker:
+    """Client-side reconstructor for DELTA RESPONSES (service.py
+    "Delta responses") — the downlink mirror of
+    :class:`LagDeltaTracker`: acks the assignment epoch it holds so
+    the server may answer with only the changed rows
+    (``result.assignment_delta``), then reconstructs the dense
+    assignments dict bit-exactly from its held base.
+
+    Usage, once per stream per epoch (composes with the lag tracker —
+    both stamp fields onto the same params dict)::
+
+        params = lag_tracker.params_for(rows)
+        assign_tracker.stamp(params)            # adds assign_ack
+        result = client.stream_assign(..., **params)
+        assignments = assign_tracker.note_result(result, members)
+        lag_tracker.note_result(result)
+
+    The tracker acks nothing until a dense answer establishes a base
+    (``stream.assign_epoch``); after that every answer either applies
+    a delta against the held base (the server only serves one when the
+    ack matched and the roster is unchanged — the same
+    monotone-epoch/ack/resync ladder as the upload path) or is a dense
+    re-seed.  Any failed request drops the ack
+    (:meth:`note_failure`), so the next answer is dense — resync
+    semantics identical to the lag tracker's."""
+
+    def __init__(self):
+        self._epoch: Optional[int] = None
+        self._owner: Optional[Dict[int, str]] = None  # pid -> member
+        self._topic: Optional[str] = None
+
+    def stamp(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        """Add ``assign_ack`` for the held base (no-op before the
+        first confirmed dense answer); returns ``params``."""
+        if self._epoch is not None and self._owner is not None:
+            params["assign_ack"] = self._epoch
+        return params
+
+    def note_result(
+        self, result: Mapping, members: Sequence[str]
+    ) -> Dict[str, Any]:
+        """Adopt one ``stream_assign`` answer and return the dense
+        assignments dict (reconstructed for a delta answer, adopted
+        as-is for a dense one).  ``members`` is the member list the
+        request named — owner indices in a delta bind to its sorted
+        order, exactly as the server's dense dict does."""
+        members_sorted = sorted(str(m) for m in members)
+        stream = (result or {}).get("stream") or {}
+        delta = (result or {}).get("assignment_delta")
+        if delta is not None:
+            if (
+                self._owner is None
+                or delta.get("base_epoch") != self._epoch
+            ):
+                # The server deltas only against an acked base; a
+                # mismatch here means state desynchronized (client
+                # bug, crossed responses) — drop the base and demand
+                # dense next epoch rather than apply onto the wrong
+                # view.
+                self.note_failure()
+                raise ValueError(
+                    "assignment_delta names a base this tracker does "
+                    "not hold; re-sync next epoch"
+                )
+            for pid, owner in zip(delta["indices"], delta["owners"]):
+                self._owner[int(pid)] = members_sorted[int(owner)]
+            self._epoch = int(delta["epoch"])
+            self._topic = delta.get("topic", self._topic)
+            return self.assignments(members_sorted)
+        assignments = (result or {}).get("assignments")
+        if assignments is None:
+            self.note_failure()
+            raise ValueError(
+                "result carries neither assignments nor "
+                "assignment_delta"
+            )
+        owner: Dict[int, str] = {}
+        topic = self._topic
+        for m, rows in assignments.items():
+            for t, pid in rows:
+                owner[int(pid)] = str(m)
+                topic = t
+        self._owner = owner
+        self._topic = topic
+        epoch = stream.get("assign_epoch")
+        # An old server (no delta-response support) never confirms an
+        # epoch — the tracker then acks nothing and behaves densely.
+        self._epoch = int(epoch) if epoch is not None else None
+        return assignments
+
+    def assignments(self, members_sorted: Sequence[str]) -> Dict[str, Any]:
+        """The held dense view, in the server's wire shape: ascending
+        pids per member (the server appends rows in ascending-pid
+        order, so reconstruction matches it bit-for-bit)."""
+        out: Dict[str, Any] = {m: [] for m in members_sorted}
+        for pid in sorted(self._owner or {}):
+            out[self._owner[pid]].append([self._topic, pid])
+        return out
+
+    def note_failure(self) -> None:
+        """The request failed: the server may have advanced its epoch
+        without this client seeing the answer — drop the base so the
+        next answer re-seeds dense."""
+        self._epoch = None
+        self._owner = None
+
+
 def compute_partition_lag(
     partition_metadata: Optional[OffsetAndMetadata],
     begin_offset: int,

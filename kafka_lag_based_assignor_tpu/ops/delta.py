@@ -1,0 +1,123 @@
+"""Device-computed changed-assignment extraction — the O(changed)
+READBACK half of the delta plane (ISSUE 19; the round-13 delta plane in
+:mod:`.streaming` made the lag *upload* O(changed)).
+
+A warm fused refine dispatch already keeps everything device-resident
+except one host materialization: the narrowed ``[P]`` choice vector.
+For a steady-state epoch that readback is almost entirely redundant —
+the budgeted bulk refine performs at most ``exchange_budget`` exchanges,
+each moving one partition row, so at most ``2 * exchange_budget``
+entries of the choice vector can differ from the entry state the host
+already holds (``StreamingAssignor._prev_choice``; membership repair
+and cold solves drop the resident state and take the dense path, so the
+bound is exact on the resident path).  This module provides the three
+pieces that turn that bound into an O(changed) device→host transfer:
+
+- :func:`readback_k` — the STATIC padded compaction width ``K`` for a
+  dispatch, derived only from ``(exchange_budget, P)``.  Both inputs
+  are already compile-time constants of the fused executables
+  (``exchange_budget`` is a static argname, ``P`` is the exact lag
+  shape), so threading ``K`` through adds NO new jit cache keys and
+  therefore no new warm-loop compiles — the property the delta-plane
+  bench gates.
+- :func:`compact_changed` — the jit-side epilogue fused into
+  ``_refine_core``: diff entry vs exit choice over the live ``[:P]``
+  prefix and emit a padded ``(indices, values, count)`` triple.
+- :func:`apply_assignment_delta` — the host-side inverse: scatter the
+  fetched entries onto the host's previous dense view, reproducing the
+  dense narrow readback bit-exactly.
+
+Overflow is detected host-side, not device-side: the true changed
+count rides along, and a count past ``K`` (possible only off the
+budgeted bulk path) falls back to fetching the dense narrow vector —
+which the executable still returns, so the fallback is a second
+``device_get``, never a re-dispatch.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# Smallest compaction width, mirroring the upload ladder's DELTA_MIN_K
+# (kept as a separate constant: .streaming imports THIS module, so the
+# dependency cannot point the other way).
+RB_MIN_K = 16
+
+# Per-entry device->host cost bound: int32 index + int32 value (the
+# narrowed choice is int16 when C <= 32767, int32 past that — size the
+# byte-win gate for the WORST case so the decision stays a pure
+# function of (exchange_budget, P) and never keys on the narrow dtype).
+_RB_ENTRY_BYTES_MAX = 4 + 4
+_RB_DENSE_BYTES_MIN = 2  # int16 narrow — worst case FOR the delta side
+
+
+def _pow2_ceil(n: int) -> int:
+    k = RB_MIN_K
+    while k < n:
+        k <<= 1
+    return k
+
+
+def readback_k(exchange_budget: int, P: int) -> int:
+    """Padded compaction width for a warm fused dispatch, or 0 to keep
+    the dense readback.
+
+    The budgeted bulk refine moves at most ``2 * exchange_budget``
+    choice entries, so the pow2 ceiling of that bound (floored at
+    ``RB_MIN_K``) captures every steady-state epoch with zero overflow.
+    Returns 0 — dense readback — when the budget is unbounded
+    (``exchange_budget <= 0``: cold chains, where churn has no device
+    bound) or when the padded compaction would not beat the dense
+    transfer even under the most delta-hostile dtype pairing
+    (int32 entries vs an int16 dense vector: win requires
+    ``K * 8 < P * 2``).
+    """
+    if exchange_budget <= 0 or P <= 0:
+        return 0
+    k = _pow2_ceil(max(2 * int(exchange_budget), RB_MIN_K))
+    if k * _RB_ENTRY_BYTES_MAX >= P * _RB_DENSE_BYTES_MIN:
+        return 0
+    return k
+
+
+def compact_changed(entry_choice, exit_choice, narrow, P: int, K: int):
+    """Fused readback-compaction epilogue (traced inside the warm
+    executables — see ``_refine_core``).
+
+    Diffs the entry choice against the exit choice over the live
+    ``[:P]`` prefix (padded rows past P never reach the host view and
+    are excluded by construction) and returns
+
+    ``(d_idx int32[K], d_vals narrow-dtype[K], d_n int32)``
+
+    where ``d_n`` is the TRUE changed count (may exceed K — the host
+    checks).  Padding entries are ``(0, narrow[0])``: index 0's real
+    exit value, so even a buggy consumer that scattered the full padded
+    vector would write only truth (mirrors the upload path's
+    self-consistent padding discipline).
+    """
+    import jax.numpy as jnp
+
+    changed = entry_choice[:P] != exit_choice[:P]
+    d_n = changed.sum(dtype=jnp.int32)
+    d_idx = jnp.nonzero(changed, size=K, fill_value=0)[0].astype(jnp.int32)
+    d_vals = jnp.take(narrow, d_idx)
+    return d_idx, d_vals, d_n
+
+
+def apply_assignment_delta(
+    base: np.ndarray, idx: np.ndarray, vals: np.ndarray, n: int
+) -> np.ndarray:
+    """Host-side inverse of :func:`compact_changed`: scatter the first
+    ``n`` fetched entries onto a copy of the host's previous dense
+    view.  Bit-parity with the dense readback is structural — the
+    values ARE gathers from the very narrow vector the dense path would
+    have fetched, and every unchanged entry equals the base by the
+    definition of the diff."""
+    out = np.ascontiguousarray(base, dtype=np.int32).copy()
+    n = int(n)
+    if n:
+        out[np.asarray(idx[:n], dtype=np.int64)] = np.asarray(
+            vals[:n]
+        ).astype(np.int32)
+    return out

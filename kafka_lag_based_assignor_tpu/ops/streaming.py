@@ -108,6 +108,7 @@ from ..utils import trace as trace_mod
 from ..utils.observability import count_constrained_bound
 from ..utils.watchdog import capture_abandon_check
 from .batched import _narrow_choice, _stream_device, assign_stream, stream_payload
+from .delta import apply_assignment_delta, compact_changed, readback_k
 from .dispatch import ensure_x64, observe_pack_shift
 from .packing import pad_bucket, pad_chunk, table_rows
 from .refine import build_choice_tables, refine_rounds_resident
@@ -212,7 +213,7 @@ def _state_digest(lags_p, choice_p, counts, num_consumers: int,
 def _refine_core(
     lags_p, choice_p, row_tab, counts, totals, limit, P: int,
     num_consumers: int, iters: int, max_pairs, exchange_budget: int,
-    bulk: bool = False,
+    bulk: bool = False, delta_k: int = 0,
 ):
     """Shared tail of every fused refine executable: the resident round
     loop plus the narrowed host-facing output.  Returns
@@ -226,7 +227,16 @@ def _refine_core(
     readback compares it against host truth, utils/scrub).  ``bulk``
     selects the warm engine's anti-ranked bulk-swap rounds (see
     :func:`..ops.refine.refine_rounds_resident`) with a 4-way partner
-    fan per heavy consumer; cold chains keep the parity selection."""
+    fan per heavy consumer; cold chains keep the parity selection.
+
+    ``delta_k > 0`` appends the O(changed)-readback compaction tail
+    (:func:`.delta.compact_changed`) — ``(d_idx int32[K],
+    d_vals narrow[K], d_n int32)`` diffing the ENTRY choice against the
+    exit choice over ``[:P]`` — so the host can fetch only the changed
+    assignments instead of the dense narrow vector.  ``delta_k`` is a
+    pure function of ``(exchange_budget, P)`` (:func:`.delta.
+    readback_k`), both already compile-time constants here, so the tail
+    adds no new executable variants beyond the warmed ladder."""
     # The digest audits the state the epoch STARTED from — the
     # long-lived resident buffers (post-scatter for delta epochs) —
     # not the refine's output: the exchange rounds rewrite the choice
@@ -239,6 +249,7 @@ def _refine_core(
     digest = _state_digest(
         lags_p, choice_p, counts, num_consumers, row_tab=row_tab
     )
+    entry_choice = choice_p
     choice_p, row_tab, counts, totals, rounds, ex = refine_rounds_resident(
         lags_p, choice_p, row_tab, counts, totals,
         num_consumers=num_consumers, iters=iters, max_pairs=max_pairs,
@@ -246,8 +257,14 @@ def _refine_core(
         bulk_transfer=bulk, fan=8 if bulk else 1,
     )
     narrow = _narrow_choice(choice_p[:P], num_consumers)
-    return (narrow, choice_p, row_tab, counts, lags_p, totals, rounds,
+    base = (narrow, choice_p, row_tab, counts, lags_p, totals, rounds,
             ex, digest)
+    if delta_k <= 0:
+        return base
+    d_idx, d_vals, d_n = compact_changed(
+        entry_choice, choice_p, narrow, P, delta_k
+    )
+    return base + (d_idx, d_vals, d_n)
 
 
 @functools.partial(
@@ -348,13 +365,14 @@ def _warm_fused_build(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "num_consumers", "iters", "max_pairs", "exchange_budget"
+        "num_consumers", "iters", "max_pairs", "exchange_budget",
+        "delta_k",
     ),
     donate_argnums=(1, 2, 3),
 )
 def _warm_fused_resident(
     lags, choice, row_tab, counts, limit, num_consumers: int, iters: int,
-    max_pairs, exchange_budget: int,
+    max_pairs, exchange_budget: int, delta_k: int = 0,
 ):
     """THE fused warm-epoch executable: quality evaluation, target test,
     and the full multi-round exchange loop in ONE dispatch over
@@ -371,7 +389,8 @@ def _warm_fused_resident(
     dispatch whose kept assignment already meets the target performs
     zero rounds.  Returns the same tuple as :func:`_refine_chain`; the
     returned padded lag vector seeds the delta path's resident lag
-    buffer."""
+    buffer.  ``delta_k > 0`` additionally appends the O(changed)
+    readback tail (see :func:`_refine_core`)."""
     P = lags.shape[0]
     B = choice.shape[0]
     M = row_tab.shape[1]
@@ -383,19 +402,22 @@ def _warm_fused_resident(
     return _refine_core(
         lags_p, choice, row_tab, counts, totals, limit, P,
         num_consumers, iters, max_pairs, exchange_budget, bulk=True,
+        delta_k=delta_k,
     )
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "P", "num_consumers", "iters", "max_pairs", "exchange_budget"
+        "P", "num_consumers", "iters", "max_pairs", "exchange_budget",
+        "delta_k",
     ),
     donate_argnums=(2, 3, 4, 5),
 )
 def _warm_fused_delta(
     idx, vals, lags_p, choice, row_tab, counts, limit, P: int,
     num_consumers: int, iters: int, max_pairs, exchange_budget: int,
+    delta_k: int = 0,
 ):
     """THE delta-epoch executable: scatter-apply a fixed-size padded
     ``[K]`` (index, value) update to the device-RESIDENT lag buffer,
@@ -422,6 +444,7 @@ def _warm_fused_delta(
     return _refine_core(
         lags_p, choice, row_tab, counts, totals, limit, P,
         num_consumers, iters, max_pairs, exchange_budget, bulk=True,
+        delta_k=delta_k,
     )
 
 
@@ -582,6 +605,23 @@ class StreamingAssignor:
                 "klba_delta_epochs_total", {"outcome": o}
             )
             for o in ("applied", "fallback", "resync")
+        }
+        # D2H accounting — the readback mirror of the H2D pair above:
+        # the dense narrow fetch vs the O(changed) compaction tail
+        # (ops/delta), plus per-epoch outcomes mirroring the upload
+        # ladder's counter so both directions of the delta plane read
+        # the same way in dump_metrics.
+        self._m_d2h_dense = metrics.REGISTRY.counter(
+            "klba_d2h_bytes_total", {"path": "dense"}
+        )
+        self._m_d2h_delta = metrics.REGISTRY.counter(
+            "klba_d2h_bytes_total", {"path": "delta"}
+        )
+        self._m_rb = {
+            o: metrics.REGISTRY.counter(
+                "klba_rb_delta_epochs_total", {"outcome": o}
+            )
+            for o in ("applied", "fallback", "overflow")
         }
         # True when the LAST cold solve was served by the P-sharded
         # backend (stats surface; reset per cold solve).
@@ -1184,6 +1224,19 @@ class StreamingAssignor:
         # delta paths' conservation check): the int64 lag sum,
         # wrap-consistent with the device reductions.
         lag_sum = int(lags.sum(dtype=np.int64))
+        # O(changed) READBACK width (ops/delta): a pure function of
+        # (exchange_budget, P) — both already compile keys of the warm
+        # executables — so threading it through creates no variants
+        # beyond the warmed ladder.  Gated on delta_enabled: the warmup
+        # stream job pins delta_enabled=False, so the dense-readback
+        # executables it warms stay byte-identical, while the delta job
+        # warms the tailed variants at every K rung.  ``rb_base`` is the
+        # host dense view the compaction tail diffs against — valid on
+        # the resident path because every host-side choice edit drops
+        # the resident state (repair/remap/seed/reset), so the entry
+        # choice on device always equals ``choice`` here.
+        rb_k = readback_k(budget, P) if self.delta_enabled else 0
+        rb_base = choice
         payload, _ = stream_payload(lags)
         resident = self._resident
         # The resident state is either the engine's own (choice, row_tab,
@@ -1276,7 +1329,7 @@ class StreamingAssignor:
             out = None
             if delta is not None:
                 out = self._dispatch_delta(
-                    delta, resident, limit, P, budget, pairs
+                    delta, resident, limit, P, budget, pairs, rb_k
                 )
                 if out is None:
                     # The delta dispatch failed (injected delta.apply
@@ -1320,10 +1373,17 @@ class StreamingAssignor:
                             ["lags"], "resynced", source="delta"
                         )
                         self._m_h2d_dense.inc(payload.nbytes)
+                        # Same delta_k as the warmed signature (an
+                        # incident-time recompile would defeat the
+                        # resync), but the tail diffs against the
+                        # FAILED dispatch's exit choice — not the
+                        # host's view — so it is unusable here.
+                        rb_base = None
                         out = _warm_fused_resident(
                             payload, out[1], out[2], out[3], limit,
                             num_consumers=C, iters=budget,
                             max_pairs=pairs, exchange_budget=budget,
+                            delta_k=rb_k,
                         )
                     else:
                         self._m_delta["applied"].inc()
@@ -1333,6 +1393,7 @@ class StreamingAssignor:
                     payload, resident[0], resident[1], resident[2],
                     limit, num_consumers=C, iters=budget,
                     max_pairs=pairs, exchange_budget=budget,
+                    delta_k=rb_k,
                 )
         else:
             observe_pack_shift(
@@ -1346,7 +1407,38 @@ class StreamingAssignor:
                 exchange_budget=budget, bucket=B,
             )
         (narrow, choice_p, row_tab, counts, lags_p, totals, rounds, ex,
-         digest) = out
+         digest) = out[:9]
+        if len(out) > 9 and rb_base is not None:
+            # O(changed) readback (ops/delta): fetch only the compaction
+            # tail + digest — bytes scale with the churn bound, not P.
+            # The digest still gates adoption AND the served answer,
+            # exactly like the dense fetch below.
+            with metrics.device_phase("refine"):
+                d_idx, d_vals, d_n, digest_np = jax.device_get(
+                    (out[9], out[10], out[11], digest)
+                )
+            n = int(d_n)
+            if n <= rb_k:
+                self._verify_digest(digest_np, P, lag_sum, source="epoch")
+                self._m_d2h_delta.inc(d_idx.nbytes + d_vals.nbytes + 4)
+                self._m_rb["applied"].inc()
+                self._adopt_resident(
+                    (choice_p, row_tab, counts, lags_p), lags
+                )
+                self._fill_stats_from_device(
+                    stats, totals, counts, rounds, ex
+                )
+                return apply_assignment_delta(rb_base, d_idx, d_vals, n)
+            # Churn exceeded the static K bound (possible only off the
+            # budgeted bulk path): the dense narrow vector is already
+            # computed device-side — a second fetch, never a
+            # re-dispatch.
+            self._m_rb["overflow"].inc()
+        elif len(out) > 9:
+            # Tail present but diffed against device-internal state
+            # (resync fallback): count the epoch against the readback
+            # ladder's fallback outcome, fetch dense.
+            self._m_rb["fallback"].inc()
         # ONE device fetch for the answer AND its digest: the narrow
         # readback blocks on the dispatch anyway, so the integrity
         # check's marginal per-epoch cost is the 32-byte ride-along
@@ -1356,6 +1448,7 @@ class StreamingAssignor:
         # above is async; documented in DEPLOYMENT.md "Kernel plane").
         with metrics.device_phase("refine"):
             narrow_np, digest_np = jax.device_get((narrow, digest))
+        self._m_d2h_dense.inc(narrow_np.nbytes)
         # THE per-epoch integrity gate (utils/scrub): the fused digest
         # must match host truth before the successors are adopted or
         # the answer served — a mismatch quarantines the stream and the
@@ -1433,12 +1526,14 @@ class StreamingAssignor:
         return idx, vals, idx.nbytes + vals.nbytes, n
 
     def _dispatch_delta(
-        self, delta, resident, limit, P: int, budget: int, pairs
+        self, delta, resident, limit, P: int, budget: int, pairs,
+        rb_k: int = 0,
     ):
         """One fused delta dispatch over the resident 4-tuple; returns
         the executable's output tuple, or None when the dispatch failed
         (fault point ``delta.apply`` fires first — the caller re-syncs
-        dense within the same epoch, warm host state intact)."""
+        dense within the same epoch, warm host state intact).  ``rb_k``
+        threads the O(changed) readback width through (ops/delta)."""
         idx, vals, nbytes, n = delta
         try:
             faults.fire("delta.apply")
@@ -1448,6 +1543,7 @@ class StreamingAssignor:
                     resident[2], limit, P=P,
                     num_consumers=self.num_consumers, iters=budget,
                     max_pairs=pairs, exchange_budget=budget,
+                    delta_k=rb_k,
                 )
         except Exception:  # noqa: BLE001 — dense re-sync is the contract
             LOGGER.warning(

@@ -473,6 +473,38 @@ def _parse_lag_delta(delta: Any):
     return d_pids, d_vals, base
 
 
+def _parse_assign_ack(params: Dict[str, Any]) -> Optional[int]:
+    """Type-validate ``params.assign_ack`` (module docstring "Delta
+    responses"): the assignment epoch whose dense view the client
+    holds — opting this request into a delta-encoded answer.  Whether
+    the ack is SERVABLE (epoch/roster match) is decided against the
+    stream's stored base under its lock."""
+    ack = params.get("assign_ack")
+    if ack is None:
+        return None
+    if isinstance(ack, bool) or not isinstance(ack, int) or ack < 0:
+        raise ValueError(
+            "params.assign_ack must be a non-negative integer"
+        )
+    return ack
+
+
+def _parse_accept_encoding(params: Dict[str, Any]) -> Optional[str]:
+    """Type-validate ``params.accept_encoding``: opts the client into
+    compressed DENSE responses (``assignments_encoded`` as
+    base64(zlib(JSON)) — the response half of the resync-storm
+    compression whose upload half is ``params.encoding``)."""
+    enc = params.get("accept_encoding")
+    if enc is None:
+        return None
+    if enc not in _LAG_ENCODINGS:
+        raise ValueError(
+            f"unknown accept_encoding {enc!r}; supported: "
+            f"{list(_LAG_ENCODINGS)}"
+        )
+    return enc
+
+
 def _decode_wire_lags(params: Dict[str, Any]):
     """Resolve ``params.lags`` honoring ``params.encoding`` (module
     docstring "Delta epochs" — resync-storm compression).  Returns the
@@ -536,6 +568,60 @@ def encode_lags_zlib(rows) -> str:
     return base64.b64encode(
         zlib.compress(json.dumps(rows).encode())
     ).decode("ascii")
+
+
+def _encode_dense_assignments(
+    assignments, resp_enc: Optional[str]
+) -> Dict[str, Any]:
+    """Wrap a dense assignments dict for the wire, honoring the
+    client's ``accept_encoding`` opt-in (the response half of the
+    resync-storm compression — a post-restart resync wave is
+    compressed in BOTH directions).  Both directions share the byte
+    pair ``klba_wire_assign_bytes_total{encoding=zlib|plain}`` so the
+    ratio reads off one counter like the upload side's."""
+    if resp_enc != "zlib":
+        return {"assignments": assignments}
+    plain = json.dumps(assignments)
+    encoded = encode_lags_zlib(assignments)
+    metrics.REGISTRY.counter(
+        "klba_wire_assign_bytes_total", {"encoding": "plain"}
+    ).inc(len(plain))
+    metrics.REGISTRY.counter(
+        "klba_wire_assign_bytes_total", {"encoding": "zlib"}
+    ).inc(len(encoded))
+    return {
+        "assignments_encoded": encoded,
+        "assignments_encoding": "zlib",
+    }
+
+
+def decode_wire_assignments(result: Dict[str, Any]) -> Dict[str, Any]:
+    """Client half of the dense-response encoding: inflate
+    ``assignments_encoded`` back into a plain ``assignments`` key
+    (bounded, mirroring :func:`_decode_wire_lags`'s inflate cap).
+    Results without the encoded key pass through untouched — callers
+    can apply this unconditionally."""
+    blob = result.get("assignments_encoded")
+    if blob is None:
+        return result
+    enc = result.get("assignments_encoding")
+    if enc not in _LAG_ENCODINGS:
+        raise ValueError(f"unknown assignments_encoding {enc!r}")
+    import base64
+    import zlib
+
+    raw = base64.b64decode(blob.encode("ascii"), validate=True)
+    d = zlib.decompressobj()
+    plain = d.decompress(raw, MAX_LINE_BYTES + 1)
+    if len(plain) > MAX_LINE_BYTES or d.unconsumed_tail:
+        raise ValueError(
+            f"decoded assignments exceed {MAX_LINE_BYTES} bytes"
+        )
+    out = dict(result)
+    out.pop("assignments_encoded")
+    out.pop("assignments_encoding")
+    out["assignments"] = json.loads(plain)
+    return out
 
 
 def _parse_lag_rows(rows):
@@ -633,6 +719,16 @@ class _Stream:
         # dense.
         self.lag_epoch = 0
         self.last_lags = None  # np.int64[P] in st.pids order
+        # Assignment-delta wire state (module docstring "Delta
+        # responses" — the RESPONSE-side mirror of the lag_delta base):
+        # the last SERVED dense answer (members, pids, choice) and its
+        # monotone epoch.  A client acking the held epoch gets only the
+        # changed rows (``result.assignment_delta``); any mismatch —
+        # roster moved, epoch gapped, restart rebuilt the stream —
+        # falls back dense, which re-seeds the client's base.  Dies
+        # with the stream, exactly like the lag base above.
+        self.assign_epoch = 0
+        self.last_served = None  # (members list, pids int64[P], choice int32[P])
         # Resident-state quarantine strikes (utils/scrub): forgiven
         # only after FORGIVE_AFTER consecutive clean epochs (a
         # corrupt -> heal -> corrupt flip-flop must still escalate);
@@ -1061,6 +1157,11 @@ class AssignorService:
         federation_rounds: int = 16,
         federation_sync_timeout_s: float = 2.0,
         federation_max_staleness_s: float = 300.0,
+        # Async gossip duals (ISSUE 19): > 0 starts the background
+        # dual-convergence daemon at that jittered cadence (seconds),
+        # so federated_assign serves rung global from the warm cache
+        # in one local round; 0 keeps every exchange synchronous.
+        federation_gossip_interval_s: float = 0.0,
         # Weighted shards (ROADMAP federated (c)): this cluster's
         # per-consumer capacity weight vector (list of positive
         # floats), exchanged in the hello handshake and summed into
@@ -1323,6 +1424,7 @@ class AssignorService:
                 fence_token=self._federation_fence_token,
                 clock=clock,
                 capacity=federation_capacity,
+                gossip_interval_s=float(federation_gossip_interval_s),
             )
         else:
             if federation_peers:
@@ -1424,6 +1526,9 @@ class AssignorService:
             "federation_rounds": cfg.federation_rounds,
             "federation_sync_timeout_s": cfg.federation_sync_timeout_s,
             "federation_max_staleness_s": cfg.federation_max_staleness_s,
+            "federation_gossip_interval_s": (
+                cfg.federation_gossip_interval_s
+            ),
             "federation_capacity": cfg.federation_capacity,
             "warmup_shapes": cfg.warmup_shapes or None,
             "slo_classes": cfg.slo_classes,
@@ -1935,6 +2040,8 @@ class AssignorService:
             raise ValueError("params.members contains duplicates")
         C = len(members_sorted)
         opts = _validate_stream_options(params.get("options") or {})
+        ack = _parse_assign_ack(params)
+        resp_enc = _parse_accept_encoding(params)
 
         if delta_params is not None and rows:
             raise ValueError(
@@ -1966,7 +2073,7 @@ class AssignorService:
             return self._stream_assign_admitted(
                 params, budget, klass, decision,
                 sid, topic, lags, pids_sorted, members_sorted, C, opts,
-                delta=delta,
+                delta=delta, ack=ack, resp_enc=resp_enc,
             )
 
     @contextmanager
@@ -2032,7 +2139,7 @@ class AssignorService:
     def _stream_assign_admitted(
         self, params, budget, klass, decision,
         sid, topic, lags, pids_sorted, members_sorted, C, opts,
-        delta=None,
+        delta=None, ack=None, resp_enc=None,
     ) -> Dict[str, Any]:
         """The admitted remainder of a stream_assign: stream state,
         the solve (or the degrade rung's kept_previous), the ladder."""
@@ -2131,11 +2238,16 @@ class AssignorService:
                         else np.zeros(prev.shape[0], dtype=np.int64)
                     )
                     choice, s = _serve_previous(prev, stats_lags, C)
+                    a_delta, a_epoch = self._note_assignment(
+                        st, ack, topic, members_sorted, st.pids, choice
+                    )
                     return self._stream_result(
                         topic, members_sorted, st.pids, choice, s,
                         fallback_used=False, degraded_rung="none",
                         warm_restart=False, opts=opts, klass=klass,
                         shed=None, lag_epoch=st.lag_epoch, resync=True,
+                        assign_delta=a_delta, assign_epoch=a_epoch,
+                        resp_enc=resp_enc,
                     )
                 lags, pids_sorted = resolved
             if st.engine is None:
@@ -2253,11 +2365,16 @@ class AssignorService:
                     "served": "kept_previous",
                 }
                 self._note_epoch(st, klass, lags)
+                a_delta, a_epoch = self._note_assignment(
+                    st, ack, topic, members_sorted, pids_sorted, choice
+                )
                 return self._stream_result(
                     topic, members_sorted, pids_sorted, choice, s,
                     fallback_used=False, degraded_rung="none",
                     warm_restart=warm_restart, opts=opts, klass=klass,
                     shed=shed_info, lag_epoch=st.lag_epoch,
+                    assign_delta=a_delta, assign_epoch=a_epoch,
+                    resp_enc=resp_enc,
                 )
             # Multi-tenant routing: with MORE than one live stream the
             # warm dispatch goes through the megabatch coalescer (one
@@ -2415,6 +2532,13 @@ class AssignorService:
             # with the successor's lag vector (a silently wrong base).
             self._note_epoch(st, klass, lags)
             lag_epoch_out = st.lag_epoch
+            # Assignment-delta bookkeeping must also happen INSIDE the
+            # locked region: the served base pair (assign_epoch,
+            # last_served) must never tear against a concurrent
+            # request's ack validation.
+            a_delta, a_epoch = self._note_assignment(
+                st, ack, topic, members_sorted, pids_sorted, choice
+            )
         finally:
             if pace_held:
                 self._resync_pacer.release()
@@ -2425,6 +2549,8 @@ class AssignorService:
             fallback_used=fallback_used, degraded_rung=degraded_rung,
             warm_restart=warm_restart, opts=opts, klass=klass,
             shed=shed_info, lag_epoch=lag_epoch_out,
+            assign_delta=a_delta, assign_epoch=a_epoch,
+            resp_enc=resp_enc,
         )
 
     def _note_epoch(self, st: _Stream, klass: str, lags) -> None:
@@ -2441,6 +2567,66 @@ class AssignorService:
         )
         st.last_lags = lags
         st.lag_epoch += 1
+
+    def _note_assignment(
+        self, st: _Stream, ack, topic, members_sorted, pids_sorted,
+        choice,
+    ):
+        """Advance the stream's assignment-delta base and decide this
+        answer's encoding (module docstring "Delta responses").  Caller
+        holds ``st.lock`` — the (epoch, last_served) pair must never
+        tear against a concurrent request's ack validation, exactly
+        like the lag base in :meth:`_note_epoch`.
+
+        Returns ``(assignment_delta or None, new assign_epoch)``.  The
+        delta is served only when the client's ack names the CURRENT
+        epoch AND the roster (members + pid set) is unchanged — the
+        same monotone-epoch/ack/resync ladder as the round-13 upload
+        path; every other case answers dense, which re-seeds the
+        client's base.  Outcomes mirror the upload counter:
+        ``klba_assign_delta_epochs_total{outcome}``."""
+        import numpy as np
+
+        choice = np.asarray(choice, dtype=np.int32)
+        pids = np.asarray(pids_sorted, dtype=np.int64)
+        prev = st.last_served
+        delta_out = None
+        if ack is not None:
+            servable = (
+                prev is not None
+                and ack == st.assign_epoch
+                and prev[0] == list(members_sorted)
+                and prev[1].shape == pids.shape
+                and np.array_equal(prev[1], pids)
+                and prev[2].shape == choice.shape
+            )
+            if servable:
+                changed = np.flatnonzero(prev[2] != choice)
+                delta_out = {
+                    "base_epoch": st.assign_epoch,
+                    "epoch": st.assign_epoch + 1,
+                    "topic": topic,
+                    "indices": pids[changed].tolist(),
+                    # Owner = index into the (sorted) member list the
+                    # client sent — stable exactly because the delta is
+                    # only served on an unchanged roster.
+                    "owners": choice[changed].tolist(),
+                }
+                outcome = "applied"
+            elif prev is None or ack != st.assign_epoch:
+                # Epoch gap / restart-rebuilt stream: the dense answer
+                # below IS the resync.
+                outcome = "resync"
+            else:
+                outcome = "fallback"
+            metrics.REGISTRY.counter(
+                "klba_assign_delta_epochs_total", {"outcome": outcome}
+            ).inc()
+        st.assign_epoch += 1
+        st.last_served = (
+            list(members_sorted), pids.copy(), choice.copy()
+        )
+        return delta_out, st.assign_epoch
 
     def _apply_wire_delta(self, st: _Stream, delta):
         """Apply a parsed ``lag_delta`` to the stream's stored base
@@ -2471,20 +2657,31 @@ class AssignorService:
         opts: Dict[str, Any], klass: str,
         shed: Optional[Dict[str, Any]],
         lag_epoch: int = 0, resync: bool = False,
+        assign_delta: Optional[Dict[str, Any]] = None,
+        assign_epoch: int = 0,
+        resp_enc: Optional[str] = None,
     ) -> Dict[str, Any]:
         import numpy as np
 
-        choice_l = np.asarray(choice).tolist()
-        pids_l = pids_sorted.tolist()
-        assignments: Dict[str, List[List[Any]]] = {
-            m: [] for m in members_sorted
-        }
-        for row, consumer in enumerate(choice_l):
-            assignments[members_sorted[consumer]].append(
-                [topic, pids_l[row]]
-            )
+        if assign_delta is not None:
+            # Delta-encoded answer (module docstring "Delta responses"):
+            # only the changed rows cross the wire — the O(P) dense
+            # dict is never even BUILT host-side, so the response cost
+            # scales with churn in both directions.
+            out: Dict[str, Any] = {"assignment_delta": assign_delta}
+        else:
+            choice_l = np.asarray(choice).tolist()
+            pids_l = pids_sorted.tolist()
+            assignments: Dict[str, List[List[Any]]] = {
+                m: [] for m in members_sorted
+            }
+            for row, consumer in enumerate(choice_l):
+                assignments[members_sorted[consumer]].append(
+                    [topic, pids_l[row]]
+                )
+            out = _encode_dense_assignments(assignments, resp_enc)
         return {
-            "assignments": assignments,
+            **out,
             "stream": {
                 "cold_start": s.cold_start,
                 "refined": s.refined,
@@ -2512,6 +2709,11 @@ class AssignorService:
                 # whether THIS answer demands a dense re-send.
                 "lag_epoch": lag_epoch,
                 "resync": resync,
+                # Delta-RESPONSE surface: the monotone epoch of the
+                # assignment this answer carries — the value a client's
+                # next ``params.assign_ack`` names to opt into a
+                # delta-encoded answer.
+                "assign_epoch": assign_epoch,
                 # Adaptive-delta surface (ROADMAP delta follow-on (b)):
                 # the delta/dense cutoff actually in force this epoch.
                 "delta_effective_fraction": s.delta_effective_fraction,
@@ -2610,6 +2812,7 @@ class AssignorService:
         C = len(members_sorted)
         rows = _decode_wire_lags(params)
         pids_sorted, lags = _parse_lag_rows(rows)
+        resp_enc = _parse_accept_encoding(params)
 
         # Overload admission, shared with stream_assign (the
         # "peer-round cost feeds the controller" contract); on THIS
@@ -2679,6 +2882,10 @@ class AssignorService:
                 "converged": fed["converged"],
                 "peers_ok": fed["peers_ok"],
                 "staleness_s": fed["staleness_s"],
+                # True when the gossip daemon's warm dual cache served
+                # this assign in one local round (no synchronous peer
+                # RTT) — the bench's constant-time-serve gate reads it.
+                "warm_cache": bool(fed.get("warm_cache", False)),
                 "epoch": self._federation.local_epoch,
             }
             metrics.FLIGHT.record(
@@ -2693,7 +2900,7 @@ class AssignorService:
                 },
             )
             return {
-                "assignments": assignments,
+                **_encode_dense_assignments(assignments, resp_enc),
                 "federation": fed_out,
                 "stats": stats_out,
             }
@@ -3590,7 +3797,13 @@ class AssignorServiceClient:
                 exc.trace_id = resp.get("trace_id")
                 raise exc
             raise RuntimeError(resp["error"]["message"])
-        return resp["result"]
+        result = resp["result"]
+        if isinstance(result, dict) and "assignments_encoded" in result:
+            # Transparent inflate of a compressed dense response
+            # (accept_encoding opt-in): callers keep reading the plain
+            # ``assignments`` key either way.
+            result = decode_wire_assignments(result)
+        return result
 
     def ping(self) -> bool:
         return self.request("ping") == "pong"
@@ -3875,6 +4088,13 @@ def main() -> None:
              "still serve the middle federation rung (default 300000)",
     )
     parser.add_argument(
+        "--federation-gossip-interval-ms", type=float, default=0.0,
+        metavar="MS",
+        help="cadence of the background dual-gossip daemon (0 = off; "
+             "> 0 serves federated_assign from the warm dual cache in "
+             "one local round)",
+    )
+    parser.add_argument(
         "--recovery-prestack", action="store_true",
         help="pre-stack recovered rosters at boot (device-resident "
              "rebuild off the serving path) so the restart storm's "
@@ -3949,6 +4169,9 @@ def main() -> None:
         / 1000.0,
         federation_max_staleness_s=max(
             opts.federation_max_staleness_ms, 0.0
+        ) / 1000.0,
+        federation_gossip_interval_s=max(
+            opts.federation_gossip_interval_ms, 0.0
         ) / 1000.0,
         federation_capacity=federation_capacity,
         mesh_devices=opts.mesh_devices,

@@ -213,6 +213,13 @@ FEDERATION_SYNC_TIMEOUT_CONFIG = "tpu.assignor.federation.sync.timeout.ms"
 FEDERATION_MAX_STALENESS_CONFIG = (
     "tpu.assignor.federation.max.staleness.ms"
 )
+# Async gossip duals (ISSUE 19): cadence of the background dual-
+# convergence daemon.  0 (the default) disables gossip — every
+# federated_assign pays the synchronous exchange; > 0 keeps the duals
+# warm so assigns serve rung global from cache in one local round.
+FEDERATION_GOSSIP_INTERVAL_CONFIG = (
+    "tpu.assignor.federation.gossip.interval.ms"
+)
 # Weighted shards (ROADMAP federated (c)): this cluster's per-consumer
 # capacity weight vector as comma-separated positive floats (length =
 # the consumer count federated_assign serves).  Exchanged in the hello
@@ -352,6 +359,7 @@ class AssignorConfig:
     federation_rounds: int = 16
     federation_sync_timeout_s: float = 2.0
     federation_max_staleness_s: float = 300.0
+    federation_gossip_interval_s: float = 0.0
     federation_capacity: Optional[list] = None
     # (max_partitions, num_consumers) shapes to pre-compile at configure().
     warmup_shapes: list = field(default_factory=list)
@@ -526,6 +534,13 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
     federation_max_staleness_s = _as_ms(
         FEDERATION_MAX_STALENESS_CONFIG, 300_000.0
     )
+    federation_gossip_interval_s = _as_ms(
+        FEDERATION_GOSSIP_INTERVAL_CONFIG, 0.0
+    )
+    if federation_gossip_interval_s < 0:
+        raise ValueError(
+            f"{FEDERATION_GOSSIP_INTERVAL_CONFIG} must be >= 0 ms"
+        )
     raw_capacity = consumer_group_props.get(
         FEDERATION_CAPACITY_CONFIG, ""
     )
@@ -677,6 +692,7 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
         federation_rounds=federation_rounds,
         federation_sync_timeout_s=federation_sync_timeout_s,
         federation_max_staleness_s=federation_max_staleness_s,
+        federation_gossip_interval_s=federation_gossip_interval_s,
         federation_capacity=federation_capacity,
         recovery_prestack=_as_bool(
             consumer_group_props.get(RECOVERY_PRESTACK_CONFIG, False)

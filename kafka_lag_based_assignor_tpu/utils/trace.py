@@ -109,6 +109,7 @@ SPAN_CATALOG = frozenset({
     "federation.assign",
     "federation.round",
     "federation.sync",
+    "federation.gossip",
     # scope roots
     "request",
     "client",

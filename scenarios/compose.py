@@ -165,6 +165,17 @@ def backend_slow(
     ))
 
 
+def peer_partition(epochs: Sequence[int]) -> FaultPlane:
+    """Sever every gossip/exchange link for the listed trace epochs
+    (``per_epoch=0``: EVERY peer RPC in the window fails, the full
+    partition shape) — the federated ladder must degrade
+    global -> last_good_global -> local_only as the dual cache ages
+    out, and recover to rung global after the heal."""
+    return FaultPlane("peer_partition", (
+        FaultEvent("peer.partition", tuple(epochs), per_epoch=0),
+    ))
+
+
 def shed_flake(epochs: Sequence[int], per_epoch: int = 1) -> FaultPlane:
     """The overload controller's admission decision itself faults —
     the service must FAIL OPEN (admit) rather than shed on an error."""

@@ -44,6 +44,10 @@ class Scenario:
     fast: bool = True
     tune: Optional[Callable] = None
     epoch_sleep_s: float = 0.0
+    # Federated scenarios replay through the two-sidecar engine
+    # (scenarios/federated.py) and gate the federation ladder instead
+    # of the stream envelope.
+    federated: bool = False
     summary: str = ""
 
 
@@ -143,6 +147,25 @@ CORPUS: Tuple[Scenario, ...] = (
         ),
     ),
     Scenario(
+        name="peer_partition",
+        trace="lag_wave_multi", seed=1112,
+        trace_knobs={"epochs": 13},
+        federated=True,
+        planes=(
+            compose.peer_partition(epochs=(4, 5, 6, 7, 8, 9)),
+        ),
+        envelope=Envelope(
+            max_rung="host_snake", max_steady_compiles=None,
+            require_anomaly_traces=False,
+        ),
+        summary=(
+            "gossip links severed mid-trace, then healed — the "
+            "federated ladder must degrade global -> "
+            "last_good_global -> local_only as the dual cache ages "
+            "out, and recover to warm-cache global after the heal"
+        ),
+    ),
+    Scenario(
         name="zipf_overload_shed",
         trace="zipf_tenants", seed=1108,
         trace_knobs={"tenants": 8, "epochs": 8},
@@ -215,6 +238,10 @@ def run_scenario(
     demands bit-exact recovery) and evaluate the envelope; returns the
     JSON-ready result row carrying everything needed to reproduce."""
     seed = sc.seed if seed is None else seed
+    if sc.federated:
+        from .federated import replay_federated
+
+        return replay_federated(sc, seed)
     trace = generate(sc.trace, seed, **sc.trace_knobs)
     injector = (
         compose.build_injector(sc.planes, seed=seed)

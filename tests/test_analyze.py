@@ -441,6 +441,114 @@ def test_a002_nested_self_acquisition():
     assert codes_of(rep, "A002") == []
 
 
+# --- A002 per-instance lock identity --------------------------------------
+
+A002_TWO_INSTANCES = """\
+import threading
+
+
+class Coord:
+    def __init__(self):
+        self._cache_lock = threading.Lock()
+
+
+def drill(mine, twin):
+    with mine._cache_lock:
+        with twin._cache_lock:
+            pass
+"""
+
+
+def test_a002_two_instances_of_one_class_are_distinct_locks():
+    """Nesting the SAME class attribute through two different instance
+    variables is two lock objects, not a self-deadlock — collapsing by
+    class attribute would flag every twin-drill/gossip-vs-serve
+    pattern that orders instances consistently."""
+    rep = run_snippet(SERVICE, A002_TWO_INSTANCES)
+    assert codes_of(rep, "A002") == []
+
+
+def test_a002_same_instance_reacquired_is_self_deadlock():
+    """The per-instance identity cuts the other way too: re-acquiring
+    one non-reentrant Lock through the SAME instance variable is a
+    guaranteed self-deadlock (previously invisible — the aliased id
+    was skipped without a finding)."""
+    src = A002_TWO_INSTANCES.replace(
+        "    with mine._cache_lock:\n"
+        "        with twin._cache_lock:",
+        "    with mine._cache_lock:\n"
+        "        with mine._cache_lock:",
+    )
+    rep = run_snippet(SERVICE, src)
+    found = codes_of(rep, "A002")
+    assert len(found) == 1
+    assert "self-deadlock" in found[0].message
+    assert "_cache_lock@mine" in found[0].message
+
+
+def test_a002_per_instance_cycle_keeps_instance_names():
+    """Opposite nesting orders across two instance variables is still
+    a reportable order cycle — and the finding names the instances,
+    not just the class attribute."""
+    src = A002_TWO_INSTANCES + textwrap.dedent(
+        """
+        def heal(mine, twin):
+            with twin._cache_lock:
+                with mine._cache_lock:
+                    pass
+        """
+    )
+    rep = run_snippet(SERVICE, src)
+    found = codes_of(rep, "A002")
+    assert len(found) == 1
+    assert "lock-order cycle" in found[0].message
+    assert "_cache_lock@mine" in found[0].message
+    assert "_cache_lock@twin" in found[0].message
+
+
+def test_a002_self_vs_peer_cross_attribute_order_unflagged():
+    """The hierarchical self-then-peer discipline over two DIFFERENT
+    attributes is four distinct lock objects under per-instance
+    identity — the attribute-collapsed view used to see a spurious
+    a->b / b->a cycle here."""
+    src = """\
+    import threading
+
+
+    class Coord:
+        def __init__(self):
+            self._a_lock = threading.Lock()
+            self._b_lock = threading.Lock()
+
+        def push(self, peer):
+            with self._a_lock:
+                with peer._b_lock:
+                    pass
+
+        def pull(self, peer):
+            with self._b_lock:
+                with peer._a_lock:
+                    pass
+    """
+    rep = run_snippet(SERVICE, src)
+    assert codes_of(rep, "A002") == []
+
+
+def test_a002_per_instance_finding_rides_sarif():
+    src = A002_TWO_INSTANCES.replace(
+        "    with mine._cache_lock:\n"
+        "        with twin._cache_lock:",
+        "    with mine._cache_lock:\n"
+        "        with mine._cache_lock:",
+    )
+    rep = run_snippet(SERVICE, src)
+    doc = build_sarif(rep.findings, rep.stats)
+    results = doc["runs"][0]["results"]
+    a002 = [r for r in results if r["ruleId"] == "A002"]
+    assert len(a002) == 1
+    assert "_cache_lock@mine" in a002[0]["message"]["text"]
+
+
 # --- A003 recompile hazard ------------------------------------------------
 
 A003_POSITIVE = """\

@@ -1,0 +1,477 @@
+"""Delta-response tests (ISSUE 19 — the O(changed) READBACK half of
+the delta plane, mirroring :mod:`test_delta`'s upload coverage): the
+``ops/delta`` compaction-width rule and host scatter, the engine's
+fused-tail readback differentially against an always-dense twin (bit
+parity + D2H byte accounting), the wire ``assign_ack`` ->
+``assignment_delta`` ladder with its monotone epoch and roster guard,
+the client-side :class:`..lag.AssignmentDeltaTracker`, and the zlib
+dense-response opt-in (``params.accept_encoding``)."""
+
+import numpy as np
+import pytest
+
+from kafka_lag_based_assignor_tpu.lag import AssignmentDeltaTracker
+from kafka_lag_based_assignor_tpu.ops.delta import (
+    RB_MIN_K,
+    apply_assignment_delta,
+    compact_changed,
+    readback_k,
+)
+from kafka_lag_based_assignor_tpu.ops.streaming import StreamingAssignor
+from kafka_lag_based_assignor_tpu.service import (
+    AssignorService,
+    AssignorServiceClient,
+    _encode_dense_assignments,
+    decode_wire_assignments,
+)
+from kafka_lag_based_assignor_tpu.testing import assert_valid_assignment
+from kafka_lag_based_assignor_tpu.utils import metrics
+
+MEMBERS = ["A", "B"]
+
+
+def _counter(name, **labels):
+    return metrics.REGISTRY.counter(name, labels)
+
+
+def _rows(lags):
+    return [[int(p), int(v)] for p, v in enumerate(lags)]
+
+
+def _params(sid, lags, members, **extra):
+    p = {
+        "stream_id": sid, "topic": "t0", "members": members,
+        "lags": _rows(lags),
+    }
+    p.update(extra)
+    return p
+
+
+@pytest.fixture
+def service():
+    with AssignorService(port=0, solve_timeout_s=60.0) as svc:
+        yield svc
+
+
+# -- ops/delta unit semantics ----------------------------------------------
+
+
+def test_readback_k_width_rule():
+    # 2 * budget, pow2-ceiled, floored at RB_MIN_K.
+    big_p = 1 << 20
+    assert readback_k(1, big_p) == RB_MIN_K
+    assert readback_k(8, big_p) == RB_MIN_K
+    assert readback_k(10, big_p) == 32
+    assert readback_k(16, big_p) == 32
+    assert readback_k(64, big_p) == 128
+
+
+def test_readback_k_dense_when_no_budget_or_no_win():
+    assert readback_k(0, 4096) == 0
+    assert readback_k(-1, 4096) == 0
+    assert readback_k(16, 0) == 0
+    # Byte-win gate under the delta-hostile dtype pairing: K=32 costs
+    # 32*8=256 bytes, the int16 dense vector costs 2*P — the delta
+    # side must STRICTLY win.
+    assert readback_k(16, 128) == 0  # 256 >= 256: dense
+    assert readback_k(16, 129) == 32  # 256 < 258: delta
+
+
+def test_compact_and_apply_roundtrip():
+    import jax.numpy as jnp
+
+    P, K = 40, 16
+    entry = np.arange(P + 8, dtype=np.int32) % 4  # padded past P
+    exit_ = entry.copy()
+    moved = np.array([3, 17, 39])
+    exit_[moved] = (exit_[moved] + 1) % 4
+    exit_[P:] = 99  # pad-row garbage must never surface
+    narrow = exit_[:P].astype(np.int16)
+    d_idx, d_vals, d_n = compact_changed(
+        jnp.asarray(entry), jnp.asarray(exit_), jnp.asarray(narrow),
+        P, K,
+    )
+    assert int(d_n) == moved.size
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(d_idx)[: int(d_n)]), moved
+    )
+    got = apply_assignment_delta(
+        entry[:P], np.asarray(d_idx), np.asarray(d_vals), int(d_n)
+    )
+    np.testing.assert_array_equal(got, exit_[:P].astype(np.int32))
+    # Padding entries are index 0's true exit value — scattering the
+    # full padded tail would still write only truth.
+    full = apply_assignment_delta(
+        entry[:P], np.asarray(d_idx), np.asarray(d_vals), K
+    )
+    np.testing.assert_array_equal(full, exit_[:P].astype(np.int32))
+
+
+def test_compact_reports_true_count_past_k():
+    """Overflow is detected host-side: the count rides along and may
+    exceed K — the host then fetches dense, never trusts the tail."""
+    import jax.numpy as jnp
+
+    P, K = 64, 16
+    entry = np.zeros(P, np.int32)
+    exit_ = np.ones(P, np.int32)  # every row changed
+    d_idx, d_vals, d_n = compact_changed(
+        jnp.asarray(entry), jnp.asarray(exit_),
+        jnp.asarray(exit_.astype(np.int16)), P, K,
+    )
+    assert int(d_n) == P
+    assert np.asarray(d_idx).shape == (K,)
+
+
+# -- engine readback: differential vs the dense twin -----------------------
+
+
+def test_engine_readback_bit_parity_and_d2h_bytes():
+    """A delta-enabled engine and an always-dense twin driven through
+    the SAME lag sequence produce bit-identical choices, while the
+    delta engine's warm epochs charge exactly the O(K) compaction-tail
+    bytes (idx int32[K] + narrow vals[K] + 4-byte count) to the
+    ``klba_d2h_bytes_total{path=delta}`` counter and count
+    ``applied`` readback outcomes — and never touch the dense
+    counter."""
+    P, C, iters, epochs = 1024, 8, 16, 4
+    rb_k = readback_k(iters, P)
+    assert rb_k == 32
+    per_epoch = rb_k * 4 + rb_k * 2 + 4  # int16 narrow: C <= 32767
+    rng = np.random.default_rng(19)
+    base = rng.integers(0, 10**6, P).astype(np.int64)
+    drifts = []
+    lags = base
+    for _ in range(epochs):
+        lags = lags.copy()
+        idx = rng.choice(P, 8, replace=False)
+        lags[idx] += rng.integers(1, 10**5, 8)
+        drifts.append(lags)
+
+    def drive(delta_enabled):
+        eng = StreamingAssignor(
+            num_consumers=C, refine_iters=iters,
+            refine_threshold=None, delta_enabled=delta_enabled,
+        )
+        out = [np.asarray(eng.rebalance(base))]  # cold (dense path)
+        d2h_delta = _counter("klba_d2h_bytes_total", path="delta")
+        d2h_dense = _counter("klba_d2h_bytes_total", path="dense")
+        applied = _counter("klba_rb_delta_epochs_total",
+                           outcome="applied")
+        marks = (d2h_delta.value, d2h_dense.value, applied.value)
+        for lags in drifts:
+            out.append(np.asarray(eng.rebalance(lags)))
+        return out, (
+            d2h_delta.value - marks[0],
+            d2h_dense.value - marks[1],
+            applied.value - marks[2],
+        )
+
+    got_delta, (db, xb, napplied) = drive(True)
+    got_dense, (db2, xb2, _) = drive(False)
+    for a, b in zip(got_delta, got_dense):
+        np.testing.assert_array_equal(a, b)
+    for choice in got_delta:
+        counts = np.bincount(choice, minlength=C)
+        assert counts.max() - counts.min() <= 1
+    # Delta engine: every warm epoch took the O(changed) readback.
+    assert napplied == epochs
+    assert db == epochs * per_epoch
+    assert xb == 0
+    # Dense twin: all bytes on the dense counter, none on delta.
+    assert db2 == 0
+    assert xb2 == epochs * P * 2  # int16 narrow vector
+
+
+# -- wire ladder: assign_ack -> assignment_delta ---------------------------
+
+
+class TestWireAssignmentDelta:
+    def test_acked_delta_matches_dense_twin(self, service):
+        """An acked epoch answers ``assignment_delta`` (no dense dict
+        at all) and the tracker's reconstruction is bit-identical to a
+        twin stream served densely through the same lag sequence."""
+        lags1 = (np.arange(96) + 1) * 1000
+        lags2 = lags1.copy()
+        lags2[:12] += 10**8  # heat one member's rows: ownership moves
+        tr = AssignmentDeltaTracker()
+        applied = _counter("klba_assign_delta_epochs_total",
+                           outcome="applied")
+        before = applied.value
+        with AssignorServiceClient(*service.address) as c:
+            r1 = c.request(
+                "stream_assign", _params("d", lags1, MEMBERS)
+            )
+            assert r1["stream"]["assign_epoch"] == 1
+            assert "assignment_delta" not in r1
+            assert tr.note_result(r1, MEMBERS) == r1["assignments"]
+            p2 = _params("d", lags2, MEMBERS)
+            tr.stamp(p2)
+            assert p2["assign_ack"] == 1
+            r2 = c.request("stream_assign", p2)
+            assert "assignments" not in r2
+            delta = r2["assignment_delta"]
+            assert delta["base_epoch"] == 1 and delta["epoch"] == 2
+            assert r2["stream"]["assign_epoch"] == 2
+            rebuilt = tr.note_result(r2, MEMBERS)
+            # Dense twin: same sequence, never acks.
+            c.request("stream_assign", _params("t", lags1, MEMBERS))
+            rt = c.request("stream_assign", _params("t", lags2, MEMBERS))
+            assert rebuilt == rt["assignments"]
+            assert_valid_assignment(rebuilt, lags2.shape[0])
+        assert applied.value == before + 1
+
+    def test_stale_ack_answers_dense_resync(self, service):
+        resync = _counter("klba_assign_delta_epochs_total",
+                          outcome="resync")
+        lags = (np.arange(64) + 1) * 100
+        with AssignorServiceClient(*service.address) as c:
+            c.request("stream_assign", _params("d", lags, MEMBERS))
+            c.request("stream_assign", _params("d", lags, MEMBERS))
+            before = resync.value
+            # Epoch is 2 now; an ack naming 1 gapped (a lost answer).
+            r = c.request(
+                "stream_assign",
+                _params("d", lags, MEMBERS, assign_ack=1),
+            )
+            assert "assignments" in r
+            assert r["stream"]["assign_epoch"] == 3
+            assert resync.value == before + 1
+
+    def test_roster_change_falls_back_dense(self, service):
+        fallback = _counter("klba_assign_delta_epochs_total",
+                            outcome="fallback")
+        lags = (np.arange(64) + 1) * 100
+        with AssignorServiceClient(*service.address) as c:
+            c.request("stream_assign", _params("d", lags, MEMBERS))
+            before = fallback.value
+            # Current ack, changed member list: delta owners would
+            # bind to the wrong sorted order — dense instead.
+            r = c.request(
+                "stream_assign",
+                _params("d", lags, MEMBERS + ["C"], assign_ack=1),
+            )
+            assert "assignments" in r
+            assert fallback.value == before + 1
+            # Current ack, changed pid set: same fallback.
+            before = fallback.value
+            r = c.request(
+                "stream_assign",
+                _params("d", lags[:-1], MEMBERS + ["C"], assign_ack=2),
+            )
+            assert "assignments" in r
+            assert fallback.value == before + 1
+
+    def test_stream_reset_rearms_dense(self, service):
+        resync = _counter("klba_assign_delta_epochs_total",
+                          outcome="resync")
+        lags = (np.arange(64) + 1) * 100
+        with AssignorServiceClient(*service.address) as c:
+            c.request("stream_assign", _params("d", lags, MEMBERS))
+            assert c.stream_reset("d") is True
+            before = resync.value
+            r = c.request(
+                "stream_assign",
+                _params("d", lags, MEMBERS, assign_ack=1),
+            )
+            # Rebuilt stream restarts its epoch counter — the dense
+            # answer IS the resync, and the epoch stays monotone from
+            # the new stream's perspective.
+            assert "assignments" in r
+            assert r["stream"]["assign_epoch"] == 1
+            assert resync.value == before + 1
+
+    def test_restart_resyncs_dense_bit_exact_vs_twin(self, tmp_path):
+        """Crash/restart drill for the RESPONSE direction: the
+        lifecycle snapshot holds no assignment-delta base, so a client
+        acking its pre-crash epoch must get a dense resync — and the
+        resynced assignment sequence must be bit-identical to an
+        unfaulted twin service driven through the same lags."""
+        path = str(tmp_path / "snap.json")
+        lags1 = (np.arange(48) + 1) * 1000
+        lags2 = lags1.copy()
+        lags2[:6] += 10**8
+        resync = _counter("klba_assign_delta_epochs_total",
+                          outcome="resync")
+        tr = AssignmentDeltaTracker()
+        kw = dict(
+            port=0, snapshot_path=path, snapshot_interval_s=3600.0,
+            recovery_warmup=False,
+        )
+        with AssignorService(**kw) as svc:
+            with AssignorServiceClient(*svc.address) as c:
+                r1 = c.request(
+                    "stream_assign", _params("rs", lags1, MEMBERS)
+                )
+                tr.note_result(r1, MEMBERS)
+                assert r1["stream"]["assign_epoch"] == 1
+            assert svc.snapshot_now()["ok"]
+        with AssignorService(**kw) as svc2:
+            with AssignorServiceClient(*svc2.address) as c:
+                before = resync.value
+                p = _params("rs", lags2, MEMBERS)
+                tr.stamp(p)
+                assert p["assign_ack"] == 1
+                r = c.request("stream_assign", p)
+                # Rebuilt stream: the dense answer IS the resync.
+                assert "assignments" in r
+                assert resync.value == before + 1
+                rebuilt = tr.note_result(r, MEMBERS)
+                assert rebuilt == r["assignments"]
+                # Dense re-seed restores delta mode end to end.
+                p2 = _params("rs", lags2, MEMBERS)
+                tr.stamp(p2)
+                r2 = c.request("stream_assign", p2)
+                assert "assignment_delta" in r2
+                tr.note_result(r2, MEMBERS)
+        # Unfaulted twin: same lag sequence, no crash — the recovered
+        # service's post-restart answers must match bit-for-bit.
+        with AssignorService(port=0, recovery_warmup=False) as twin:
+            with AssignorServiceClient(*twin.address) as c:
+                c.request("stream_assign", _params("rs", lags1, MEMBERS))
+                t1 = c.request(
+                    "stream_assign", _params("rs", lags2, MEMBERS)
+                )
+                t2 = c.request(
+                    "stream_assign", _params("rs", lags2, MEMBERS)
+                )
+        assert r["assignments"] == t1["assignments"]
+        assert tr.assignments(sorted(MEMBERS)) == t2["assignments"]
+
+    @pytest.mark.parametrize("bad", [True, -1, "one", 1.5])
+    def test_ack_validation(self, service, bad):
+        lags = (np.arange(16) + 1) * 10
+        with AssignorServiceClient(*service.address) as c:
+            with pytest.raises(RuntimeError, match="assign_ack"):
+                c.request(
+                    "stream_assign",
+                    _params("d", lags, MEMBERS, assign_ack=bad),
+                )
+
+
+# -- client-side tracker unit semantics ------------------------------------
+
+
+class TestAssignmentDeltaTracker:
+    def test_acks_nothing_before_dense_base(self):
+        tr = AssignmentDeltaTracker()
+        p = {}
+        assert tr.stamp(p) is p and "assign_ack" not in p
+
+    def test_old_server_without_epoch_stays_dense(self):
+        tr = AssignmentDeltaTracker()
+        tr.note_result(
+            {"assignments": {"A": [["t0", 0]]}, "stream": {}}, ["A"]
+        )
+        p = {}
+        tr.stamp(p)
+        assert "assign_ack" not in p
+
+    def test_unheld_base_raises_and_resyncs(self):
+        tr = AssignmentDeltaTracker()
+        tr.note_result(
+            {
+                "assignments": {"A": [["t0", 0]], "B": []},
+                "stream": {"assign_epoch": 1},
+            },
+            MEMBERS,
+        )
+        with pytest.raises(ValueError, match="re-sync"):
+            tr.note_result(
+                {
+                    "assignment_delta": {
+                        "base_epoch": 7, "epoch": 8, "topic": "t0",
+                        "indices": [0], "owners": [1],
+                    }
+                },
+                MEMBERS,
+            )
+        p = {}
+        tr.stamp(p)
+        assert "assign_ack" not in p  # base dropped: next epoch dense
+
+    def test_result_without_either_shape_raises(self):
+        tr = AssignmentDeltaTracker()
+        with pytest.raises(ValueError, match="neither"):
+            tr.note_result({"stream": {}}, MEMBERS)
+
+    def test_delta_application_binds_sorted_members(self):
+        tr = AssignmentDeltaTracker()
+        tr.note_result(
+            {
+                "assignments": {"B": [["t0", 0], ["t0", 1]], "A": []},
+                "stream": {"assign_epoch": 1},
+            },
+            ["B", "A"],
+        )
+        got = tr.note_result(
+            {
+                "assignment_delta": {
+                    "base_epoch": 1, "epoch": 2, "topic": "t0",
+                    # owner 0 = "A" in sorted order, whatever order
+                    # the request named the members in.
+                    "indices": [1], "owners": [0],
+                }
+            },
+            ["B", "A"],
+        )
+        assert got == {"A": [["t0", 1]], "B": [["t0", 0]]}
+
+
+# -- zlib dense-response opt-in --------------------------------------------
+
+
+class TestResponseEncoding:
+    def test_encode_decode_roundtrip_unit(self):
+        assignments = {
+            "A": [["t0", p] for p in range(0, 64, 2)],
+            "B": [["t0", p] for p in range(1, 64, 2)],
+        }
+        assert _encode_dense_assignments(assignments, None) == {
+            "assignments": assignments
+        }
+        wrapped = _encode_dense_assignments(assignments, "zlib")
+        assert wrapped["assignments_encoding"] == "zlib"
+        assert "assignments" not in wrapped
+        out = decode_wire_assignments(dict(wrapped))
+        assert out["assignments"] == assignments
+        assert "assignments_encoded" not in out
+        # Pass-through for plain results; unknown encodings refuse.
+        plain = {"assignments": assignments}
+        assert decode_wire_assignments(plain) is plain
+        with pytest.raises(ValueError, match="assignments_encoding"):
+            decode_wire_assignments(
+                {"assignments_encoded": "eJw=",
+                 "assignments_encoding": "gzip"}
+            )
+
+    def test_wire_opt_in_matches_plain_and_counts_bytes(self, service):
+        lags = (np.arange(256) + 1) * 17
+        z = _counter("klba_wire_assign_bytes_total", encoding="zlib")
+        pl = _counter("klba_wire_assign_bytes_total", encoding="plain")
+        zb, pb = z.value, pl.value
+        with AssignorServiceClient(*service.address) as c:
+            plain_r = c.request(
+                "stream_assign", _params("p", lags, MEMBERS)
+            )
+            assert (z.value, pl.value) == (zb, pb)  # no opt-in
+            enc_r = c.request(
+                "stream_assign",
+                _params("e", lags, MEMBERS, accept_encoding="zlib"),
+            )
+        # The client transparently inflated: same dense dict as the
+        # identically-driven plain twin, and the compressed bytes won.
+        assert enc_r["assignments"] == plain_r["assignments"]
+        assert "assignments_encoded" not in enc_r
+        assert z.value > zb and pl.value > pb
+        assert z.value - zb < pl.value - pb
+
+    def test_unknown_accept_encoding_is_structured_error(self, service):
+        lags = (np.arange(16) + 1) * 10
+        with AssignorServiceClient(*service.address) as c:
+            with pytest.raises(RuntimeError, match="accept_encoding"):
+                c.request(
+                    "stream_assign",
+                    _params("d", lags, MEMBERS,
+                            accept_encoding="gzip"),
+                )

@@ -579,6 +579,158 @@ class TestFederatedService:
             })
 
 
+# -- async gossip duals (ISSUE 19) -----------------------------------------
+
+
+class TestGossipDuals:
+    def test_gossip_phase_whitelisted_unknown_rejected(self):
+        params = wire.sync_request(
+            "a", 1, 1, C, scale=1.0,
+            duals_a=np.zeros(C, np.float32),
+            duals_b=np.zeros(C, np.float32),
+            phase="gossip",
+        )
+        assert params["phase"] == "gossip"
+        assert set(params) <= wire._REQUEST_KEYS
+        with pytest.raises(wire.PayloadViolation, match="phase"):
+            wire.sync_request(
+                "a", 1, 1, C, scale=1.0,
+                duals_a=np.zeros(C, np.float32),
+                duals_b=np.zeros(C, np.float32),
+                phase="mutate",
+            )
+
+    def test_idle_without_shard_or_peers_and_status(self):
+        coord = FederationCoordinator("solo", [])
+        try:
+            idle = _counter(
+                "klba_gossip_rounds_total", {"outcome": "idle"}
+            )
+            assert coord.gossip_now() == "idle"
+            assert _counter(
+                "klba_gossip_rounds_total", {"outcome": "idle"}
+            ) == idle + 1
+            g = coord.status()["gossip"]
+            assert g["interval_s"] == 0.0
+            assert g["thread_alive"] is False
+            assert g["last"]["outcome"] == "idle"
+        finally:
+            coord.close()
+
+    def test_ctor_rejects_negative_interval(self):
+        with pytest.raises(ValueError, match="gossip_interval_s"):
+            FederationCoordinator("solo", [], gossip_interval_s=-0.1)
+
+    def test_gossip_refresh_then_warm_cache_serve(self, duo):
+        """One gossip round refreshes the dual cache; with the warm
+        window open, the next federated_assign serves rung global in
+        ONE local round — no synchronous exchange — and says so via
+        ``federation.warm_cache``."""
+        _warm_federation(duo)
+        fed = duo["svcs"]["a"]._federation
+        ok = _counter("klba_gossip_rounds_total", {"outcome": "ok"})
+        assert fed.gossip_now() == "ok"
+        assert _counter(
+            "klba_gossip_rounds_total", {"outcome": "ok"}
+        ) == ok + 1
+        assert fed.last_gossip["outcome"] == "ok"
+        prev = (fed.gossip_interval_s, fed.gossip_freshness_s)
+        fed.gossip_interval_s, fed.gossip_freshness_s = 1.0, 60.0
+        try:
+            with faults.injected(
+                # Every synchronous peer RPC severed: only the warm
+                # cache can serve rung global here.
+                faults.FaultInjector(7).plan("peer.partition", times=0)
+            ):
+                r = _fed_assign(duo, "a")
+        finally:
+            fed.gossip_interval_s, fed.gossip_freshness_s = prev
+        assert r["federation"]["rung"] == "global"
+        assert r["federation"]["warm_cache"] is True
+        _assert_balanced(r)
+
+    def test_stale_gossip_cache_falls_through_ladder(self, duo):
+        """A cache past the gossip FRESHNESS window (but inside the
+        last-good staleness bound) must NOT serve as warm-cache
+        global — the ordinary ladder answers last_good_global."""
+        _warm_federation(duo)
+        fed = duo["svcs"]["a"]._federation
+        prev = (fed.gossip_interval_s, fed.gossip_freshness_s)
+        fed.gossip_interval_s, fed.gossip_freshness_s = 1.0, 0.5
+        with fed._cache_lock:
+            fed._last_good["at"] -= 1.0  # older than freshness
+        try:
+            with faults.injected(
+                faults.FaultInjector(7).plan("peer.partition", times=0)
+            ):
+                r = _fed_assign(duo, "a")
+        finally:
+            fed.gossip_interval_s, fed.gossip_freshness_s = prev
+        assert r["federation"]["rung"] == "last_good_global"
+        assert r["federation"].get("warm_cache") is False
+        _assert_balanced(r)
+
+    def test_gossip_degraded_under_partition_keeps_cache(self, duo):
+        _warm_federation(duo)
+        fed = duo["svcs"]["a"]._federation
+        degraded = _counter(
+            "klba_gossip_rounds_total", {"outcome": "degraded"}
+        )
+        with faults.injected(
+            faults.FaultInjector(7).plan("peer.partition", times=0)
+        ):
+            assert fed.gossip_now() == "degraded"
+        assert _counter(
+            "klba_gossip_rounds_total", {"outcome": "degraded"}
+        ) == degraded + 1
+        with fed._cache_lock:
+            assert fed._last_good is not None  # kept, just aging
+
+    def test_daemon_thread_starts_and_stops_with_service(self):
+        ports = _free_ports(2)
+        svc = AssignorService(
+            port=ports[0],
+            coalesce_max_batch=1,
+            scrub_interval_ms=0,
+            federation_self_id="g0",
+            federation_peers=f"g1=127.0.0.1:{ports[1]}",
+            federation_gossip_interval_s=30.0,  # never fires in-test
+        )
+        svc.start()
+        try:
+            fed = svc._federation
+            assert fed.gossip_interval_s == 30.0
+            assert fed._gossip_thread is not None
+            assert fed._gossip_thread.is_alive()
+            assert fed.status()["gossip"]["thread_alive"] is True
+        finally:
+            svc.stop()
+        assert not fed._gossip_thread.is_alive()
+
+    def test_gossip_config_key_wiring(self):
+        from kafka_lag_based_assignor_tpu.utils.config import (
+            parse_config,
+        )
+
+        cfg = parse_config({
+            "group.id": "g",
+            "tpu.assignor.federation.self.id": "west",
+            "tpu.assignor.federation.peers": "east=h:7531",
+            "tpu.assignor.federation.gossip.interval.ms": 250,
+        })
+        assert cfg.federation_gossip_interval_s == 0.25
+        assert parse_config({
+            "group.id": "g",
+        }).federation_gossip_interval_s == 0.0
+        with pytest.raises(ValueError, match="gossip"):
+            parse_config({
+                "group.id": "g",
+                "tpu.assignor.federation.self.id": "west",
+                "tpu.assignor.federation.peers": "east=h:7531",
+                "tpu.assignor.federation.gossip.interval.ms": -1,
+            })
+
+
 # -- partition/heal soak (slow) --------------------------------------------
 
 

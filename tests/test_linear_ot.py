@@ -393,6 +393,76 @@ class TestConfigKnobs:
             parse_config({"group.id": "g", key: value})
 
 
+class TestQualityTileAutotune:
+    """Boot-time tile autotune (ops/dispatch.autotune_quality_tile):
+    the fallback when ``memory_stats`` is absent keeps the static tile
+    (tier-1 runs must keep one deterministic geometry), and a real
+    stats dict drives the documented pow2 sizing rule."""
+
+    @pytest.fixture(autouse=True)
+    def _restore_knobs(self):
+        prev_quality = dict(dispatch_mod._QUALITY)
+        prev_source = dict(dispatch_mod._TILE_SOURCE)
+        yield
+        dispatch_mod._QUALITY.update(prev_quality)
+        dispatch_mod._TILE_SOURCE.clear()
+        dispatch_mod._TILE_SOURCE.update(prev_source)
+
+    def test_fallback_keeps_static_tile_on_cpu(self):
+        """No argument on the CPU backend: the device probe yields no
+        memory_stats, so the pre-existing tile survives unchanged and
+        the choice is logged as cpu-default."""
+        before = dispatch_mod.quality_tile()
+        got = dispatch_mod.autotune_quality_tile()
+        assert got == before
+        assert dispatch_mod.quality_tile() == before
+        src = dispatch_mod.quality_status()["tile_source"]
+        assert src["source"] == "cpu-default"
+        assert src["memory_bytes"] is None
+        g = metrics.REGISTRY.gauge(
+            "klba_quality_tile_autotuned", {"source": "cpu-default"}
+        )
+        assert g.value == before
+
+    def test_fallback_on_explicit_falsy_stats(self):
+        """An explicit empty stats dict (a backend that exposes the
+        API but reports nothing) takes the same fallback branch."""
+        before = dispatch_mod.quality_tile()
+        assert dispatch_mod.autotune_quality_tile(memory_stats={}) \
+            == before
+        assert dispatch_mod._TILE_SOURCE["source"] == "cpu-default"
+
+    def test_sizing_rule_from_fake_device_stats(self):
+        """free = limit - in_use; the tile is the largest pow2 with
+        3 * tile * 1024 * 4 under free // 8.  503316480 free bytes
+        gives a 62914560-byte budget: 4096 rows fit (50331648) and
+        8192 do not (100663296)."""
+        stats = {
+            "bytes_limit": 603_316_480,
+            "bytes_in_use": 100_000_000,
+        }
+        got = dispatch_mod.autotune_quality_tile(memory_stats=stats)
+        assert got == 4096
+        assert dispatch_mod.quality_tile() == 4096
+        src = dispatch_mod.quality_status()["tile_source"]
+        assert src["source"] == "autotuned"
+        assert src["memory_bytes"] == 503_316_480
+        g = metrics.REGISTRY.gauge(
+            "klba_quality_tile_autotuned", {"source": "autotuned"}
+        )
+        assert g.value == 4096
+
+    def test_sizing_rule_caps_and_floors(self):
+        """A huge device saturates at the 65536-row cap; a starved one
+        floors at the minimum 8-row tile instead of failing."""
+        huge = {"bytes_limit": 1 << 40, "bytes_in_use": 0}
+        assert dispatch_mod.autotune_quality_tile(
+            memory_stats=huge) == 65536
+        tiny = {"bytes_limit": 2, "bytes_in_use": 1}
+        assert dispatch_mod.autotune_quality_tile(
+            memory_stats=tiny) == 8
+
+
 class TestWarmupPerMode:
     def test_linear_solver_warms_linear_rows(self):
         from kafka_lag_based_assignor_tpu.warmup import warmup

@@ -296,6 +296,28 @@ def test_corpus_satisfies_the_fleet_floor():
         get_scenario("nope")
 
 
+def test_peer_partition_scenario_gates_the_federated_plane():
+    """The ISSUE-19 federated drill is registered in the corpus: a
+    federated replay (two real sidecars), a full peer.partition sever
+    window with trace epochs on both sides so degrade AND heal are
+    exercised, and it rides the CI --fast subset so tier1.yml gates
+    the federated degradation envelope on every push."""
+    sc = get_scenario("peer_partition")
+    assert sc.federated is True
+    assert sc.fast is True
+    sever = [
+        ep
+        for plane in sc.planes
+        for ev in plane.events
+        if ev.point == "peer.partition"
+        for ep in ev.epochs
+    ]
+    assert sever
+    epochs = sc.trace_knobs["epochs"]
+    assert min(sever) > 0  # converged epochs before the sever...
+    assert max(sever) < epochs - 1  # ...and healed epochs after
+
+
 def test_run_fleet_rejects_unknown_only():
     with pytest.raises(KeyError, match="unknown scenario"):
         run_fleet(only=["definitely_not_a_scenario"])

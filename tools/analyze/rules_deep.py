@@ -826,12 +826,21 @@ def _a002_resolver(facts: Dict[str, Any]):
             if kind is not None:
                 return f"{shortmod(rel)}::{attr}", kind, rel
             return f"?::{attr}", None, None
+        # Per-instance identity: a lock reached through a NON-self base
+        # (``peer._cache_lock``) is a different lock object per
+        # instance, so its id carries the instance expression —
+        # collapsing it onto the class attribute would alias every
+        # instance's lock into one node and invent cycles/self-
+        # deadlocks between code that orders two instances correctly
+        # (e.g. a gossip thread touching its own coordinator next to a
+        # drill touching a twin's).
+        inst = f"@{ref['base']}"
         defs = by_attr.get(attr, [])
         if len(defs) == 1:
             drel, kind, dcls = defs[0]
             owner = f"{dcls}." if dcls else ""
-            return f"{shortmod(drel)}::{owner}{attr}", kind, drel
-        return f"?::{attr}", None, None
+            return f"{shortmod(drel)}::{owner}{attr}{inst}", kind, drel
+        return f"?::{attr}{inst}", None, None
 
     return resolve
 
@@ -863,11 +872,17 @@ def _finalize_a002(facts: Dict[str, Any]) -> Iterator[Finding]:
             outer_id, outer_kind, _ = resolve(e["outer"], rel)
             inner_id, _, _ = resolve(e["inner"], rel)
             if outer_id == inner_id:
-                same_self = (
-                    e["outer"].get("base") == "self"
-                    and e["inner"].get("base") == "self"
+                # Same id + same base expression = the SAME lock
+                # object (self-through-self, or the same non-self
+                # instance variable re-acquired) — with per-instance
+                # ids two different instances of one class never reach
+                # here, so this branch is exactly the guaranteed
+                # self-deadlock.
+                same_inst = (
+                    e["outer"].get("base") is not None
+                    and e["outer"].get("base") == e["inner"].get("base")
                 )
-                if same_self and outer_kind == "Lock":
+                if same_inst and outer_kind == "Lock":
                     key = (rel, e["line"], outer_id)
                     if key not in emitted:
                         emitted.add(key)

@@ -257,6 +257,24 @@ def main() -> int:
             dense_b = int(h2d.get("dense", 0))
             delta_b = int(h2d.get("delta", 0))
             print(f"h2d bytes: dense {dense_b} / delta {delta_b}")
+        # D2H mirror (DEPLOYMENT.md "Delta responses"): readback bytes
+        # by path plus the O(changed)-readback hit-rate — the response
+        # direction of the same sparse-path question.
+        d2h = by_label("klba_d2h_bytes_total", "path")
+        if d2h:
+            dense_b = int(d2h.get("dense", 0))
+            delta_b = int(d2h.get("delta", 0))
+            print(f"d2h bytes: dense {dense_b} / delta {delta_b}")
+        rb = by_label("klba_rb_delta_epochs_total", "outcome")
+        rb_total = sum(rb.values())
+        if rb_total:
+            applied = rb.get("applied", 0)
+            print(
+                f"readback delta hit-rate {applied / rb_total:.3f} "
+                f"({int(applied)} applied / "
+                f"{int(rb.get('fallback', 0))} fallback / "
+                f"{int(rb.get('overflow', 0))} overflow)"
+            )
         outcomes = by_label("klba_delta_epochs_total", "outcome")
         total = sum(outcomes.values())
         if total:
