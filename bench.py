@@ -3600,6 +3600,313 @@ def delta_roundtrip_gates(dr) -> list:
     return failures
 
 
+def _hlo_sort_dims(txt: str) -> list:
+    """Sorted-dimension sizes of every stablehlo.sort in a lowered
+    module (the operand tensor types follow the comparator region, so
+    each sort op is paired with its closing type line)."""
+    import re
+
+    out = []
+    lines = txt.splitlines()
+    for i, line in enumerate(lines):
+        if '"stablehlo.sort"' not in line:
+            continue
+        m = re.search(r"dimension = (\d+)", line)
+        dim = int(m.group(1)) if m else -1
+        for j in range(i + 1, min(i + 400, len(lines))):
+            t = re.search(r"\}\) : \(tensor<([0-9x]+)x", lines[j])
+            if t:
+                shape = [int(d) for d in t.group(1).split("x")]
+                out.append(
+                    shape[dim] if 0 <= dim < len(shape) else max(shape)
+                )
+                break
+    return out
+
+
+def config19_mesh2d():
+    """Cross-axis mesh probe (ISSUE 20): the 2-D ("streams", "p")
+    composition against its 1-D twins.  What must hold (gated in main
+    whenever >= 8 devices are visible — on CPU that needs
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8``, else the
+    probe records ``skipped``): the P-sharded rounding tail
+    bit-identical to the mesh-1 (single-device) tail with a lowering
+    that contains NO full-P sort; every megabatch wave — cold, warm
+    refine, delta, locked — bit-identical across single-device, 1-D
+    streams, (2, 4), and (4, 2); ZERO fresh compiles in the 2-D
+    steady state; and the 2-D steady wall within 1.05x the better 1-D
+    twin (the virtual mesh timeshares one CPU, so the placements do
+    identical compute and only placement overhead can differ)."""
+    import threading
+    import time as time_mod
+
+    from kafka_lag_based_assignor_tpu.ops.coalesce import (
+        MegabatchCoalescer,
+    )
+    from kafka_lag_based_assignor_tpu.ops.linear_ot import (
+        assign_topic_linear,
+    )
+    from kafka_lag_based_assignor_tpu.ops.packing import pad_bucket
+    from kafka_lag_based_assignor_tpu.ops.streaming import (
+        StreamingAssignor,
+        delta_k_ladder,
+    )
+    from kafka_lag_based_assignor_tpu.sharded import mesh as mesh_mod
+    from kafka_lag_based_assignor_tpu.sharded import solve as ssolve
+    from kafka_lag_based_assignor_tpu.sharded.mesh import (
+        SOLVE_AXIS,
+        MeshManager,
+    )
+    from kafka_lag_based_assignor_tpu.utils.observability import (
+        compile_count,
+        install_compile_counter,
+    )
+
+    install_compile_counter()
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    n_dev = len(jax.devices())
+    out = {"config": "mesh2d_scale", "devices": n_dev}
+    if n_dev < 8:
+        out["skipped"] = (
+            f"{n_dev} device(s) visible; the 2-D probe needs 8 (set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8 for "
+            "the virtual CPU mesh)"
+        )
+        log(json.dumps(out))
+        return out
+
+    # ---- Part A: the P-sharded rounding tail vs the mesh-1 tail.
+    # P pads past the scan-rounding ceiling, so the sharded lowering
+    # elects the distributed winner-election + segmented-repair tail —
+    # which must match the single-device linear solve bit for bit and
+    # sort nothing P-sized.
+    P, C = 6000, 16
+    rng = np.random.default_rng(0x2D17)
+    lags = zipf_lags(rng, P)
+    pids = np.arange(P, dtype=np.int32)
+    valid = np.ones(P, dtype=bool)
+    choice1, _, _ = assign_topic_linear(
+        lags, pids, valid, num_consumers=C, refine_iters=64
+    )
+    choice1 = np.asarray(choice1)
+    mgr24 = MeshManager(
+        devices=8, solve_min_rows=1024, shape="2x4"
+    ).configure()
+    mgr42 = MeshManager(
+        devices=8, solve_min_rows=1024, shape="4x2"
+    ).configure()
+    mgr1p = MeshManager(devices=8, solve_min_rows=1024).configure()
+    tail = {"partitions": P, "consumers": C}
+    tail_walls = {}
+    for name, mgr in (("2x4", mgr24), ("4x2", mgr42), ("1d_p", mgr1p)):
+        ch, _, _, _ = ssolve.solve_linear_sharded(
+            mgr.solve_mesh(), lags, C, refine_iters=64
+        )
+        tail[f"bit_identical_{name}"] = bool(
+            np.array_equal(np.asarray(ch), choice1)
+        )
+        c0 = compile_count()
+        walls = []
+        for _ in range(3):
+            t0 = time_mod.perf_counter()
+            ssolve.solve_linear_sharded(
+                mgr.solve_mesh(), lags, C, refine_iters=64
+            )
+            walls.append((time_mod.perf_counter() - t0) * 1000.0)
+        tail[f"warm_compile_count_{name}"] = compile_count() - c0
+        tail_walls[name] = float(np.median(walls))
+        tail[f"p50_ms_{name}"] = round(tail_walls[name], 2)
+    tail["bit_identical"] = bool(
+        tail["bit_identical_2x4"]
+        and tail["bit_identical_4x2"]
+        and tail["bit_identical_1d_p"]
+    )
+    tail["warm_compile_count"] = (
+        tail["warm_compile_count_2x4"] + tail["warm_compile_count_4x2"]
+    )
+    tail["wall_ratio_vs_1d"] = round(
+        min(tail_walls["2x4"], tail_walls["4x2"])
+        / max(tail_walls["1d_p"], 1e-9),
+        3,
+    )
+    # The sharded lowering must keep every sort sub-P (the replicated
+    # full-P2 sort is exactly what the distributed tail removes).
+    P2 = pad_bucket(P)
+    mesh = mgr24.solve_mesh()
+    step = ssolve._linear_tail_executable(mesh, C, 64)
+    sh_p = NamedSharding(mesh, PartitionSpec(SOLVE_AXIS))
+    sh_r = NamedSharding(mesh, PartitionSpec())
+    txt = step.lower(
+        jax.device_put(np.ones(P2, np.int64), sh_p),
+        jax.device_put(np.ones(P2, bool), sh_p),
+        jax.device_put(np.zeros(C, np.float32), sh_r),
+        jax.device_put(np.zeros(C, np.float32), sh_r),
+    ).as_text()
+    dims = _hlo_sort_dims(txt)
+    tail["padded_rows"] = P2
+    tail["max_sorted_dim"] = max(dims) if dims else 0
+    tail["full_p_sorts"] = sum(1 for d in dims if d >= P2)
+    out["tail"] = tail
+
+    # ---- Part B: megabatch wave parity + steady wall across the
+    # placements.  One shared wave script — cold, lock, warm dense,
+    # delta (8-row perturbations), heavy churn — replayed under each
+    # placement; every wave must be bit-identical to the single-device
+    # run (the engines' cold solves stay single-device under the
+    # 1<<20 row floor, so the runs differ ONLY in placement).
+    N, P2b, C2 = 8, 2048, 8
+    # Warm phase: re-stack+lock, first locked dense, first locked
+    # DELTA (the delta executable is a separate compile — production
+    # warms it via the coalesce warm-up jobs); measured phase: dense
+    # and delta waves, compile-gated.
+    WARM, MEASURED = 3, 6
+    rng_w = np.random.default_rng(0xB2D)
+    cold_arrs = [
+        rng_w.integers(0, 1000, P2b).astype(np.int64) for _ in range(N)
+    ]
+    script = []
+    for w in range(WARM + MEASURED):
+        if w in (2, 4, 5):  # delta waves: small perturbation of the last
+            prev = script[-1]
+            arrs = []
+            for a in prev:
+                nxt = a.copy()
+                nxt[:8] = nxt[:8] + 1 + (np.arange(8) % 7)
+                arrs.append(nxt)
+        else:
+            arrs = [
+                rng_w.integers(0, 1000, P2b).astype(np.int64)
+                for _ in range(N)
+            ]
+        script.append(arrs)
+    delta_k = delta_k_ladder(2)[-1]
+
+    def run_script(shape):
+        mgr = (
+            MeshManager(
+                devices=8, solve_min_rows=1 << 20, shape=shape
+            ).configure()
+            if shape is not None
+            else None
+        )
+        ctx = mesh_mod.managed(mgr) if mgr is not None else None
+        if ctx is not None:
+            ctx.__enter__()
+        try:
+            engines = [
+                StreamingAssignor(
+                    num_consumers=C2,
+                    refine_iters=64,
+                    refine_threshold=None,
+                    delta_max_fraction=1.0,
+                    delta_buckets=2,
+                )
+                for _ in range(N)
+            ]
+            for e, a in zip(engines, cold_arrs):
+                e.rebalance(a)
+            coal = MegabatchCoalescer(
+                window_s=2.0,
+                max_batch=N,
+                lock_waves=1,
+                delta_k=delta_k,
+                mesh_manager=mgr,
+            )
+            wave_outs, wave_walls, errs = [], [], []
+            c0 = None
+            try:
+                for w, arrs in enumerate(script):
+                    if w == WARM:
+                        c0 = compile_count()
+                    outs = [None] * N
+
+                    def run(i):
+                        try:
+                            outs[i] = engines[i].submit_epoch(
+                                arrs[i], coal
+                            )
+                        except Exception as exc:  # noqa: BLE001
+                            errs.append((i, exc))
+
+                    threads = [
+                        threading.Thread(target=run, args=(i,))
+                        for i in range(N)
+                    ]
+                    t0 = time_mod.perf_counter()
+                    for t in threads:
+                        t.start()
+                    for t in threads:
+                        t.join()
+                    wave_walls.append(
+                        (time_mod.perf_counter() - t0) * 1000.0
+                    )
+                    wave_outs.append([np.asarray(o) for o in outs])
+                compiles = compile_count() - c0
+                with coal._roster_lock:
+                    batches = [
+                        r.batch
+                        for r in coal._rosters.values()
+                        if r.batch is not None
+                    ]
+                batch_mesh = batches[0].mesh if batches else None
+                locked_axes = (
+                    dict(batch_mesh.shape) if batch_mesh is not None
+                    else None
+                )
+            finally:
+                coal.close()
+            steady = float(np.median(wave_walls[WARM:]))
+            return wave_outs, steady, compiles, locked_axes, len(errs)
+        finally:
+            if ctx is not None:
+                ctx.__exit__(None, None, None)
+
+    runs = {}
+    for shape in ("2x4", "4x2", "off", None):
+        key = shape if shape is not None else "single"
+        runs[key] = run_script(shape)
+
+    base_outs = runs["single"][0]
+    mb = {
+        "streams": N,
+        "partitions": P2b,
+        "consumers": C2,
+        "waves": WARM + MEASURED,
+    }
+    all_identical = True
+    for key in ("2x4", "4x2", "off"):
+        outs = runs[key][0]
+        same = all(
+            np.array_equal(outs[w][i], base_outs[w][i])
+            for w in range(len(script))
+            for i in range(N)
+        )
+        mb[f"bit_identical_{key}"] = bool(same)
+        all_identical &= same
+    mb["all_identical"] = bool(all_identical)
+    mb["errors"] = sum(runs[k][4] for k in runs)
+    mb["warm_compile_count"] = runs["2x4"][2] + runs["4x2"][2]
+    mb["locked_axes_2x4"] = runs["2x4"][3]
+    mb["locked_axes_4x2"] = runs["4x2"][3]
+    mb["locked_2d"] = bool(
+        (runs["2x4"][3] or {}).get(SOLVE_AXIS, 0) > 1
+        and (runs["4x2"][3] or {}).get(SOLVE_AXIS, 0) > 1
+    )
+    mb["steady_p50_ms_2x4"] = round(runs["2x4"][1], 2)
+    mb["steady_p50_ms_4x2"] = round(runs["4x2"][1], 2)
+    mb["steady_p50_ms_1d_streams"] = round(runs["off"][1], 2)
+    mb["steady_p50_ms_single"] = round(runs["single"][1], 2)
+    mb["wall_ratio_vs_1d"] = round(
+        min(runs["2x4"][1], runs["4x2"][1])
+        / max(runs["off"][1], 1e-9),
+        3,
+    )
+    out["megabatch"] = mb
+    return out
+
+
 def main():
     # A wedged accelerator tunnel must degrade the benchmark, not hang it
     # (the framework's own watchdog philosophy, SURVEY §5 failure row):
@@ -3665,7 +3972,7 @@ def main():
                config11_scrub, config12_federated, config13_sharded,
                config14_linear, config15_linear_kernel,
                config16_scenarios, config17_tracing,
-               config18_delta_roundtrip):
+               config18_delta_roundtrip, config19_mesh2d):
         before = klba_metrics.REGISTRY.snapshot()
         r = fn()
         deltas = klba_metrics.histogram_deltas(
@@ -4174,6 +4481,69 @@ def main():
                 f"{sh.get('collective_drill')} — a mesh fault must "
                 "serve valid through the single-device fallback and "
                 "degrade the manager"
+            )
+
+    # mesh2d_scale gates (ISSUE 20): the P-sharded rounding tail must
+    # be bit-identical to the mesh-1 tail with no full-P sort in its
+    # lowering; every wave bit-identical across single / 1-D streams /
+    # (2,4) / (4,2); zero 2-D steady-state compiles; the 2-D roster
+    # actually locked cross-axis; and the 2-D steady wall within 1.05x
+    # the better 1-D twin.
+    m2 = results.get("mesh2d_scale", {})
+    if m2 and not m2.get("skipped"):
+        tl = m2.get("tail", {})
+        if not tl.get("bit_identical", False):
+            failures.append(
+                "mesh2d_scale P-sharded rounding tail is not "
+                "bit-identical to the single-device linear tail"
+            )
+        if tl.get("full_p_sorts", 1) != 0:
+            failures.append(
+                f"mesh2d_scale tail lowering contains "
+                f"{tl.get('full_p_sorts')} full-P sort(s) "
+                f"(max sorted dim {tl.get('max_sorted_dim')} vs "
+                f"P2 {tl.get('padded_rows')}) — the rounding tail is "
+                "not running P-sharded"
+            )
+        if tl.get("warm_compile_count", 1) != 0:
+            failures.append(
+                f"mesh2d_scale tail compiled "
+                f"{tl.get('warm_compile_count')} executable(s) in the "
+                "warm loop — the sharded tail program cache is not "
+                "holding"
+            )
+        mb2 = m2.get("megabatch", {})
+        if not mb2.get("all_identical", False):
+            failures.append(
+                "mesh2d_scale megabatch waves are not bit-identical "
+                "across the single / 1-D streams / (2,4) / (4,2) "
+                "placements"
+            )
+        if mb2.get("errors", 1) != 0:
+            failures.append(
+                f"mesh2d_scale megabatch saw {mb2.get('errors')} "
+                "submit error(s)"
+            )
+        if mb2.get("warm_compile_count", 1) != 0:
+            failures.append(
+                f"mesh2d_scale megabatch compiled "
+                f"{mb2.get('warm_compile_count')} executable(s) in the "
+                "2-D steady state — the cross-axis warm-up is not "
+                "covering the locked executables"
+            )
+        if not mb2.get("locked_2d", False):
+            failures.append(
+                f"mesh2d_scale megabatch never locked a cross-axis "
+                f"roster (locked axes 2x4={mb2.get('locked_axes_2x4')} "
+                f"4x2={mb2.get('locked_axes_4x2')}) — the 2-D "
+                "placement path did not engage"
+            )
+        ratio = mb2.get("wall_ratio_vs_1d")
+        if ratio is not None and ratio > 1.05:
+            failures.append(
+                f"mesh2d_scale megabatch wall_ratio_vs_1d {ratio} > "
+                "1.05 — the 2-D placement regressed past the 1-D "
+                "streams twin"
             )
 
     # linear_ot_scale gates (ISSUE 14): quality parity with the dense

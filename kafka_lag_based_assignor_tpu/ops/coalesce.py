@@ -1158,12 +1158,30 @@ class MegabatchCoalescer:
         over, or None for the single-device placement (no/degraded
         manager, or a batch axis the mesh does not divide)."""
         mgr = self._mesh_mgr()
-        if mgr is None or not mgr.active:
+        if mgr is None or not mgr.active or not mgr.streams_available:
             return None
         from ..sharded.megabatch import shardable
 
         mesh = mgr.streams_mesh()
         return mesh if shardable(mesh, n_pad) else None
+
+    def _batch_mesh(self, n_pad: int):
+        """The mesh a LOCKING batch should shard over, most capable
+        rung first: the full 2-D ("streams", "p") mesh when the
+        manager sits on the 2-D rung and the batch axis covers the
+        flattened S*D grid (rows whole per chip over the entire pool
+        — sharded/megabatch.place_batch2d), else the 1-D streams mesh,
+        else None (single-device).  Returns ``(mesh, is2d)``."""
+        mgr = self._mesh_mgr()
+        if mgr is None or not mgr.active:
+            return None, False
+        from ..sharded.megabatch import shardable2d
+
+        if mgr.mesh2d_available:
+            mesh2d = mgr.mesh2d()
+            if shardable2d(mesh2d, n_pad):
+                return mesh2d, True
+        return self._stream_mesh(n_pad), False
 
     def _degrade_mesh(self, reason: str) -> None:
         """A sharded flush failed: fall the PROCESS back to the
@@ -1711,24 +1729,31 @@ class MegabatchCoalescer:
             # The roster locks: this wave's stacked successors BECOME
             # the resident batch (the widened lag rows included — the
             # stacked delta path scatters into them); rows' ownership
-            # moves to it.  With an active streams mesh the successors
-            # are sharded over it ONCE here (sharded/megabatch) — the
+            # moves to it.  With an active mesh the successors are
+            # sharded over it ONCE here (sharded/megabatch) — the full
+            # 2-D ("streams", "p") placement when the manager's rung
+            # and both axes allow, else stream-axis only — and the
             # locked executable then donates sharded buffers and
             # returns sharded successors, so the steady state pays no
             # per-flush re-placement; a placement failure locks
             # single-device and degrades the manager.
-            mesh = self._stream_mesh(n_pad)
+            mesh, is2d = self._batch_mesh(n_pad)
             if mesh is not None:
                 try:
-                    from ..sharded.megabatch import place_batch
+                    from ..sharded.megabatch import (
+                        place_batch,
+                        place_batch2d,
+                    )
 
-                    choice_b, tab_b, counts_b, lags_b = place_batch(
+                    place = place_batch2d if is2d else place_batch
+                    choice_b, tab_b, counts_b, lags_b = place(
                         mesh, (choice_b, tab_b, counts_b, lags_b)
                     )
                 except Exception:  # noqa: BLE001 — single-device locks
                     LOGGER.warning(
-                        "stream-axis placement failed; locking the "
-                        "roster on the single-device placement",
+                        "%s placement failed; locking the roster on "
+                        "the single-device placement",
+                        "cross-axis" if is2d else "stream-axis",
                         exc_info=True,
                     )
                     self._degrade_mesh("place")

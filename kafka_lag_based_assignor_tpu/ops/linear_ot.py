@@ -409,7 +409,6 @@ def finish_from_duals(
     loudly rather than serve a silently unbalanced assignment."""
     from ..utils import metrics
 
-    global _LAST
     C = int(num_consumers)
     with metrics.device_phase("rounding"):
         choice, counts, totals = _finish_linear_jit(
@@ -420,6 +419,36 @@ def finish_from_duals(
     choice_np, counts_np, totals_np = (
         np.asarray(x) for x in jax.device_get((choice, counts, totals))
     )
+    record_linear_solve(
+        lags_p, valid_p, totals_np, C,
+        tiles=tiles, tile=tile, rounds=rounds,
+        backend=backend, kernel=kernel,
+    )
+    return choice_np, counts_np, totals_np
+
+
+def record_linear_solve(
+    lags_p: np.ndarray,
+    valid_p: np.ndarray,
+    totals_np: np.ndarray,
+    num_consumers: int,
+    *,
+    tiles: int,
+    tile: int,
+    rounds: int,
+    backend: str,
+    kernel: bool = False,
+) -> None:
+    """Shared epilogue of EVERY linear-mode rounding backend (the
+    single-device :func:`finish_from_duals` and the P-sharded tail in
+    :mod:`..sharded.solve`): assert the additive bound against the
+    solved totals, then record the quality-plane metrics and the
+    ``_LAST`` observability row.  Factored out so a backend that runs
+    the rounding elsewhere cannot silently skip the bound contract."""
+    from ..utils import metrics
+
+    global _LAST
+    C = int(num_consumers)
     bound = additive_bound(lags_p, valid_p, C)
     max_tot = float(totals_np.max()) if totals_np.size else 0.0
     if bound > 0.0 and max_tot > bound * (1.0 + 1e-6) + 0.5:
@@ -448,7 +477,6 @@ def finish_from_duals(
     metrics.REGISTRY.gauge("klba_quality_last_peak_bytes").set(
         _LAST["peak_bytes_estimate"]
     )
-    return choice_np, counts_np, totals_np
 
 
 def _trivial_assignment(lags_np, valid_np, num_consumers: int):

@@ -92,6 +92,7 @@ The churn/quality trade-off is configurable per rebalance via
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import logging
 from dataclasses import dataclass
@@ -639,6 +640,12 @@ class StreamingAssignor:
         # None = stale (host-side edits: repair, remap, reset, shape
         # change).
         self._resident = None
+        # True while the resident buffers are P-sharded over the mesh
+        # (sharded/resident placement): the warm refine dispatch then
+        # launches a multi-participant collective program and must hold
+        # the mesh dispatch gate (sharded/mesh) — concurrent collective
+        # launches starve the runtime's rendezvous.
+        self._resident_sharded = False
         # Host mirror of the resident lag buffer's first P entries —
         # the base the delta differ diffs against.  None whenever the
         # resident state is stale (the mirror lives and dies with it).
@@ -854,6 +861,7 @@ class StreamingAssignor:
         mirror together — a mirror that outlives the buffer it mirrors
         would let a later delta scatter onto the wrong base."""
         self._resident = None
+        self._resident_sharded = False
         self._lag_mirror = None
 
     def _adopt_resident(self, resident, lags: np.ndarray) -> None:
@@ -872,8 +880,79 @@ class StreamingAssignor:
             )
             self._quarantined = None
         resident = self._corrupt_resident(resident, lags.shape[0])
+        resident = self._place_resident(resident, lags.shape[0])
         self._resident = resident
         self._lag_mirror = np.array(lags, dtype=np.int64, copy=True)
+
+    def _collective_gate(self):
+        """The mesh dispatch gate when the resident buffers are
+        P-sharded (their fused programs are collective-bearing and
+        concurrent collective launches starve the runtime's
+        rendezvous — sharded/mesh), a no-op context otherwise.  Taken
+        around the LAUNCH only, never around a coalescer park (a
+        parked thread holding the gate would serialize wave
+        formation into single-row flushes)."""
+        if self._resident_sharded:
+            from ..sharded.mesh import dispatch_gate
+
+            return dispatch_gate()
+        return contextlib.nullcontext()
+
+    def _resident_mesh_manager(self, num_rows: int):
+        """The mesh manager electing this stream's resident P-shard
+        placement — the same selection rule as the sharded cold solve
+        (``mesh_backend`` pin/auto + the solve_min_rows floor), so the
+        resident state shards exactly when the cold path does."""
+        mb = self.mesh_backend
+        if mb is None:
+            return None
+        if mb == "auto":
+            from .dispatch import sharded_solve_manager
+
+            return sharded_solve_manager(num_rows, self.num_consumers)
+        return mb if (
+            mb.active
+            and self.num_consumers >= 2
+            and mb.should_shard_solve(num_rows)
+        ) else None
+
+    def _place_resident(self, resident, P: int):
+        """Opt-in P-sharded placement of the four resident buffers
+        (sharded/resident): when the active mesh manager elects the P
+        backend for this shape, the [B] row buffers shard over the
+        tenant's "p" slice and the consumer-axis state replicates —
+        values (and therefore the digest/quarantine/seed_choice
+        contracts) are bit-identical, only bytes move.  Locked-roster
+        handles are skipped (the coalescer owns that placement); any
+        failure keeps the single-device buffers and degrades the
+        manager so the fleet falls back with it."""
+        self._resident_sharded = False
+        if getattr(resident, "materialize", None) is not None:
+            return resident
+        mgr = self._resident_mesh_manager(P)
+        if mgr is None:
+            return resident
+        from ..sharded import resident as resident_mod
+
+        try:
+            mesh = mgr.solve_mesh()
+            if not resident_mod.shardable_rows(
+                mesh, int(resident[0].shape[0])
+            ):
+                return resident
+            placed = resident_mod.place_resident(mesh, resident)
+        except Exception:
+            LOGGER.warning(
+                "resident P-shard placement failed; keeping the "
+                "single-device buffers", exc_info=True,
+            )
+            mgr.degrade("resident")
+            return resident
+        metrics.REGISTRY.counter(
+            "klba_resident_placed_total", {"axis": "p"}
+        ).inc()
+        self._resident_sharded = True
+        return placed
 
     def _corrupt_resident(self, resident, P: int):
         """Chaos injection site (fault points ``device.corrupt.choice``
@@ -1200,6 +1279,39 @@ class StreamingAssignor:
         the executable's own totals/counts outputs — the fused
         replacement for the post-refine host bincount."""
         with metrics.span("stream.refine"):
+            if self._resident_sharded:
+                # P-sharded resident: the fused refine is a collective
+                # program, so this is a sharded dispatch boundary like
+                # the cold solve's — probe the collective health
+                # (``mesh.collective`` fault point) BEFORE launching;
+                # the inline launch itself takes the mesh dispatch
+                # gate at its call sites (``_collective_gate``).
+                # On a lost collective (or a manager that degraded
+                # under another stream's feet) the resident drops and
+                # the epoch re-solves on the CURRENT rung's placement —
+                # always a valid assignment, one rung down.
+                from ..sharded.mesh import MeshCollectiveError
+
+                mgr = self._resident_mesh_manager(lags.shape[0])
+                if mgr is None:
+                    self._drop_resident()
+                    stats.cold_start = True
+                    out = self._cold_solve(lags)
+                    stats.sharded_solve = self._cold_was_sharded
+                    return out
+                try:
+                    mgr.check_collective()
+                except MeshCollectiveError:
+                    LOGGER.warning(
+                        "mesh collective lost at the warm-refine "
+                        "boundary; re-solving this epoch on the "
+                        "degraded placement"
+                    )
+                    self._drop_resident()
+                    stats.cold_start = True
+                    out = self._cold_solve(lags)
+                    stats.sharded_solve = self._cold_was_sharded
+                    return out
             return self._dispatch_warm_refine_inner(lags, choice, stats)
 
     def _dispatch_warm_refine_inner(
@@ -1379,22 +1491,24 @@ class StreamingAssignor:
                         # FAILED dispatch's exit choice — not the
                         # host's view — so it is unusable here.
                         rb_base = None
-                        out = _warm_fused_resident(
-                            payload, out[1], out[2], out[3], limit,
-                            num_consumers=C, iters=budget,
-                            max_pairs=pairs, exchange_budget=budget,
-                            delta_k=rb_k,
-                        )
+                        with self._collective_gate():
+                            out = _warm_fused_resident(
+                                payload, out[1], out[2], out[3], limit,
+                                num_consumers=C, iters=budget,
+                                max_pairs=pairs, exchange_budget=budget,
+                                delta_k=rb_k,
+                            )
                     else:
                         self._m_delta["applied"].inc()
             if out is None:
                 self._m_h2d_dense.inc(payload.nbytes)
-                out = _warm_fused_resident(
-                    payload, resident[0], resident[1], resident[2],
-                    limit, num_consumers=C, iters=budget,
-                    max_pairs=pairs, exchange_budget=budget,
-                    delta_k=rb_k,
-                )
+                with self._collective_gate():
+                    out = _warm_fused_resident(
+                        payload, resident[0], resident[1], resident[2],
+                        limit, num_consumers=C, iters=budget,
+                        max_pairs=pairs, exchange_budget=budget,
+                        delta_k=rb_k,
+                    )
         else:
             observe_pack_shift(
                 ("warm_fused_build", lags.shape, C),
@@ -1537,7 +1651,7 @@ class StreamingAssignor:
         idx, vals, nbytes, n = delta
         try:
             faults.fire("delta.apply")
-            with metrics.span("stream.h2d_delta"):
+            with metrics.span("stream.h2d_delta"), self._collective_gate():
                 out = _warm_fused_delta(
                     idx, vals, resident[3], resident[0], resident[1],
                     resident[2], limit, P=P,

@@ -1059,6 +1059,13 @@ class AssignorService:
         # and serves in-flight requests down the existing ladder.
         mesh_devices: Any = "off",
         mesh_solve_min_rows: int = 65536,
+        # Cross-axis composition (DEPLOYMENT.md "Cross-axis mesh"):
+        # the (S, D) ("streams", "p") factorization of the mesh pool —
+        # "off" keeps the 1-D rungs, "auto" picks the most square
+        # split favouring "p", "SxD" pins it.  On the 2-D rung a
+        # locked megabatch of large tenants spreads BOTH axes; faults
+        # walk the ladder 2-D -> 1-D streams -> 1-D p -> single.
+        mesh_shape: Any = "off",
         # Quality-mode plane (ops/dispatch + ops/linear_ot;
         # DEPLOYMENT.md "Quality modes"): routing between the dense
         # Sinkhorn path and the linear-space O(P + C) mirror-prox path
@@ -1245,6 +1252,7 @@ class AssignorService:
             MeshManager(
                 devices=mesh_devices,
                 solve_min_rows=int(mesh_solve_min_rows),
+                shape=mesh_shape,
             )
             if _parse_spec(mesh_devices) != "off"
             else None
@@ -1508,6 +1516,7 @@ class AssignorService:
             "delta_adaptive": cfg.delta_adaptive,
             "mesh_devices": cfg.mesh_devices,
             "mesh_solve_min_rows": cfg.mesh_solve_min_rows,
+            "mesh_shape": cfg.mesh_shape,
             "quality_mode": cfg.quality_mode,
             "quality_tile": cfg.quality_tile,
             "metrics_port": cfg.metrics_port,
@@ -4114,6 +4123,14 @@ def main() -> None:
              "65536)",
     )
     parser.add_argument(
+        "--mesh-shape", default="off", metavar="SxD",
+        help="cross-axis ('streams','p') factorization of the mesh "
+             "pool: 'off' (default, 1-D rungs), 'auto' (most square "
+             "split favouring 'p'), or 'SxD' (e.g. '2x4'); faults "
+             "degrade 2-D -> streams -> p -> single (DEPLOYMENT.md "
+             "'Cross-axis mesh')",
+    )
+    parser.add_argument(
         "--quality-mode", default="auto",
         choices=("sinkhorn", "linear", "auto"),
         help="quality-solve routing (DEPLOYMENT.md 'Quality modes'): "
@@ -4176,6 +4193,7 @@ def main() -> None:
         federation_capacity=federation_capacity,
         mesh_devices=opts.mesh_devices,
         mesh_solve_min_rows=opts.mesh_solve_min_rows,
+        mesh_shape=opts.mesh_shape,
         quality_mode=opts.quality_mode,
         quality_tile=opts.quality_tile,
     )

@@ -1,4 +1,5 @@
-"""Stream-axis sharding for the roster-locked megabatch.
+"""Stream-axis (and cross-axis 2-D) sharding for the roster-locked
+megabatch.
 
 The megabatch coalescer (:mod:`..ops.coalesce`) stacks N tenants' warm
 epochs into ONE vmapped fused dispatch — but on a single device those N
@@ -21,15 +22,40 @@ placement decisions, and the coalescer stays the only caller.
   cover and divide the mesh (pow2 n_pad over pow2 D always divides once
   n_pad >= D).
 
+**Cross-axis composition** (the 2-D ``("streams", "p")`` mesh,
+:meth:`..sharded.mesh.MeshManager.mesh2d`): a 2-D shape gives the
+"streams" axis only S of the pool's S*D devices, so a batch locked
+stream-only on that rung would cap at S-way row parallelism while D-1
+of every group's chips idle.  :func:`place_batch2d` composes BOTH
+axes on the batch dimension — ``PartitionSpec(("streams", "p"))``
+flattens the full 2-D grid under the stacked N axis, every roster row
+lands WHOLE on exactly one of the S*D chips, and the vmapped locked
+executable stays collective-free (bit-for-bit the stream-sharded
+program, just spread over the full pool).  The row axis is
+deliberately NOT split here: slicing [B] under the vmapped refine
+forces the partitioner into per-wave all-gather + replicated-sort
+round trips (measured ~4x a steady wave on the 8-device virtual
+mesh, scaling with B) — a single tenant whose [B] exceeds one chip
+is served by the resident P-shard plane (:mod:`.resident`) and the
+P-sharded solve/rounding tail (:mod:`.solve`) on the SAME mesh's "p"
+axis, which is exactly the cross-axis contract: one (S, D) grid,
+batch rows over all of it, per-tenant row state over "p".
+Eligibility (:func:`shardable2d`): the padded batch axis must cover
+and divide the flattened S*D extent.  The executables are unchanged
+— placement remains input sharding, the SPMD partitioner propagates
+it, and the integer refine is exact under any placement, so roster
+lock, donation, delta staging, and the per-row digest lanes all read
+identically.
+
 Round-10 invariants are preserved by construction: the executables and
 their donation signatures are unchanged (placement is input sharding,
 not new code paths), churn still invalidates the roster exactly once,
 and per-row failure isolation/digest quarantine read per-row outputs
 that slicing a sharded array serves identically.  A ``mesh.collective``
-fault (or a real placement/dispatch failure) degrades the coalescer to
-the single-device placement via the mesh manager — in-flight rows
-resolve through the existing single-stream fallback, never an invalid
-answer.
+fault (or a real placement/dispatch failure) degrades the coalescer
+down the manager's ladder (2-D -> streams -> single-device) — in-flight
+rows resolve through the existing single-stream fallback, never an
+invalid answer.
 """
 
 from __future__ import annotations
@@ -37,7 +63,7 @@ from __future__ import annotations
 import jax
 from jax.sharding import NamedSharding, PartitionSpec
 
-from .mesh import STREAMS_AXIS
+from .mesh import SOLVE_AXIS, STREAMS_AXIS
 
 
 def shardable(mesh, n_pad: int) -> bool:
@@ -49,12 +75,42 @@ def shardable(mesh, n_pad: int) -> bool:
     return D > 1 and n_pad >= D and n_pad % D == 0
 
 
+def shardable2d(mesh2d, n_pad: int) -> bool:
+    """Cross-axis eligibility: the padded batch axis must cover and
+    divide the FLATTENED S*D extent (pow2 n_pad over a pow2 grid
+    always divides once ``n_pad >= S*D``)."""
+    if mesh2d is None:
+        return False
+    SD = mesh2d.shape[STREAMS_AXIS] * mesh2d.shape[SOLVE_AXIS]
+    return SD > 1 and n_pad >= SD and n_pad % SD == 0
+
+
 def stream_sharding(mesh, rank: int) -> NamedSharding:
     """Leading-axis ("streams") sharding for a rank-``rank`` stacked
     array: rows spread over devices, every trailing axis replicated
-    within its row's shard."""
+    within its row's shard (on a 2-D mesh the unused "p" axis
+    replicates)."""
     spec = PartitionSpec(STREAMS_AXIS, *([None] * (rank - 1)))
     return NamedSharding(mesh, spec)
+
+
+def cross_sharding(mesh2d, rank: int) -> NamedSharding:
+    """Devices-flattened batch-axis sharding for a rank-``rank``
+    stacked array on the 2-D mesh: the leading N axis spreads over the
+    FULL ("streams", "p") grid — each row whole on one of the S*D
+    chips — and every trailing axis stays unsplit within it."""
+    spec = PartitionSpec(
+        (STREAMS_AXIS, SOLVE_AXIS), *([None] * (rank - 1))
+    )
+    return NamedSharding(mesh2d, spec)
+
+
+def _leading_sharding(mesh, rank: int) -> NamedSharding:
+    """The leading-axis sharding for ``mesh`` — flattened cross-axis
+    when the mesh carries a "p" extent, plain streams otherwise."""
+    if dict(getattr(mesh, "shape", {})).get(SOLVE_AXIS, 1) > 1:
+        return cross_sharding(mesh, rank)
+    return stream_sharding(mesh, rank)
 
 
 def place_batch(mesh, arrays):
@@ -66,12 +122,24 @@ def place_batch(mesh, arrays):
     )
 
 
+def place_batch2d(mesh2d, arrays):
+    """Shard a locked batch's resident 4-tuple ``(choice [N, B],
+    row_tab [N, C, M], counts [N, C], lags [N, B])`` on the full 2-D
+    mesh: every buffer's batch axis spreads over the flattened
+    ("streams", "p") grid, rows whole per chip.  One reshard per LOCK,
+    exactly like :func:`place_batch`."""
+    return tuple(
+        jax.device_put(a, cross_sharding(mesh2d, a.ndim)) for a in arrays
+    )
+
+
 def place_rows(mesh, *host_arrays):
     """Start the async H2D of a wave's staged host arrays with the
-    streams sharding — each row's slice lands on its own device.  The
-    caller (the coalescer's counted ``_stage_upload`` /
-    ``_stage_delta_upload`` sites) owns the byte accounting."""
+    batch's leading-axis sharding — each row's slice lands on its own
+    device (on the 2-D mesh, one of the S*D flattened chips), no
+    gather hop.  The caller (the coalescer's counted ``_stage_upload``
+    / ``_stage_delta_upload`` sites) owns the byte accounting."""
     return tuple(
-        jax.device_put(a, stream_sharding(mesh, a.ndim))
+        jax.device_put(a, _leading_sharding(mesh, a.ndim))
         for a in host_arrays
     )

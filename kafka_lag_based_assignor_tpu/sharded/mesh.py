@@ -17,15 +17,27 @@ those paths shares:
   process-wide backend selection input (the :mod:`..utils.faults`
   ``_ACTIVE`` pattern: one global load on the off path).
 
-* **Single-device is the default AND the degradation target.**  An
-  unconfigured process never builds a mesh; a configured one that loses
-  devices (``configure`` finding fewer than asked), takes an injected
+* **Cross-axis composition** (``tpu.assignor.mesh.shape``): the same
+  device set can additionally factor as a 2-D ("streams", "p") mesh —
+  ``S x D`` tenants-by-rows — so a stream-sharded megabatch holds
+  P-sharded rows per tenant (:mod:`.megabatch` 2-D placement) and the
+  warm loop's resident buffers live P-sharded.  ``"auto"`` picks the
+  most square (S, D) factorization favouring the "p" axis; an explicit
+  ``"SxD"`` string pins it; a shape the device count cannot satisfy
+  falls back to the 1-D rung at boot (fail open, never raise).
+
+* **Single-device is the default AND the degradation target**, reached
+  down a documented ladder.  An unconfigured process never builds a
+  mesh.  A configured one that loses devices, takes an injected
   ``mesh.collective`` fault, or sees a sharded dispatch raise is
-  :meth:`degraded <MeshManager.degrade>` — every later backend
-  selection answers "single-device" and the existing degraded-mode
-  ladder serves the in-flight request (the callers catch, never the
-  mesh).  Degradation is observable: ``klba_mesh_active`` /
-  ``klba_mesh_devices`` gauges, ``klba_mesh_degraded_total{reason}``.
+  :meth:`degraded <MeshManager.degrade>` one rung at a time:
+  2-D -> 1-D streams -> 1-D p -> single device (:data:`LADDER`);
+  1-D-only configurations keep the historical one-step drop
+  (1d -> single).  Every selection hook answers from the current rung,
+  so no request is ever served off a half-dead mesh.  Degradation is
+  observable: ``klba_mesh_active`` / ``klba_mesh_devices`` /
+  ``klba_mesh_shape{axis}`` gauges, ``klba_mesh_degraded_total{reason}``
+  and the per-transition ``klba_mesh_degrade_total{from,to}``.
 
 Lint rule L020 confines ``Mesh``/``shard_map``/``NamedSharding``
 construction to this package, so topology cannot leak back into ad-hoc
@@ -38,7 +50,9 @@ import inspect
 import logging
 import threading
 from contextlib import contextmanager
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
 
 import jax
 from jax.sharding import Mesh
@@ -62,9 +76,22 @@ CHECK_KW = (
 )
 
 #: Axis names: the P-sharded solve partitions partition rows over "p";
-#: the megabatch spreads tenant rows over "streams".
+#: the megabatch spreads tenant rows over "streams".  The 2-D mesh
+#: composes both: axis order ("streams", "p").
 SOLVE_AXIS = "p"
 STREAMS_AXIS = "streams"
+
+#: The documented degrade ladder for a 2-D ("streams", "p") mesh, least
+#: to most degraded.  Each ``mesh.collective`` fault (or sharded
+#: dispatch failure) steps exactly ONE rung; 1-D-only configurations
+#: use the two-rung ("1d", "single") ladder instead (the historical
+#: one-step drop).  Scenario envelopes gate observed
+#: ``klba_mesh_degrade_total{from,to}`` transitions against this order.
+LADDER: Tuple[str, ...] = ("2d", "streams", "p", "single")
+
+#: Rungs where each sharded capability remains available.
+_SOLVE_RUNGS = frozenset(("2d", "1d", "p"))
+_STREAMS_RUNGS = frozenset(("2d", "1d", "streams"))
 
 #: Default P floor below which a single device wins outright (the
 #: sharded seed/refine pays collectives per round; a small solve's
@@ -72,12 +99,35 @@ STREAMS_AXIS = "streams"
 #: ``tpu.assignor.mesh.solve.min.rows``.
 DEFAULT_SOLVE_MIN_ROWS = 65536
 
+# Collective-dispatch gate.  N request threads each launching a
+# D-participant collective program starve the runtime's rendezvous
+# (observed on the virtual CPU mesh as "waiting for all participants
+# to arrive at rendezvous" stalls across interleaved RunIds until the
+# solve watchdog fires): each in-flight program holds threads hostage
+# waiting for peers that can never be scheduled.  One collective
+# program in flight at a time is both safe and fast — the program
+# itself already uses every device.  Re-entrant so a gated entry may
+# call another gated entry (cold solve -> sharded tail).  The locked
+# megabatch path is collective-free by construction and does NOT take
+# the gate: concurrency there is the whole point.
+_DISPATCH_GATE = threading.RLock()
+
+
+def dispatch_gate() -> threading.RLock:
+    """The process-wide collective-dispatch serialization gate.
+
+    Every entry that launches a multi-participant collective program
+    (``solve_sharded``, ``refine_sharded``, ``solve_linear_sharded``,
+    ``plan_stats_sharded``, the resident warm refine) holds this for
+    the duration of its dispatch."""
+    return _DISPATCH_GATE
+
 
 class MeshCollectiveError(RuntimeError):
     """A sharded dispatch lost a collective (injected ``mesh.collective``
     fault or a real cross-device failure): the mesh manager has already
-    degraded to the single-device backend; the caller serves this
-    request down the existing ladder."""
+    degraded one rung down the ladder; the caller serves this request
+    down the existing degraded-mode ladder."""
 
 
 def _parse_spec(spec: Any) -> Any:
@@ -98,6 +148,43 @@ def _parse_spec(spec: Any) -> Any:
     return n
 
 
+def _parse_shape(spec: Any) -> Any:
+    """``"off"`` | ``"auto"`` | an ``"SxD"`` string / (S, D) pair."""
+    if spec in (None, "", "off", "0", 0, False):
+        return "off"
+    if spec == "auto":
+        return "auto"
+    if isinstance(spec, str):
+        parts = spec.lower().replace("*", "x").split("x")
+        if len(parts) != 2:
+            raise ValueError(
+                f"mesh shape spec {spec!r} invalid; use 'off', 'auto', "
+                "or 'SxD' (e.g. '2x4')"
+            )
+        spec = parts
+    try:
+        s, d = (int(v) for v in spec)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"mesh shape spec {spec!r} invalid; use 'off', 'auto', or "
+            "'SxD' (e.g. '2x4')"
+        )
+    if s < 1 or d < 1:
+        raise ValueError(f"mesh shape {s}x{d}: both axes must be >= 1")
+    return (s, d)
+
+
+def auto_shape(n: int) -> Tuple[int, int]:
+    """The ``"auto"`` (S, D) factorization of ``n`` devices: the most
+    square split favouring the "p" axis (D >= S) — 8 -> (2, 4),
+    4 -> (2, 2), 2 -> (1, 2), primes -> (1, n)."""
+    s = int(n) ** 0.5
+    s = int(s)
+    while s > 1 and n % s:
+        s -= 1
+    return (max(s, 1), n // max(s, 1))
+
+
 class MeshManager:
     """One process's device-mesh topology + health state.
 
@@ -106,23 +193,31 @@ class MeshManager:
     visible devices; inactive when only one is visible), or an integer
     N (exactly the first N visible devices; fewer visible = boot-time
     degrade, not an exception — fail open to single-device).
-    ``solve_min_rows`` gates the P-sharded solve backend: below it the
-    single-device path wins outright.
+    ``shape`` is the ``tpu.assignor.mesh.shape`` spec: ``"off"`` (1-D
+    meshes only, the historical behavior), ``"auto"``, or ``"SxD"`` —
+    a satisfiable shape starts the manager on the "2d" rung of
+    :data:`LADDER`.  ``solve_min_rows`` gates the P-sharded solve
+    backend: below it the single-device path wins outright.
     """
 
     def __init__(
         self,
         devices: Any = "auto",
         solve_min_rows: int = DEFAULT_SOLVE_MIN_ROWS,
+        shape: Any = "off",
     ):
         self.spec = _parse_spec(devices)
+        self.shape_spec = _parse_shape(shape)
         self.solve_min_rows = int(solve_min_rows)
         self._lock = threading.Lock()
         self._devices: List[Any] = []
         self._degraded: Optional[str] = None
         self._configured = False
+        self._rung = "single"
+        self._shape: Optional[Tuple[int, int]] = None
         self._solve_mesh: Optional[Mesh] = None
         self._streams_mesh: Optional[Mesh] = None
+        self._mesh2d: Optional[Mesh] = None
         self._m_active = metrics.REGISTRY.gauge("klba_mesh_active")
         self._m_devices = metrics.REGISTRY.gauge("klba_mesh_devices")
 
@@ -132,19 +227,20 @@ class MeshManager:
         """Discover + validate the mesh (call once at service start,
         NEVER per request).  A spec the visible devices cannot satisfy
         degrades to single-device — boot keeps serving — rather than
-        raising; re-calling re-validates (a shrunk device set degrades
-        here too)."""
+        raising; an unsatisfiable 2-D shape falls back to the 1-D rung;
+        re-calling re-validates (a shrunk device set degrades here
+        too)."""
         with self._lock:
             self._configured = True
             if self.spec == "off":
-                self._install([], None)
+                self._install([], None, "single")
                 return self
             visible = list(jax.devices())
             want = len(visible) if self.spec == "auto" else int(self.spec)
             if want < 2:
                 # One device is not a mesh: quietly single-device (the
                 # "auto" default on a lone chip must not look degraded).
-                self._install([], None)
+                self._install([], None, "single")
                 return self
             if len(visible) < want:
                 LOGGER.warning(
@@ -152,44 +248,96 @@ class MeshManager:
                     "degrading to the single-device backend",
                     self.spec, len(visible),
                 )
-                self._install([], "missing_devices")
+                self._install([], "missing_devices", "single")
                 return self
-            self._install(visible[:want], None)
+            devices = visible[:want]
+            rung, shape = "1d", None
+            if self.shape_spec != "off":
+                shape = (
+                    auto_shape(want)
+                    if self.shape_spec == "auto" else self.shape_spec
+                )
+                if shape[0] * shape[1] != want:
+                    LOGGER.warning(
+                        "mesh.shape=%dx%d does not factor %d device(s); "
+                        "falling back to the 1-D rung",
+                        shape[0], shape[1], want,
+                    )
+                    shape = None
+                else:
+                    rung = "2d"
+            self._install(devices, None, rung, shape)
             LOGGER.info(
-                "device mesh configured: %d device(s) on %s",
-                want, visible[0].platform,
+                "device mesh configured: %d device(s) on %s (rung %s%s)",
+                want, visible[0].platform, rung,
+                f", shape {shape[0]}x{shape[1]}" if shape else "",
             )
         return self
 
-    def _install(self, devices: List[Any], degraded: Optional[str]) -> None:
-        """Caller holds the lock: adopt a device set (or none) and
-        rebuild the cached axis meshes."""
+    def _install(
+        self,
+        devices: List[Any],
+        degraded: Optional[str],
+        rung: str,
+        shape: Optional[Tuple[int, int]] = None,
+    ) -> None:
+        """Caller holds the lock: adopt a device set (or none) at one
+        ladder rung and rebuild the cached axis meshes."""
         self._devices = devices
         self._degraded = degraded
-        if devices:
-            self._solve_mesh = Mesh(devices, axis_names=(SOLVE_AXIS,))
-            self._streams_mesh = Mesh(devices, axis_names=(STREAMS_AXIS,))
-        else:
-            self._solve_mesh = None
-            self._streams_mesh = None
+        self._rung = rung if devices else "single"
+        self._shape = shape if (devices and rung == "2d") else None
+        self._solve_mesh = (
+            Mesh(devices, axis_names=(SOLVE_AXIS,))
+            if devices and rung in _SOLVE_RUNGS else None
+        )
+        self._streams_mesh = (
+            Mesh(devices, axis_names=(STREAMS_AXIS,))
+            if devices and rung in _STREAMS_RUNGS else None
+        )
+        self._mesh2d = (
+            Mesh(
+                np.asarray(devices, dtype=object).reshape(self._shape),
+                axis_names=(STREAMS_AXIS, SOLVE_AXIS),
+            )
+            if self._shape is not None else None
+        )
         if degraded is not None:
             metrics.REGISTRY.counter(
                 "klba_mesh_degraded_total", {"reason": degraded}
             ).inc()
-        self._m_active.set(1 if devices else 0)
+        self._m_active.set(1 if self.active else 0)
         self._m_devices.set(len(devices))
+        s, d = self._shape if self._shape else (0, 0)
+        metrics.REGISTRY.gauge(
+            "klba_mesh_shape", {"axis": STREAMS_AXIS}
+        ).set(s)
+        metrics.REGISTRY.gauge(
+            "klba_mesh_shape", {"axis": SOLVE_AXIS}
+        ).set(d)
 
     # -- selection ----------------------------------------------------------
 
     @property
     def active(self) -> bool:
-        """True while the sharded backends may be selected (configured,
-        >= 2 devices, not degraded)."""
-        return bool(self._devices) and self._degraded is None
+        """True while ANY sharded backend may be selected (configured,
+        >= 2 devices, not on the single-device rung)."""
+        return bool(self._devices) and self._rung != "single"
+
+    @property
+    def rung(self) -> str:
+        """The current ladder rung ("2d" | "streams" | "p" | "single",
+        or "1d" for shape-off configurations)."""
+        return self._rung
 
     @property
     def size(self) -> int:
         return len(self._devices) if self.active else 0
+
+    @property
+    def mesh_shape(self) -> Optional[Tuple[int, int]]:
+        """The active (S, D) factorization, or None below the 2-D rung."""
+        return self._shape
 
     def solve_mesh(self) -> Mesh:
         """The 1-D ("p",) mesh of the P-sharded solve."""
@@ -205,41 +353,79 @@ class MeshManager:
             raise RuntimeError("mesh manager is not active")
         return m
 
+    def mesh2d(self) -> Mesh:
+        """The 2-D ("streams", "p") mesh (the "2d" rung only)."""
+        m = self._mesh2d
+        if m is None or not self.active:
+            raise RuntimeError("mesh manager is not on the 2-D rung")
+        return m
+
+    @property
+    def solve_available(self) -> bool:
+        """P-axis sharding available at the current rung."""
+        return self.active and self._solve_mesh is not None
+
+    @property
+    def streams_available(self) -> bool:
+        """Stream-axis sharding available at the current rung."""
+        return self.active and self._streams_mesh is not None
+
+    @property
+    def mesh2d_available(self) -> bool:
+        """Cross-axis ("streams", "p") placement available (2-D rung)."""
+        return self.active and self._mesh2d is not None
+
     def should_shard_solve(self, num_rows: int) -> bool:
-        """Backend selection for one P-sized solve: mesh active AND the
-        row count clears the single-device-wins floor."""
-        return self.active and int(num_rows) >= self.solve_min_rows
+        """Backend selection for one P-sized solve: the "p" capability
+        live at the current rung AND the row count clears the
+        single-device-wins floor."""
+        return self.solve_available and int(num_rows) >= self.solve_min_rows
 
     # -- degradation --------------------------------------------------------
 
     def check_collective(self) -> None:
         """The ``mesh.collective`` fault point for callers about to
         enter a sharded dispatch: a firing plan degrades the manager
-        (every later selection answers single-device) and raises
-        :class:`MeshCollectiveError` so THIS request walks the
-        caller's existing ladder — no invalid assignment is ever
-        served off a half-dead mesh."""
+        ONE ladder rung (every later selection answers from the new
+        rung) and raises :class:`MeshCollectiveError` so THIS request
+        walks the caller's existing ladder — no invalid assignment is
+        ever served off a half-dead mesh."""
         try:
             faults.fire("mesh.collective")
         except Exception as exc:
             self.degrade("collective")
             raise MeshCollectiveError(
-                "mesh collective failed; degraded to the single-device "
-                "backend"
+                "mesh collective failed; degraded one rung toward the "
+                "single-device backend"
             ) from exc
 
     def degrade(self, reason: str) -> None:
-        """Fall back to the single-device backend process-wide (a lost
-        device, a collective fault, a sharded dispatch raising).
-        Idempotent; :meth:`restore` / :meth:`configure` re-arms."""
+        """Step ONE rung down the documented ladder (a lost device, a
+        collective fault, a sharded dispatch raising): 2-D configs walk
+        2d -> streams -> p -> single; 1-D configs keep the historical
+        one-step drop to single.  Idempotent at the bottom;
+        :meth:`restore` / :meth:`configure` re-arms."""
         with self._lock:
-            if self._degraded is not None or not self._devices:
+            if not self._devices or self._rung == "single":
                 return
+            frm = self._rung
+            # "p" and "1d" are both last sharded rungs: one step to single.
+            nxt = {"2d": "streams", "streams": "p"}.get(frm, "single")
             LOGGER.warning(
-                "device mesh degraded (%s): sharded backends disabled, "
-                "single-device serves", reason,
+                "device mesh degraded (%s): rung %s -> %s", reason,
+                frm, nxt,
             )
-            self._install([], reason)
+            metrics.REGISTRY.counter(
+                "klba_mesh_degrade_total", {"from": frm, "to": nxt}
+            ).inc()
+            if nxt == "single":
+                self._install([], reason, "single")
+            else:
+                metrics.REGISTRY.counter(
+                    "klba_mesh_degraded_total", {"reason": reason}
+                ).inc()
+                self._install(self._devices, None, nxt)
+                self._degraded = reason
 
     def restore(self) -> "MeshManager":
         """Re-validate after an operator fixed the topology (the mesh
@@ -256,6 +442,11 @@ class MeshManager:
             "devices": len(self._devices),
             "degraded": self._degraded,
             "solve_min_rows": self.solve_min_rows,
+            "shape": (
+                f"{self._shape[0]}x{self._shape[1]}"
+                if self._shape else None
+            ),
+            "rung": self._rung,
         }
 
 

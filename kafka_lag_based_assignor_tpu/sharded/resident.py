@@ -1,0 +1,75 @@
+"""P-axis placement for a stream's device-RESIDENT warm state.
+
+The streaming engine's four resident buffers — padded choice [B],
+row table [C, M], counts [C], padded lags [B] — live on ONE chip even
+when the cold solve sharded, so the warm loop's capacity still caps at
+a single device's HBM.  This module owns the placement decision (lint
+rule L020 confines mesh/sharding construction to ``sharded/``): when
+the active mesh manager elects the P backend for the stream's shape,
+:func:`place_resident` re-places the freshly adopted buffers across
+the tenant's ("p",) mesh slice —
+
+* the two [B] row-axis buffers (choice, lags) shard over "p";
+* the two consumer-axis buffers (row_tab [C, M], counts [C]) stay
+  replicated — C << P, and the exchange refine walks whole per-pair
+  [K, M] slices, so splitting them would trade one chip's bytes for a
+  gather per round.
+
+Placement is INPUT sharding, not a new code path: the warm fused
+executables are unchanged and the SPMD partitioner propagates the
+layout through them, so the donated successors come back sharded and
+the steady state pays no per-epoch re-placement.  Every value is
+bit-identical under re-placement, which is exactly why the
+digest/quarantine/seed_choice contracts survive untouched: the fused
+digest hashes the same ints, quarantine drops handles not layouts, and
+a seed_choice rebuild simply adopts (and re-places) fresh buffers.
+
+Eligibility (:func:`shardable_rows`) mirrors the megabatch rule on the
+other axis: the padded row bucket must cover and divide the mesh.  Any
+placement failure is non-fatal — the caller keeps the single-device
+buffers and degrades the manager so the fleet falls back too.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .mesh import SOLVE_AXIS
+
+
+def shardable_rows(mesh, bucket: int) -> bool:
+    """True when a padded row bucket splits evenly over ``mesh``'s
+    "p" axis (pow2 buckets over pow2 meshes always divide once
+    ``bucket >= D``)."""
+    if mesh is None:
+        return False
+    D = mesh.shape[SOLVE_AXIS]
+    return D > 1 and bucket >= D and bucket % D == 0
+
+
+def row_sharding(mesh) -> NamedSharding:
+    """[B] row-axis sharding: rows spread over the "p" devices."""
+    return NamedSharding(mesh, PartitionSpec(SOLVE_AXIS))
+
+
+def replicated(mesh) -> NamedSharding:
+    """Consumer-axis buffers: replicated on every "p" device."""
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def place_resident(mesh, resident):
+    """Re-place a freshly adopted resident 4-tuple ``(choice [B],
+    row_tab [C, M], counts [C], lags [B])`` with the P-axis layout.
+    Values are unchanged (a reshard moves bytes, not bits), so the
+    next dispatch's digest sees exactly the state it would have seen
+    single-device.  Returns the placed tuple in input order."""
+    choice, row_tab, counts, lags = resident
+    rows = row_sharding(mesh)
+    rep = replicated(mesh)
+    return (
+        jax.device_put(choice, rows),
+        jax.device_put(row_tab, rep),
+        jax.device_put(counts, rep),
+        jax.device_put(lags, rows),
+    )

@@ -75,7 +75,7 @@ from ..ops.packing import pad_bucket, pad_chunk
 from ..ops.refine import _PAIR_BITS, _SBIG_INT, _VBITS
 from ..ops.sortops import bincount_sorted, segment_argmin_first, segment_sum
 from ..utils import faults, metrics
-from .mesh import CHECK_KW, SOLVE_AXIS, shard_map
+from .mesh import CHECK_KW, SOLVE_AXIS, dispatch_gate, shard_map
 
 
 def _quant_shift_all(lags, assigned, axis: str):
@@ -409,7 +409,7 @@ def solve_sharded(
     step = _sharded_executable(
         mesh, C, int(refine_iters), max_pairs, int(patience), True
     )
-    with metrics.span("sharded.solve"):
+    with metrics.span("sharded.solve"), dispatch_gate():
         choice, counts, totals, rounds = step(
             *_place_inputs(mesh, lags_p, valid)
         )
@@ -458,7 +458,7 @@ def refine_sharded(
     step = _sharded_executable(
         mesh, C, int(iters), max_pairs, int(patience), False
     )
-    with metrics.span("sharded.refine"):
+    with metrics.span("sharded.refine"), dispatch_gate():
         out = step(
             *_place_inputs(
                 mesh,
@@ -515,14 +515,15 @@ def plan_stats_sharded(mesh, lags, valid, choice, num_consumers: int):
 
     ensure_x64()
     step = _plan_stats_executable(mesh, int(num_consumers))
-    totals, counts = step(
-        *_place_inputs(
-            mesh,
-            np.ascontiguousarray(lags, dtype=np.int64),
-            np.ascontiguousarray(valid, dtype=bool),
-            np.ascontiguousarray(choice, dtype=np.int32),
+    with dispatch_gate():
+        totals, counts = step(
+            *_place_inputs(
+                mesh,
+                np.ascontiguousarray(lags, dtype=np.int64),
+                np.ascontiguousarray(valid, dtype=bool),
+                np.ascontiguousarray(choice, dtype=np.int32),
+            )
         )
-    )
     return np.asarray(totals), np.asarray(counts)
 
 
@@ -595,6 +596,381 @@ def _linear_duals_executable(
     return jax.jit(mapped)
 
 
+# ---------------------------------------------------------------------------
+# P-sharded rounding tail
+# ---------------------------------------------------------------------------
+
+
+def _bincount_scatter(vals, num_segments: int):
+    """Backend-independent integer histogram (pure scatter-add): the
+    same ints as :func:`..ops.sortops.bincount_sorted` without its
+    accelerator sort branch — the sharded tail's lowering must stay
+    free of P-sized sorts on every backend."""
+    S = int(num_segments)
+    in_range = (vals >= 0) & (vals < S)
+    return (
+        jnp.zeros((S,), jnp.int32)
+        .at[jnp.clip(vals, 0, S - 1)]
+        .add(in_range.astype(jnp.int32))
+    )
+
+
+def _segsum_scatter(vals, seg, num_segments: int):
+    """Sort-free integer segment sum (exact on ints in any order)."""
+    S = int(num_segments)
+    in_range = (seg >= 0) & (seg < S)
+    return (
+        jnp.zeros((S,), vals.dtype)
+        .at[jnp.clip(seg, 0, S - 1)]
+        .add(jnp.where(in_range, vals, 0))
+    )
+
+
+def _lex_rank(sorted_keys, query_keys):
+    """Global rank of each query row under the lexicographic composite
+    key order, WITHOUT a cross-shard sort: ``sorted_keys`` are per-key
+    ``[D, L]`` gathers of each shard's locally sorted key columns,
+    ``query_keys`` the per-key ``[N]`` local queries.  The rank is the
+    count of entries strictly below the query summed over every shard's
+    sorted column — computed by a vectorized lexicographic binary
+    search (``L.bit_length()`` unrolled steps of ``[N, D]`` gathers).
+    Callers append the unique global row id as the last key, so the
+    count IS the row's position in the virtual global sort.  Returns
+    int32[N]."""
+    D, L = sorted_keys[0].shape
+    N = query_keys[0].shape[0]
+    lo = jnp.zeros((N, D), jnp.int32)
+    hi = jnp.full((N, D), L, jnp.int32)
+
+    def fetch(col2d, mid):
+        return jax.vmap(
+            lambda col, m: col[m], in_axes=(0, 1), out_axes=1
+        )(col2d, mid)
+
+    for _ in range(max(1, int(L).bit_length())):
+        active = lo < hi
+        mid = jnp.minimum((lo + hi) >> 1, L - 1)
+        less = jnp.zeros((N, D), bool)
+        tie = jnp.ones((N, D), bool)
+        for k, q in zip(sorted_keys, query_keys):
+            v = fetch(k, mid)
+            less = less | (tie & (v < q[:, None]))
+            tie = tie & (v == q[:, None])
+        lo = jnp.where(active & less, mid + 1, lo)
+        hi = jnp.where(active & ~less, mid, hi)
+    return jnp.sum(lo, axis=1).astype(jnp.int32)
+
+
+def _rank_scatter(rank_loc, val_loc, P2: int, axis: str):
+    """Rebuild the replicated SORTED-LAYOUT array from per-shard values
+    and their global ranks: gather both, then one permutation scatter
+    (ranks are unique, so the scatter is deterministic).  This is how
+    the tail materializes ``x[perm]`` without ever sorting [P2]."""
+    ranks = lax.all_gather(rank_loc, axis, axis=0, tiled=True)
+    vals = lax.all_gather(val_loc, axis, axis=0, tiled=True)
+    return jnp.zeros((P2,), val_loc.dtype).at[ranks].set(vals)
+
+
+@functools.lru_cache(maxsize=32)
+def _linear_tail_executable(mesh, num_consumers: int, refine_iters: int):
+    """Build + jit the P-SHARDED linear rounding tail: the exact math
+    of :func:`..models.sinkhorn._round_refine_portfolio` (parallel
+    rounding branch) with every P-sized SORT replaced by shard-local
+    sorts + distributed rank election, so no device ever sorts [P2]:
+
+    * the plan-argmax grouping sort, the greedy processing order, and
+      the overflow repair order each become a LOCAL [P2/D] sort plus a
+      :func:`_lex_rank` lexicographic binary search over the gathered
+      per-shard sorted keys (composite keys end in the unique global
+      row id — ranks are a bijection, hence bit-equal layouts);
+    * the sorted layouts the f32 kept-load cumsum and the rounds scan
+      walk are rebuilt REPLICATED via permutation scatters
+      (:func:`_rank_scatter`) — same op on same input bits as the
+      single-device path, so the order-sensitive float reductions match
+      bit-for-bit;
+    * overflow seating drops ``_round_parallel``'s C*cap_max slot sort
+      for the closed form: ``cum_slots[r] = sum_j min(rem_j, r)`` open
+      slots precede round ``r``, so overflow rank k seats at round
+      ``r = searchsorted(cum_slots, k, 'right') - 1``, position
+      ``k - cum_slots[r]`` in kept-load rank order — integer-exact
+      against the slot sort because all open-slot keys are distinct;
+    * the exchange refine runs the ACTUAL
+      :func:`..ops.refine.refine_rounds_resident` code replicated on
+      all-gathered rows (its per-round working sets are [K, M] with
+      M = table_rows(P2, C) < P2 for C >= 2 — not P-sized), over a
+      choice table built DISTRIBUTED: local segment sorts, one
+      all-gathered count prefix, and a psum'd position scatter.  The
+      round body only consumes each consumer's valid-row multiset plus
+      the valid-prefix invariant, both of which the distributed build
+      reproduces exactly, so the refine trajectory is bit-identical to
+      the single-device ``build_choice_tables`` table.
+
+    Scale contract: total lag must stay below 2**53 (the documented
+    ``_scale_np`` contract) so the psum'd f64 scale — and therefore
+    every per-row f32 ws — is exact and mesh-invariant."""
+    from ..models.sinkhorn import _START_SLACK
+    from ..ops.packing import table_rows
+    from ..ops.plan_stats import implicit_plan_argmax
+    from ..ops.refine import refine_rounds_resident
+    from ..ops.rounds_kernel import _rounds_scan
+
+    C = int(num_consumers)
+    D = mesh.shape[SOLVE_AXIS]
+    axis = SOLVE_AXIS
+    i32max = jnp.iinfo(jnp.int32).max
+    i64max = jnp.iinfo(jnp.int64).max
+
+    def step(lags, valid, A, B):
+        L = lags.shape[0]
+        P2 = L * D
+        M = table_rows(P2, C)
+        cap_max = P2 // C + 1
+        arangeL = jnp.arange(L, dtype=jnp.int32)
+        didx = lax.axis_index(axis).astype(jnp.int32)
+        gidx = didx * L + arangeL
+
+        # _scaled_ws with the f64 total psum-reduced: integer partial
+        # sums below 2**53 are exact in any order, so ws bits match the
+        # single-device path per row.
+        w = jnp.where(valid, lags, 0).astype(jnp.float64)
+        scale = jnp.maximum(lax.psum(jnp.sum(w), axis), 1.0) / C
+        ws = (w / scale).astype(jnp.float32)
+
+        jstar = implicit_plan_argmax(ws, valid, A, B, tie_noise=False)
+        neg_lag = jnp.where(valid, -lags, i64max)
+
+        # Rank in _round_parallel's (jstar, neg_lag, row) grouping
+        # order — local sort + lexicographic binary search.
+        s1, s2, s3 = lax.sort((jstar, neg_lag, gidx), num_keys=3)
+        rank_par = _lex_rank(
+            (lax.all_gather(s1, axis), lax.all_gather(s2, axis),
+             lax.all_gather(s3, axis)),
+            (jstar, neg_lag, gidx),
+        )
+
+        # Replicated sorted-layout twins (permutation scatters).
+        sj_s = _rank_scatter(rank_par, jstar, P2, axis)
+        ws_s = _rank_scatter(rank_par, ws, P2, axis)
+
+        n_valid = lax.psum(jnp.sum(valid.astype(jnp.int32)), axis)
+        floor_cap = n_valid // C
+        extras = n_valid - floor_cap * C
+        cap = floor_cap + (
+            jnp.arange(C, dtype=jnp.int32) < extras
+        ).astype(jnp.int32)
+
+        idx_p = jnp.arange(P2, dtype=jnp.int32)
+        bnd = jnp.searchsorted(
+            sj_s, jnp.arange(C + 1, dtype=jnp.int32)
+        ).astype(jnp.int32)
+        pos = idx_p - bnd[jnp.clip(sj_s, 0, C)]
+        keep_s = (sj_s < C) & (pos < cap[jnp.clip(sj_s, 0, C - 1)])
+        kept_cnt = jnp.minimum(bnd[1:] - bnd[:-1], cap)
+        # Order-sensitive f32 cumsum over the EXACT single-device
+        # sorted layout — mesh-invariant kept-load bits.
+        csum = jnp.concatenate(
+            [jnp.zeros((1,), jnp.float32),
+             jnp.cumsum(jnp.where(keep_s, ws_s, jnp.float32(0.0)))]
+        )
+        kept_load = csum[bnd[1:]] - csum[bnd[:-1]]
+        rem = cap - kept_cnt
+        lr_order = jnp.argsort(kept_load).astype(jnp.int32)
+
+        # Closed-form seat table: round r opens the consumers with
+        # rem > r, in kept-load rank order.
+        sorted_rem = jnp.sort(rem)
+        prefix_rem = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(sorted_rem)]
+        )
+        rr = jnp.arange(cap_max + 1, dtype=jnp.int32)
+        t_r = jnp.searchsorted(
+            sorted_rem, rr, side="right"
+        ).astype(jnp.int32)
+        cum_slots = prefix_rem[t_r] + rr * (jnp.int32(C) - t_r)
+        open_mask = rem[lr_order][None, :] > rr[:, None]
+        open_cum = jnp.cumsum(open_mask.astype(jnp.int32), axis=1)
+        seat_dest = jnp.where(
+            open_mask,
+            rr[:, None] * C + open_cum - 1,
+            jnp.int32((cap_max + 1) * C),
+        )
+        seat_tab = (
+            jnp.zeros(((cap_max + 1) * C,), jnp.int32)
+            .at[seat_dest.reshape(-1)]
+            .set(
+                jnp.broadcast_to(
+                    lr_order[None, :], open_mask.shape
+                ).reshape(-1),
+                mode="drop",
+            )
+            .reshape(cap_max + 1, C)
+        )
+
+        # Overflow rank in (neg_lag, sorted-layout position) order —
+        # the stable tiebreak _round_parallel's okey sort uses.
+        keep_loc = keep_s[rank_par]
+        overflow = valid & ~keep_loc
+        okey = jnp.where(overflow, neg_lag, i64max)
+        o1, o2 = lax.sort((okey, rank_par), num_keys=2)
+        orank = _lex_rank(
+            (lax.all_gather(o1, axis), lax.all_gather(o2, axis)),
+            (okey, rank_par),
+        )
+        r_of = (
+            jnp.searchsorted(
+                cum_slots, orank, side="right"
+            ).astype(jnp.int32)
+            - 1
+        )
+        m_of = orank - cum_slots[jnp.clip(r_of, 0, cap_max)]
+        seat = seat_tab[
+            jnp.clip(r_of, 0, cap_max), jnp.clip(m_of, 0, C - 1)
+        ]
+        choice_loc = jnp.where(
+            keep_loc, jstar, jnp.where(overflow, seat, -1)
+        ).astype(jnp.int32)
+
+        # Greedy twin: distributed processing-order ranks feeding the
+        # ACTUAL rounds-scan kernel, run replicated.
+        neg_g = jnp.where(valid, -lags, 1)
+        pid_key = jnp.where(valid, gidx, i32max)
+        h1, h2, h3 = lax.sort((neg_g, pid_key, gidx), num_keys=3)
+        rank_g = _lex_rank(
+            (lax.all_gather(h1, axis), lax.all_gather(h2, axis),
+             lax.all_gather(h3, axis)),
+            (neg_g, pid_key, gidx),
+        )
+        lag_gs = _rank_scatter(rank_g, lags, P2, axis)
+        valid_gs = _rank_scatter(rank_g, valid, P2, axis)
+        g_totals, g_sorted_choice = _rounds_scan(
+            lag_gs, valid_gs, jnp.zeros((C,), lags.dtype), C
+        )
+        g_choice_loc = g_sorted_choice[rank_g]
+        g_counts = _bincount_scatter(g_sorted_choice, C)
+
+        ot_totals = lax.psum(
+            _segsum_scatter(
+                jnp.where(valid, lags, 0),
+                jnp.where(valid, choice_loc, -1),
+                C,
+            ),
+            axis,
+        )
+        use_ot = jnp.max(ot_totals) <= _START_SLACK * jnp.max(g_totals)
+        start_loc = jnp.where(use_ot, choice_loc, g_choice_loc)
+
+        # Distributed choice-table build: local segment sort, one
+        # all-gathered count prefix, psum'd position scatter.  Each
+        # consumer's segment holds its assigned-row multiset in a
+        # valid-prefix layout — all the refine round body consumes.
+        lags_full = lax.all_gather(lags, axis, axis=0, tiled=True)
+        start_full = lax.all_gather(start_loc, axis, axis=0, tiled=True)
+        seg_loc = jnp.where(
+            valid & (start_loc >= 0), start_loc, C
+        ).astype(jnp.int32)
+        sseg, srow_g = lax.sort((seg_loc, gidx), num_keys=1)
+        bnd_l = jnp.searchsorted(
+            sseg, jnp.arange(C + 1, dtype=jnp.int32)
+        ).astype(jnp.int32)
+        cnt_loc = bnd_l[1:] - bnd_l[:-1]
+        cnt_all = lax.all_gather(cnt_loc, axis)  # [D, C]
+        prefix = jnp.sum(
+            jnp.where(
+                jnp.arange(D, dtype=jnp.int32)[:, None] < didx,
+                cnt_all, 0,
+            ),
+            axis=0,
+        ).astype(jnp.int32)
+        pos_l = arangeL - bnd_l[jnp.clip(sseg, 0, C)]
+        dest = jnp.where(
+            sseg < C,
+            sseg * M + prefix[jnp.clip(sseg, 0, C - 1)] + pos_l,
+            jnp.int32(C * M),
+        )
+        tab_flat = lax.psum(
+            jnp.zeros((C * M,), jnp.int32)
+            .at[dest]
+            .set(srow_g + 1, mode="drop"),
+            axis,
+        )
+        row_tab = jnp.where(
+            tab_flat > 0, tab_flat - 1, jnp.int32(P2)
+        ).reshape(C, M)
+        r_counts = lax.psum(cnt_loc, axis)
+        r_totals = lax.psum(
+            _segsum_scatter(jnp.where(valid, lags, 0), seg_loc, C),
+            axis,
+        )
+
+        s_choice, _, s_counts, s_totals, _, _ = refine_rounds_resident(
+            lags_full, start_full, row_tab, r_counts, r_totals,
+            num_consumers=C, iters=int(refine_iters),
+            max_pairs=min(C // 2, 64),
+        )
+        use_s = jnp.max(s_totals) < jnp.max(g_totals)
+        g_choice_full = lax.all_gather(
+            g_choice_loc, axis, axis=0, tiled=True
+        )
+        fin_choice = jnp.where(use_s, s_choice, g_choice_full)
+        fin_counts = jnp.where(use_s, s_counts, g_counts)
+        fin_totals = jnp.where(use_s, s_totals, g_totals)
+        out_loc = lax.dynamic_slice(fin_choice, (didx * L,), (L,))
+        return out_loc.astype(jnp.int32), fin_counts, fin_totals
+
+    mapped = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(
+            PartitionSpec(SOLVE_AXIS), PartitionSpec(SOLVE_AXIS),
+            PartitionSpec(), PartitionSpec(),
+        ),
+        out_specs=(
+            PartitionSpec(SOLVE_AXIS),  # choice
+            PartitionSpec(),            # counts: replicated
+            PartitionSpec(),            # totals: replicated
+        ),
+        **{CHECK_KW: False},
+    )
+    return jax.jit(mapped)
+
+
+def _finish_sharded_tail(
+    mesh, lags_p: np.ndarray, valid: np.ndarray, A, B,
+    num_consumers: int, refine_iters: int, *,
+    tiles: int, tile: int, rounds: int, kernel: bool,
+):
+    """Host wrapper of the P-sharded rounding tail: place the padded
+    inputs with the "p" sharding, run the tail executable, then the
+    SAME epilogue as :func:`..ops.linear_ot.finish_from_duals` (the
+    additive-bound assert, quality metrics, ``_LAST`` row) via the
+    shared :func:`..ops.linear_ot.record_linear_solve`."""
+    from ..ops import linear_ot
+
+    C = int(num_consumers)
+    D = mesh.shape[SOLVE_AXIS]
+    step = _linear_tail_executable(mesh, C, int(refine_iters))
+    lags_d, valid_d = _place_inputs(mesh, lags_p, valid)
+    rspec = NamedSharding(mesh, PartitionSpec())
+    A_d = jax.device_put(np.asarray(A, np.float32), rspec)
+    B_d = jax.device_put(np.asarray(B, np.float32), rspec)
+    with metrics.device_phase("rounding"), dispatch_gate():
+        choice, counts, totals = step(lags_d, valid_d, A_d, B_d)
+        jax.block_until_ready((choice, counts, totals))
+    choice_np, counts_np, totals_np = (
+        np.asarray(x)
+        for x in jax.device_get((choice, counts, totals))
+    )
+    metrics.REGISTRY.counter(
+        "klba_sharded_dispatch_total", {"path": "rounding"}
+    ).inc()
+    linear_ot.record_linear_solve(
+        lags_p, valid, totals_np, C,
+        tiles=tiles, tile=tile, rounds=rounds,
+        backend=f"sharded:{D}", kernel=kernel,
+    )
+    return choice_np, counts_np, totals_np
+
+
 def solve_linear_sharded(
     mesh,
     lags: np.ndarray,
@@ -603,11 +979,14 @@ def solve_linear_sharded(
     refine_iters: int = 64,
     tile: Optional[int] = None,
 ):
-    """One linear-OT quality cold solve with the DUALS P-sharded over
-    ``mesh`` (module docstring): the O(iters * P * C) marginal scans —
-    the dominant cost — split across shards; the O(P log P) rounding
-    pass then runs the unchanged single-device linear rounding on the
-    replicated duals, so the result is bit-identical to
+    """One linear-OT quality cold solve with BOTH halves P-sharded over
+    ``mesh`` (module docstring): the O(iters * P * C) marginal scans
+    split across shards, and — above the sequential-rounding threshold
+    — the O(P log P) rounding tail runs P-sharded too
+    (:func:`_linear_tail_executable`: distributed rank election +
+    segmented repair + the replicated exchange refine over a
+    distributed-built table, no P-sized sort on any device).  Both
+    halves are bit-identical to
     :func:`..ops.linear_ot.assign_topic_linear` at ANY mesh size.
 
     ``lags`` is the exact host [P] int64 vector.  Fires
@@ -655,7 +1034,7 @@ def solve_linear_sharded(
         mesh, C, int(iters), tile_e, kernel=kernel
     )
     lags_d, valid_d = _place_inputs(mesh, lags_p, valid)
-    with metrics.span("sharded.linear_duals"):
+    with metrics.span("sharded.linear_duals"), dispatch_gate():
         with metrics.device_phase("duals"):
             try:
                 A, B, rounds = step(
@@ -681,13 +1060,25 @@ def solve_linear_sharded(
     metrics.REGISTRY.counter(
         "klba_sharded_dispatch_total", {"path": "linear"}
     ).inc()
-    pids_p = np.arange(P2, dtype=np.int32)
-    choice, counts, totals = linear_ot.finish_from_duals(
-        lags_p, pids_p, valid, np.asarray(A), np.asarray(B), C,
-        int(refine_iters), tiles=n_tiles, tile=tile_e,
-        rounds=int(rounds_np), backend=f"sharded:{D}",
-        kernel=kernel,
-    )
+    from ..models.sinkhorn import _SCAN_ROUNDING_MAX_P
+
+    if D > 1 and C >= 2 and P2 > _SCAN_ROUNDING_MAX_P:
+        # Above the sequential-rounding threshold the single-device
+        # tail takes the parallel branch — the one the sharded tail
+        # reproduces bit-for-bit — so the rounding runs P-sharded.
+        choice, counts, totals = _finish_sharded_tail(
+            mesh, lags_p, valid, np.asarray(A), np.asarray(B), C,
+            int(refine_iters), tiles=n_tiles, tile=tile_e,
+            rounds=int(rounds_np), kernel=kernel,
+        )
+    else:
+        pids_p = np.arange(P2, dtype=np.int32)
+        choice, counts, totals = linear_ot.finish_from_duals(
+            lags_p, pids_p, valid, np.asarray(A), np.asarray(B), C,
+            int(refine_iters), tiles=n_tiles, tile=tile_e,
+            rounds=int(rounds_np), backend=f"sharded:{D}",
+            kernel=kernel,
+        )
     return (
         choice[:P_len].astype(np.int32),
         counts,

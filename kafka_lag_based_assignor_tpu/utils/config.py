@@ -127,6 +127,13 @@ DELTA_ADAPTIVE_CONFIG = "tpu.assignor.delta.adaptive"
 # solve backend is not selected.
 MESH_DEVICES_CONFIG = "tpu.assignor.mesh.devices"
 MESH_SOLVE_MIN_ROWS_CONFIG = "tpu.assignor.mesh.solve.min.rows"
+# Cross-axis 2-D composition (DEPLOYMENT.md "Cross-axis mesh"):
+# ``mesh.shape`` factorizes the mesh.devices pool into an (S, D)
+# ("streams", "p") grid — "off" (default, 1-D behaviour), "auto" (the
+# most square split favouring "p"), or an explicit "SxD" (e.g. "2x4";
+# S*D must equal the validated device count or boot falls down the
+# degrade ladder: 2-D -> 1-D streams -> 1-D p -> single device).
+MESH_SHAPE_CONFIG = "tpu.assignor.mesh.shape"
 # SLO classes + overload control (utils/overload, served by the
 # sidecar).  Per-stream class: "tpu.assignor.slo.class.<stream_id>" =
 # critical | standard | best_effort (a wire-level params.slo_class
@@ -327,6 +334,8 @@ class AssignorConfig:
     # row floor ("off" = single-device, the default).
     mesh_devices: str = "off"
     mesh_solve_min_rows: int = 65536
+    # Cross-axis (S, D) factorization of the mesh ("off" = 1-D rungs).
+    mesh_shape: str = "off"
     # SLO classes + overload control (utils/overload): per-stream class
     # map, per-class deadline budgets (seconds), and the overload
     # detector's pressure normalizers (0 latency budget = auto).
@@ -612,6 +621,7 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
 
     # Mesh knobs: the spec is validated HERE (the sharded/ parser) so a
     # typo'd device count fails at configure() time, not at boot.
+    from ..sharded.mesh import _parse_shape as _parse_mesh_shape
     from ..sharded.mesh import _parse_spec as _parse_mesh_spec
 
     raw_mesh = consumer_group_props.get(MESH_DEVICES_CONFIG, "off")
@@ -622,6 +632,12 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
     mesh_solve_min_rows = _as_int(
         MESH_SOLVE_MIN_ROWS_CONFIG, 65536, 1
     )
+    raw_shape = consumer_group_props.get(MESH_SHAPE_CONFIG, "off")
+    try:
+        shape = _parse_mesh_shape(raw_shape)
+    except ValueError as exc:
+        raise ValueError(f"{MESH_SHAPE_CONFIG}: {exc}")
+    mesh_shape = shape if isinstance(shape, str) else f"{shape[0]}x{shape[1]}"
 
     # The controller keeps this knob in ms (it normalizes a p99 that is
     # measured in ms), so convert _as_ms's seconds back out once, here.
@@ -673,6 +689,7 @@ def parse_config(configs: Mapping[str, Any]) -> AssignorConfig:
         ),
         mesh_devices=mesh_devices,
         mesh_solve_min_rows=mesh_solve_min_rows,
+        mesh_shape=mesh_shape,
         slo_classes=slo_classes,
         slo_deadline_s=slo_deadline_s,
         overload_latency_budget_ms=overload_latency_budget_ms,

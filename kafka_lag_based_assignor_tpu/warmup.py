@@ -115,8 +115,12 @@ def warmup(
         per (mesh, bucket, C, budget)) — the LINEAR quality variant
         the streaming cold hook dispatches unless the quality mode is
         pinned "sinkhorn" (recorded as ``("sharded_linear", D, P, C,
-        s)`` / ``("sharded", D, P, C, s)`` rows accordingly).  The
-        stream-sharded MEGABATCH variants warm through
+        s)`` / ``("sharded", D, P, C, s)`` rows accordingly).  Shapes
+        the manager elects for the P backend ALSO warm the P-sharded
+        RESIDENT placement variants (the fused warm executables
+        recompile for sharded inputs; ``("sharded_resident", D, P, C,
+        s)`` rows).  The stream-sharded — and, on the 2-D rung, the
+        cross-axis ("streams", "p") — MEGABATCH variants warm through
         the ``coalesce`` jobs automatically while the manager is the
         process-active one (the warm-up waves lock onto the sharded
         placement exactly like production waves).  None skips.
@@ -314,6 +318,47 @@ def warmup(
                         mesh_manager.size,
                         sharded_job,
                     )
+                )
+            if (
+                "stream" in solvers
+                and mesh_manager is not None
+                and mesh_manager.active
+                and mesh_manager.should_shard_solve(P)
+            ):
+
+                def resident_job(lags1d=lags1d, C=C):
+                    # P-sharded RESIDENT placement (sharded/resident):
+                    # a fused warm executable's jit cache keys include
+                    # the input SHARDINGS, so the placed choice/lags
+                    # buffers are a separate compile from the
+                    # single-device twins stream_job warmed.  Drive
+                    # cold + dense warm + delta epochs with the active
+                    # manager as the engine's backend — exactly the
+                    # placement the production adopt hook applies —
+                    # so every sharded-input variant compiles here.
+                    from .ops.streaming import StreamingAssignor
+
+                    eng = StreamingAssignor(
+                        num_consumers=C,
+                        refine_iters=stream_refine_iters,
+                        refine_threshold=None,
+                        delta_enabled=delta_buckets > 0,
+                        delta_max_fraction=1.0,
+                        delta_buckets=max(delta_buckets, 1),
+                        mesh_backend=mesh_manager,
+                    )
+                    cur = lags1d.copy()
+                    eng.rebalance(cur)
+                    cur = cur + 1  # dense warm epoch, placed resident
+                    out = eng.rebalance(cur)
+                    if delta_buckets > 0:
+                        nxt = cur.copy()
+                        nxt[:8] = nxt[:8] + 1 + (np.arange(8) % 7)
+                        out = eng.rebalance(nxt)
+                    return out
+
+                jobs.append(
+                    ("sharded_resident", mesh_manager.size, resident_job)
                 )
             if "stream" in solvers and delta_buckets > 0:
                 from .ops.streaming import delta_k_ladder

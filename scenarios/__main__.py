@@ -10,11 +10,19 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from pathlib import Path
 from typing import List, Optional
 
-from .corpus import CORPUS, run_fleet
+# The cross-axis mesh scenarios need the 8-device virtual CPU mesh
+# (tests/conftest.py sets the same flag for pytest); must land before
+# anything imports jax, and never clobbers an explicit operator choice.
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+)
+
+from .corpus import CORPUS, run_fleet  # noqa: E402
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -176,6 +176,23 @@ def peer_partition(epochs: Sequence[int]) -> FaultPlane:
     ))
 
 
+def mesh_collective(
+    epochs: Sequence[int], per_epoch: int = 1
+) -> FaultPlane:
+    """A sharded dispatch loses a collective (the ``mesh.collective``
+    point fires at every sharded entry: the P-sharded solve, the
+    resident placement, the locked 2-D megabatch flush).  Each firing
+    steps the mesh manager exactly ONE rung down the documented ladder
+    (2-D -> streams -> p -> single); the faulted request itself
+    resolves through the single-device fallback inside its own budget
+    — never an invalid assignment."""
+    return FaultPlane("mesh_collective", (
+        FaultEvent(
+            "mesh.collective", tuple(epochs), per_epoch=per_epoch,
+        ),
+    ))
+
+
 def shed_flake(epochs: Sequence[int], per_epoch: int = 1) -> FaultPlane:
     """The overload controller's admission decision itself faults —
     the service must FAIL OPEN (admit) rather than shed on an error."""

@@ -44,6 +44,11 @@ class Scenario:
     fast: bool = True
     tune: Optional[Callable] = None
     epoch_sleep_s: float = 0.0
+    # Wire-level ``options`` object sent with EVERY stream_assign of
+    # the replay (e.g. ``{"refine_threshold": None}`` to force a warm
+    # dispatch every epoch — scenarios that need deterministic wave
+    # membership in the coalescer use this).
+    request_options: Optional[Dict[str, Any]] = None
     # Federated scenarios replay through the two-sidecar engine
     # (scenarios/federated.py) and gate the federation ladder instead
     # of the stream envelope.
@@ -189,6 +194,43 @@ CORPUS: Tuple[Scenario, ...] = (
         ),
     ),
     Scenario(
+        name="large_tenant_2d",
+        trace="zipf_tenants", seed=1113,
+        trace_knobs={"tenants": 8, "epochs": 8},
+        planes=(
+            compose.mesh_collective(epochs=(4, 6)),
+        ),
+        service_kwargs={
+            "mesh_devices": "auto",
+            "mesh_shape": "2x4",
+            "mesh_solve_min_rows": 128,
+            # Wide enough that all 8 tenants of one epoch ride ONE
+            # coalesced wave (the wave locks after 1 round and every
+            # later epoch hits the locked sharded dispatch boundary —
+            # where the injected collective faults are consumed).
+            "coalesce_window_ms": 50.0,
+            "coalesce_max_batch": 8,
+            "coalesce_lock_waves": 1,
+        },
+        parallel=True,
+        # Refine every epoch: stable 8-row wave membership keeps the
+        # coalescer's roster locked, so the fault epochs land on the
+        # locked sharded dispatch boundary deterministically.
+        request_options={"refine_threshold": None},
+        envelope=Envelope(
+            max_rung="host_snake", max_steady_compiles=None,
+            require_mesh_ladder=True, min_mesh_degrades=2,
+        ),
+        summary=(
+            "zipf tenant mix on the 2-D ('streams','p') mesh — the "
+            "dominant tenant's rows are P-sharded, the locked "
+            "megabatch spreads over the full grid, and injected "
+            "mesh.collective faults must walk the documented ladder "
+            "(2d -> streams -> p) one rung at a time, never serving "
+            "an invalid assignment"
+        ),
+    ),
+    Scenario(
         name="flapping_roster",
         trace="flapping_consumers", seed=1109,
         fast=False,
@@ -255,6 +297,7 @@ def run_scenario(
         parallel=sc.parallel,
         tune=sc.tune,
         epoch_sleep_s=sc.epoch_sleep_s,
+        request_options=sc.request_options,
     )
     if sc.envelope.require_bit_exact_recovery:
         twin = replay(
@@ -294,6 +337,7 @@ def run_scenario(
         "quarantines": result.quarantines,
         "corruptions_planted": result.corruptions_planted,
         "faults": result.faults_snapshot,
+        "mesh_degrades": result.mesh_degrades,
         "restarted_at": result.restarted_at,
         "recovery": result.recovery,
         "twin_mismatches": result.twin_mismatches,

@@ -33,6 +33,20 @@ RUNG_ORDER = {
     "host_snake": 3,
 }
 
+#: The MESH manager's documented degrade steps (sharded/mesh LADDER):
+#: a ``mesh.collective`` fault walks a 2-D config
+#: 2d -> streams -> p -> single one rung at a time; 1-D configs keep
+#: the historical one-step drop.  Envelopes gate every observed
+#: ``klba_mesh_degrade_total{from,to}`` transition against this set —
+#: a skipped rung (2d -> single) or a re-armed jump (p -> 2d) is a
+#: ladder violation even when every request was still served validly.
+MESH_LADDER_STEPS = frozenset({
+    ("2d", "streams"),
+    ("streams", "p"),
+    ("p", "single"),
+    ("1d", "single"),
+})
+
 
 @dataclass(frozen=True)
 class Envelope:
@@ -69,6 +83,14 @@ class Envelope:
     # keeps everything fails the envelope too).
     require_anomaly_traces: bool = True
     healthy_trace_slack: int = 8
+    # Cross-axis mesh drills: every mesh degrade transition observed
+    # during the replay must be a documented one-rung ladder step
+    # (:data:`MESH_LADDER_STEPS`), and at least ``min_mesh_degrades``
+    # transitions must have been exercised (a fleet that silently
+    # never entered a sharded dispatch would otherwise pass the
+    # ladder gate vacuously).
+    require_mesh_ladder: bool = False
+    min_mesh_degrades: int = 0
 
 
 def evaluate(result, envelope: Envelope) -> List[str]:
@@ -188,6 +210,23 @@ def evaluate(result, envelope: Envelope) -> List[str]:
             v.append(
                 f"{result.twin_mismatches} epoch(s) diverged from the "
                 "unfaulted twin after recovery"
+            )
+
+    if envelope.require_mesh_ladder:
+        degrades = getattr(result, "mesh_degrades", {}) or {}
+        total = 0
+        for key, count in sorted(degrades.items()):
+            frm, _, to = key.partition("->")
+            total += int(count)
+            if (frm, to) not in MESH_LADDER_STEPS:
+                v.append(
+                    f"mesh degrade {frm!r} -> {to!r} (x{count}) is not "
+                    "a documented one-rung ladder step"
+                )
+        if total < envelope.min_mesh_degrades:
+            v.append(
+                f"mesh ladder exercised {total} degrade(s) < "
+                f"{envelope.min_mesh_degrades} required"
             )
 
     if envelope.require_anomaly_traces:

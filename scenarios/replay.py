@@ -98,6 +98,11 @@ class ReplayResult:
     twin_mismatches: Optional[int] = None
     trace_stats: Dict[str, Any] = field(default_factory=dict)
     kept_trace_ids: List[str] = field(default_factory=list)
+    #: ``"from->to" -> count`` deltas of the mesh manager's
+    #: ``klba_mesh_degrade_total`` transitions during this replay —
+    #: what the cross-axis envelopes gate against the documented
+    #: ladder order.
+    mesh_degrades: Dict[str, int] = field(default_factory=dict)
 
     def choices(self) -> Dict[Tuple[int, str], bytes]:
         """(epoch, stream) -> choice bytes, for twin comparison."""
@@ -109,6 +114,15 @@ class ReplayResult:
 
 def _counter_sum(name: str) -> float:
     return sum(c.value for c in metrics.REGISTRY.series(name))
+
+
+def _mesh_degrade_totals() -> Dict[str, float]:
+    """``"from->to" -> value`` for every mesh degrade-transition
+    series currently in the registry."""
+    return {
+        f"{c.labels.get('from')}->{c.labels.get('to')}": c.value
+        for c in metrics.REGISTRY.series("klba_mesh_degrade_total")
+    }
 
 
 def _quarantine_total() -> float:
@@ -138,6 +152,7 @@ def replay(
     tune: Optional[Callable[[AssignorService], None]] = None,
     epoch_sleep_s: float = 0.0,
     trace_sample_rate: float = 0.125,
+    request_options: Optional[Dict[str, Any]] = None,
 ) -> ReplayResult:
     """Run one trace against a fresh sidecar; see the module docstring.
 
@@ -178,6 +193,7 @@ def replay(
     )
     shed_before = shed_totals_by_class()
     quarantine_before = _quarantine_total()
+    mesh_before = _mesh_degrade_totals()
     # The sidecar runs in-process, so the global trace collector sees
     # this replay's traces; pin the healthy sample rate, widen the ring
     # past any plausible scenario volume (retention must be judged on
@@ -227,6 +243,11 @@ def replay(
             "lags": [[i, v] for i, v in enumerate(se.lags)],
             "slo_class": se.slo_class,
         }
+        if request_options is not None:
+            # Scenario-pinned wire options on every request (e.g.
+            # ``refine_threshold: null`` forces a warm dispatch every
+            # epoch for deterministic coalescer wave membership).
+            params["options"] = dict(request_options)
         cl = client_for(se.stream_id)
         t0 = time.perf_counter()
         try:
@@ -333,6 +354,11 @@ def replay(
         if v - shed_before.get(k, 0) > 0
     }
     result.quarantines = int(_quarantine_total() - quarantine_before)
+    result.mesh_degrades = {
+        k: int(v - mesh_before.get(k, 0))
+        for k, v in _mesh_degrade_totals().items()
+        if v - mesh_before.get(k, 0) > 0
+    }
     return result
 
 

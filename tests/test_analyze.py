@@ -270,6 +270,97 @@ def test_a001_sibling_branch_not_after():
     assert codes_of(rep, "A001") == []
 
 
+A001_ALIAS = """\
+import functools
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(1,))
+def _warm_step(lags, choice, iters: int):
+    return choice
+
+
+def epoch(lags, choice):
+    snapshot = choice
+    out = _warm_step(lags, choice, iters=2)
+    return snapshot.sum(), out
+"""
+
+
+def test_a001_alias_read_after_donation():
+    """A donated buffer reachable through a SECOND name binding is
+    just as corrupt after the dispatch — the alias read is flagged at
+    its own line, naming both bindings."""
+    rep = run_snippet(STREAMING, A001_ALIAS)
+    found = codes_of(rep, "A001")
+    assert len(found) == 1
+    assert found[0].line == 13  # the alias read, not the dispatch
+    assert "`choice`" in found[0].message
+    assert "alias `snapshot`" in found[0].message
+
+
+def test_a001_alias_transitive_and_subscript():
+    """Aliases chain (``a = buf; b = a``) and a ``resident[i]``
+    donation is reachable through a name bound to the container."""
+    src = """\
+    import functools
+    import jax
+
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def _locked(lags, choice, iters: int):
+        return choice
+
+
+    def epoch(lags, resident):
+        held = resident
+        kept = held
+        out = _locked(lags, resident[0], iters=2)
+        return kept, out
+    """
+    rep = run_snippet(COALESCE, src)
+    found = codes_of(rep, "A001")
+    assert len(found) == 1
+    assert found[0].line == 14
+    assert "alias `kept`" in found[0].message
+
+
+def test_a001_alias_negative_rebound_or_unrelated():
+    """A name that aliased the buffer but was rebound BEFORE the
+    dispatch no longer reaches the donated storage, and a binding to
+    a different buffer never did."""
+    src = A001_ALIAS.replace(
+        "    snapshot = choice\n",
+        "    snapshot = choice\n"
+        "    snapshot = lags\n"
+        "    unrelated = lags\n",
+    )
+    rep = run_snippet(STREAMING, src)
+    assert codes_of(rep, "A001") == []
+
+
+def test_a001_alias_negative_killed_after_dispatch():
+    """An alias rebound after the dispatch but before any read is
+    dead — no path reads the donated storage through it."""
+    src = A001_ALIAS.replace(
+        "    return snapshot.sum(), out\n",
+        "    snapshot = out\n    return snapshot.sum(), out\n",
+    )
+    rep = run_snippet(STREAMING, src)
+    assert codes_of(rep, "A001") == []
+
+
+def test_a001_alias_waived():
+    src = A001_ALIAS.replace(
+        "    return snapshot.sum(), out",
+        "    return snapshot.sum(), out  "
+        "# noqa: A001 — scrubber comparison read",
+    )
+    rep = run_snippet(STREAMING, src)
+    assert codes_of(rep, "A001") == []
+    assert codes_of(rep, "W001") == []  # the waiver is USED
+
+
 # --- A002 lock discipline -------------------------------------------------
 
 A002_BREAKER = """\

@@ -1,0 +1,414 @@
+"""2-D ("streams", "p") mesh bit-parity suite (cross-axis mesh
+composition): the SAME seeded inputs driven through the single-device
+control, the (2, 4), and the (4, 2) compositions must produce
+bit-identical assignments everywhere the design promises parity — the
+P-sharded linear cold solve and its distributed rounding tail, the
+inline warm-refine and delta epochs over P-sharded resident buffers,
+and every locked-megabatch wave (dense, delta, churn re-stack).  The
+placements move bytes, never values.  Quarantine/heal under both 2-D
+shapes rides along (detection order is thread-timing dependent, so that
+leg asserts per-shape recovery rather than cross-shape equality).  All
+on the virtual 8-device CPU mesh tests/conftest.py forces."""
+
+import contextlib
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from kafka_lag_based_assignor_tpu.ops.coalesce import MegabatchCoalescer
+from kafka_lag_based_assignor_tpu.ops.dispatch import quality_scope
+from kafka_lag_based_assignor_tpu.ops.linear_ot import assign_topic_linear
+from kafka_lag_based_assignor_tpu.ops.streaming import (
+    StreamingAssignor,
+    delta_k_ladder,
+)
+from kafka_lag_based_assignor_tpu.sharded import mesh as mesh_mod
+from kafka_lag_based_assignor_tpu.sharded import solve as ssolve
+from kafka_lag_based_assignor_tpu.utils import faults
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8,
+    reason="virtual 8-device CPU mesh unavailable",
+)
+
+# The two 2-D factorizations of the 8-device mesh; ``None`` is the
+# single-device control everywhere below.
+SHAPES_2D = ("2x4", "4x2")
+
+N_STREAMS = 8
+P, C = 512, 8
+
+
+@pytest.fixture(autouse=True)
+def _no_global_manager():
+    """No leftover active manager or fault plan (other suites must
+    keep their single-device behavior)."""
+    faults.deactivate()
+    mesh_mod.deactivate()
+    yield
+    faults.deactivate()
+    mesh_mod.deactivate()
+
+
+def _manager(shape, solve_min_rows=1 << 20):
+    kw = dict(devices="auto", solve_min_rows=solve_min_rows)
+    if shape is not None:
+        kw["shape"] = shape
+    return mesh_mod.MeshManager(**kw).configure()
+
+
+def _managed(mgr):
+    return (
+        mesh_mod.managed(mgr) if mgr is not None
+        else contextlib.nullcontext()
+    )
+
+
+def _skewed(rng, n):
+    """Zipf-flavored lag vector: a low floor with heavy spikes, so the
+    solves face real imbalance (ties AND outliers) rather than uniform
+    noise."""
+    lags = rng.integers(0, 50, n).astype(np.int64)
+    spikes = rng.choice(n, n // 16, replace=False)
+    lags[spikes] += rng.integers(10**6, 10**9, spikes.shape[0])
+    return lags
+
+
+def _assert_valid(choice, n, c):
+    assert choice.shape == (n,)
+    assert choice.min() >= 0 and choice.max() < c
+    counts = np.bincount(choice, minlength=c)
+    assert counts.max() - counts.min() <= 1
+
+
+def _locked_batch(coal):
+    with coal._roster_lock:
+        batches = [
+            r.batch for r in coal._rosters.values() if r.batch is not None
+        ]
+    assert len(batches) == 1
+    return batches[0]
+
+
+def _axes_2d(batch):
+    """The locked batch's mesh axis sizes — proof the wave genuinely ran
+    on the 2-D composition, not a silently degraded 1-D placement."""
+    assert batch.mesh is not None
+    axes = dict(batch.mesh.shape)
+    assert axes[mesh_mod.STREAMS_AXIS] > 1
+    assert axes[mesh_mod.SOLVE_AXIS] > 1
+    return axes
+
+
+# -- cold solve + P-sharded rounding tail -----------------------------------
+
+
+class TestColdSolveParity:
+    def test_linear_tail_bit_parity_across_mesh_shapes(self):
+        """The P-sharded linear solve — including the distributed
+        rounding tail, which engages above the scan ceiling — is
+        bit-identical to the single-device linear solve under (2, 4),
+        (4, 2), AND the 1-D p mesh."""
+        P_big, C_big = 6000, 16
+        rng = np.random.default_rng(0x2D01)
+        lags = _skewed(rng, P_big)
+        pids = np.arange(P_big, dtype=np.int32)
+        valid = np.ones(P_big, dtype=bool)
+        want, _, _ = assign_topic_linear(
+            lags, pids, valid, num_consumers=C_big, refine_iters=64
+        )
+        want = np.asarray(want)
+        for shape in (*SHAPES_2D, None):
+            mgr = _manager(shape, solve_min_rows=1024)
+            choice, _, _, _ = ssolve.solve_linear_sharded(
+                mgr.solve_mesh(), lags, C_big, refine_iters=64
+            )
+            np.testing.assert_array_equal(
+                np.asarray(choice), want, err_msg=f"shape={shape}"
+            )
+
+    def test_engine_cold_parity_quality_linear(self):
+        """Engine-level cold rebalance with quality mode pinned
+        "linear": the control serves through the single-device linear
+        solve, the mesh configs through the P-sharded one — every
+        config must agree bit for bit."""
+        P_big, C_big = 6000, 16
+        rng = np.random.default_rng(0x2D02)
+        lag_sets = [_skewed(rng, P_big) for _ in range(2)]
+        outs = {}
+        with quality_scope("linear"):
+            for shape in (None, *SHAPES_2D):
+                mgr = (
+                    _manager(shape, solve_min_rows=1024)
+                    if shape is not None else None
+                )
+                with _managed(mgr):
+                    per = []
+                    for lags in lag_sets:
+                        eng = StreamingAssignor(
+                            num_consumers=C_big, cold_refine_iters=64
+                        )
+                        per.append(np.asarray(eng.rebalance(lags.copy())))
+                        if shape is not None:
+                            assert eng.last_stats.sharded_solve
+                    outs[shape] = per
+        for shape in SHAPES_2D:
+            for want, got in zip(outs[None], outs[shape]):
+                np.testing.assert_array_equal(
+                    got, want, err_msg=f"shape={shape}"
+                )
+                _assert_valid(got, P_big, C_big)
+
+
+# -- inline warm refine + delta epochs over P-sharded residents -------------
+
+
+class TestInlineWarmParity:
+    def test_warm_and_delta_epochs_parity_resident_sharded(self):
+        """One engine driven through the same epoch script — cold, dense
+        warm refines, small-delta epochs — under no mesh, (2, 4), and
+        (4, 2) with the resident buffers P-sharded (row floor below P):
+        every epoch's served choice is bit-identical.  Quality mode is
+        pinned "linear" so the cold solves agree across the
+        single-device and sharded backends."""
+        rng = np.random.default_rng(0x2D03)
+        cold = _skewed(rng, P)
+        epochs = []
+        cur = cold
+        for k in range(6):
+            nxt = cur.copy()
+            if k % 2 == 0:
+                nxt = _skewed(rng, P)  # dense drift epoch
+            else:
+                idx = rng.choice(P, 8, replace=False)
+                nxt[idx] = nxt[idx] + rng.integers(1, 1000, 8)
+            epochs.append(nxt)
+            cur = nxt
+        outs = {}
+        with quality_scope("linear"):
+            for shape in (None, *SHAPES_2D):
+                mgr = (
+                    _manager(shape, solve_min_rows=256)
+                    if shape is not None else None
+                )
+                with _managed(mgr):
+                    eng = StreamingAssignor(
+                        num_consumers=C,
+                        refine_iters=64,
+                        refine_threshold=None,
+                        cold_refine_iters=64,
+                        delta_max_fraction=1.0,
+                        delta_buckets=2,
+                    )
+                    per = [np.asarray(eng.rebalance(cold.copy()))]
+                    for arr in epochs:
+                        per.append(np.asarray(eng.rebalance(arr.copy())))
+                    outs[shape] = per
+        for shape in SHAPES_2D:
+            for k, (want, got) in enumerate(zip(outs[None], outs[shape])):
+                np.testing.assert_array_equal(
+                    got, want, err_msg=f"shape={shape} epoch={k}"
+                )
+                _assert_valid(got, P, C)
+
+
+# -- locked megabatch waves -------------------------------------------------
+
+
+def _wave_script(seed, waves=6):
+    """Deterministic megabatch wave script: per-stream cold vectors plus
+    ``waves`` epochs mixing dense drift and 8-row delta perturbations.
+    Generated ONCE per test so every placement replays identical
+    bytes."""
+    rng = np.random.default_rng(seed)
+    cold = [
+        rng.integers(0, 1000, P).astype(np.int64)
+        for _ in range(N_STREAMS)
+    ]
+    script = []
+    prev = cold
+    for w in range(waves):
+        if w in (2, 4):  # delta waves: small perturbation of the last
+            arrs = []
+            for a in prev:
+                nxt = a.copy()
+                nxt[:8] = nxt[:8] + 1 + (np.arange(8) % 7)
+                arrs.append(nxt)
+        else:
+            arrs = [
+                rng.integers(0, 1000, P).astype(np.int64)
+                for _ in range(N_STREAMS)
+            ]
+        script.append(arrs)
+        prev = arrs
+    return cold, script
+
+
+def _wave(engines, coal, arrs):
+    outs = [None] * len(engines)
+    errs = []
+
+    def run(i):
+        try:
+            outs[i] = engines[i].submit_epoch(arrs[i], coal)
+        except Exception as exc:  # noqa: BLE001 — asserted by callers
+            errs.append((i, exc))
+
+    threads = [
+        threading.Thread(target=run, args=(i,))
+        for i in range(len(engines))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return outs, errs
+
+
+class TestMegabatchWaveParity:
+    def test_locked_waves_parity_with_churn(self):
+        """The full wave script — re-stack+lock, dense, delta, a
+        seed_choice churn (roster invalidation + re-stack + re-lock),
+        more dense and delta — replayed under the single-device
+        control and both 2-D placements: EVERY wave of EVERY stream is
+        bit-identical, and both 2-D runs end locked on a genuinely 2-D
+        mesh.  Cold solves stay single-device under the 1<<20 row
+        floor, so the runs differ only in placement."""
+        cold, script = _wave_script(0x2D04)
+        churn_wave = 3  # a dense wave right after the first delta wave
+
+        def run_config(shape):
+            mgr = _manager(shape) if shape is not None else None
+            with _managed(mgr):
+                engines = [
+                    StreamingAssignor(
+                        num_consumers=C,
+                        refine_iters=64,
+                        refine_threshold=None,
+                        delta_max_fraction=1.0,
+                        delta_buckets=2,
+                    )
+                    for _ in range(N_STREAMS)
+                ]
+                for e, a in zip(engines, cold):
+                    e.rebalance(a.copy())
+                coal = MegabatchCoalescer(
+                    window_s=2.0,
+                    max_batch=N_STREAMS,
+                    lock_waves=1,
+                    delta_k=delta_k_ladder(2)[-1],
+                    mesh_manager=mgr,
+                )
+                wave_outs = []
+                try:
+                    for w, arrs in enumerate(script):
+                        if w == churn_wave:
+                            engines[0].seed_choice(
+                                np.asarray(
+                                    engines[0]._prev_choice,
+                                    dtype=np.int32,
+                                )
+                            )
+                        outs, errs = _wave(engines, coal, arrs)
+                        assert not errs, errs
+                        wave_outs.append([np.asarray(o) for o in outs])
+                    batch = _locked_batch(coal)
+                    axes = _axes_2d(batch) if shape is not None else None
+                    # The churn wave forced at least one invalidation +
+                    # re-stack (exact counts are pipeline-timing
+                    # dependent; test_sharded pins them down in a
+                    # churn-only script).
+                    assert coal.stats()["roster_invalidations"] >= 1
+                finally:
+                    coal.close()
+            return wave_outs, axes
+
+        base, _ = run_config(None)
+        for shape in SHAPES_2D:
+            outs, axes = run_config(shape)
+            s, d = (int(x) for x in shape.split("x"))
+            assert axes == {
+                mesh_mod.STREAMS_AXIS: s, mesh_mod.SOLVE_AXIS: d,
+            }
+            for w in range(len(script)):
+                for i in range(N_STREAMS):
+                    np.testing.assert_array_equal(
+                        outs[w][i],
+                        base[w][i],
+                        err_msg=f"shape={shape} wave={w} stream={i}",
+                    )
+                    _assert_valid(outs[w][i], P, C)
+
+
+# -- quarantine / heal under the 2-D composition ----------------------------
+
+
+class TestQuarantineHeal2D:
+    @pytest.mark.parametrize("shape", SHAPES_2D)
+    def test_corrupt_locked_row_quarantines_and_heals(self, shape):
+        """device.corrupt.choice on a 2-D-placed locked row: the next
+        wave's per-row digest detects the flip, the poisoned stream(s)
+        fail with CorruptStateDetected while the rest serve valid
+        answers, and the healed re-stack re-locks on the SAME 2-D
+        placement (corruption recovery must not cost the mesh)."""
+        from kafka_lag_based_assignor_tpu.utils.scrub import (
+            CorruptStateDetected,
+        )
+
+        rng = np.random.default_rng(0x2D05)
+        mgr = _manager(shape)
+        with mesh_mod.managed(mgr):
+            engines = [
+                StreamingAssignor(
+                    num_consumers=C,
+                    refine_iters=64,
+                    refine_threshold=None,
+                )
+                for _ in range(N_STREAMS)
+            ]
+            for e in engines:
+                e.rebalance(rng.integers(0, 1000, P).astype(np.int64))
+            coal = MegabatchCoalescer(
+                window_s=2.0, max_batch=N_STREAMS, lock_waves=1,
+                mesh_manager=mgr,
+            )
+
+            def fresh():
+                return [
+                    rng.integers(0, 1000, P).astype(np.int64)
+                    for _ in range(N_STREAMS)
+                ]
+
+            try:
+                _wave(engines, coal, fresh())
+                _axes_2d(_locked_batch(coal))
+                inj = faults.FaultInjector(11).plan(
+                    "device.corrupt.choice", times=1
+                )
+                with faults.injected(inj):
+                    # Wave A adopts successors then corrupts one row at
+                    # the readback boundary; wave B's input-side digest
+                    # catches the flip.
+                    outs, errs = _wave(engines, coal, fresh())
+                    assert not errs
+                    outs, errs = _wave(engines, coal, fresh())
+                assert inj.fired("device.corrupt.choice") == 1
+                assert len(errs) in (1, 2)
+                for _, exc in errs:
+                    assert isinstance(exc, CorruptStateDetected)
+                for o in outs:
+                    if o is not None:
+                        _assert_valid(np.asarray(o), P, C)
+                # Quarantined engines heal on the next wave (rebuilt
+                # from host truth) and the roster re-locks 2-D.
+                outs, errs = _wave(engines, coal, fresh())
+                assert not errs
+                for o in outs:
+                    _assert_valid(np.asarray(o), P, C)
+                _wave(engines, coal, fresh())
+                _axes_2d(_locked_batch(coal))
+            finally:
+                coal.close()

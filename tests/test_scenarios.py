@@ -244,6 +244,54 @@ def test_envelope_corruption_and_recovery_gates():
     assert evaluate(res, env) == []
 
 
+def test_envelope_mesh_ladder_gate():
+    """Cross-axis drills: every observed mesh degrade must be a
+    documented one-rung step, and the ladder must actually have been
+    exercised (no vacuous pass)."""
+    env = Envelope(
+        max_steady_compiles=None, require_mesh_ladder=True,
+        min_mesh_degrades=2,
+    )
+    res = _result(
+        [_rec()],
+        mesh_degrades={"2d->streams": 1, "streams->p": 1},
+    )
+    assert evaluate(res, env) == []
+    # A skipped rung is a violation even with everything served.
+    res.mesh_degrades = {"2d->single": 1, "streams->p": 1}
+    v = evaluate(res, env)
+    assert any("not a documented one-rung ladder step" in s for s in v)
+    # Too few transitions: the gate must not pass vacuously.
+    res.mesh_degrades = {"2d->streams": 1}
+    v = evaluate(res, env)
+    assert any("1 degrade(s) < 2 required" in s for s in v)
+    # 1-D configs keep the historical one-step drop.
+    res.mesh_degrades = {"1d->single": 1}
+    assert evaluate(
+        res,
+        Envelope(
+            max_steady_compiles=None, require_mesh_ladder=True,
+            min_mesh_degrades=1,
+        ),
+    ) == []
+
+
+def test_mesh_collective_plane_and_large_tenant_2d_entry():
+    """The cross-axis scenario composes the mesh.collective plane on
+    a 2-D shape with the locked-megabatch knobs, and its envelope
+    demands the documented ladder."""
+    plane = compose.mesh_collective(epochs=(4, 6))
+    assert [ev.point for ev in plane.events] == ["mesh.collective"]
+    assert plane.events[0].epochs == (4, 6)
+    sc = get_scenario("large_tenant_2d")
+    assert sc.planes and sc.planes[0].name == "mesh_collective"
+    assert sc.service_kwargs["mesh_shape"] == "2x4"
+    assert sc.service_kwargs["coalesce_lock_waves"] == 1
+    assert sc.envelope.require_mesh_ladder
+    assert sc.envelope.min_mesh_degrades >= 2
+    assert sc.envelope.max_invalid == 0  # never serves invalid
+
+
 def test_twin_mismatches_counts_missing_cells():
     a = _result([_rec(epoch=1, choice=np.zeros(4, np.int32))])
     b = _result([

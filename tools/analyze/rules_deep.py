@@ -5,7 +5,9 @@ gates and chaos soaks only catch at runtime, proven at review time.
         ``donate_argnames`` position of a jitted dispatch is INVALID
         after the dispatch (XLA reuses its buffer).  Any read of that
         binding on a path after the dispatch — including the next
-        iteration of an enclosing warm loop — is the silent-corruption
+        iteration of an enclosing warm loop, and including reads
+        through a SECOND name bound to the same buffer before the
+        dispatch (``snapshot = choice``) — is the silent-corruption
         class the resident-state scrubber only detects after the fact.
   A002  lock-order / held-lock discipline: builds the project-wide
         lock-acquisition graph (``with <lock>:`` nesting plus one level
@@ -43,9 +45,11 @@ finalize over the merged set, so a donor defined in ops/streaming.py is
 matched at its coalescer call sites.  Waivable with ``# noqa: A00x``
 stating a reason.  Known limits (deliberate — reviewer aid, not a
 verifier): bindings are tracked syntactically at the dispatch site
-(aliases of the same buffer through other names are not followed), a
-kill inside one branch of a conditional counts for all paths, and lock
-identity is name-based (per-instance locks of one class share a node).
+(plain-name aliases — ``alias = buf`` still standing at the dispatch
+line — are followed to a fixpoint; aliases smuggled through containers,
+calls, or attributes of OTHER bases are not), a kill inside one branch
+of a conditional counts for all paths, and lock identity is name-based
+(per-instance locks of one class share a node).
 """
 
 from __future__ import annotations
@@ -267,37 +271,84 @@ def _track_key(expr: ast.AST) -> Optional[tuple]:
     return None
 
 
+def _alias_keys(
+    fn: ast.AST, key: tuple, call_line: int
+) -> List[tuple]:
+    """``key`` plus every plain name whose binding still standing at
+    the dispatch line reads the same buffer (``alias = buf``,
+    ``alias = resident[i]``, ``alias = base.attr`` — transitively, to
+    a fixpoint).  A donated buffer stays reachable through every such
+    second binding, so the use-after-donation scan must follow all of
+    them; a name rebound to something else before the dispatch no
+    longer aliases it."""
+    last_rhs: Dict[str, ast.AST] = {}
+    best_line: Dict[str, int] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        if node.lineno >= call_line:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name) and node.lineno > best_line.get(
+                t.id, -1
+            ):
+                best_line[t.id] = node.lineno
+                last_rhs[t.id] = value
+    keys = [key]
+    resolved = {key}
+    changed = True
+    while changed:
+        changed = False
+        for name, rhs in sorted(last_rhs.items()):
+            nk = ("n", name)
+            if nk in resolved:
+                continue
+            rk = _track_key(rhs)
+            if rk is not None and rk in resolved:
+                resolved.add(nk)
+                keys.append(nk)
+                changed = True
+    return keys
+
+
 def _scan_events(
-    events: List[Tuple[str, tuple, int]], key: tuple
-) -> Tuple[Optional[str], Optional[int]]:
-    """First decisive event for ``key``: ("use", line), ("killed",
-    None), or (None, None) when the binding is never touched."""
+    events: List[Tuple[str, tuple, int]], live: set
+) -> Tuple[Optional[int], Optional[tuple]]:
+    """First read of any binding still in ``live``; mutates ``live``,
+    discarding bindings as stores/killbases rebind them (an attr
+    binding also dies when its base name is rebound).  Returns
+    ``(line, key)`` of the first live read, or ``(None, None)``."""
     for kind, k, line in events:
-        if kind == "store":
-            if k == key:
-                return "killed", None
-            if key[0] == "a" and k == ("n", key[1]):
-                return "killed", None
-        elif kind == "killbase":
-            if k == key:
-                return "killed", None
-            if key[0] == "a" and k == ("n", key[1]):
-                return "killed", None
-        elif kind == "load" and k == key:
-            return "use", line
+        if not live:
+            break
+        if kind in ("store", "killbase"):
+            live.discard(k)
+            if k[0] == "n":
+                for ak in [
+                    x for x in live if x[0] == "a" and x[1] == k[1]
+                ]:
+                    live.discard(ak)
+        elif kind == "load" and k in live:
+            return line, k
     return None, None
 
 
 def _use_after_call(
-    fn_body: List[ast.stmt], call: ast.Call, key: tuple
-) -> Optional[int]:
-    """Line of the first read of ``key`` after the statement containing
-    ``call`` (before any rebind), following the tail of every enclosing
-    block and the back edge of the innermost enclosing loop; None when
-    the binding is rebound first or never read again."""
+    fn_body: List[ast.stmt], call: ast.Call, keys: List[tuple]
+) -> Tuple[Optional[int], Optional[tuple]]:
+    """``(line, key)`` of the first read of any of ``keys`` (the
+    donated binding plus its aliases) after the statement containing
+    ``call`` and before that binding's rebind, following the tail of
+    every enclosing block and the back edge of the innermost enclosing
+    loop; ``(None, None)`` when every binding is rebound first or
+    never read again."""
     chain = _find_chain(fn_body, call)
     if chain is None:
-        return None
+        return None, None
     events: List[Tuple[str, tuple, int]] = []
     block, idx = chain[-1]
     stmt = block[idx]
@@ -310,9 +361,12 @@ def _use_after_call(
     for blk, i in reversed(chain):
         for later in blk[i + 1:]:
             _emit_events(later, events)
-    verdict, line = _scan_events(events, key)
-    if verdict is not None:
-        return line
+    live = set(keys)
+    line, used = _scan_events(events, live)
+    if line is not None:
+        return line, used
+    if not live:
+        return None, None  # every binding rebound before any read
     # back edge: the innermost enclosing loop replays its body, so the
     # dispatch's own argument loads become next-iteration reads
     for blk, i in reversed(chain[:-1]):
@@ -321,9 +375,8 @@ def _use_after_call(
             loop_events: List[Tuple[str, tuple, int]] = []
             for body_stmt in s.body:
                 _emit_events(body_stmt, loop_events)
-            verdict, line = _scan_events(loop_events, key)
-            return line if verdict == "use" else None
-    return None
+            return _scan_events(loop_events, live)
+    return None, None
 
 
 # --- A003 raw-runtime detection -------------------------------------------
@@ -486,7 +539,15 @@ def _dispatch_scan(ctx: FileContext) -> Dict[str, Any]:
         }
         key = _track_key(expr)
         if key is not None and fn is not None:
-            fact["use"] = _use_after_call(fn.body, call, key)
+            keys = _alias_keys(fn, key, call.lineno)
+            use, used_key = _use_after_call(fn.body, call, keys)
+            fact["use"] = use
+            if (
+                used_key is not None
+                and used_key != key
+                and used_key[0] == "n"
+            ):
+                fact["via"] = used_key[1]
         else:
             fact["use"] = None
         fact["raw"] = _arg_is_raw(expr, fn, call.lineno)
@@ -567,16 +628,21 @@ def _finalize_a001(facts: Dict[str, Any]) -> Iterator[Finding]:
                 use = fact.get("use")
                 if use is None:
                     continue
+                via = fact.get("via")
+                reach = (
+                    f" through its alias `{via}`" if via else ""
+                )
                 yield Finding(
                     rel,
                     use,
                     "A001",
                     f"use after donation: `{fact['desc']}` was "
                     f"donated to {call['callee']}() (dispatch at "
-                    f"line {call['line']}) and is read afterwards — "
-                    "XLA reuses donated buffers, so this read sees "
-                    "corrupt data; rebind the dispatch result (or "
-                    "waive with `# noqa: A001`)",
+                    f"line {call['line']}) and is read "
+                    f"afterwards{reach} — XLA reuses donated "
+                    "buffers, so this read sees corrupt data; "
+                    "rebind the dispatch result (or waive with "
+                    "`# noqa: A001`)",
                 )
 
 

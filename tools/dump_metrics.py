@@ -312,6 +312,34 @@ def main() -> int:
                 f"(spec {mesh.get('spec')}, solve floor "
                 f"{mesh.get('solve_min_rows')} rows)"
             )
+            # Cross-axis composition view (DEPLOYMENT.md "Cross-axis
+            # mesh"): the active (streams, p) factorization and the
+            # degrade-ladder rung, plus any observed ladder
+            # transitions — the "is the fleet still on the 2-D
+            # placement, and if not, how did it come down" look.
+            shape = mesh.get("shape")
+            rung = mesh.get("rung")
+            if shape is not None or rung not in (None, "single"):
+                print(
+                    f"mesh composition: shape "
+                    f"{shape if shape is not None else '1-D'}, "
+                    f"rung {rung}"
+                )
+            degrades = {
+                (
+                    s["labels"].get("from", "?"),
+                    s["labels"].get("to", "?"),
+                ): s["value"]
+                for s in js.get(
+                    "klba_mesh_degrade_total", {}
+                ).get("series", [])
+            }
+            if degrades:
+                rows = ", ".join(
+                    f"{frm}->{to}={int(v)}"
+                    for (frm, to), v in sorted(degrades.items())
+                )
+                print(f"mesh ladder transitions: {rows}")
             sharded = by_label("klba_sharded_dispatch_total", "path")
             if sharded:
                 rows = ", ".join(
